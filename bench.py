@@ -1,0 +1,67 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on TPU.
+
+Mirrors the reference's measurement protocol: synthetic ImageNet data
+(`train_imagenet.py --benchmark 1`), batch 32 per device, fused training
+step (forward+backward+SGD update ≡ kvstore='device' + update_on_kvstore).
+Baseline anchor: 181.53 images/sec on 1×P100 (docs/how_to/perf.md:179-188,
+BASELINE.md) — the reference's own headline single-accelerator number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import DataParallelTrainer
+
+    n_dev = len(jax.devices())
+    per_device_batch = 32
+    batch = per_device_batch * n_dev
+    image_shape = (3, 224, 224)
+
+    net = mx.models.resnet(num_classes=1000, num_layers=50)
+    trainer = DataParallelTrainer(
+        net,
+        data_shapes={"data": (batch,) + image_shape},
+        label_shapes={"softmax_label": (batch,)},
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-4},
+        initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="in", magnitude=2),
+    )
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-1, 1, (batch,) + image_shape).astype("float32")
+    label = rng.randint(0, 1000, (batch,)).astype("float32")
+
+    # warmup (compile)
+    for _ in range(2):
+        outs = trainer.step(data, label)
+    jax.block_until_ready(outs)
+
+    iters = 20
+    tic = time.time()
+    for _ in range(iters):
+        outs = trainer.step(data, label)
+    jax.block_until_ready(outs)
+    toc = time.time()
+
+    images_per_sec = batch * iters / (toc - tic)
+    baseline = 181.53  # 1xP100 ResNet-50 b32 training (BASELINE.md)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / (baseline * n_dev), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

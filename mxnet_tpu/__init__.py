@@ -1,0 +1,54 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet-0.9.5
+capabilities (reference: aaronenyeshi/mxnet), rebuilt on JAX/XLA/Pallas.
+
+Public surface mirrors ``python/mxnet/__init__.py``: nd/ndarray, sym/symbol,
+Context helpers, io, module, optimizer, metric, initializer, kvstore, autograd,
+random, callback, lr_scheduler, profiler.
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
+    num_devices
+from . import engine
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd
+
+ndarray._init_ndarray_module()
+
+from . import symbol  # noqa: E402
+from . import symbol as sym  # noqa: E402
+from .symbol import Variable  # noqa: E402
+from . import executor  # noqa: E402
+from .attribute import AttrScope  # noqa: E402
+from .name import NameManager, Prefix  # noqa: E402
+from . import initializer  # noqa: E402
+from .initializer import init_registry  # noqa: E402
+from . import optimizer  # noqa: E402
+from .optimizer import Optimizer  # noqa: E402
+from . import lr_scheduler  # noqa: E402
+from . import metric  # noqa: E402
+from . import kvstore as kvs  # noqa: E402
+from .kvstore import KVStore, create as create_kvstore  # noqa: E402
+from . import io  # noqa: E402
+from . import module  # noqa: E402
+from .module import Module  # noqa: E402
+from . import model  # noqa: E402
+from .model import FeedForward  # noqa: E402
+from . import callback  # noqa: E402
+from . import monitor  # noqa: E402
+from .monitor import Monitor  # noqa: E402
+from . import profiler  # noqa: E402
+from . import rnn  # noqa: E402
+from . import visualization  # noqa: E402
+from . import visualization as viz  # noqa: E402
+from . import parallel  # noqa: E402
+from . import models  # noqa: E402
+from . import test_utils  # noqa: E402
+from . import contrib  # noqa: E402
+
+kvstore = kvs
+
+__version__ = "0.1.0"

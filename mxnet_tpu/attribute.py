@@ -1,0 +1,52 @@
+"""AttrScope: scoped symbol annotations.
+
+Reference: ``python/mxnet/attribute.py`` — carries ``ctx_group``,
+``lr_mult`` etc. onto symbols created inside a ``with mx.AttrScope(...)``
+block (used by model-parallel examples:
+``example/model-parallel-lstm/lstm.py:48-112``).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope"]
+
+
+class AttrScope:
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        self._attr = {"__%s__" % k if not k.startswith("__") else k: str(v)
+                      for k, v in kwargs.items()}
+
+    def get(self, attr):
+        """Merge user attrs with scope attrs (user wins)."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        self._old_scope = getattr(AttrScope._current, "value", None)
+        attr = {} if self._old_scope is None else \
+            dict(self._old_scope._attr)
+        attr.update(self._attr)
+        merged = AttrScope.__new__(AttrScope)
+        merged._attr = attr
+        merged._old_scope = None
+        AttrScope._current.value = merged
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        AttrScope._current.value = self._old_scope
+
+    @staticmethod
+    def current():
+        cur = getattr(AttrScope._current, "value", None)
+        if cur is None:
+            cur = AttrScope()
+            AttrScope._current.value = cur
+        return cur

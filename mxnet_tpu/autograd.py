@@ -1,0 +1,206 @@
+"""Imperative autograd tape.
+
+Reference: ``src/ndarray/autograd.cc`` (``AutogradRuntime``: thread-local
+``is_train_``, ``MarkVariables``, ``RecordOp`` building an AGNode DAG,
+``ComputeGradient`` replaying the tape through a throwaway GraphExecutor) and
+the python surface ``python/mxnet/contrib/autograd.py``.
+
+TPU-native design: each recorded imperative op stores the ``jax.vjp`` closure
+captured at call time — the tape IS the backward program, no symbol rebuild /
+executor bind needed.  Gradient flow is keyed on the identity of the immutable
+``jax.Array`` values, which is exactly the reference's versioned-variable
+discipline (a new version = a new value object).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["is_recording", "is_training", "set_recording", "set_training",
+           "record", "pause", "train_mode", "predict_mode", "train_section",
+           "test_section", "mark_variables", "backward", "get_grad",
+           "grad_and_loss", "grad"]
+
+_STATE = threading.local()
+
+
+def _state():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+        _STATE.tape = []
+        _STATE.marked = {}   # id(NDArray) -> (var_nd, grad_nd, grad_req)
+    return _STATE
+
+
+def is_recording():
+    return _state().recording
+
+
+def is_training():
+    return _state().training
+
+
+def set_recording(flag):
+    s = _state()
+    prev, s.recording = s.recording, bool(flag)
+    return prev
+
+
+def set_training(flag):
+    s = _state()
+    prev, s.training = s.training, bool(flag)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._rec, self._train = is_record, train_mode_
+        self._prev = None
+
+    def __enter__(self):
+        s = _state()
+        self._prev = (s.recording, s.training)
+        if self._rec is not None:
+            s.recording = self._rec
+        if self._train is not None:
+            s.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        s = _state()
+        s.recording, s.training = self._prev
+
+
+def record(train_mode_=True):
+    """Record imperative ops onto the tape (and set train mode)."""
+    return _RecordingStateScope(True, train_mode_)
+
+
+def pause(train_mode_=False):
+    return _RecordingStateScope(False, train_mode_)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# reference contrib.autograd naming
+train_section = record
+test_section = pause
+
+
+class _TapeNode:
+    __slots__ = ("op_name", "vjp", "in_arrs", "outs")
+
+    def __init__(self, op_name, vjp, in_arrs, outs):
+        self.op_name = op_name
+        self.vjp = vjp
+        # Keep strong refs to the input/output jax.Arrays: gradient flow is
+        # keyed on their identity, and holding them pins the ids so a freed
+        # buffer can never alias a later array (id-reuse) mid-backward.
+        self.in_arrs = tuple(in_arrs)
+        self.outs = tuple(outs)
+
+
+def record_op(op_name, vjp, in_arrs, outs):
+    """Called by imperative_invoke while recording."""
+    _state().tape.append(_TapeNode(op_name, vjp, in_arrs, outs))
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to variables (reference MarkVariables,
+    autograd.cc:54-68)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    s = _state()
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        s.marked[id(var)] = (var, g, req)
+
+
+def get_grad(var):
+    ent = _state().marked.get(id(var))
+    return ent[1] if ent is not None else None
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Replay the tape; accumulate grads into marked variables' buffers."""
+    from .ndarray import NDArray
+    s = _state()
+    grad_map = {}
+    if out_grads is None:
+        out_grads = [None] * len(outputs)
+    for y, gy in zip(outputs, out_grads):
+        g = (jnp.ones_like(y._data) if gy is None
+             else (gy._data if isinstance(gy, NDArray) else jnp.asarray(gy)))
+        _accum(grad_map, id(y._data), g)
+
+    for node in reversed(s.tape):
+        cots = [grad_map.get(id(o)) for o in node.outs]
+        if all(c is None for c in cots):
+            continue
+        cots = tuple(jnp.zeros_like(o) if c is None else c
+                     for c, o in zip(cots, node.outs))
+        in_grads = node.vjp(cots)
+        for arr, g in zip(node.in_arrs, in_grads):
+            if g is not None:
+                _accum(grad_map, id(arr), g)
+
+    for var, gbuf, req in s.marked.values():
+        g = grad_map.get(id(var._data))
+        if g is None:
+            continue
+        if req == "write":
+            gbuf._data = g
+        elif req == "add":
+            gbuf._data = gbuf._data + g
+        # 'null': skip
+    if not retain_graph:
+        s.tape.clear()
+
+
+def _accum(grad_map, key, g):
+    prev = grad_map.get(key)
+    grad_map[key] = g if prev is None else prev + g
+
+
+# ---------------------------------------------------------------------------
+# Functional decorators (reference python/mxnet/contrib/autograd.py)
+# ---------------------------------------------------------------------------
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient of ``func`` and its loss."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        from . import ndarray as nd
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else argnum
+            variables = [args[i] for i in argnums]
+        for x in variables:
+            if not isinstance(x, nd.NDArray):
+                raise MXNetError("grad_and_loss inputs must be NDArrays")
+        grads = [nd.zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        with record():
+            outputs = func(*args)
+        backward(outputs if isinstance(outputs, (list, tuple)) else [outputs])
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Gradient-only version of grad_and_loss."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+    return wrapped

@@ -1,0 +1,110 @@
+"""Core shared infrastructure: errors, env-var config registry, misc helpers.
+
+TPU-native rebuild of the roles played by the reference's ``python/mxnet/base.py``
+(ctypes loading, ``MXNetError``, ``check_call``) and its env-var config tier
+(``dmlc::GetEnv`` sites documented in ``docs/how_to/env_var.md``).  There is no C
+ABI to load here — the compute path is JAX/XLA — so this module keeps only the
+semantic surface: the error type, the typed environment-variable registry, and
+name/registry helpers used across the package.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "MXNetError",
+    "EnvVar",
+    "env_registry",
+    "register_env",
+    "get_env",
+    "string_types",
+    "numeric_types",
+]
+
+string_types = (str,)
+numeric_types = (int, float)
+
+
+class MXNetError(Exception):
+    """Framework error type (reference: ``python/mxnet/base.py`` MXNetError)."""
+
+
+# ---------------------------------------------------------------------------
+# Environment-variable config registry.
+#
+# The reference reads ~30 env vars ad-hoc via dmlc::GetEnv and documents them
+# centrally in docs/how_to/env_var.md.  We invert that: vars are *registered*
+# with a type, default and docstring, so `mxnet_tpu.base.env_registry` is the
+# central, queryable documentation.
+# ---------------------------------------------------------------------------
+class EnvVar:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name, type_, default, doc=""):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+
+    def get(self):
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        if self.type is bool:
+            return raw.lower() not in ("0", "false", "off", "")
+        try:
+            return self.type(raw)
+        except (TypeError, ValueError):
+            return self.default
+
+
+env_registry: dict = {}
+_env_lock = threading.Lock()
+
+
+def register_env(name, type_, default, doc=""):
+    """Register a typed environment variable; returns the EnvVar handle."""
+    with _env_lock:
+        var = env_registry.get(name)
+        if var is None:
+            var = EnvVar(name, type_, default, doc)
+            env_registry[name] = var
+        return var
+
+
+def get_env(name, default=None):
+    """Read a registered env var (falling back to raw os.environ lookup)."""
+    var = env_registry.get(name)
+    if var is not None:
+        return var.get()
+    return os.environ.get(name, default)
+
+
+# Core runtime knobs, mirroring the reference's documented set where the
+# concept survives on TPU (docs/how_to/env_var.md).
+register_env("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+             "Execution mode: 'NaiveEngine' forces synchronous dispatch "
+             "(block after every op) for debugging; anything else uses JAX's "
+             "native async dispatch.")
+register_env("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+             "Whether to compile whole training graphs as one XLA program "
+             "(the TPU analogue of bulk-exec segments).")
+register_env("MXNET_BACKWARD_DO_MIRROR", bool, False,
+             "Trade compute for memory in backward (jax.checkpoint/remat on "
+             "eligible subgraphs; reference: graph_executor.cc:210-223).")
+register_env("MXNET_PROFILER_AUTOSTART", bool, False,
+             "Start the Chrome-trace profiler at import time.")
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 20,
+             "Threshold (elements) above which dist kvstore shards a value "
+             "across servers/hosts.")
+
+
+_UID_LOCK = threading.Lock()
+_UID_COUNT = [0]
+
+
+def _uid():
+    with _UID_LOCK:
+        _UID_COUNT[0] += 1
+        return _UID_COUNT[0]

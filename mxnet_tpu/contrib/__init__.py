@@ -1,0 +1,4 @@
+"""contrib namespace (reference python/mxnet/contrib/)."""
+from . import autograd
+
+__all__ = ["autograd"]

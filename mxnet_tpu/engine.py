@@ -1,0 +1,104 @@
+"""Execution engine facade.
+
+The reference's core runtime is a hand-built async dependency engine
+(``src/engine/threaded_engine*.cc``): every NDArray mutation becomes a queued
+op with read/write var sets, executed by per-device worker threads.  On the
+JAX/XLA stack that machinery is *native to the runtime*: dispatch is already
+asynchronous (ops return futures-backed ``jax.Array``s immediately), data
+dependencies are tracked by value, and per-device execution streams are PJRT's
+concern.  What survives here is the engine's *control surface*:
+
+* ``NaiveEngine`` mode (``MXNET_ENGINE_TYPE=NaiveEngine``) — synchronous
+  dispatch for debugging, the reference's own advice at
+  ``threaded_engine.h:330-337``;
+* ``WaitForVar`` / ``WaitForAll`` sync points (reference
+  ``include/mxnet/engine.h:180-190``);
+* the profiler seam: every dispatched op reports (name, start, end, device)
+  to the Chrome-trace profiler (reference ``src/engine/profiler.cc``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+
+from .base import get_env
+
+__all__ = ["Engine", "get", "is_naive", "waitall"]
+
+
+class Engine:
+    """Singleton engine facade."""
+
+    _inst = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._naive = get_env("MXNET_ENGINE_TYPE") == "NaiveEngine"
+        self._profiler = None  # set by profiler module when recording
+
+    @staticmethod
+    def get():
+        with Engine._lock:
+            if Engine._inst is None:
+                Engine._inst = Engine()
+            return Engine._inst
+
+    # -- modes -------------------------------------------------------------
+    @property
+    def naive(self):
+        return self._naive
+
+    def set_naive(self, value):
+        """Force synchronous dispatch (debugging aid)."""
+        self._naive = bool(value)
+
+    # -- dispatch seam ------------------------------------------------------
+    def dispatch(self, name, fn, *args, **kwargs):
+        """Run ``fn`` through the engine seam: profiling + naive-mode sync.
+
+        In threaded (default) mode this adds nothing — XLA dispatch is already
+        async — so the hot path is one attribute check.
+        """
+        prof = self._profiler
+        if prof is None and not self._naive:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter_ns()
+        out = fn(*args, **kwargs)
+        if self._naive:
+            jax.block_until_ready(out)
+        if prof is not None:
+            prof.record(name, t0, time.perf_counter_ns())
+        return out
+
+    # -- sync points --------------------------------------------------------
+    @staticmethod
+    def wait_for_var(arr):
+        jax.block_until_ready(arr)
+
+    @staticmethod
+    def wait_for_all():
+        # Drain all outstanding async work on every device.
+        for d in jax.devices():
+            try:
+                d.synchronize_all_activity()
+            except (AttributeError, RuntimeError):
+                pass
+        try:
+            jax.effects_barrier()
+        except AttributeError:
+            pass
+
+
+def get():
+    return Engine.get()
+
+
+def is_naive():
+    return Engine.get().naive
+
+
+def waitall():
+    """Block until all queued device work completes (mx.nd.waitall)."""
+    Engine.wait_for_all()

@@ -1,0 +1,330 @@
+"""Executor: compiled symbolic execution.
+
+Reference: ``include/mxnet/executor.h`` + ``src/executor/graph_executor.cc``.
+The reference's ``GraphExecutor::Init`` pipeline (Gradient pass, PlaceDevice,
+InferShape/Type, PlanMemory, AttachOpExecs, cached ops, bulk segments —
+SURVEY.md §3.3) is exactly what XLA does when it compiles one traced program:
+
+* gradient generation      → ``jax.vjp`` over the traced forward
+* PlanMemory + bulk exec   → XLA fusion & buffer assignment
+* cached engine ops        → the jit cache
+* mirroring (memonger)     → ``jax.checkpoint`` when MXNET_BACKWARD_DO_MIRROR
+
+So ``bind`` here = build a pure function by topologically walking the Symbol
+DAG, then jit three variants: predict forward, train forward, and a fused
+forward+backward (one XLA program per training step — the TPU answer to the
+reference's engine-level compute/comm overlap).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError, get_env
+from .ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+def _apply_pure(node, *xs):
+    """Stateless op application (rematerialization-eligible)."""
+    return node.op.apply(node.attrs, xs, (), False, None)[0]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, grads, reqs, aux, group2ctx=None,
+                 shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        if len(args) != len(self._arg_names):
+            raise MXNetError("bind: expected %d args, got %d"
+                             % (len(self._arg_names), len(args)))
+        self.arg_arrays = list(args)
+        self.aux_arrays = list(aux)
+        self.grad_req = dict(reqs)
+        self.grad_arrays = [grads.get(n) for n in self._arg_names]
+        self._grad_dict = {n: g for n, g in zip(self._arg_names,
+                                                self.grad_arrays)
+                           if g is not None}
+        self._group2ctx = group2ctx or {}
+        self._monitor_cb = None
+        self._monitor_all = False
+
+        # indices of args we differentiate (grad_req != 'null')
+        self._diff_idx = [i for i, n in enumerate(self._arg_names)
+                          if self.grad_req.get(n, "null") != "null"
+                          and self.grad_arrays[i] is not None]
+
+        self._build_maps()
+        self._compile()
+
+        # placeholder outputs carry the inferred shapes so output_shapes is
+        # valid before the first forward (SequentialModule wires on it)
+        shape_seed = {n: a.shape for n, a in zip(self._arg_names,
+                                                 self.arg_arrays)}
+        try:
+            _, out_shapes, _ = symbol.infer_shape_partial(**shape_seed)
+        except MXNetError:
+            out_shapes = [None] * len(self._output_names)
+        self.outputs = [NDArray(jnp.zeros(tuple(s) if s else ()))
+                        for s in out_shapes]
+        self._last_state = None
+
+    # ------------------------------------------------------------------
+    def _build_maps(self):
+        symbol = self._symbol
+        self._nodes = symbol._nodes()
+        aux_set = set(self._aux_names)
+        self._var_map = {}
+        ai = gi = 0
+        arg_order = {n: i for i, n in enumerate(self._arg_names)}
+        aux_order = {n: i for i, n in enumerate(self._aux_names)}
+        for node in self._nodes:
+            if node.is_variable:
+                if node.name in aux_set:
+                    self._var_map[id(node)] = ("aux", aux_order[node.name])
+                else:
+                    self._var_map[id(node)] = ("arg", arg_order[node.name])
+        self._head = [(id(n), oi) for n, oi in symbol._outputs]
+
+    def _trace(self, arg_vals, aux_vals, is_train, rng, tap=None):
+        """Pure traced evaluation of the DAG."""
+        vals = {}
+        new_aux = list(aux_vals)
+        remat = get_env("MXNET_BACKWARD_DO_MIRROR")
+        for idx, node in enumerate(self._nodes):
+            if node.is_variable:
+                kind, i = self._var_map[id(node)]
+                vals[(id(node), 0)] = (arg_vals[i] if kind == "arg"
+                                       else aux_vals[i])
+                continue
+            ins = [vals[(n_id, oi)] for n_id, oi in
+                   ((id(n), oi) for n, oi in node.arg_inputs())]
+            aux_in = tuple(vals[(id(n), oi)] for n, oi in node.aux_inputs())
+            need_rng = node.op.needs_rng or node.op.stateful
+            r = jax.random.fold_in(rng, idx) if (need_rng and
+                                                 rng is not None) else None
+            if remat and not node.op.stateful and not node.op.needs_rng:
+                outs = jax.checkpoint(
+                    functools.partial(_apply_pure, node))(*ins)
+                upd = ()
+            else:
+                outs, upd = node.op.apply(node.attrs, ins, aux_in,
+                                          is_train, r)
+            for oi, o in enumerate(outs):
+                vals[(id(node), oi)] = o
+            for (an, _), u in zip(node.aux_inputs(), upd):
+                new_aux[self._var_map[id(an)][1]] = u
+            if tap is not None:
+                tap(node, outs)
+        outputs = tuple(vals[k] for k in self._head)
+        return outputs, tuple(new_aux)
+
+    def _compile(self):
+        trace = self._trace
+        diff_idx = tuple(self._diff_idx)
+
+        def fwd(arg_vals, aux_vals, rng, is_train):
+            return trace(arg_vals, aux_vals, is_train, rng)
+
+        self._jit_fwd = jax.jit(fwd, static_argnums=(3,))
+
+        def fwd_bwd(arg_vals, aux_vals, rng, ograds):
+            arg_vals = list(arg_vals)
+
+            def f(diff_vals):
+                full = list(arg_vals)
+                for i, v in zip(diff_idx, diff_vals):
+                    full[i] = v
+                outs, new_aux = trace(tuple(full), aux_vals, True, rng)
+                return outs, new_aux
+
+            diff_vals = tuple(arg_vals[i] for i in diff_idx)
+            outs, vjp, new_aux = jax.vjp(f, diff_vals, has_aux=True)
+            cots = tuple(jnp.ones_like(o) if g is None else g
+                         for o, g in zip(outs, ograds))
+            grads = vjp(cots)[0]
+            return outs, new_aux, grads
+
+        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+
+    # ------------------------------------------------------------------
+    def _gather(self):
+        arg_vals = tuple(a._data for a in self.arg_arrays)
+        aux_vals = tuple(a._data for a in self.aux_arrays)
+        return arg_vals, aux_vals
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self._arg_names:
+                raise MXNetError("unknown argument %r in forward" % k)
+            i = self._arg_names.index(k)
+            self.arg_arrays[i]._data = jax.device_put(
+                v._data if isinstance(v, NDArray) else jnp.asarray(v),
+                self._ctx.jax_device())
+        arg_vals, aux_vals = self._gather()
+        rng = _random.next_key()
+        if self._monitor_cb is not None:
+            outs, new_aux = self._forward_monitored(arg_vals, aux_vals,
+                                                    is_train, rng)
+        else:
+            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng,
+                                          bool(is_train))
+        for o_nd, o in zip(self.outputs, outs):
+            o_nd._data = o
+        if is_train:
+            for a_nd, a in zip(self.aux_arrays, new_aux):
+                a_nd._data = a
+            self._last_state = (arg_vals, aux_vals, rng)
+        return self.outputs
+
+    def _forward_monitored(self, arg_vals, aux_vals, is_train, rng):
+        """Eager forward that reports every op output to the monitor callback
+        (reference graph_executor.cc:758-778 monitor install)."""
+        records = []
+
+        def tap(node, outs):
+            names = node.op.outputs(node.attrs)
+            for nm, o in zip(names, outs):
+                records.append(("%s_%s" % (node.name, nm), o))
+
+        outs, new_aux = self._trace(arg_vals, aux_vals, is_train, rng,
+                                    tap=tap)
+        for nm, o in records:
+            self._monitor_cb(nm, NDArray(o))
+        return outs, new_aux
+
+    def backward(self, out_grads=None):
+        """Backward using the last train-mode forward's inputs.
+
+        Runs the fused forward+backward XLA program (forward is recomputed
+        inside one compiled computation — cheaper on TPU than materializing
+        every intermediate across two dispatches)."""
+        if self._last_state is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        arg_vals, aux_vals, rng = self._last_state
+        if out_grads is None:
+            ograds = tuple(None for _ in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = tuple(g._data if isinstance(g, NDArray) else
+                           (None if g is None else jnp.asarray(g))
+                           for g in out_grads)
+        outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals, rng,
+                                                 ograds)
+        for o_nd, o in zip(self.outputs, outs):
+            o_nd._data = o
+        for a_nd, a in zip(self.aux_arrays, new_aux):
+            a_nd._data = a
+        for i, g in zip(self._diff_idx, grads):
+            name = self._arg_names[i]
+            req = self.grad_req.get(name, "write")
+            gbuf = self.grad_arrays[i]
+            if req == "add":
+                gbuf._data = gbuf._data + g
+            else:
+                gbuf._data = g
+        return [self.grad_arrays[i] for i in self._diff_idx]
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        """Fused train step: one compiled program for forward+backward."""
+        self.forward_prepare(**kwargs)
+        arg_vals, aux_vals = self._gather()
+        rng = _random.next_key()
+        self._last_state = (arg_vals, aux_vals, rng)
+        return self.backward(out_grads)
+
+    def forward_prepare(self, **kwargs):
+        for k, v in kwargs.items():
+            i = self._arg_names.index(k)
+            self.arg_arrays[i]._data = jax.device_put(
+                v._data if isinstance(v, NDArray) else jnp.asarray(v),
+                self._ctx.jax_device())
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(self._grad_dict)
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        dev = self._ctx.jax_device()
+        for name, arr in arg_params.items():
+            if name in self._arg_names:
+                self.arg_arrays[self._arg_names.index(name)]._data = \
+                    jax.device_put(jnp.asarray(
+                        arr.asnumpy() if isinstance(arr, NDArray) else arr),
+                        dev)
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in arguments" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self._aux_names:
+                    self.aux_arrays[self._aux_names.index(name)]._data = \
+                        jax.device_put(jnp.asarray(
+                            arr.asnumpy() if isinstance(arr, NDArray)
+                            else arr), dev)
+                elif not allow_extra_params:
+                    raise MXNetError("Found name %r not in aux states"
+                                     % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new input shapes, sharing parameter arrays
+        (reference executor.py reshape → bind with shared memory)."""
+        from . import ndarray as nd
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**kwargs)
+        new_args, new_grads = [], {}
+        for i, name in enumerate(self._arg_names):
+            new_shape = arg_shapes[i]
+            cur = self.arg_arrays[i]
+            if new_shape is None or tuple(new_shape) == cur.shape:
+                new_args.append(cur)
+                if self.grad_arrays[i] is not None:
+                    new_grads[name] = self.grad_arrays[i]
+            else:
+                if not (partial_shaping or name in kwargs):
+                    raise MXNetError(
+                        "arg %s shape changed without partial_shaping" % name)
+                new_args.append(nd.zeros(new_shape, self._ctx,
+                                         dtype=str(cur.dtype)))
+                if self.grad_arrays[i] is not None:
+                    new_grads[name] = nd.zeros(new_shape, self._ctx,
+                                               dtype=str(cur.dtype))
+        new_aux = []
+        for i, name in enumerate(self._aux_names):
+            cur = self.aux_arrays[i]
+            ns = aux_shapes[i]
+            if ns is None or tuple(ns) == cur.shape:
+                new_aux.append(cur)
+            else:
+                new_aux.append(nd.zeros(ns, self._ctx, dtype=str(cur.dtype)))
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self.grad_req, new_aux, group2ctx=self._group2ctx)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_cb = callback
+        self._monitor_all = monitor_all
+
+    def debug_str(self):
+        return self._symbol.debug_str()

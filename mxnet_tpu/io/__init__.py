@@ -1,0 +1,13 @@
+"""Data IO: iterators and batch types.
+
+Reference: ``src/io/`` iterators (MNISTIter, CSVIter, ImageRecordIter,
+BatchLoader/PrefetcherIter decorators) + ``python/mxnet/io.py``
+(NDArrayIter, PrefetchingIter, DataBatch/DataDesc).
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
+                 MNISTIter, PrefetchingIter, ResizeIter, ImageRecordIter)
+from . import recordio
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "PrefetchingIter", "ResizeIter", "ImageRecordIter",
+           "recordio"]

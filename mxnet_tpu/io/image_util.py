@@ -1,0 +1,69 @@
+"""Host-side image decode/encode helpers (PIL-backed, gated).
+
+Reference: the OpenCV decode/augment path in ``src/io/image_aug_default.cc``
+and ``src/io/image_io.cc``.  This image has no cv2; PIL (via torchvision's
+dependency) is used when present, else a clear error.
+"""
+from __future__ import annotations
+
+import io as _io
+
+import numpy as np
+
+from ..base import MXNetError
+
+try:
+    from PIL import Image
+    _HAS_PIL = True
+except ImportError:  # pragma: no cover
+    Image = None
+    _HAS_PIL = False
+
+
+def _require_pil():
+    if not _HAS_PIL:
+        raise MXNetError("image decode requires PIL, which is not available "
+                         "in this environment")
+
+
+def decode_image(img_bytes):
+    """bytes -> HWC uint8 RGB array."""
+    _require_pil()
+    img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
+    return np.asarray(img)
+
+
+def encode_image(arr, quality=95, fmt=".jpg"):
+    """HWC uint8 array -> encoded bytes."""
+    _require_pil()
+    img = Image.fromarray(np.asarray(arr, dtype=np.uint8))
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG" if fmt in (".jpg", ".jpeg") else "PNG",
+             quality=quality)
+    return buf.getvalue()
+
+
+def decode_record_image(img_bytes, data_shape, rand_crop=False,
+                        rand_mirror=False):
+    """Decode + resize/crop to CHW float32 (subset of the reference's
+    default augmenter: resize-shortest, center/random crop, mirror)."""
+    _require_pil()
+    c, h, w = data_shape
+    img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
+    iw, ih = img.size
+    # resize shortest side to target then crop
+    scale = max(h / ih, w / iw)
+    if scale != 1.0:
+        img = img.resize((max(int(iw * scale + 0.5), w),
+                          max(int(ih * scale + 0.5), h)))
+    iw, ih = img.size
+    if rand_crop:
+        x0 = np.random.randint(0, iw - w + 1)
+        y0 = np.random.randint(0, ih - h + 1)
+    else:
+        x0, y0 = (iw - w) // 2, (ih - h) // 2
+    img = img.crop((x0, y0, x0 + w, y0 + h))
+    arr = np.asarray(img, dtype=np.float32)
+    if rand_mirror and np.random.rand() < 0.5:
+        arr = arr[:, ::-1]
+    return arr.transpose(2, 0, 1)  # HWC -> CHW

@@ -1,0 +1,468 @@
+"""Data iterators.
+
+Reference: ``python/mxnet/io.py`` (NDArrayIter :457, PrefetchingIter :285,
+MXDataIter wrapper) and ``src/io/`` C++ iterators.  The prefetch design
+mirrors the reference's ``dmlc::ThreadedIter`` double-buffering: a background
+thread stages the next batch onto device while the current one computes.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "PrefetchingIter", "ResizeIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name + shape (+dtype/layout) of one input (reference io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (reference io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError()
+
+    def getdata(self):
+        raise NotImplementedError()
+
+    def getlabel(self):
+        raise NotImplementedError()
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError()
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, numpy array) (reference
+    io.py _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d
+                    for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
+                        "them or dict with them as values")
+    ret = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        ret.append((k, np.asarray(v)))
+    return ret
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays, with shuffle / pad / discard handling
+    (reference io.py:457)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size."
+        if shuffle:
+            idx = np.arange(self.num_data)
+            np.random.shuffle(idx)
+            self.data = [(k, v[idx]) for k, v in self.data]
+            self.label = [(k, v[idx]) for k, v in self.label]
+        if last_batch_handle == "discard":
+            new_n = self.num_data - self.num_data % batch_size
+            self.data = [(k, v[:new_n]) for k, v in self.data]
+            self.label = [(k, v[:new_n]) for k, v in self.label]
+            self.num_data = new_n
+        self.data_list = [v for _, v in self.data] + \
+            [v for _, v in self.label]
+        self.num_source = len(self.data_list)
+        self.cursor = -batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + \
+                (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [nd.array(v[self.cursor:self.cursor + self.batch_size])
+                    for _, v in data_source]
+        pad = self.batch_size - self.num_data + self.cursor
+        return [nd.array(np.concatenate(
+            (v[self.cursor:], v[:pad]), axis=0)) for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches per epoch
+    (reference io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators (reference
+    io.py:285 / dmlc::ThreadedIter double-buffering)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0].shape[0]
+        self._queues = [queue.Queue(maxsize=2) for _ in iters]
+        self._stop = threading.Event()
+        self._threads = []
+        self._start_threads()
+        self.current_batch = [None] * self.n_iter
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[n], s.shape, s.dtype)
+                     if isinstance(s, DataDesc) else DataDesc(r[n], s[1])
+                     for n, s in zip([x.name for x in i.provide_data],
+                                     i.provide_data)]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[n], s.shape, s.dtype)
+                     if isinstance(s, DataDesc) else DataDesc(r[n], s[1])
+                     for n, s in zip([x.name for x in i.provide_label],
+                                     i.provide_label)]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _start_threads(self):
+        def run(i):
+            while not self._stop.is_set():
+                try:
+                    batch = self.iters[i].next()
+                except StopIteration:
+                    self._queues[i].put(None)
+                    return
+                self._queues[i].put(batch)
+
+        self._threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                         for i in range(self.n_iter)]
+        for t in self._threads:
+            t.start()
+
+    def reset(self):
+        self._stop.set()
+        for q in self._queues:
+            while not q.empty():
+                q.get_nowait()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._queues = [queue.Queue(maxsize=2) for _ in self.iters]
+        self._start_threads()
+
+    def iter_next(self):
+        batches = [q.get() for q in self._queues]
+        if any(b is None for b in batches):
+            return False
+        self.current_batch = batches
+        return True
+
+    def next(self):
+        if self.iter_next():
+            b = self.current_batch
+            return DataBatch(sum([x.data for x in b], []),
+                             sum([(x.label or []) for x in b], []),
+                             b[0].pad, b[0].index)
+        raise StopIteration
+
+    def getdata(self):
+        return sum([x.data for x in self.current_batch], [])
+
+    def getlabel(self):
+        return sum([(x.label or []) for x in self.current_batch], [])
+
+    def getpad(self):
+        return self.current_batch[0].pad
+
+    def getindex(self):
+        return self.current_batch[0].index
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (reference src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        super().__init__(data, label, batch_size=batch_size,
+                         data_name="data", label_name="label", **kwargs)
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(
+            num, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        assert magic == 2049
+        return np.frombuffer(f.read(), dtype=np.uint8)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format iterator (reference src/io/iter_mnist.cc); `flat`
+    yields (N, 784) else (N, 1, 28, 28)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, **kwargs):
+        imgs = _read_idx_images(image).astype(np.float32) / 255.0
+        lbls = _read_idx_labels(label).astype(np.float32)
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs.reshape(len(imgs), 1, 28, 28)
+        super().__init__(imgs, lbls, batch_size=batch_size, shuffle=shuffle,
+                         label_name="softmax_label")
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (reference iter_image_recordio_2.cc).
+
+    Full decode/augment parity needs the native pipeline (planned in
+    ``src/``, SURVEY.md §7.8); this python implementation reads the packed
+    record stream, decodes with PIL if available, and prefetches.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
+                 rand_crop=False, rand_mirror=False, **kwargs):
+        super().__init__(batch_size)
+        from . import recordio
+        from .image_util import decode_record_image
+        self._decode = decode_record_image
+        self.record = recordio.MXRecordIO(path_imgrec, "r")
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.mean = np.array([mean_r, mean_g, mean_b]).reshape(3, 1, 1)
+        self.scale = scale
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self._batch = None
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self.record.reset()
+
+    def iter_next(self):
+        from . import recordio
+        datas, labels = [], []
+        for _ in range(self.batch_size):
+            s = self.record.read()
+            if s is None:
+                if not datas:
+                    return False
+                while len(datas) < self.batch_size:  # pad with wrap
+                    datas.append(datas[-1])
+                    labels.append(labels[-1])
+                break
+            header, img_bytes = recordio.unpack(s)
+            img = self._decode(img_bytes, self.data_shape,
+                               rand_crop=self.rand_crop,
+                               rand_mirror=self.rand_mirror)
+            img = (img - self.mean) * self.scale
+            datas.append(img)
+            lbl = header.label
+            labels.append(lbl if self.label_width > 1 else float(
+                np.asarray(lbl).reshape(-1)[0]))
+        self._batch = DataBatch([nd.array(np.stack(datas))],
+                                [nd.array(np.asarray(labels))], pad=0)
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self._batch
+        raise StopIteration
+
+    def getdata(self):
+        return self._batch.data
+
+    def getlabel(self):
+        return self._batch.label
+
+    def getpad(self):
+        return 0

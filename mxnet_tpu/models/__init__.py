@@ -1,0 +1,12 @@
+"""Model zoo: symbol builders for the reference's acceptance workloads
+(reference ``example/image-classification/symbols/`` + ``example/rnn``)."""
+from .mlp import get_symbol as mlp
+from .lenet import get_symbol as lenet
+from .resnet import get_symbol as resnet
+from .alexnet import get_symbol as alexnet
+from .vgg import get_symbol as vgg
+from .inception_bn import get_symbol as inception_bn
+from .lstm_lm import get_symbol as lstm_lm
+
+__all__ = ["mlp", "lenet", "resnet", "alexnet", "vgg", "inception_bn",
+           "lstm_lm"]

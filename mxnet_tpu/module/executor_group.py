@@ -1,0 +1,243 @@
+"""DataParallelExecutorGroup: one executor per device, batch sliced across.
+
+Reference: ``python/mxnet/module/executor_group.py:77-648`` —
+``decide_slices`` (:207), per-device ``simple_bind`` with shared memory
+(:537), forward fan-out, backward, gradient landing in per-exec grad arrays
+for KVStore reduction.
+
+TPU note: with a single TPU context this degenerates to one fused-XLA
+executor; the multi-device *sharded* fast path (in-graph psum over a mesh)
+lives in ``mxnet_tpu.parallel`` and is selected by Module when possible.
+This class keeps full reference semantics (works over cpu/tpu context lists,
+as the reference test suite does with cpu stand-ins).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..io.io import DataDesc
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice the batch by workload (reference executor_manager.py:14)."""
+    total = sum(work_load_list)
+    batch_num_list = [round(batch_size * w / total) for w in work_load_list]
+    # fix rounding drift
+    diff = batch_size - sum(batch_num_list)
+    batch_num_list[-1] += diff
+    slices = []
+    start = 0
+    for n in batch_num_list:
+        slices.append(slice(start, start + n))
+        start += n
+    return slices
+
+
+def _load_general(data, targets):
+    """Copy list-of-batch-arrays into per-exec target arrays
+    (reference executor_group.py:14-50)."""
+    for d_src, d_targets in zip(data, targets):
+        src = d_src.asnumpy() if hasattr(d_src, "asnumpy") else \
+            np.asarray(d_src)
+        for slice_idx, target in d_targets:
+            target[:] = src[slice_idx]
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = fixed_param_names or []
+        self.logger = logger
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.execs = []
+        self.data_shapes = None
+        self.label_shapes = None
+        self.slices = None
+        self.shared_group = shared_group
+
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = ("null" if k in self.fixed_param_names
+                                        or not for_training else grad_req)
+                elif k in [d.name for d in data_shapes]:
+                    self.grad_req[k] = grad_req if inputs_need_grad else \
+                        "null"
+                else:
+                    self.grad_req[k] = "null"
+        else:
+            self.grad_req = dict(grad_req)
+
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    # ------------------------------------------------------------------
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        batch_size = data_shapes[0].shape[
+            DataDesc.get_batch_axis(getattr(data_shapes[0], "layout",
+                                            "NCHW"))]
+        self.batch_size = batch_size
+        self.slices = _split_input_slice(batch_size, self.workload)
+        self.execs = []
+        for i, ctx in enumerate(self.contexts):
+            islice = self.slices[i]
+            n_i = islice.stop - islice.start
+            shapes = {}
+            for d in data_shapes:
+                shapes[d.name] = (n_i,) + tuple(d.shape[1:])
+            if label_shapes:
+                for l in label_shapes:
+                    shapes[l.name] = (n_i,) + tuple(l.shape[1:])
+            ex = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
+                                         **shapes)
+            if shared_group is not None and i < len(shared_group.execs):
+                # Share parameter/aux NDArray handles with the shared group
+                # (reference: shared memory pool in InitDataEntryMemory;
+                # here handle-sharing makes cross-bucket updates visible
+                # with zero copies, since executors read handles per call).
+                src = shared_group.execs[i]
+                for name in self.param_names:
+                    if name in ex.arg_dict and name in src.arg_dict and \
+                            ex.arg_dict[name].shape == \
+                            src.arg_dict[name].shape:
+                        ex.arg_arrays[ex._arg_names.index(name)] = \
+                            src.arg_dict[name]
+                for name in self.aux_names:
+                    if name in ex.aux_dict and name in src.aux_dict and \
+                            ex.aux_dict[name].shape == \
+                            src.aux_dict[name].shape:
+                        ex.aux_arrays[ex._aux_names.index(name)] = \
+                            src.aux_dict[name]
+            self.execs.append(ex)
+
+        self.data_names = [d.name for d in data_shapes]
+        self.label_names = [l.name for l in (label_shapes or [])]
+        self._make_arrays()
+
+    def _make_arrays(self):
+        self.data_arrays = [
+            [(self.slices[i], e.arg_dict[name])
+             for i, e in enumerate(self.execs)]
+            for name in self.data_names if name in self.arg_names]
+        self.label_arrays = [
+            [(self.slices[i], e.arg_dict[name])
+             for i, e in enumerate(self.execs)]
+            for name in self.label_names if name in self.arg_names]
+        self.param_arrays = [
+            [e.arg_dict[name] for e in self.execs]
+            for name in self.param_names if name in self.arg_names]
+        self.grad_arrays = [
+            [e.grad_dict.get(name) for e in self.execs]
+            for name in self.param_names if name in self.arg_names] \
+            if self.for_training else []
+        self.aux_arrays = [
+            [e.aux_dict[name] for e in self.execs]
+            for name in self.aux_names]
+        data_names_set = set(self.data_names)
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [
+                [e.grad_dict.get(name) for e in self.execs]
+                for name in self.data_names]
+
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and \
+                label_shapes == self.label_shapes:
+            return
+        self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    # ------------------------------------------------------------------
+    def set_params(self, arg_params, aux_params):
+        for ex in self.execs:
+            ex.copy_params_from(arg_params, aux_params,
+                                allow_extra_params=True)
+
+    def get_params(self, arg_params, aux_params):
+        """Average params over devices into the given dicts (reference
+        sync_params_from_devices path)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.asnumpy() for w in block) / len(block)
+            arg_params[name] = nd.array(weight)
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.asnumpy() for w in block) / len(block)
+            aux_params[name] = nd.array(weight)
+
+    # ------------------------------------------------------------------
+    def _load_batch(self, data_batch):
+        _load_general(data_batch.data, self.data_arrays)
+        if self.for_training and getattr(data_batch, "label", None):
+            if self.label_arrays:
+                _load_general(data_batch.label, self.label_arrays)
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        self._load_batch(data_batch)
+        if not is_train and getattr(data_batch, "label", None) and \
+                self.label_arrays:
+            _load_general(data_batch.label, self.label_arrays)
+        for ex in self.execs:
+            ex.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to run "
+                             "backward")
+        for i, ex in enumerate(self.execs):
+            if out_grads is None:
+                ex.backward()
+            else:
+                sliced = [g.slice(self.slices[i].start, self.slices[i].stop)
+                          for g in out_grads]
+                ex.backward(sliced)
+
+    def forward_backward(self, data_batch):
+        """Fused train step: one XLA program per device (forward+backward)."""
+        self._load_batch(data_batch)
+        for ex in self.execs:
+            ex.forward_backward()
+
+    # ------------------------------------------------------------------
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[ex.outputs[i] for ex in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return [nd.concatenate(parts, axis=0) if len(parts) > 1
+                    else parts[0] for parts in outputs]
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [[e.grad_dict[name] for e in self.execs]
+                 for name in self.data_names]
+        if merge_multi_context:
+            return [nd.concatenate(parts, axis=0) if len(parts) > 1
+                    else parts[0] for parts in grads]
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        for i, ex in enumerate(self.execs):
+            islice = self.slices[i]
+            labels_slice = [label.slice(islice.start, islice.stop)
+                            if label.shape[0] == self.batch_size else label
+                            for label in labels]
+            eval_metric.update(labels_slice, ex.outputs)
+
+    def install_monitor(self, mon):
+        for ex in self.execs:
+            mon.install(ex)

@@ -1,0 +1,527 @@
+"""NDArray: the imperative tensor.
+
+Reference: ``include/mxnet/ndarray.h`` + ``src/ndarray/ndarray.cc`` +
+``python/mxnet/ndarray.py``.  The reference NDArray is a ref-counted device
+buffer whose every mutation is pushed to the async engine; python-side op
+functions are auto-generated from the op registry and funnel through
+``MXImperativeInvoke`` (``src/c_api/c_api_ndarray.cc:322``).
+
+TPU-native design: an NDArray is a *mutable handle* to an immutable
+``jax.Array``.  JAX's async dispatch plays the role of the dependency engine —
+ops return immediately with futures-backed arrays; ``wait_to_read`` /
+``asnumpy`` are the sync points (reference ``WaitForVar``).  Mutation
+("write" ops, ``x[:] = v``, ``out=`` kwargs, optimizer updates) rebinds the
+handle to a new functional value, which preserves MXNet's in-place API without
+aliasing hazards.  Op functions are auto-generated from the registry at import
+time, mirroring ``_init_ndarray_module`` (``python/mxnet/_ctypes/ndarray.py``).
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import engine as _engine
+from . import random as _random
+from .base import MXNetError, _uid
+from .context import Context, cpu, current_context
+from .ops.registry import get_op, list_ops
+
+__all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+           "concatenate", "save", "load", "waitall", "imperative_invoke",
+           "onehot_encode"]
+
+# captured before _init_ndarray_module adds op functions named like
+# builtins ('slice', 'max', ...) to this module's namespace
+_py_slice = slice
+
+
+def _eager(name, fn, *arrs):
+    """Eager math entry that participates in the autograd tape.
+
+    Every NDArray dunder (`x * y`, `-x`, `x.sum()`) funnels through here so
+    python-operator expressions inside ``autograd.record()`` get gradients,
+    exactly like registry-op calls (reference: python operators dispatch to
+    registered ops through MXImperativeInvoke and hit RecordOp)."""
+    from . import autograd
+    if autograd.is_recording():
+        outs, vjp = jax.vjp(lambda *xs: (fn(*xs),), *arrs)
+        autograd.record_op(name, vjp, arrs, outs)
+        return outs[0]
+    return fn(*arrs)
+
+_DTYPE_ALIASES = {
+    "float16": jnp.float16, "bfloat16": jnp.bfloat16, "float32": jnp.float32,
+    "float64": jnp.float64, "int8": jnp.int8, "uint8": jnp.uint8,
+    "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
+}
+
+
+def _as_jnp_dtype(dtype):
+    if dtype is None:
+        return jnp.float32
+    if isinstance(dtype, str):
+        return _DTYPE_ALIASES.get(dtype, jnp.dtype(dtype))
+    return jnp.dtype(dtype)
+
+
+def _ctx_of(jarr):
+    try:
+        dev = list(jarr.devices())[0]
+    except Exception:
+        return cpu(0)
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("tpu", dev.id)
+
+
+class NDArray:
+    """Mutable handle to an immutable on-device array."""
+
+    __slots__ = ("_data", "_writable")
+
+    def __init__(self, data, writable=True):
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data = data
+        self._writable = writable
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape, dtype=np.int64)) if self._data.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def context(self):
+        return _ctx_of(self._data)
+
+    ctx = context
+
+    @property
+    def T(self):
+        return NDArray(self._data.T)
+
+    # -- sync / host access -------------------------------------------------
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+
+    def asnumpy(self):
+        return np.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise MXNetError("Truth value of multi-element NDArray is ambiguous")
+        return bool(self.asscalar())
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    # -- views / copies -----------------------------------------------------
+    def reshape(self, shape, *more):
+        if more:
+            shape = (shape,) + tuple(more)
+        if isinstance(shape, int):
+            shape = (shape,)
+        return NDArray(self._data.reshape(shape))
+
+    def astype(self, dtype):
+        return NDArray(self._data.astype(_as_jnp_dtype(dtype)))
+
+    def copy(self):
+        return NDArray(self._data + 0 if self._data.dtype != jnp.bool_
+                       else jnp.array(self._data))
+
+    def copyto(self, other):
+        """Copy to another NDArray (in place) or to a Context (new array)."""
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data,
+                                         other.context.jax_device())
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        raise MXNetError("copyto does not support type %s" % type(other))
+
+    def as_in_context(self, ctx):
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    def slice(self, start, stop):
+        return NDArray(self._data[start:stop])
+
+    def slice_axis(self, axis, begin, end):
+        idx = [_py_slice(None)] * self.ndim
+        idx[axis] = _py_slice(begin, end)
+        return NDArray(self._data[tuple(idx)])
+
+    def at(self, idx):
+        return NDArray(self._data[idx])
+
+    def flatten(self):
+        return self.reshape((self.shape[0], -1)) if self.ndim > 1 else self
+
+    def expand_dims(self, axis):
+        return NDArray(jnp.expand_dims(self._data, axis))
+
+    def transpose(self, axes=None):
+        return NDArray(jnp.transpose(self._data, axes))
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data
+        return NDArray(self._data[key])
+
+    def __setitem__(self, key, value):
+        if not self._writable:
+            raise MXNetError("NDArray is not writable")
+        if isinstance(value, NDArray):
+            value = value._data
+        try:
+            dev = next(iter(self._data.devices()))
+        except Exception:
+            dev = None
+        if isinstance(key, _py_slice) and key == _py_slice(None):
+            if isinstance(value, (int, float)):
+                new = jnp.full_like(self._data, value)
+            else:
+                new = jnp.broadcast_to(
+                    jnp.asarray(value, dtype=self._data.dtype),
+                    self.shape)
+            # stay committed to the same device (multi-device executor
+            # groups rely on each bound array keeping its placement)
+            self._data = jax.device_put(new, dev) if dev is not None else new
+            return
+        if isinstance(key, NDArray):
+            key = key._data
+        new = self._data.at[key].set(value)
+        self._data = jax.device_put(new, dev) if dev is not None else new
+
+    # -- arithmetic ---------------------------------------------------------
+    def _binary(self, other, fn, differentiable=True):
+        if isinstance(other, NDArray):
+            if differentiable:
+                return NDArray(_eager(fn.__name__ if hasattr(fn, "__name__")
+                                      else "binary", fn, self._data,
+                                      other._data))
+            other = other._data
+            return NDArray(fn(self._data, other))
+        if differentiable:
+            return NDArray(_eager("binary_scalar",
+                                  lambda a: fn(a, other), self._data))
+        return NDArray(fn(self._data, other))
+
+    def __add__(self, o): return self._binary(o, jnp.add)
+    def __radd__(self, o): return self._binary(o, lambda a, b: jnp.add(b, a))
+    def __sub__(self, o): return self._binary(o, jnp.subtract)
+    def __rsub__(self, o): return self._binary(o, lambda a, b: jnp.subtract(b, a))
+    def __mul__(self, o): return self._binary(o, jnp.multiply)
+    def __rmul__(self, o): return self._binary(o, lambda a, b: jnp.multiply(b, a))
+    def __truediv__(self, o): return self._binary(o, jnp.divide)
+    def __rtruediv__(self, o): return self._binary(o, lambda a, b: jnp.divide(b, a))
+    def __div__(self, o): return self.__truediv__(o)
+    def __mod__(self, o): return self._binary(o, jnp.mod)
+    def __pow__(self, o): return self._binary(o, jnp.power)
+    def __rpow__(self, o): return self._binary(o, lambda a, b: jnp.power(b, a))
+    def __neg__(self):
+        return NDArray(_eager("negative", jnp.negative, self._data))
+
+    def __abs__(self):
+        return NDArray(_eager("abs", jnp.abs, self._data))
+
+    def _ibinary(self, o, fn):
+        if isinstance(o, NDArray):
+            self._data = _eager("ibinary", fn, self._data, o._data)
+        else:
+            self._data = _eager("ibinary_scalar",
+                                lambda a: fn(a, o), self._data)
+        return self
+
+    def __iadd__(self, o): return self._ibinary(o, jnp.add)
+    def __isub__(self, o): return self._ibinary(o, jnp.subtract)
+    def __imul__(self, o): return self._ibinary(o, jnp.multiply)
+    def __itruediv__(self, o): return self._ibinary(o, jnp.divide)
+
+    def __eq__(self, o): return self._binary(o, jnp.equal, False)
+    def __ne__(self, o): return self._binary(o, jnp.not_equal, False)
+    def __gt__(self, o): return self._binary(o, jnp.greater, False)
+    def __ge__(self, o): return self._binary(o, jnp.greater_equal, False)
+    def __lt__(self, o): return self._binary(o, jnp.less, False)
+    def __le__(self, o): return self._binary(o, jnp.less_equal, False)
+
+    def __hash__(self):
+        return id(self)
+
+    def _reduce(self, name, fn, axis, keepdims):
+        return NDArray(_eager(name, lambda a: fn(a, axis=axis,
+                                                 keepdims=keepdims),
+                              self._data))
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", jnp.sum, axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", jnp.mean, axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", jnp.max, axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", jnp.min, axis, keepdims)
+
+    def argmax(self, axis=None):
+        return NDArray(jnp.argmax(self._data, axis=axis).astype(jnp.float32))
+
+    def __repr__(self):
+        return "<NDArray %s @%s>\n%r" % (
+            "x".join(map(str, self.shape)), self.context, self.asnumpy())
+
+    # -- autograd hooks (contrib.autograd; see autograd.py) ------------------
+    def attach_grad(self, grad_req="write"):
+        from . import autograd
+        autograd.mark_variables([self], [zeros_like(self)], grad_req)
+
+    @property
+    def grad(self):
+        from . import autograd
+        return autograd.get_grad(self)
+
+    def backward(self, out_grad=None, retain_graph=False):
+        from . import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph)
+
+
+# ---------------------------------------------------------------------------
+# Creation / conversion
+# ---------------------------------------------------------------------------
+def _device(ctx):
+    ctx = ctx or current_context()
+    return ctx.jax_device()
+
+
+def array(source, ctx=None, dtype=None):
+    """Create an NDArray from any array-like."""
+    if isinstance(source, NDArray):
+        source = source.asnumpy()
+    was_ndarray = isinstance(source, np.ndarray)
+    npv = np.asarray(source)
+    if dtype is None:
+        # reference semantics: non-numpy sources default to float32
+        # (python/mxnet/ndarray.py array())
+        if not was_ndarray or npv.dtype == np.float64:
+            dtype = jnp.float32
+        elif npv.dtype == np.int64:
+            dtype = jnp.int32
+        else:
+            dtype = npv.dtype
+    return NDArray(jax.device_put(jnp.asarray(npv, dtype=_as_jnp_dtype(dtype)),
+                                  _device(ctx)))
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jax.device_put(
+        jnp.zeros(shape, dtype=_as_jnp_dtype(dtype)), _device(ctx)))
+
+
+def ones(shape, ctx=None, dtype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jax.device_put(
+        jnp.ones(shape, dtype=_as_jnp_dtype(dtype)), _device(ctx)))
+
+
+def full(shape, val, ctx=None, dtype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jax.device_put(
+        jnp.full(shape, val, dtype=_as_jnp_dtype(dtype)), _device(ctx)))
+
+
+def zeros_like(other):
+    return NDArray(jnp.zeros_like(other._data))
+
+
+def ones_like(other):
+    return NDArray(jnp.ones_like(other._data))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    arr = jnp.arange(start, stop, step, dtype=_as_jnp_dtype(dtype))
+    if repeat > 1:
+        arr = jnp.repeat(arr, repeat)
+    return NDArray(jax.device_put(arr, _device(ctx)))
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis))
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    out._data = jax.nn.one_hot(indices._data.astype(jnp.int32), depth,
+                               dtype=out._data.dtype)
+    return out
+
+
+def waitall():
+    _engine.waitall()
+
+
+# ---------------------------------------------------------------------------
+# Save / load (reference: NDArray::Save/Load, ndarray.h:178-184; format here is
+# an npz container carrying the same {list|dict of named arrays} semantics)
+# ---------------------------------------------------------------------------
+def save(fname, data):
+    # np.savez always appends .npz to names lacking it; canonical on-disk
+    # name is therefore fname + '.npz' and load() resolves the same way.
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        np.savez(_npz_save_name(fname),
+                 __mx_format__=np.array("dict"),
+                 **{k: v.asnumpy() for k, v in data.items()})
+    elif isinstance(data, (list, tuple)):
+        np.savez(_npz_save_name(fname),
+                 __mx_format__=np.array("list"),
+                 **{"arr_%d" % i: v.asnumpy() for i, v in enumerate(data)})
+    else:
+        raise MXNetError("save requires NDArray, list or dict")
+
+
+def load(fname):
+    with np.load(_npz_load_name(fname)) as zf:
+        fmt = str(zf["__mx_format__"])
+        if fmt == "dict":
+            return {k: array(v) for k, v in zf.items()
+                    if k != "__mx_format__"}
+        items = sorted((k for k in zf.files if k.startswith("arr_")),
+                       key=lambda k: int(k[4:]))
+        return [array(zf[k]) for k in items]
+
+
+def _npz_save_name(fname):
+    return fname if fname.endswith(".npz") else fname + ".npz"
+
+
+def _npz_load_name(fname):
+    import os
+    if fname.endswith(".npz") or not os.path.exists(fname + ".npz"):
+        return fname
+    return fname + ".npz"
+
+
+# ---------------------------------------------------------------------------
+# Imperative invoke + auto-generated op functions
+# (reference: MXImperativeInvoke, c_api_ndarray.cc:322; generation:
+#  python/mxnet/_ctypes/ndarray.py:44+)
+# ---------------------------------------------------------------------------
+def imperative_invoke(op_name, args, kwargs):
+    from . import autograd
+    op = get_op(op_name)
+    out = kwargs.pop("out", None)
+    kwargs.pop("name", None)
+
+    nd_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
+    attr_kwargs = {k: v for k, v in kwargs.items()
+                   if not isinstance(v, NDArray)}
+    if op.key_var_num_args and op.key_var_num_args not in attr_kwargs:
+        attr_kwargs[op.key_var_num_args] = len(args)
+    attrs = op.parse_attrs(attr_kwargs)
+
+    arg_names = op.arguments(attrs)
+    aux_names = op.aux_states(attrs)
+
+    inputs = list(args[:len(arg_names)])
+    aux_nds = list(args[len(arg_names):])
+    for nm in arg_names[len(inputs):]:
+        if nm in nd_kwargs:
+            inputs.append(nd_kwargs[nm])
+    for nm in aux_names[len(aux_nds):]:
+        if nm in nd_kwargs:
+            aux_nds.append(nd_kwargs[nm])
+
+    in_arrs = [x._data for x in inputs]
+    aux_arrs = tuple(x._data for x in aux_nds)
+    rng = _random.next_key() if (op.needs_rng or op.stateful) else None
+    is_train = autograd.is_training()
+
+    if autograd.is_recording():
+        def pure(*xs):
+            o, na = op.apply(attrs, xs, aux_arrs, is_train, rng)
+            return o, na
+        outs, vjp, new_aux = _engine.get().dispatch(
+            op_name, jax.vjp, pure, *in_arrs, has_aux=True)
+        autograd.record_op(op_name, vjp, in_arrs, outs)
+    else:
+        outs, new_aux = _engine.get().dispatch(
+            op_name, op.apply, attrs, in_arrs, aux_arrs, is_train, rng)
+
+    for nd_, na in zip(aux_nds, new_aux):
+        nd_._data = na
+
+    if op.mutate:
+        mutated = set()
+        for out_idx, arg_idx in op.mutate:
+            inputs[arg_idx]._data = outs[out_idx]
+            mutated.add(out_idx)
+        outs = tuple(o for i, o in enumerate(outs) if i not in mutated)
+
+    if out is not None:
+        outs_nd = (out,) if isinstance(out, NDArray) else tuple(out)
+        for o_nd, o in zip(outs_nd, outs):
+            o_nd._data = o
+        return out
+    results = [NDArray(o) for o in outs]
+    return results[0] if len(results) == 1 else results
+
+
+def _make_op_func(op_name):
+    def fn(*args, **kwargs):
+        return imperative_invoke(op_name, args, kwargs)
+    fn.__name__ = op_name
+    op = get_op(op_name)
+    fn.__doc__ = op.doc or ("%s operator (auto-generated from registry)."
+                            % op_name)
+    return fn
+
+
+def _init_ndarray_module():
+    """Attach one python function per registered op to this module."""
+    mod = sys.modules[__name__]
+    for name in list_ops():
+        if not hasattr(mod, name):
+            setattr(mod, name, _make_op_func(name))

@@ -1,0 +1,20 @@
+"""Operator library: single modern registry + op modules.
+
+Importing this package registers every operator (reference:
+``src/operator/``'s static registration; SURVEY.md §2.2 inventory).
+"""
+from .registry import get_op, list_ops, register, OpDef
+
+from . import elemwise      # noqa: F401
+from . import tensor        # noqa: F401
+from . import reduce        # noqa: F401
+from . import init_ops      # noqa: F401
+from . import indexing      # noqa: F401
+from . import nn            # noqa: F401
+from . import softmax       # noqa: F401
+from . import ordering      # noqa: F401
+from . import sampling      # noqa: F401
+from . import sequence      # noqa: F401
+from . import optimizer_op  # noqa: F401
+
+__all__ = ["get_op", "list_ops", "register", "OpDef"]

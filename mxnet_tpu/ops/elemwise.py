@@ -1,0 +1,228 @@
+"""Elementwise operators.
+
+Reference: ``src/operator/tensor/elemwise_unary_op.cc`` /
+``elemwise_binary_op_basic.cc`` / ``elemwise_binary_broadcast_op_*.cc`` /
+``elemwise_binary_scalar_op_*.cc`` / ``elemwise_sum.cc`` and the scalar
+functor zoo in ``src/operator/mshadow_op.h``.  On TPU all of these lower to
+single XLA elementwise HLOs that the compiler fuses into neighbouring
+matmuls/reductions — there is nothing to hand-schedule; the value here is the
+registry surface (names, gradients, shape rules) that NDArray/Symbol expose.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import Dtype, Float, Int, register, register_alias
+
+_f = Float
+
+
+# ---------------------------------------------------------------------------
+# Unary math
+# ---------------------------------------------------------------------------
+def _unary(name, fn, aliases=(), doc=""):
+    register(name, fcompute=lambda attrs, x: fn(x), doc=doc)
+    for a in aliases:
+        register_alias(name, a)
+
+
+_unary("relu", lambda x: jnp.maximum(x, 0))
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("tanh", jnp.tanh)
+_unary("_copy", lambda x: x, aliases=("identity",))
+_unary("negative", jnp.negative)
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.fix)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("erf", jax.scipy.special.erf)
+
+
+# -- gradient-control ops ----------------------------------------------------
+register("BlockGrad", fcompute=lambda attrs, x: jax.lax.stop_gradient(x),
+         doc="Output = input; gradient is blocked (reference stop_gradient).")
+register_alias("BlockGrad", "stop_gradient")
+
+
+@jax.custom_vjp
+def _make_loss_core(x, grad_scale):
+    return x
+
+
+def _ml_fwd(x, grad_scale):
+    return x, (x, grad_scale)
+
+
+def _ml_bwd(res, g):
+    x, grad_scale = res
+    # Reference MakeLoss backward ignores the head gradient and emits
+    # grad_scale * ones (src/operator/make_loss-inl.h semantics).
+    return (jnp.full_like(x, grad_scale), None)
+
+
+_make_loss_core.defvjp(_ml_fwd, _ml_bwd)
+
+register("make_loss",
+         fcompute=lambda attrs, x: _make_loss_core(
+             x, float(attrs.get("grad_scale", 1.0))),
+         attrs={"grad_scale": _f(1.0)},
+         doc="Treat input as a loss head: backward emits grad_scale * ones.")
+
+
+def _cast_infer_type(attrs, in_types):
+    return in_types, [attrs["dtype"]], []
+
+
+register("Cast",
+         fcompute=lambda attrs, x: x.astype(jnp.dtype(attrs["dtype"])),
+         attrs={"dtype": Dtype(required=True)},
+         infer_type=_cast_infer_type)
+register_alias("Cast", "cast")
+
+
+# ---------------------------------------------------------------------------
+# Binary (same-shape) — reference elemwise_binary_op_basic.cc
+# ---------------------------------------------------------------------------
+def _binary(name, fn, aliases=()):
+    register(name, fcompute=lambda attrs, a, b: fn(a, b),
+             arguments=("lhs", "rhs"))
+    for a in aliases:
+        register_alias(name, a)
+
+
+_binary("elemwise_add", jnp.add, aliases=("_plus", "_add"))
+_binary("elemwise_sub", jnp.subtract, aliases=("_minus", "_sub"))
+_binary("elemwise_mul", jnp.multiply, aliases=("_mul",))
+_binary("elemwise_div", jnp.divide, aliases=("_div",))
+_binary("_grad_add", jnp.add)
+_binary("_maximum", jnp.maximum)
+_binary("_minimum", jnp.minimum)
+_binary("_power", jnp.power)
+_binary("_hypot", jnp.hypot)
+_binary("_mod", jnp.mod)
+
+
+# ---------------------------------------------------------------------------
+# Broadcasting binary — reference elemwise_binary_broadcast_op_*.cc
+# ---------------------------------------------------------------------------
+def _broadcast_shape(lhs, rhs):
+    try:
+        return tuple(jnp.broadcast_shapes(tuple(lhs), tuple(rhs)))
+    except ValueError:
+        raise MXNetError("incompatible broadcast shapes %s %s" % (lhs, rhs))
+
+
+def _bcast_infer_shape(attrs, in_shapes):
+    lhs, rhs = in_shapes
+    if lhs is None or rhs is None:
+        return in_shapes, [None], []
+    return in_shapes, [_broadcast_shape(lhs, rhs)], []
+
+
+def _bcast(name, fn, logic=False):
+    it = (lambda attrs, ts: (ts, ["float32"], [])) if logic else None
+    register(name, fcompute=lambda attrs, a, b: (
+        fn(a, b).astype(jnp.float32) if logic else fn(a, b)),
+        arguments=("lhs", "rhs"), infer_shape=_bcast_infer_shape,
+        infer_type=it)
+
+
+_bcast("broadcast_add", jnp.add)
+register_alias("broadcast_add", "broadcast_plus")
+_bcast("broadcast_sub", jnp.subtract)
+register_alias("broadcast_sub", "broadcast_minus")
+_bcast("broadcast_mul", jnp.multiply)
+_bcast("broadcast_div", jnp.divide)
+_bcast("broadcast_power", jnp.power)
+_bcast("broadcast_maximum", jnp.maximum)
+_bcast("broadcast_minimum", jnp.minimum)
+_bcast("broadcast_hypot", jnp.hypot)
+_bcast("broadcast_mod", jnp.mod)
+_bcast("broadcast_equal", jnp.equal, logic=True)
+_bcast("broadcast_not_equal", jnp.not_equal, logic=True)
+_bcast("broadcast_greater", jnp.greater, logic=True)
+_bcast("broadcast_greater_equal", jnp.greater_equal, logic=True)
+_bcast("broadcast_lesser", jnp.less, logic=True)
+_bcast("broadcast_lesser_equal", jnp.less_equal, logic=True)
+
+
+# ---------------------------------------------------------------------------
+# Scalar binary — reference elemwise_binary_scalar_op_*.cc
+# ---------------------------------------------------------------------------
+def _scalar(name, fn):
+    register(name,
+             fcompute=lambda attrs, x: fn(x, jnp.asarray(
+                 attrs["scalar"], dtype=x.dtype)),
+             attrs={"scalar": _f(required=True)})
+
+
+_scalar("_plus_scalar", jnp.add)
+_scalar("_minus_scalar", jnp.subtract)
+_scalar("_rminus_scalar", lambda x, s: s - x)
+_scalar("_mul_scalar", jnp.multiply)
+_scalar("_div_scalar", jnp.divide)
+_scalar("_rdiv_scalar", lambda x, s: s / x)
+_scalar("_power_scalar", jnp.power)
+_scalar("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_scalar("_maximum_scalar", jnp.maximum)
+_scalar("_minimum_scalar", jnp.minimum)
+_scalar("_mod_scalar", jnp.mod)
+_scalar("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_scalar("_equal_scalar", lambda x, s: jnp.equal(x, s).astype(x.dtype))
+_scalar("_not_equal_scalar", lambda x, s: jnp.not_equal(x, s).astype(x.dtype))
+_scalar("_greater_scalar", lambda x, s: jnp.greater(x, s).astype(x.dtype))
+_scalar("_greater_equal_scalar",
+        lambda x, s: jnp.greater_equal(x, s).astype(x.dtype))
+_scalar("_lesser_scalar", lambda x, s: jnp.less(x, s).astype(x.dtype))
+_scalar("_lesser_equal_scalar",
+        lambda x, s: jnp.less_equal(x, s).astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# N-ary sum — reference elemwise_sum.cc (ElementWiseSum / add_n)
+# ---------------------------------------------------------------------------
+def _sum_n(attrs, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+register("add_n", fcompute=_sum_n, arguments=("arg",),
+         attrs={"num_args": Int(required=True)}, key_var_num_args="num_args",
+         doc="Sum of N arrays (reference ElementWiseSum).")
+register_alias("add_n", "ElementWiseSum")
+register_alias("add_n", "_sum")

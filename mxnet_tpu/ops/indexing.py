@@ -1,0 +1,84 @@
+"""Indexing operators: Embedding / take / batch_take / one_hot.
+
+Reference: ``src/operator/tensor/indexing_op.cc``.  Embedding lowers to an XLA
+gather (and its gradient to scatter-add), which is the TPU-native equivalent
+of the reference's AddTakeGrad kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import Dtype, Float, Int, Str, register
+
+
+def _embedding_fc(attrs, data, weight):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+def _embedding_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    in_shapes[1] = (attrs["input_dim"], attrs["output_dim"])
+    if ds is None:
+        return in_shapes, [None], []
+    return in_shapes, [tuple(ds) + (attrs["output_dim"],)], []
+
+
+register("Embedding", fcompute=_embedding_fc,
+         arguments=("data", "weight"),
+         attrs={"input_dim": Int(required=True),
+                "output_dim": Int(required=True), "dtype": Dtype("float32")},
+         infer_shape=_embedding_infer)
+
+
+def _take_fc(attrs, a, indices):
+    mode = attrs["mode"]
+    idx = indices.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[attrs["axis"]] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, a.shape[attrs["axis"]])
+    return jnp.take(a, idx, axis=attrs["axis"])
+
+
+def _take_infer(attrs, in_shapes):
+    sa, si = in_shapes
+    if sa is None or si is None:
+        return in_shapes, [None], []
+    ax = attrs["axis"]
+    return in_shapes, [tuple(sa[:ax]) + tuple(si) + tuple(sa[ax + 1:])], []
+
+
+register("take", fcompute=_take_fc, arguments=("a", "indices"),
+         attrs={"axis": Int(0), "mode": Str("clip")},
+         infer_shape=_take_infer)
+
+
+def _batch_take_fc(attrs, a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1).reshape(-1)
+
+
+register("batch_take", fcompute=_batch_take_fc, arguments=("a", "indices"),
+         infer_shape=lambda attrs, ins: (
+             ins, [None if ins[0] is None else (ins[0][0],)], []))
+
+
+def _one_hot_fc(attrs, indices):
+    return jax.nn.one_hot(indices.astype(jnp.int32), attrs["depth"],
+                          dtype=jnp.dtype(attrs["dtype"] or "float32")) \
+        * (attrs["on_value"] - attrs["off_value"]) + attrs["off_value"]
+
+
+def _one_hot_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    return in_shapes, [tuple(ds) + (attrs["depth"],)], []
+
+
+register("one_hot", fcompute=_one_hot_fc, arguments=("indices",),
+         attrs={"depth": Int(required=True), "on_value": Float(1.0),
+                "off_value": Float(0.0), "dtype": Dtype("float32")},
+         infer_shape=_one_hot_infer,
+         infer_type=lambda attrs, ts: (ts, [attrs["dtype"] or "float32"], []))

@@ -1,0 +1,59 @@
+"""Initialization operators (no tensor inputs).
+
+Reference: ``src/operator/tensor/init_op.cc`` (`_zeros/_ones/_arange/
+zeros_like/ones_like`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Dtype, Float, IntOrNone, Shape, register
+
+
+def _dtype_of(attrs):
+    return jnp.dtype(attrs["dtype"] or "float32")
+
+
+def _register_filler(name, value):
+    register(name,
+             fcompute=lambda attrs: jnp.full(
+                 attrs["shape"], value, dtype=_dtype_of(attrs)),
+             arguments=(),
+             attrs={"shape": Shape(required=True), "dtype": Dtype("float32"),
+                    "ctx": Dtype(None)},
+             infer_shape=lambda attrs, ins: ([], [tuple(attrs["shape"])], []),
+             infer_type=lambda attrs, ts: ([], [attrs["dtype"] or "float32"],
+                                           []))
+
+
+_register_filler("_zeros", 0)
+_register_filler("_ones", 1)
+
+
+def _arange_fc(attrs):
+    arr = jnp.arange(attrs["start"],
+                     attrs["stop"],
+                     attrs["step"], dtype=_dtype_of(attrs))
+    if attrs["repeat"] and attrs["repeat"] > 1:
+        arr = jnp.repeat(arr, attrs["repeat"])
+    return arr
+
+
+def _arange_infer(attrs, ins):
+    start, stop, step = attrs["start"], attrs["stop"], attrs["step"]
+    n = int(np.ceil((stop - start) / step)) if stop is not None else 0
+    n *= max(int(attrs["repeat"] or 1), 1)
+    return [], [(n,)], []
+
+
+register("_arange", fcompute=_arange_fc, arguments=(),
+         attrs={"start": Float(0.0), "stop": Float(None),
+                "step": Float(1.0), "repeat": IntOrNone(1),
+                "dtype": Dtype("float32"), "ctx": Dtype(None)},
+         infer_shape=_arange_infer,
+         infer_type=lambda attrs, ts: ([], [attrs["dtype"] or "float32"], []))
+
+
+register("zeros_like", fcompute=lambda attrs, x: jnp.zeros_like(x))
+register("ones_like", fcompute=lambda attrs, x: jnp.ones_like(x))

@@ -1,0 +1,464 @@
+"""Neural-network layer operators.
+
+Reference: the legacy `MXNET_REGISTER_OP_PROPERTY` layers —
+``src/operator/fully_connected.cc``, ``activation.cc``, ``convolution.cc``,
+``deconvolution.cc``, ``pooling.cc``, ``batch_norm.cc``, ``dropout.cc``,
+``lrn.cc``, ``leaky_relu.cc``, ``instance_norm.cc``, ``l2_normalization.cc``,
+``upsampling.cc`` and their ``cudnn_*-inl.h``/MIOpen twins.
+
+TPU-native: every layer is a pure JAX computation — conv/matmul go straight to
+``lax.conv_general_dilated`` / ``jnp.matmul`` so XLA tiles them on the MXU;
+there is no algorithm autotuning cache (``cudnn_algoreg-inl.h``) to rebuild
+because XLA owns scheduling.  Data layout follows the reference's NCHW API
+(layout conversion for TPU happens inside XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import (Bool, Dtype, Float, Int, IntOrNone, Shape, Str,
+                       register, register_alias)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+def _fc_args(attrs):
+    return ["data", "weight"] if attrs["no_bias"] else \
+        ["data", "weight", "bias"]
+
+
+def _fc_fcompute(attrs, data, weight, bias=None):
+    x = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(x, weight.T)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _fc_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    nh = attrs["num_hidden"]
+    if ds is not None:
+        d = int(np.prod(ds[1:]))
+        in_shapes[1] = (nh, d)
+        if not attrs["no_bias"]:
+            in_shapes[2] = (nh,)
+        return in_shapes, [(ds[0], nh)], []
+    return in_shapes, [None], []
+
+
+register("FullyConnected", fcompute=_fc_fcompute, arguments=_fc_args,
+         attrs={"num_hidden": Int(required=True), "no_bias": Bool(False)},
+         infer_shape=_fc_infer,
+         doc="Y = X·Wᵀ + b (reference src/operator/fully_connected.cc). "
+             "Lowers to one MXU matmul.")
+
+
+# ---------------------------------------------------------------------------
+# Activation / LeakyReLU
+# ---------------------------------------------------------------------------
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+register("Activation",
+         fcompute=lambda attrs, x: _ACTS[attrs["act_type"]](x),
+         attrs={"act_type": Str(required=True)})
+
+
+def _leaky_args(attrs):
+    return ["data", "gamma"] if attrs["act_type"] == "prelu" else ["data"]
+
+
+def _leaky_fc(attrs, data, gamma=None):
+    t = attrs["act_type"]
+    slope = attrs["slope"]
+    if t == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if t == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if t == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if t == "rrelu":
+        # deterministic midpoint in inference; training-mode random slope is
+        # sampled by the stateful wrapper below
+        mid = (attrs["lower_bound"] + attrs["upper_bound"]) / 2
+        return jnp.where(data > 0, data, mid * data)
+    raise MXNetError("unknown LeakyReLU act_type %r" % t)
+
+
+def _leaky_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if attrs["act_type"] == "prelu" and ds is not None:
+        in_shapes[1] = (ds[1],)
+    return in_shapes, [ds], []
+
+
+register("LeakyReLU", fcompute=_leaky_fc, arguments=_leaky_args,
+         attrs={"act_type": Str("leaky"), "slope": Float(0.25),
+                "lower_bound": Float(0.125), "upper_bound": Float(0.334)},
+         infer_shape=_leaky_infer)
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+def _conv_args(attrs):
+    return ["data", "weight"] if attrs["no_bias"] else \
+        ["data", "weight", "bias"]
+
+
+def _tuple_n(v, n, name):
+    if v is None:
+        return (1,) * n if name != "pad" else (0,) * n
+    if len(v) != n:
+        raise MXNetError("%s must have %d elements, got %s" % (name, n, v))
+    return tuple(v)
+
+
+def _conv_dims(attrs):
+    return len(attrs["kernel"])
+
+
+def _conv_fcompute(attrs, data, weight, bias=None):
+    n = _conv_dims(attrs)
+    stride = _tuple_n(attrs["stride"], n, "stride")
+    pad = _tuple_n(attrs["pad"], n, "pad")
+    dilate = _tuple_n(attrs["dilate"], n, "dilate")
+    spatial = "DHW"[-n:] if n <= 3 else None
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    out = jax.lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=attrs["num_group"])
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def _conv_out_dim(d, k, s, p, dil):
+    return (d + 2 * p - (dil * (k - 1) + 1)) // s + 1
+
+
+def _conv_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    n = _conv_dims(attrs)
+    kernel = tuple(attrs["kernel"])
+    stride = _tuple_n(attrs["stride"], n, "stride")
+    pad = _tuple_n(attrs["pad"], n, "pad")
+    dilate = _tuple_n(attrs["dilate"], n, "dilate")
+    nf, ng = attrs["num_filter"], attrs["num_group"]
+    in_shapes[1] = (nf, ds[1] // ng) + kernel
+    if not attrs["no_bias"]:
+        in_shapes[2] = (nf,)
+    spatial = tuple(_conv_out_dim(d, k, s, p, dil) for d, k, s, p, dil
+                    in zip(ds[2:], kernel, stride, pad, dilate))
+    return in_shapes, [(ds[0], nf) + spatial], []
+
+
+_CONV_ATTRS = {
+    "kernel": Shape(required=True), "stride": Shape(None), "pad": Shape(None),
+    "dilate": Shape(None), "num_filter": Int(required=True),
+    "num_group": Int(1), "no_bias": Bool(False),
+    "workspace": Int(1024), "cudnn_tune": Str(None),
+    "cudnn_off": Bool(False), "layout": Str(None),
+}
+
+register("Convolution", fcompute=_conv_fcompute, arguments=_conv_args,
+         attrs=_CONV_ATTRS, infer_shape=_conv_infer,
+         doc="N-D convolution, NCHW/OIHW (reference convolution.cc). "
+             "workspace/cudnn_* attrs are accepted no-ops on TPU.")
+register_alias("Convolution", "Convolution_v1")
+
+
+def _deconv_fcompute(attrs, data, weight, bias=None):
+    n = _conv_dims(attrs)
+    stride = _tuple_n(attrs["stride"], n, "stride")
+    pad = _tuple_n(attrs["pad"], n, "pad")
+    spatial = "DHW"[-n:]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "IO" + spatial, "NC" + spatial))
+    k = tuple(attrs["kernel"])
+    out = jax.lax.conv_transpose(
+        data, weight, strides=stride,
+        padding=[(p, p) for p in pad],
+        dimension_numbers=dn, transpose_kernel=True)
+    # conv_transpose with 'transpose_kernel' matches gradient-of-conv
+    # semantics, which is exactly the reference Deconvolution definition.
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def _deconv_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    n = _conv_dims(attrs)
+    kernel = tuple(attrs["kernel"])
+    stride = _tuple_n(attrs["stride"], n, "stride")
+    pad = _tuple_n(attrs["pad"], n, "pad")
+    adj = _tuple_n(attrs["adj"], n, "adj") if attrs["adj"] else (0,) * n
+    nf = attrs["num_filter"]
+    in_shapes[1] = (ds[1], nf // attrs["num_group"]) + kernel
+    if not attrs["no_bias"]:
+        in_shapes[2] = (nf,)
+    spatial = tuple((d - 1) * s - 2 * p + k + a for d, k, s, p, a
+                    in zip(ds[2:], kernel, stride, pad, adj))
+    return in_shapes, [(ds[0], nf) + spatial], []
+
+
+register("Deconvolution", fcompute=_deconv_fcompute, arguments=_conv_args,
+         attrs=dict(_CONV_ATTRS, adj=Shape(None), target_shape=Shape(None)),
+         infer_shape=_deconv_infer)
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+def _pool_fcompute(attrs, data):
+    n = len(attrs["kernel"]) if attrs["kernel"] else data.ndim - 2
+    if attrs["global_pool"]:
+        axes = tuple(range(2, data.ndim))
+        if attrs["pool_type"] == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = tuple(attrs["kernel"])
+    stride = _tuple_n(attrs["stride"], n, "stride")
+    pad = _tuple_n(attrs["pad"], n, "pad")
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if attrs["pooling_convention"] == "full":
+        # ceil-mode output: widen the trailing pad so reduce_window covers
+        # the partial window (reference pooling_convention=full)
+        full_pads = [(0, 0), (0, 0)]
+        for d, k, s, p in zip(data.shape[2:], kernel, stride, pad):
+            out = int(np.ceil((d + 2 * p - k) / s)) + 1
+            need = (out - 1) * s + k - d - p
+            full_pads.append((p, max(need, p)))
+        pads = tuple(full_pads)
+    if attrs["pool_type"] == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window,
+                                     strides, pads)
+    if attrs["pool_type"] == "sum":
+        return jax.lax.reduce_window(data, 0.0, jax.lax.add, window,
+                                     strides, pads)
+    # avg: count includes padding, like the reference's default pooling
+    s = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, pads)
+    return s / float(np.prod(kernel))
+
+
+def _pool_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    if attrs["global_pool"]:
+        return in_shapes, [tuple(ds[:2]) + (1,) * (len(ds) - 2)], []
+    n = len(attrs["kernel"])
+    kernel = tuple(attrs["kernel"])
+    stride = _tuple_n(attrs["stride"], n, "stride")
+    pad = _tuple_n(attrs["pad"], n, "pad")
+    rounder = np.ceil if attrs["pooling_convention"] == "full" else np.floor
+    spatial = tuple(int(rounder((d + 2 * p - k) / s)) + 1
+                    for d, k, s, p in zip(ds[2:], kernel, stride, pad))
+    return in_shapes, [tuple(ds[:2]) + spatial], []
+
+
+register("Pooling", fcompute=_pool_fcompute,
+         attrs={"kernel": Shape(None), "pool_type": Str("max"),
+                "global_pool": Bool(False), "stride": Shape(None),
+                "pad": Shape(None), "pooling_convention": Str("valid")},
+         infer_shape=_pool_infer)
+register_alias("Pooling", "Pooling_v1")
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (stateful: aux moving_mean/moving_var; reference batch_norm.cc)
+# ---------------------------------------------------------------------------
+def _bn_fstateful(attrs, inputs, aux, is_train, rng):
+    data, gamma, beta = inputs
+    moving_mean, moving_var = aux
+    eps, momentum = attrs["eps"], attrs["momentum"]
+    axes = (0,) + tuple(range(2, data.ndim))
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    if attrs["fix_gamma"]:
+        gamma = jnp.ones_like(gamma)
+    use_global = attrs["use_global_stats"] or not is_train
+    if use_global:
+        mean, var = moving_mean, moving_var
+        new_aux = (moving_mean, moving_var)
+    else:
+        mean = jnp.mean(data, axis=axes)
+        var = jnp.var(data, axis=axes)
+        new_aux = (momentum * moving_mean + (1 - momentum) * mean,
+                   momentum * moving_var + (1 - momentum) * var)
+    inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+    out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+    if attrs["output_mean_var"]:
+        return (out, mean, var), new_aux
+    return (out,), new_aux
+
+
+def _bn_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None] * (3 if attrs["output_mean_var"] else 1), \
+            [None, None]
+    c = (ds[1],)
+    in_shapes[1] = c
+    in_shapes[2] = c
+    outs = [ds, c, c] if attrs["output_mean_var"] else [ds]
+    return in_shapes, outs, [c, c]
+
+
+register("BatchNorm",
+         fstateful=_bn_fstateful,
+         arguments=("data", "gamma", "beta"),
+         aux_states=("moving_mean", "moving_var"),
+         attrs={"eps": Float(1e-3), "momentum": Float(0.9),
+                "fix_gamma": Bool(True), "use_global_stats": Bool(False),
+                "output_mean_var": Bool(False)},
+         num_outputs=lambda attrs: 3 if attrs["output_mean_var"] else 1,
+         outputs=lambda attrs: (["output", "mean", "var"]
+                                if attrs["output_mean_var"] else ["output"]),
+         infer_shape=_bn_infer,
+         doc="Batch normalization with moving-average aux state "
+             "(reference src/operator/batch_norm.cc).")
+
+
+# ---------------------------------------------------------------------------
+# Dropout (train-mode RNG)
+# ---------------------------------------------------------------------------
+def _dropout_fstateful(attrs, inputs, aux, is_train, rng):
+    (data,) = inputs
+    p = attrs["p"]
+    if not is_train or p <= 0:
+        return (data,), ()
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, data.shape)
+    return ((data * mask) / keep,), ()
+
+
+register("Dropout", fstateful=_dropout_fstateful,
+         attrs={"p": Float(0.5)}, needs_rng=True,
+         doc="Inverted dropout; identity at inference "
+             "(reference src/operator/dropout.cc).")
+
+
+# ---------------------------------------------------------------------------
+# LRN (reference lrn.cc: cross-channel local response normalization)
+# ---------------------------------------------------------------------------
+def _lrn_fc(attrs, x):
+    alpha, beta, knorm, nsize = (attrs["alpha"], attrs["beta"],
+                                 attrs["knorm"], attrs["nsize"])
+    sq = jnp.square(x)
+    half = nsize // 2
+    # sum over channel window via padded cumulative trick
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, half)
+    sqp = jnp.pad(sq, pads)
+    acc = sum(sqp[:, i:i + x.shape[1]] for i in range(nsize))
+    return x * jnp.power(knorm + (alpha / nsize) * acc, -beta)
+
+
+register("LRN", fcompute=_lrn_fc,
+         attrs={"alpha": Float(1e-4), "beta": Float(0.75),
+                "knorm": Float(2.0), "nsize": Int(required=True)})
+
+
+# ---------------------------------------------------------------------------
+# InstanceNorm / L2Normalization
+# ---------------------------------------------------------------------------
+def _in_fc(attrs, data, gamma, beta):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + attrs["eps"]) \
+        * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+def _in_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    in_shapes[1] = (ds[1],)
+    in_shapes[2] = (ds[1],)
+    return in_shapes, [ds], []
+
+
+register("InstanceNorm", fcompute=_in_fc,
+         arguments=("data", "gamma", "beta"),
+         attrs={"eps": Float(1e-3)}, infer_shape=_in_infer)
+
+
+def _l2norm_fc(attrs, x):
+    eps, mode = attrs["eps"], attrs["mode"]
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise MXNetError("unknown L2Normalization mode %r" % mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+register("L2Normalization", fcompute=_l2norm_fc,
+         attrs={"eps": Float(1e-10), "mode": Str("instance")})
+
+
+# ---------------------------------------------------------------------------
+# UpSampling (reference upsampling.cc; nearest only — bilinear kernel weights
+# variant maps to Deconvolution)
+# ---------------------------------------------------------------------------
+def _upsampling_fc(attrs, *xs):
+    scale = attrs["scale"]
+    outs = []
+    target = None
+    for x in xs:
+        y = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        if target is None:
+            target = y.shape[2:]
+        outs.append(y)
+    if len(outs) == 1:
+        return outs[0]
+    return jnp.concatenate(outs, axis=1)
+
+
+def _upsampling_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    scale = attrs["scale"]
+    c = sum(s[1] for s in in_shapes if s is not None)
+    return in_shapes, [(ds[0], c, ds[2] * scale, ds[3] * scale)], []
+
+
+register("UpSampling", fcompute=_upsampling_fc, arguments=("arg",),
+         key_var_num_args="num_args",
+         attrs={"scale": Int(required=True), "num_args": Int(required=True),
+                "sample_type": Str("nearest"), "num_filter": Int(0),
+                "multi_input_mode": Str("concat"), "workspace": Int(512)},
+         infer_shape=_upsampling_infer)

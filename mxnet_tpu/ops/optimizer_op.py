@@ -1,0 +1,98 @@
+"""Fused optimizer-update operators.
+
+Reference: ``src/operator/optimizer_op.cc:18-156`` (`sgd_update`,
+`sgd_mom_update`, `adam_update`, `rmsprop_update`, `rmspropalex_update`) —
+the kernels python optimizers actually call.  Each is one fused XLA
+computation; state inputs (momentum etc.) are mutated in place at the NDArray
+layer via the registry's ``mutate`` mechanism (reference FMutateInputs).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import Float, register
+
+
+def _prep_grad(grad, weight, attrs):
+    g = grad * attrs["rescale_grad"]
+    if attrs["clip_gradient"] > 0:
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    return g + attrs["wd"] * weight
+
+
+_COMMON = {"lr": Float(required=True), "wd": Float(0.0),
+           "rescale_grad": Float(1.0), "clip_gradient": Float(-1.0)}
+
+
+def _sgd_update(attrs, weight, grad):
+    return weight - attrs["lr"] * _prep_grad(grad, weight, attrs)
+
+
+register("sgd_update", fcompute=_sgd_update,
+         arguments=("weight", "grad"), attrs=dict(_COMMON))
+
+
+def _sgd_mom_update(attrs, weight, grad, mom):
+    g = _prep_grad(grad, weight, attrs)
+    mom_new = attrs["momentum"] * mom - attrs["lr"] * g
+    return weight + mom_new, mom_new
+
+
+register("sgd_mom_update", fcompute=_sgd_mom_update,
+         arguments=("weight", "grad", "mom"),
+         attrs=dict(_COMMON, momentum=Float(0.0)),
+         num_outputs=1, mutate=((1, 2),))
+
+
+def _adam_update(attrs, weight, grad, mean, var):
+    g = _prep_grad(grad, weight, attrs)
+    b1, b2 = attrs["beta1"], attrs["beta2"]
+    mean_new = b1 * mean + (1 - b1) * g
+    var_new = b2 * var + (1 - b2) * jnp.square(g)
+    w = weight - attrs["lr"] * mean_new / (jnp.sqrt(var_new) +
+                                           attrs["epsilon"])
+    return w, mean_new, var_new
+
+
+register("adam_update", fcompute=_adam_update,
+         arguments=("weight", "grad", "mean", "var"),
+         attrs=dict(_COMMON, beta1=Float(0.9), beta2=Float(0.999),
+                    epsilon=Float(1e-8)),
+         num_outputs=1, mutate=((1, 2), (2, 3)))
+
+
+def _rmsprop_update(attrs, weight, grad, n):
+    g = _prep_grad(grad, weight, attrs)
+    rho = attrs["gamma1"]
+    n_new = rho * n + (1 - rho) * jnp.square(g)
+    w = weight - attrs["lr"] * g / jnp.sqrt(n_new + attrs["epsilon"])
+    if attrs["clip_weights"] > 0:
+        w = jnp.clip(w, -attrs["clip_weights"], attrs["clip_weights"])
+    return w, n_new
+
+
+register("rmsprop_update", fcompute=_rmsprop_update,
+         arguments=("weight", "grad", "n"),
+         attrs=dict(_COMMON, gamma1=Float(0.95), epsilon=Float(1e-8),
+                    clip_weights=Float(-1.0)),
+         num_outputs=1, mutate=((1, 2),))
+
+
+def _rmspropalex_update(attrs, weight, grad, n, g_avg, delta):
+    g = _prep_grad(grad, weight, attrs)
+    rho, mom = attrs["gamma1"], attrs["gamma2"]
+    n_new = rho * n + (1 - rho) * jnp.square(g)
+    g_new = rho * g_avg + (1 - rho) * g
+    delta_new = mom * delta - attrs["lr"] * g / jnp.sqrt(
+        n_new - jnp.square(g_new) + attrs["epsilon"])
+    w = weight + delta_new
+    if attrs["clip_weights"] > 0:
+        w = jnp.clip(w, -attrs["clip_weights"], attrs["clip_weights"])
+    return w, n_new, g_new, delta_new
+
+
+register("rmspropalex_update", fcompute=_rmspropalex_update,
+         arguments=("weight", "grad", "n", "g", "delta"),
+         attrs=dict(_COMMON, gamma1=Float(0.95), gamma2=Float(0.9),
+                    epsilon=Float(1e-8), clip_weights=Float(-1.0)),
+         num_outputs=1, mutate=((1, 2), (2, 3), (3, 4)))

@@ -1,0 +1,114 @@
+"""Ordering operators: sort / argsort / topk.
+
+Reference: ``src/operator/tensor/ordering_op.cc``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import Bool, Int, IntOrNone, Str, register
+
+
+def _resolve_axis(axis, ndim):
+    if axis is None:
+        return None
+    return axis % ndim
+
+
+def _sort_fc(attrs, x):
+    ax = _resolve_axis(attrs["axis"], x.ndim)
+    if ax is None:
+        x = x.reshape(-1)
+        ax = 0
+    out = jnp.sort(x, axis=ax)
+    if not attrs["is_ascend"]:
+        out = jnp.flip(out, axis=ax)
+    return out
+
+
+register("sort", fcompute=_sort_fc,
+         attrs={"axis": IntOrNone(-1), "is_ascend": Bool(True)},
+         infer_shape=lambda attrs, ins: (
+             ins, [ins[0] if attrs["axis"] is not None or ins[0] is None
+                   else (int(jnp.prod(jnp.array(ins[0]))),)], []))
+
+
+def _argsort_fc(attrs, x):
+    ax = _resolve_axis(attrs["axis"], x.ndim)
+    if ax is None:
+        x = x.reshape(-1)
+        ax = 0
+    idx = jnp.argsort(x, axis=ax)
+    if not attrs["is_ascend"]:
+        idx = jnp.flip(idx, axis=ax)
+    return idx.astype(jnp.float32)
+
+
+register("argsort", fcompute=_argsort_fc,
+         attrs={"axis": IntOrNone(-1), "is_ascend": Bool(True)},
+         infer_type=lambda attrs, ts: (ts, ["float32"], []))
+
+
+def _topk_shapes(attrs, ds):
+    ax = _resolve_axis(attrs["axis"], len(ds)) if ds else 0
+    k = attrs["k"]
+    if ax is None:
+        base = (int(jnp.prod(jnp.array(ds))),)
+        ax = 0
+    else:
+        base = tuple(ds)
+    out = list(base)
+    if attrs["ret_typ"] != "mask":
+        out[ax] = k
+    return tuple(out)
+
+
+def _topk_fc(attrs, x):
+    ax = _resolve_axis(attrs["axis"], x.ndim)
+    if ax is None:
+        x = x.reshape(-1)
+        ax = 0
+    k = attrs["k"]
+    sign = 1 if attrs["is_ascend"] else -1
+    idx_sorted = jnp.argsort(sign * x, axis=ax)
+    idx = jnp.take(idx_sorted, jnp.arange(k), axis=ax)
+    vals = jnp.take_along_axis(x, idx, axis=ax)
+    rt = attrs["ret_typ"]
+    if rt == "value":
+        return vals
+    if rt == "indices":
+        return idx.astype(jnp.float32)
+    if rt == "both":
+        return vals, idx.astype(jnp.float32)
+    if rt == "mask":
+        mask = jnp.zeros_like(x)
+        mask = jnp.put_along_axis(mask, idx, 1.0, axis=ax,
+                                  inplace=False)
+        return mask
+    raise MXNetError("unknown ret_typ %r" % rt)
+
+
+def _topk_infer(attrs, ins):
+    (ds,) = ins
+    if ds is None:
+        n = 2 if attrs["ret_typ"] == "both" else 1
+        return ins, [None] * n, []
+    out = _topk_shapes(attrs, ds)
+    if attrs["ret_typ"] == "both":
+        return ins, [out, out], []
+    return ins, [out], []
+
+
+register("topk", fcompute=_topk_fc,
+         attrs={"axis": IntOrNone(-1), "k": Int(1),
+                "ret_typ": Str("indices"), "is_ascend": Bool(False)},
+         num_outputs=lambda attrs: 2 if attrs["ret_typ"] == "both" else 1,
+         outputs=lambda attrs: (["value", "indices"]
+                                if attrs["ret_typ"] == "both"
+                                else ["output"]),
+         infer_shape=_topk_infer,
+         infer_type=lambda attrs, ts: (
+             ts, [ts[0], "float32"] if attrs["ret_typ"] == "both"
+             else ["float32" if attrs["ret_typ"] == "indices" else ts[0]],
+             []))

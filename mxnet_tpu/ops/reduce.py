@@ -1,0 +1,168 @@
+"""Reduction / broadcasting-axis operators.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op_value.cc`` /
+``broadcast_reduce_op_index.cc`` (sum/mean/prod/nansum/nanprod/max/min/norm,
+argmax/argmin/argmax_channel, broadcast_to/broadcast_axis).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import Bool, Int, IntOrNone, Shape, register, register_alias
+
+
+def _norm_axes(axis, ndim):
+    if axis is None or axis == ():
+        return tuple(range(ndim))
+    if isinstance(axis, int):
+        axis = (axis,)
+    return tuple(a % ndim for a in axis)
+
+
+def _reduce_out_shape(ds, axis, keepdims, exclude=False):
+    axes = _norm_axes(axis, len(ds))
+    if exclude:
+        axes = tuple(i for i in range(len(ds)) if i not in axes)
+    if keepdims:
+        return tuple(1 if i in axes else d for i, d in enumerate(ds))
+    return tuple(d for i, d in enumerate(ds) if i not in axes)
+
+
+def _reduce_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    out = _reduce_out_shape(ds, attrs["axis"], attrs["keepdims"],
+                            attrs.get("exclude", False))
+    return in_shapes, [out], []
+
+
+def _register_reduce(name, fn, aliases=()):
+    def fc(attrs, x):
+        axes = _norm_axes(attrs["axis"], x.ndim)
+        if attrs.get("exclude", False):
+            axes = tuple(i for i in range(x.ndim) if i not in axes)
+        return fn(x, axis=axes, keepdims=attrs["keepdims"])
+
+    register(name, fcompute=fc,
+             attrs={"axis": Shape(None), "keepdims": Bool(False),
+                    "exclude": Bool(False)},
+             infer_shape=_reduce_infer)
+    for a in aliases:
+        register_alias(name, a)
+
+
+_register_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_register_reduce("mean", jnp.mean)
+_register_reduce("prod", jnp.prod)
+_register_reduce("nansum", jnp.nansum)
+_register_reduce("nanprod", jnp.nanprod)
+_register_reduce("max", jnp.max, aliases=("max_axis",))
+_register_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+def _norm_fc(attrs, x):
+    return jnp.sqrt(jnp.sum(jnp.square(x)))
+
+
+register("norm", fcompute=_norm_fc,
+         infer_shape=lambda attrs, ins: (ins, [()], []),
+         doc="L2 norm over all elements (reference norm).")
+
+
+# -- arg reductions (float32 outputs, matching reference behavior) -----------
+def _arg_reduce_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    ax = attrs["axis"]
+    if ax is None:
+        return in_shapes, [() if not attrs["keepdims"]
+                           else (1,) * len(ds)], []
+    out = _reduce_out_shape(ds, ax, attrs["keepdims"])
+    return in_shapes, [out], []
+
+
+def _register_argreduce(name, fn):
+    def fc(attrs, x):
+        ax = attrs["axis"]
+        if ax is None:
+            res = fn(x.reshape(-1), axis=0)
+            if attrs["keepdims"]:
+                res = res.reshape((1,) * x.ndim)
+            return res.astype(jnp.float32)
+        res = fn(x, axis=ax)
+        if attrs["keepdims"]:
+            res = jnp.expand_dims(res, ax)
+        return res.astype(jnp.float32)
+
+    register(name, fcompute=fc,
+             attrs={"axis": IntOrNone(None), "keepdims": Bool(False)},
+             infer_shape=_arg_reduce_infer,
+             infer_type=lambda attrs, ts: (ts, ["float32"], []))
+
+
+_register_argreduce("argmax", jnp.argmax)
+_register_argreduce("argmin", jnp.argmin)
+
+
+register("argmax_channel",
+         fcompute=lambda attrs, x: jnp.argmax(x, axis=1).astype(jnp.float32),
+         infer_shape=lambda attrs, ins: (
+             ins, [None if ins[0] is None else
+                   (ins[0][0],) + tuple(ins[0][2:])], []),
+         infer_type=lambda attrs, ts: (ts, ["float32"], []))
+
+
+# -- broadcast_to / broadcast_axis -------------------------------------------
+def _broadcast_to_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    tgt = attrs["shape"]
+    if ds is None:
+        return in_shapes, [tuple(tgt)], []
+    out = tuple(t if t != 0 else d for t, d in zip(tgt, ds))
+    return in_shapes, [out], []
+
+
+def _broadcast_to_fc(attrs, x):
+    tgt = tuple(t if t != 0 else d for t, d in zip(attrs["shape"], x.shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+register("broadcast_to", fcompute=_broadcast_to_fc,
+         attrs={"shape": Shape(required=True)},
+         infer_shape=_broadcast_to_infer)
+
+
+def _broadcast_axis_fc(attrs, x):
+    axes = attrs["axis"]
+    sizes = attrs["size"]
+    if isinstance(axes, int):
+        axes = (axes,)
+    if isinstance(sizes, int):
+        sizes = (sizes,)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        if x.shape[a] != 1:
+            raise MXNetError("broadcast_axis: axis %d must have size 1" % a)
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+def _broadcast_axis_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    tgt = list(ds)
+    axes, sizes = attrs["axis"], attrs["size"]
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return in_shapes, [tuple(tgt)], []
+
+
+register("broadcast_axis", fcompute=_broadcast_axis_fc,
+         attrs={"axis": Shape(required=True), "size": Shape(required=True)},
+         infer_shape=_broadcast_axis_infer)
+register_alias("broadcast_axis", "broadcast_axes")

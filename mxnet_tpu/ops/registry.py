@@ -1,0 +1,310 @@
+"""Operator registry.
+
+The reference ships TWO registration styles (legacy ``MXNET_REGISTER_OP_PROPERTY``
+layers plus NNVM ``NNVM_REGISTER_OP`` stateless ops) bridged by
+``src/nnvm/legacy_op_util.cc``.  Its own history says: don't do that.  This is
+the single modern registry (SURVEY.md §7.4): every operator — layer or
+elementwise — is one ``OpDef`` carrying
+
+* ``fcompute``  — a *pure, traceable* JAX function ``(attrs, *inputs) -> out(s)``
+* ``fstateful`` — for ops with auxiliary state / train-mode behavior / RNG
+  (BatchNorm, Dropout, RNN, samplers):
+  ``(attrs, inputs, aux, is_train, rng) -> (outputs, new_aux)``
+* shape/type inference (bidirectional enough for ``simple_bind`` to infer
+  parameter shapes from data shapes, like nnvm's InferShape pass)
+* argument/output/aux naming (feeds ``Symbol.list_arguments`` etc.)
+* a typed attr parser (the dmlc-parameter equivalent: typed, defaulted,
+  documented kwargs parsed from python values or JSON strings —
+  reference ``DMLC_DECLARE_PARAMETER`` in every ``-inl.h``)
+
+Gradients are not hand-registered: executors differentiate ``fcompute`` with
+``jax.vjp``.  Ops with non-standard backward semantics (SoftmaxOutput's
+implicit loss gradient, BlockGrad, make_loss) encode them via
+``jax.custom_vjp`` inside their fcompute.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "AttrSpec",
+           "Int", "Float", "Bool", "Str", "Shape", "Dtype", "IntOrNone",
+           "elemwise_shape_infer", "elemwise_type_infer"]
+
+_OP_REGISTRY: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# Typed attribute parsing (dmlc-parameter equivalent)
+# ---------------------------------------------------------------------------
+def _parse_bool(v):
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+def _parse_shape(v):
+    if v is None:
+        return None
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
+
+
+def _parse_int_or_none(v):
+    if v is None or v == "None":
+        return None
+    return int(v)
+
+
+def _parse_dtype(v):
+    if v is None or v == "None":
+        return None
+    return np.dtype(v).name
+
+
+def Int(default=None, required=False, doc=""):
+    return AttrSpec(int, default, required, doc)
+
+
+def IntOrNone(default=None, doc=""):
+    return AttrSpec(_parse_int_or_none, default, False, doc)
+
+
+def Float(default=None, required=False, doc=""):
+    return AttrSpec(float, default, required, doc)
+
+
+def Bool(default=False, required=False, doc=""):
+    return AttrSpec(_parse_bool, default, required, doc)
+
+
+def Str(default=None, required=False, doc=""):
+    return AttrSpec(str, default, required, doc)
+
+
+def Shape(default=None, required=False, doc=""):
+    return AttrSpec(_parse_shape, default, required, doc)
+
+
+def Dtype(default=None, required=False, doc=""):
+    return AttrSpec(_parse_dtype, default, required, doc)
+
+
+class AttrSpec:
+    __slots__ = ("parse", "default", "required", "doc")
+
+    def __init__(self, parse, default, required, doc):
+        self.parse = parse
+        self.default = default
+        self.required = required
+        self.doc = doc
+
+
+# ---------------------------------------------------------------------------
+# OpDef
+# ---------------------------------------------------------------------------
+class OpDef:
+    """A registered operator."""
+
+    def __init__(self, name, fcompute=None, fstateful=None, attrs=None,
+                 arguments=("data",), outputs=("output",), aux_states=(),
+                 infer_shape=None, infer_type=None, num_outputs=1,
+                 key_var_num_args=None, needs_rng=False, mutate=(), doc=""):
+        self.name = name
+        self.fcompute = fcompute
+        self.fstateful = fstateful
+        self.attr_specs = dict(attrs or {})
+        self._arguments = arguments
+        self._outputs = outputs
+        self._aux_states = aux_states
+        self._infer_shape = infer_shape
+        self._infer_type = infer_type
+        self._num_outputs = num_outputs
+        # name of the attr holding the variadic input count (Concat: num_args)
+        self.key_var_num_args = key_var_num_args
+        self.needs_rng = needs_rng
+        # ((out_idx, arg_idx), ...): extra outputs written back into input
+        # handles by imperative_invoke (reference FMutateInputs — optimizer
+        # update ops mutate their state inputs, op_attr_types.h)
+        self.mutate = tuple(mutate)
+        self.stateful = fstateful is not None
+        self.doc = doc
+
+    # -- attrs -------------------------------------------------------------
+    def parse_attrs(self, raw):
+        """Parse raw kwargs (python values or strings) into a typed dict."""
+        out = {}
+        for k, spec in self.attr_specs.items():
+            if k in raw:
+                v = raw[k]
+                out[k] = spec.parse(v) if v is not None else None
+            elif spec.required:
+                raise MXNetError(
+                    "op %s: required attribute %r missing" % (self.name, k))
+            else:
+                out[k] = spec.default
+        unknown = set(raw) - set(self.attr_specs)
+        # Symbol-level annotations (__ctx_group__, __lr_mult__...) pass through
+        unknown = {k for k in unknown if not k.startswith("__")}
+        if unknown:
+            raise MXNetError("op %s: unknown attributes %s"
+                             % (self.name, sorted(unknown)))
+        return out
+
+    def serialize_attrs(self, attrs):
+        """Typed attrs -> string dict (for JSON graph save, reference format)."""
+        out = {}
+        for k, v in attrs.items():
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                out[k] = "True" if v else "False"
+            else:
+                out[k] = str(v)
+        return out
+
+    # -- structure ---------------------------------------------------------
+    def arguments(self, attrs):
+        args = self._arguments
+        if callable(args):
+            return list(args(attrs))
+        if self.key_var_num_args is not None:
+            n = int(attrs[self.key_var_num_args])
+            base = args[0] if args else "arg"
+            return ["%s%d" % (base, i) for i in range(n)]
+        return list(args)
+
+    def outputs(self, attrs):
+        outs = self._outputs
+        if callable(outs):
+            return list(outs(attrs))
+        return list(outs)
+
+    def aux_states(self, attrs):
+        aux = self._aux_states
+        if callable(aux):
+            return list(aux(attrs))
+        return list(aux)
+
+    def num_inputs(self, attrs):
+        return len(self.arguments(attrs))
+
+    def num_outputs(self, attrs):
+        n = self._num_outputs
+        if callable(n):
+            return int(n(attrs))
+        return int(n)
+
+    # -- inference ---------------------------------------------------------
+    def infer_shape(self, attrs, in_shapes, aux_shapes=None):
+        """Returns (in_shapes, out_shapes, aux_shapes); entries may stay None
+        if underdetermined.  in_shapes entries are tuples or None."""
+        if self._infer_shape is None:
+            return elemwise_shape_infer(self, attrs, in_shapes)
+        res = self._infer_shape(attrs, list(in_shapes))
+        if len(res) == 2:
+            ins, outs = res
+            aux = [None] * len(self.aux_states(attrs))
+        else:
+            ins, outs, aux = res
+        return list(ins), list(outs), list(aux)
+
+    def infer_type(self, attrs, in_types):
+        if self._infer_type is None:
+            return elemwise_type_infer(self, attrs, in_types)
+        res = self._infer_type(attrs, list(in_types))
+        if len(res) == 2:
+            ins, outs = res
+            aux = [in_types[0] if in_types else "float32"] * len(
+                self.aux_states(attrs))
+        else:
+            ins, outs, aux = res
+        return list(ins), list(outs), list(aux)
+
+    # -- execution ---------------------------------------------------------
+    def apply(self, attrs, inputs, aux=(), is_train=False, rng=None):
+        """Uniform execution entry: returns (outputs_tuple, new_aux_tuple)."""
+        if self.fstateful is not None:
+            outs, new_aux = self.fstateful(attrs, inputs, aux, is_train, rng)
+            return _as_tuple(outs), _as_tuple(new_aux)
+        if self.needs_rng:
+            outs = self.fcompute(attrs, *inputs, rng=rng)
+        else:
+            outs = self.fcompute(attrs, *inputs)
+        return _as_tuple(outs), ()
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def _as_tuple(x):
+    if x is None:
+        return ()
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+# ---------------------------------------------------------------------------
+# Default inference helpers
+# ---------------------------------------------------------------------------
+def elemwise_shape_infer(op, attrs, in_shapes):
+    """All inputs and outputs share one (broadcast-free) shape."""
+    known = [s for s in in_shapes if s is not None]
+    shape = known[0] if known else None
+    if shape is not None:
+        for s in known:
+            if tuple(s) != tuple(shape):
+                raise MXNetError(
+                    "op %s: inconsistent input shapes %s vs %s"
+                    % (op.name, s, shape))
+    ins = [shape if s is None else s for s in in_shapes]
+    outs = [shape] * op.num_outputs(attrs)
+    return ins, outs, [None] * len(op.aux_states(attrs))
+
+
+def elemwise_type_infer(op, attrs, in_types):
+    known = [t for t in in_types if t is not None]
+    t = known[0] if known else None
+    ins = [t if x is None else x for x in in_types]
+    outs = [t] * op.num_outputs(attrs)
+    return ins, outs, [t] * len(op.aux_states(attrs))
+
+
+# ---------------------------------------------------------------------------
+# Registration
+# ---------------------------------------------------------------------------
+def register(name, **kwargs):
+    """Register an op; usable directly or as a decorator on fcompute."""
+    def _do(fcompute):
+        if name in _OP_REGISTRY:
+            raise MXNetError("op %s already registered" % name)
+        opdef = OpDef(name, fcompute=fcompute, **kwargs)
+        _OP_REGISTRY[name] = opdef
+        return opdef
+
+    if "fcompute" in kwargs or "fstateful" in kwargs:
+        fc = kwargs.pop("fcompute", None)
+        return _do(fc)
+    return _do
+
+
+def register_alias(name, alias):
+    _OP_REGISTRY[alias] = _OP_REGISTRY[name]
+
+
+def get_op(name):
+    op = _OP_REGISTRY.get(name)
+    if op is None:
+        raise MXNetError("operator %r is not registered" % name)
+    return op
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)

@@ -1,0 +1,84 @@
+"""Random sampling operators.
+
+Reference: ``src/operator/tensor/sample_op.cc`` (`_sample_uniform/normal/
+gamma/exponential/poisson/negbinomial/generalized_negbinomial`).  The
+reference draws from per-device stateful mshadow PRNGs (resource requests);
+here each imperative call consumes a split of the global functional key
+(mxnet_tpu.random), and compiled executors thread keys explicitly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import Dtype, Float, Shape, register, register_alias
+
+
+def _shape_dtype(attrs):
+    return tuple(attrs["shape"] or ()), jnp.dtype(attrs["dtype"] or "float32")
+
+
+def _register_sampler(name, draw, extra_attrs, aliases=()):
+    def fc(attrs, rng=None):
+        shape, dtype = _shape_dtype(attrs)
+        return draw(attrs, rng, shape, dtype)
+
+    attrs = {"shape": Shape(None), "dtype": Dtype("float32"),
+             "ctx": Dtype(None)}
+    attrs.update(extra_attrs)
+    register(name, fcompute=fc, arguments=(), needs_rng=True, attrs=attrs,
+             infer_shape=lambda attrs, ins: (
+                 [], [tuple(attrs["shape"] or ())], []),
+             infer_type=lambda attrs, ts: (
+                 [], [attrs["dtype"] or "float32"], []))
+    for a in aliases:
+        register_alias(name, a)
+
+
+_register_sampler(
+    "_sample_uniform",
+    lambda attrs, rng, shape, dtype: jax.random.uniform(
+        rng, shape, dtype=dtype, minval=attrs["low"], maxval=attrs["high"]),
+    {"low": Float(0.0), "high": Float(1.0)},
+    aliases=("uniform", "_random_uniform"))
+
+_register_sampler(
+    "_sample_normal",
+    lambda attrs, rng, shape, dtype: attrs["loc"] +
+    attrs["scale"] * jax.random.normal(rng, shape, dtype=dtype),
+    {"loc": Float(0.0), "scale": Float(1.0)},
+    aliases=("normal", "_random_normal"))
+
+_register_sampler(
+    "_sample_gamma",
+    lambda attrs, rng, shape, dtype: jax.random.gamma(
+        rng, attrs["alpha"], shape, dtype=dtype) * attrs["beta"],
+    {"alpha": Float(1.0), "beta": Float(1.0)},
+    aliases=("_random_gamma",))
+
+_register_sampler(
+    "_sample_exponential",
+    lambda attrs, rng, shape, dtype: jax.random.exponential(
+        rng, shape, dtype=dtype) / attrs["lam"],
+    {"lam": Float(1.0)},
+    aliases=("_random_exponential",))
+
+_register_sampler(
+    "_sample_poisson",
+    lambda attrs, rng, shape, dtype: jax.random.poisson(
+        rng, attrs["lam"], shape).astype(dtype),
+    {"lam": Float(1.0)},
+    aliases=("_random_poisson",))
+
+_register_sampler(
+    "_sample_negbinomial",
+    lambda attrs, rng, shape, dtype: _neg_binomial(
+        rng, attrs["k"], attrs["p"], shape, dtype),
+    {"k": Float(1.0), "p": Float(1.0)},
+    aliases=("_random_negbinomial",))
+
+
+def _neg_binomial(rng, k, p, shape, dtype):
+    r1, r2 = jax.random.split(rng)
+    lam = jax.random.gamma(r1, k, shape) * ((1 - p) / max(p, 1e-12))
+    return jax.random.poisson(r2, lam, shape).astype(dtype)

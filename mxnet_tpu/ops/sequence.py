@@ -1,0 +1,80 @@
+"""Sequence operators: SequenceLast / SequenceMask / SequenceReverse.
+
+Reference: ``src/operator/sequence_last.cc`` / ``sequence_mask.cc`` /
+``sequence_reverse.cc`` (time-major [T, N, ...] layout, optional
+``sequence_length`` input of shape [N]).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import Bool, Float, register
+
+
+def _seq_args(attrs):
+    return ["data", "sequence_length"] if attrs["use_sequence_length"] \
+        else ["data"]
+
+
+def _seq_last_fc(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)  # [N]
+    n = data.shape[1]
+    return data[idx, jnp.arange(n)]
+
+
+def _seq_last_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if attrs["use_sequence_length"] and ds is not None:
+        in_shapes[1] = (ds[1],)
+    if ds is None:
+        return in_shapes, [None], []
+    return in_shapes, [tuple(ds[1:])], []
+
+
+register("SequenceLast", fcompute=_seq_last_fc, arguments=_seq_args,
+         attrs={"use_sequence_length": Bool(False)},
+         infer_shape=_seq_last_infer)
+
+
+def _time_mask(data, sequence_length):
+    t = data.shape[0]
+    steps = jnp.arange(t).reshape(t, 1)
+    mask = steps < sequence_length.astype(jnp.int32).reshape(1, -1)
+    return mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+
+
+def _seq_mask_fc(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return data
+    mask = _time_mask(data, sequence_length)
+    return jnp.where(mask, data, attrs["value"])
+
+
+def _seq_mask_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if attrs["use_sequence_length"] and ds is not None:
+        in_shapes[1] = (ds[1],)
+    return in_shapes, [ds], []
+
+
+register("SequenceMask", fcompute=_seq_mask_fc, arguments=_seq_args,
+         attrs={"use_sequence_length": Bool(False), "value": Float(0.0)},
+         infer_shape=_seq_mask_infer)
+
+
+def _seq_reverse_fc(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return jnp.flip(data, axis=0)
+    t = data.shape[0]
+    steps = jnp.arange(t).reshape(t, 1)
+    lens = sequence_length.astype(jnp.int32).reshape(1, -1)
+    rev_idx = jnp.where(steps < lens, lens - 1 - steps, steps)  # [T, N]
+    return jnp.take_along_axis(
+        data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+register("SequenceReverse", fcompute=_seq_reverse_fc, arguments=_seq_args,
+         attrs={"use_sequence_length": Bool(False)},
+         infer_shape=_seq_mask_infer)

@@ -1,0 +1,267 @@
+"""Softmax and output-head (implicit loss) operators.
+
+Reference: ``src/operator/softmax_output.cc``, ``softmax_activation.cc``,
+``regression_output.cc`` (Linear/Logistic/MAE), ``svm_output.cc``,
+``src/operator/loss_binary_op.cc`` (softmax_cross_entropy), ``src/operator/nn/
+softmax-inl.h``.
+
+The reference's output heads have *implicit loss* semantics: their backward
+ignores the incoming head gradient and emits the loss gradient directly
+(e.g. SoftmaxOutput backward = softmax(x) - onehot(label)).  That contract is
+encoded here with ``jax.custom_vjp`` so executors can treat every op uniformly
+through ``jax.vjp``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import Bool, Float, Int, Str, register, register_alias
+
+
+# ---------------------------------------------------------------------------
+# Plain softmax ops
+# ---------------------------------------------------------------------------
+register("softmax",
+         fcompute=lambda attrs, x: jax.nn.softmax(
+             x / attrs["temperature"], axis=attrs["axis"]),
+         attrs={"axis": Int(-1), "temperature": Float(1.0)})
+register("log_softmax",
+         fcompute=lambda attrs, x: jax.nn.log_softmax(
+             x / attrs["temperature"], axis=attrs["axis"]),
+         attrs={"axis": Int(-1), "temperature": Float(1.0)})
+
+
+def _softmax_act_fc(attrs, x):
+    if attrs["mode"] == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+register("SoftmaxActivation", fcompute=_softmax_act_fc,
+         attrs={"mode": Str("instance")})
+
+
+# ---------------------------------------------------------------------------
+# SoftmaxOutput
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _softmax_output(cfg, data, label):
+    return _softmax_fwd_value(cfg, data)
+
+
+def _softmax_fwd_value(cfg, data):
+    multi_output = cfg[2]
+    axis = 1 if multi_output else -1
+    if not multi_output and data.ndim > 2 and not cfg[4]:
+        return jax.nn.softmax(data.reshape(data.shape[0], -1),
+                              axis=-1).reshape(data.shape)
+    return jax.nn.softmax(data, axis=axis)
+
+
+def _softmax_output_fwd(cfg, data, label):
+    out = _softmax_fwd_value(cfg, data)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(cfg, res, g):
+    grad_scale, ignore_label, multi_output, use_ignore, _, normalization = cfg
+    prob, label = res
+    if multi_output:
+        # data: (n, c, d1...), label: (n, d1...)
+        num_class = prob.shape[1]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), num_class,
+                                axis=1, dtype=prob.dtype)
+    else:
+        num_class = prob.shape[-1]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), num_class,
+                                dtype=prob.dtype)
+        onehot = onehot.reshape(prob.shape)
+    grad = prob - onehot
+    if use_ignore:
+        if multi_output:
+            mask = (label != ignore_label).astype(prob.dtype)
+            grad = grad * jnp.expand_dims(mask, 1)
+        else:
+            mask = (label != ignore_label).astype(prob.dtype)
+            grad = grad * mask.reshape(mask.shape + (1,) * (grad.ndim -
+                                                            mask.ndim))
+    if normalization == "batch":
+        grad = grad / prob.shape[0]
+    elif normalization == "valid" and use_ignore:
+        valid = jnp.maximum(jnp.sum(label != ignore_label), 1)
+        grad = grad / valid.astype(grad.dtype)
+    elif normalization == "valid":
+        grad = grad / float(label.size)
+    return (grad * grad_scale, jnp.zeros_like(label))
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+def _softmax_output_fc(attrs, data, label):
+    cfg = (attrs["grad_scale"], attrs["ignore_label"], attrs["multi_output"],
+           attrs["use_ignore"], attrs["preserve_shape"],
+           attrs["normalization"])
+    return _softmax_output(cfg, data, label)
+
+
+def _softmax_output_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    if attrs["multi_output"]:
+        in_shapes[1] = (ds[0],) + tuple(ds[2:])
+    else:
+        in_shapes[1] = (ds[0],)
+    return in_shapes, [ds], []
+
+
+register("SoftmaxOutput", fcompute=_softmax_output_fc,
+         arguments=("data", "label"),
+         attrs={"grad_scale": Float(1.0), "ignore_label": Float(-1.0),
+                "multi_output": Bool(False), "use_ignore": Bool(False),
+                "preserve_shape": Bool(False),
+                "normalization": Str("null"),
+                "out_grad": Bool(False), "smooth_alpha": Float(0.0)},
+         infer_shape=_softmax_output_infer,
+         doc="Softmax forward; backward emits softmax-cross-entropy gradient "
+             "w.r.t. data (reference src/operator/softmax_output.cc).")
+register_alias("SoftmaxOutput", "Softmax")
+
+
+# ---------------------------------------------------------------------------
+# Regression outputs
+# ---------------------------------------------------------------------------
+def _make_regression(name, fwd_fn, grad_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+    def core(grad_scale, data, label):
+        return fwd_fn(data)
+
+    def fwd(grad_scale, data, label):
+        out = fwd_fn(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        lbl = label.reshape(out.shape)
+        # reference regression_output-inl.h:70-77: grad_scale / num_output
+        # where num_output = label.Size() / batch
+        num_output = max(out.size // out.shape[0], 1)
+        grad = grad_fn(out, lbl) * (grad_scale / num_output)
+        return (grad, jnp.zeros_like(label))
+
+    core.defvjp(fwd, bwd)
+
+    def infer(attrs, in_shapes):
+        ds = in_shapes[0]
+        if ds is not None:
+            in_shapes[1] = ds
+        return in_shapes, [ds], []
+
+    register(name,
+             fcompute=lambda attrs, d, l: core(attrs["grad_scale"], d, l),
+             arguments=("data", "label"),
+             attrs={"grad_scale": Float(1.0)}, infer_shape=infer)
+
+
+_make_regression("LinearRegressionOutput",
+                 lambda d: d, lambda o, l: o - l)
+_make_regression("LogisticRegressionOutput",
+                 jax.nn.sigmoid, lambda o, l: o - l)
+_make_regression("MAERegressionOutput",
+                 lambda d: d, lambda o, l: jnp.sign(o - l))
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput (reference svm_output.cc: hinge loss head)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _svm_output(cfg, data, label):
+    return data
+
+
+def _svm_fwd(cfg, data, label):
+    return data, (data, label)
+
+
+def _svm_bwd(cfg, res, g):
+    margin, reg_coef, use_linear = cfg
+    data, label = res
+    n, c = data.shape[0], data.shape[-1]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), c, dtype=data.dtype)
+    sign = jnp.where(onehot > 0, -1.0, 1.0)
+    viol = (margin + sign * data) > 0
+    if use_linear:
+        grad = jnp.where(viol, sign * reg_coef, 0.0)
+    else:
+        grad = jnp.where(viol, 2.0 * reg_coef * (margin + sign * data) * sign,
+                         0.0)
+    return (grad.astype(data.dtype), jnp.zeros_like(label))
+
+
+_svm_output.defvjp(_svm_fwd, _svm_bwd)
+
+
+def _svm_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is not None:
+        in_shapes[1] = (ds[0],)
+    return in_shapes, [ds], []
+
+
+register("SVMOutput",
+         fcompute=lambda attrs, d, l: _svm_output(
+             (attrs["margin"], attrs["regularization_coefficient"],
+              attrs["use_linear"]), d, l),
+         arguments=("data", "label"),
+         attrs={"margin": Float(1.0),
+                "regularization_coefficient": Float(1.0),
+                "use_linear": Bool(False)},
+         infer_shape=_svm_infer)
+
+
+# ---------------------------------------------------------------------------
+# softmax_cross_entropy (reference loss_binary_op.cc)
+# ---------------------------------------------------------------------------
+def _sce_fc(attrs, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1],
+                            dtype=data.dtype)
+    return jnp.sum(-onehot * logp).reshape(1)
+
+
+register("softmax_cross_entropy", fcompute=_sce_fc,
+         arguments=("data", "label"),
+         infer_shape=lambda attrs, ins: (ins, [(1,)], []))
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg (identity with sparsity regularizer gradient)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _kl_sparse(cfg, data):
+    return data
+
+
+def _kl_fwd(cfg, data):
+    return data, data
+
+
+def _kl_bwd(cfg, data, g):
+    sparseness_target, penalty = cfg
+    rho_hat = jnp.mean(jax.nn.sigmoid(data), axis=0, keepdims=True)
+    rho = sparseness_target
+    grad_reg = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+    return (g + grad_reg * jnp.ones_like(data) / data.shape[0],)
+
+
+_kl_sparse.defvjp(_kl_fwd, _kl_bwd)
+
+register("IdentityAttachKLSparseReg",
+         fcompute=lambda attrs, x: _kl_sparse(
+             (attrs["sparseness_target"], attrs["penalty"]), x),
+         attrs={"sparseness_target": Float(0.1), "penalty": Float(0.001),
+                "momentum": Float(0.9)})

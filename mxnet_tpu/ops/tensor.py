@@ -1,0 +1,412 @@
+"""Matrix / shape-manipulation operators.
+
+Reference: ``src/operator/tensor/matrix_op.cc`` (Reshape/Flatten/transpose/
+expand_dims/slice/slice_axis/dot/batch_dot/clip/repeat/tile/reverse),
+``swapaxis.cc``, ``concat.cc``, ``slice_channel.cc``, ``pad.cc``,
+``control_flow_op.cc`` (where).  All lower to single XLA HLOs; ``dot`` and
+``batch_dot`` are the MXU ops — kept as plain lax.dot_general so XLA tiles
+them onto the systolic array.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import Bool, Float, Int, IntOrNone, Shape, Str, register, \
+    register_alias
+
+
+# ---------------------------------------------------------------------------
+# Reshape family
+# ---------------------------------------------------------------------------
+def _infer_reshape_shape(data_shape, target):
+    """Implements the reference Reshape's special codes 0 / -1 / -2 / -3 / -4
+    (matrix_op.cc ReshapeParam)."""
+    out = []
+    src = list(data_shape)
+    i = 0
+    it = iter(range(len(target)))
+    k = 0
+    while k < len(target):
+        d = target[k]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            a, b = target[k + 1], target[k + 2]
+            cur = src[i]; i += 1
+            if a == -1:
+                a = cur // b
+            if b == -1:
+                b = cur // a
+            out.extend([a, b]); k += 2
+        else:
+            out.append(d); i += 1
+        k += 1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1]))
+        total = int(np.prod(data_shape))
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+def _reshape_fcompute(attrs, x):
+    tgt = attrs["shape"]
+    if attrs["reverse"]:
+        rev = _infer_reshape_shape(x.shape[::-1], tuple(tgt)[::-1])
+        return x.reshape(rev[::-1])
+    return x.reshape(_infer_reshape_shape(x.shape, tgt))
+
+
+def _reshape_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    tgt = attrs["shape"]
+    if attrs["reverse"]:
+        rev = _infer_reshape_shape(ds[::-1], tuple(tgt)[::-1])
+        return in_shapes, [tuple(rev[::-1])], []
+    return in_shapes, [_infer_reshape_shape(ds, tgt)], []
+
+
+register("Reshape", fcompute=_reshape_fcompute,
+         attrs={"shape": Shape(required=True), "reverse": Bool(False)},
+         infer_shape=_reshape_infer)
+register_alias("Reshape", "reshape")
+
+
+def _flatten_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    return in_shapes, [(ds[0], int(np.prod(ds[1:])) if len(ds) > 1 else 1)], []
+
+
+register("Flatten",
+         fcompute=lambda attrs, x: x.reshape(x.shape[0], -1),
+         infer_shape=_flatten_infer)
+register_alias("Flatten", "flatten")
+
+
+def _transpose_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    axes = attrs["axes"]
+    if not axes:
+        axes = tuple(reversed(range(len(ds))))
+    return in_shapes, [tuple(ds[a] for a in axes)], []
+
+
+register("transpose",
+         fcompute=lambda attrs, x: jnp.transpose(
+             x, attrs["axes"] if attrs["axes"] else None),
+         attrs={"axes": Shape(())}, infer_shape=_transpose_infer)
+
+
+def _expand_dims_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    ax = attrs["axis"]
+    if ax < 0:
+        ax += len(ds) + 1
+    return in_shapes, [tuple(ds[:ax]) + (1,) + tuple(ds[ax:])], []
+
+
+register("expand_dims",
+         fcompute=lambda attrs, x: jnp.expand_dims(x, attrs["axis"]),
+         attrs={"axis": Int(required=True)}, infer_shape=_expand_dims_infer)
+
+
+def _swapaxis_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    s = list(ds)
+    a, b = attrs["dim1"], attrs["dim2"]
+    s[a], s[b] = s[b], s[a]
+    return in_shapes, [tuple(s)], []
+
+
+register("SwapAxis",
+         fcompute=lambda attrs, x: jnp.swapaxes(
+             x, attrs["dim1"], attrs["dim2"]),
+         attrs={"dim1": Int(0), "dim2": Int(0)}, infer_shape=_swapaxis_infer)
+register_alias("SwapAxis", "swapaxes")
+
+
+# ---------------------------------------------------------------------------
+# Slicing
+# ---------------------------------------------------------------------------
+def _norm_slice(begin, end, shape):
+    idx = []
+    for i, dim in enumerate(shape):
+        b = begin[i] if i < len(begin) and begin[i] is not None else 0
+        e = end[i] if i < len(end) and end[i] is not None else dim
+        idx.append(slice(b, e))
+    return tuple(idx)
+
+
+def _slice_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    idx = _norm_slice(attrs["begin"], attrs["end"], ds)
+    out = tuple(len(range(*s.indices(d))) for s, d in zip(idx, ds))
+    return in_shapes, [out], []
+
+
+register("slice",
+         fcompute=lambda attrs, x: x[
+             _norm_slice(attrs["begin"], attrs["end"], x.shape)],
+         attrs={"begin": Shape(required=True), "end": Shape(required=True)},
+         infer_shape=_slice_infer)
+register_alias("slice", "crop")
+
+
+def _slice_axis_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    ax = attrs["axis"] % len(ds)
+    end = attrs["end"] if attrs["end"] is not None else ds[ax]
+    if end < 0:
+        end += ds[ax]
+    begin = attrs["begin"]
+    if begin < 0:
+        begin += ds[ax]
+    s = list(ds)
+    s[ax] = end - begin
+    return in_shapes, [tuple(s)], []
+
+
+def _slice_axis_fc(attrs, x):
+    ax = attrs["axis"] % x.ndim
+    end = attrs["end"] if attrs["end"] is not None else x.shape[ax]
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(attrs["begin"], end)
+    return x[tuple(idx)]
+
+
+register("slice_axis", fcompute=_slice_axis_fc,
+         attrs={"axis": Int(required=True), "begin": Int(required=True),
+                "end": IntOrNone(None)},
+         infer_shape=_slice_axis_infer)
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot — the MXU path
+# ---------------------------------------------------------------------------
+def _dot_fc(attrs, a, b):
+    ta, tb = attrs["transpose_a"], attrs["transpose_b"]
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    a2 = a.T if ta else a
+    b2 = b.T if tb else b
+    return jnp.matmul(a2, b2) if (a2.ndim <= 2 and b2.ndim <= 2) else \
+        jnp.tensordot(a2, b2, axes=1)
+
+
+def _dot_infer(attrs, in_shapes):
+    sa, sb = in_shapes
+    if sa is None or sb is None:
+        return in_shapes, [None], []
+    ta, tb = attrs["transpose_a"], attrs["transpose_b"]
+    if len(sa) == 1 and len(sb) == 1:
+        return in_shapes, [()], []
+    a = tuple(reversed(sa)) if ta else tuple(sa)
+    b = tuple(reversed(sb)) if tb else tuple(sb)
+    return in_shapes, [a[:-1] + b[1:]], []
+
+
+register("dot", fcompute=_dot_fc, arguments=("lhs", "rhs"),
+         attrs={"transpose_a": Bool(False), "transpose_b": Bool(False)},
+         infer_shape=_dot_infer,
+         doc="Matrix product; lowers to lax.dot_general on the MXU "
+             "(reference src/operator/tensor/matrix_op.cc dot).")
+
+
+def _batch_dot_fc(attrs, a, b):
+    a2 = jnp.swapaxes(a, -1, -2) if attrs["transpose_a"] else a
+    b2 = jnp.swapaxes(b, -1, -2) if attrs["transpose_b"] else b
+    return jnp.matmul(a2, b2)
+
+
+def _batch_dot_infer(attrs, in_shapes):
+    sa, sb = in_shapes
+    if sa is None or sb is None:
+        return in_shapes, [None], []
+    a = (sa[0], sa[2], sa[1]) if attrs["transpose_a"] else tuple(sa)
+    b = (sb[0], sb[2], sb[1]) if attrs["transpose_b"] else tuple(sb)
+    return in_shapes, [(a[0], a[1], b[2])], []
+
+
+register("batch_dot", fcompute=_batch_dot_fc, arguments=("lhs", "rhs"),
+         attrs={"transpose_a": Bool(False), "transpose_b": Bool(False)},
+         infer_shape=_batch_dot_infer)
+
+
+# ---------------------------------------------------------------------------
+# clip / repeat / tile / reverse / where
+# ---------------------------------------------------------------------------
+register("clip",
+         fcompute=lambda attrs, x: jnp.clip(
+             x, attrs["a_min"], attrs["a_max"]),
+         attrs={"a_min": Float(required=True), "a_max": Float(required=True)})
+
+
+def _repeat_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    r, ax = attrs["repeats"], attrs["axis"]
+    if ax is None:
+        return in_shapes, [(int(np.prod(ds)) * r,)], []
+    s = list(ds)
+    s[ax] *= r
+    return in_shapes, [tuple(s)], []
+
+
+register("repeat",
+         fcompute=lambda attrs, x: jnp.repeat(
+             x, attrs["repeats"], axis=attrs["axis"]),
+         attrs={"repeats": Int(required=True), "axis": IntOrNone(None)},
+         infer_shape=_repeat_infer)
+
+
+def _tile_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    reps = attrs["reps"]
+    nd = max(len(ds), len(reps))
+    s = (1,) * (nd - len(ds)) + tuple(ds)
+    r = (1,) * (nd - len(reps)) + tuple(reps)
+    return in_shapes, [tuple(a * b for a, b in zip(s, r))], []
+
+
+register("tile",
+         fcompute=lambda attrs, x: jnp.tile(x, attrs["reps"]),
+         attrs={"reps": Shape(required=True)}, infer_shape=_tile_infer)
+
+
+register("reverse",
+         fcompute=lambda attrs, x: jnp.flip(x, axis=attrs["axis"]),
+         attrs={"axis": Shape(required=True)})
+register_alias("reverse", "flip")
+
+
+def _where_infer(attrs, in_shapes):
+    cond, x, y = in_shapes
+    s = x if x is not None else y
+    return [cond if cond is not None else s, s, s], [s], []
+
+
+register("where",
+         fcompute=lambda attrs, c, x, y: jnp.where(
+             c.astype(bool) if c.ndim == x.ndim else
+             c.astype(bool).reshape(c.shape + (1,) * (x.ndim - c.ndim)),
+             x, y),
+         arguments=("condition", "x", "y"), infer_shape=_where_infer)
+
+
+# ---------------------------------------------------------------------------
+# Concat / SliceChannel (the legacy layer pair) + stack
+# ---------------------------------------------------------------------------
+def _concat_infer(attrs, in_shapes):
+    known = [s for s in in_shapes if s is not None]
+    if not known:
+        return in_shapes, [None], []
+    dim = attrs["dim"]
+    out = list(known[0])
+    out[dim] = 0
+    filled = []
+    for s in in_shapes:
+        if s is None:
+            return in_shapes, [None], []
+        out[dim] += s[dim]
+        filled.append(s)
+    return filled, [tuple(out)], []
+
+
+register("Concat",
+         fcompute=lambda attrs, *xs: jnp.concatenate(xs, axis=attrs["dim"]),
+         arguments=("arg",), key_var_num_args="num_args",
+         attrs={"num_args": Int(required=True), "dim": Int(1)},
+         infer_shape=_concat_infer)
+register_alias("Concat", "concat")
+
+
+def _slice_channel_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    n = attrs["num_outputs"]
+    if ds is None:
+        return in_shapes, [None] * n, []
+    ax = attrs["axis"]
+    s = list(ds)
+    if s[ax] % n != 0:
+        raise MXNetError("SliceChannel: dim %d not divisible by %d"
+                         % (s[ax], n))
+    s[ax] //= n
+    if attrs["squeeze_axis"]:
+        s.pop(ax)
+    return in_shapes, [tuple(s)] * n, []
+
+
+def _slice_channel_fc(attrs, x):
+    parts = jnp.split(x, attrs["num_outputs"], axis=attrs["axis"])
+    if attrs["squeeze_axis"]:
+        parts = [jnp.squeeze(p, axis=attrs["axis"]) for p in parts]
+    return tuple(parts)
+
+
+register("SliceChannel", fcompute=_slice_channel_fc,
+         attrs={"num_outputs": Int(required=True), "axis": Int(1),
+                "squeeze_axis": Bool(False)},
+         outputs=lambda attrs: ["output%d" % i
+                                for i in range(attrs["num_outputs"])],
+         num_outputs=lambda attrs: attrs["num_outputs"],
+         infer_shape=_slice_channel_infer)
+register_alias("SliceChannel", "split")
+
+
+# ---------------------------------------------------------------------------
+# Pad (reference src/operator/pad.cc: 4D/5D, constant/edge/reflect)
+# ---------------------------------------------------------------------------
+def _pad_widths(pad_width, ndim):
+    pw = list(pad_width)
+    return tuple((pw[2 * i], pw[2 * i + 1]) for i in range(ndim))
+
+
+def _pad_fc(attrs, x):
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect"}[attrs["mode"]]
+    widths = _pad_widths(attrs["pad_width"], x.ndim)
+    if mode == "constant":
+        return jnp.pad(x, widths, mode="constant",
+                       constant_values=attrs["constant_value"])
+    return jnp.pad(x, widths, mode=mode)
+
+
+def _pad_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    widths = _pad_widths(attrs["pad_width"], len(ds))
+    return in_shapes, [tuple(d + a + b
+                             for d, (a, b) in zip(ds, widths))], []
+
+
+register("Pad", fcompute=_pad_fc,
+         attrs={"mode": Str("constant"), "pad_width": Shape(required=True),
+                "constant_value": Float(0.0)},
+         infer_shape=_pad_infer)
+register_alias("Pad", "pad")

@@ -1,0 +1,14 @@
+"""Parallelism: device meshes, sharded training steps, collectives.
+
+This package is the TPU-native replacement for the reference's parallelism
+machinery (SURVEY.md §2.3): KVStore reduce/broadcast and ps-lite push/pull
+become XLA collectives (psum / all_gather / ppermute) over a
+``jax.sharding.Mesh``; ``ctx_group`` model parallelism becomes sharding
+annotations; and beyond-reference sequence parallelism (ring attention)
+lives here too.
+"""
+from .mesh import make_mesh, data_parallel_sharding, local_mesh
+from .dp import DataParallelTrainer
+
+__all__ = ["make_mesh", "data_parallel_sharding", "local_mesh",
+           "DataParallelTrainer"]

@@ -1,0 +1,256 @@
+"""Sharded data-parallel trainer: the TPU fast path for kvstore='device'.
+
+Reference semantics being replaced (SURVEY.md §2.3.1-2): per-device
+executors + Comm::Reduce gradient all-reduce + updater + Comm::Broadcast.
+Here the WHOLE training step — forward, backward, gradient all-reduce, and
+optimizer update — is ONE compiled XLA program over a ``jax.sharding.Mesh``:
+parameters are replicated, the batch is sharded over the ``dp`` axis, and
+XLA inserts the ICI all-reduce where the replicated-parameter gradients
+meet the sharded batch (the ``psum`` that subsumes kvstore push+pull).
+``update_on_kvstore`` ≡ the optimizer living inside the compiled step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..initializer import InitDesc, Uniform
+from ..ndarray import NDArray
+from .mesh import local_mesh
+
+__all__ = ["DataParallelTrainer"]
+
+
+# in-graph optimizer updates, reusing the fused registry op math
+def _sgd_update_fn(opt_params):
+    from ..ops.registry import get_op
+    op = get_op("sgd_mom_update" if opt_params.get("momentum", 0.0) > 0
+                else "sgd_update")
+    attrs = op.parse_attrs({k: v for k, v in opt_params.items()
+                            if k in op.attr_specs})
+
+    def init_state(w):
+        if opt_params.get("momentum", 0.0) > 0:
+            return (jnp.zeros_like(w),)
+        return ()
+
+    def update(w, g, state):
+        if state:
+            new_w, new_m = op.fcompute(attrs, w, g, state[0])
+            return new_w, (new_m,)
+        return op.fcompute(attrs, w, g), ()
+
+    return init_state, update
+
+
+def _adam_update_fn(opt_params):
+    from ..ops.registry import get_op
+    op = get_op("adam_update")
+    attrs = op.parse_attrs({k: v for k, v in opt_params.items()
+                            if k in op.attr_specs})
+
+    def init_state(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, state):
+        new_w, new_mean, new_var = op.fcompute(attrs, w, g, *state)
+        return new_w, (new_mean, new_var)
+
+    return init_state, update
+
+
+_OPTIMIZERS = {"sgd": _sgd_update_fn, "adam": _adam_update_fn}
+
+
+class DataParallelTrainer:
+    """Compiled data-parallel training over a mesh.
+
+    >>> trainer = DataParallelTrainer(softmax_sym, batch_size=256,
+    ...                               data_shapes={'data': (256, 3, 224, 224)},
+    ...                               label_shapes={'softmax_label': (256,)})
+    >>> outputs = trainer.step(data, label)   # one fused XLA step
+    """
+
+    def __init__(self, symbol, data_shapes, label_shapes=None, mesh=None,
+                 optimizer="sgd", optimizer_params=None, initializer=None,
+                 batch_axis="dp", dtype="float32", fixed_params=()):
+        self.symbol = symbol
+        self.mesh = mesh if mesh is not None else local_mesh(batch_axis)
+        self.batch_axis = batch_axis
+        self._fixed = set(fixed_params)
+
+        opt_params = dict(optimizer_params or {})
+        lr = opt_params.pop("learning_rate", 0.01)
+        opt_params["lr"] = lr
+        batch = next(iter(data_shapes.values()))[0]
+        opt_params.setdefault("rescale_grad", 1.0 / batch)
+        if opt_params.get("clip_gradient") is None:
+            opt_params.pop("clip_gradient", None)
+        if optimizer not in _OPTIMIZERS:
+            raise MXNetError("in-graph optimizer %r not supported (have %s)"
+                             % (optimizer, sorted(_OPTIMIZERS)))
+        self._opt_init, self._opt_update = _OPTIMIZERS[optimizer](opt_params)
+
+        shapes = dict(data_shapes)
+        if label_shapes:
+            shapes.update(label_shapes)
+        self.data_names = list(data_shapes)
+        self.label_names = list(label_shapes or {})
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.param_names = [n for n in self.arg_names
+                            if n not in shapes]
+        self._arg_shapes = dict(zip(self.arg_names, arg_shapes))
+        self._aux_shapes = dict(zip(self.aux_names, aux_shapes))
+        self._dtype = dtype
+
+        self._replicated = NamedSharding(self.mesh, P())
+        self._batched = NamedSharding(self.mesh, P(batch_axis))
+
+        self._init_params(initializer or Uniform(0.01))
+        self._compile()
+
+    # ------------------------------------------------------------------
+    def _init_params(self, initializer):
+        attrs = self.symbol.attr_dict()
+        params = {}
+        for name in self.param_names:
+            arr = nd.zeros(self._arg_shapes[name], dtype=self._dtype)
+            initializer(InitDesc(name, attrs.get(name)), arr)
+            params[name] = jax.device_put(arr._data, self._replicated)
+        self.params = params
+        self.opt_state = {n: tuple(
+            jax.device_put(s, self._replicated)
+            for s in self._opt_init(params[n])) for n in self.param_names}
+        aux = {}
+        init_aux = nd.zeros((1,))
+        for name in self.aux_names:
+            arr = nd.zeros(self._aux_shapes[name], dtype=self._dtype)
+            initializer(InitDesc(name, attrs.get(name)), arr)
+            aux[name] = jax.device_put(arr._data, self._replicated)
+        self.aux = aux
+
+    def _compile(self):
+        from ..executor import _apply_pure  # noqa: F401 (import check)
+        symbol = self.symbol
+        nodes = symbol._nodes()
+        aux_set = set(self.aux_names)
+        head = [(id(n), oi) for n, oi in symbol._outputs]
+        param_names = self.param_names
+        data_names = self.data_names + self.label_names
+
+        def trace(args_map, aux_map, rng, is_train):
+            vals = {}
+            new_aux = dict(aux_map)
+            for idx, node in enumerate(nodes):
+                if node.is_variable:
+                    vals[(id(node), 0)] = (aux_map[node.name]
+                                           if node.name in aux_set
+                                           else args_map[node.name])
+                    continue
+                ins = [vals[(id(n), oi)] for n, oi in node.arg_inputs()]
+                aux_in = tuple(vals[(id(n), oi)]
+                               for n, oi in node.aux_inputs())
+                r = jax.random.fold_in(rng, idx) \
+                    if (node.op.needs_rng or node.op.stateful) else None
+                outs, upd = node.op.apply(node.attrs, ins, aux_in,
+                                          is_train, r)
+                for oi, o in enumerate(outs):
+                    vals[(id(node), oi)] = o
+                for (an, _), u in zip(node.aux_inputs(), upd):
+                    new_aux[an.name] = u
+            return tuple(vals[k] for k in head), new_aux
+
+        opt_update = self._opt_update
+        fixed = self._fixed
+
+        def train_step(params, opt_state, aux, batch, rng):
+            def f(ps):
+                args = dict(batch)
+                args.update(ps)
+                outs, new_aux = trace(args, aux, rng, True)
+                return outs, new_aux
+
+            outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
+            cots = tuple(jnp.ones_like(o) for o in outs)
+            grads = vjp(cots)[0]
+            new_params, new_opt = {}, {}
+            for name in param_names:
+                if name in fixed or grads.get(name) is None:
+                    new_params[name] = params[name]
+                    new_opt[name] = opt_state[name]
+                else:
+                    w, s = opt_update(params[name], grads[name],
+                                      opt_state[name])
+                    new_params[name] = w
+                    new_opt[name] = s
+            return new_params, new_opt, new_aux, outs
+
+        def predict_step(params, aux, batch, rng):
+            args = dict(batch)
+            args.update(params)
+            outs, _ = trace(args, aux, rng, False)
+            return outs
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._predict_step = jax.jit(predict_step)
+
+    # ------------------------------------------------------------------
+    def _shard_batch(self, batch):
+        out = {}
+        for k, v in batch.items():
+            arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            out[k] = jax.device_put(arr, self._batched)
+        return out
+
+    def step(self, data, label=None, rng=None):
+        """Run one fused training step; returns output jax arrays."""
+        batch = dict(data) if isinstance(data, dict) else \
+            {self.data_names[0]: data}
+        if label is not None:
+            if isinstance(label, dict):
+                batch.update(label)
+            else:
+                batch[self.label_names[0]] = label
+        batch = self._shard_batch(batch)
+        if rng is None:
+            from .. import random as _random
+            rng = _random.next_key()
+        self.params, self.opt_state, self.aux, outs = self._train_step(
+            self.params, self.opt_state, self.aux, batch, rng)
+        return outs
+
+    def predict(self, data, rng=None):
+        batch = dict(data) if isinstance(data, dict) else \
+            {self.data_names[0]: data}
+        batch = self._shard_batch(batch)
+        if rng is None:
+            from .. import random as _random
+            rng = _random.next_key()
+        return self._predict_step(self.params, self.aux, batch, rng)
+
+    def get_params(self):
+        """Host-synced {name: NDArray} dicts (arg, aux)."""
+        args = {n: nd.array(np.asarray(jax.device_get(v)))
+                for n, v in self.params.items()}
+        aux = {n: nd.array(np.asarray(jax.device_get(v)))
+               for n, v in self.aux.items()}
+        return args, aux
+
+    def set_params(self, arg_params, aux_params=None):
+        for n, v in arg_params.items():
+            if n in self.params:
+                self.params[n] = jax.device_put(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v),
+                    self._replicated)
+        for n, v in (aux_params or {}).items():
+            if n in self.aux:
+                self.aux[n] = jax.device_put(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v),
+                    self._replicated)

@@ -1,0 +1,53 @@
+"""Device mesh helpers.
+
+The reference scales by enumerating devices into a context list
+(``ctx=[mx.gpu(i) for i in range(N)]``); the TPU-native unit of scale is a
+``jax.sharding.Mesh`` over the ICI fabric.  These helpers build the standard
+meshes (dp / dp×mp / dp×mp×sp) and the NamedShardings the trainer uses.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "local_mesh", "data_parallel_sharding", "P",
+           "NamedSharding"]
+
+
+def make_mesh(axes, devices=None):
+    """Build a Mesh from {axis_name: size}; size -1 means 'the rest'.
+
+    make_mesh({'dp': 8})                       # pure data parallel
+    make_mesh({'dp': 2, 'mp': 4})              # dp × tensor parallel
+    make_mesh({'dp': -1, 'sp': 2})             # sequence parallel inner axis
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if unknown:
+        assert len(unknown) == 1, "only one axis may be -1"
+        sizes[unknown[0]] = n // known
+    assert int(np.prod(sizes)) == n, \
+        "mesh axes %s don't cover %d devices" % (dict(zip(names, sizes)), n)
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def local_mesh(axis_name="dp", devices=None):
+    """One-axis mesh over all local devices."""
+    if devices is None:
+        devices = jax.devices()
+    return make_mesh({axis_name: len(devices)}, devices)
+
+
+def data_parallel_sharding(mesh, batch_axis="dp"):
+    """(replicated_params, batch_sharded) NamedShardings for pure DP."""
+    replicated = NamedSharding(mesh, P())
+    batched = NamedSharding(mesh, P(batch_axis))
+    return replicated, batched

@@ -1,0 +1,95 @@
+"""Profiler: Chrome-trace op timing + native XLA profiling.
+
+Reference: ``src/engine/profiler.{h,cc}`` (per-op OprExecStat → Chrome trace
+JSON via DumpProfile) + ``python/mxnet/profiler.py`` control API.
+
+Two layers here:
+* the engine-seam profiler — records python-dispatch spans for every op the
+  engine facade executes (names match op registry names), dumping the same
+  Chrome ``traceEvents`` JSON the reference emits;
+* ``jax.profiler`` passthrough (``start``/``stop`` with a logdir) for real
+  XLA/TPU traces (the modern equivalent of per-kernel timing).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from . import engine as _engine
+from .base import get_env
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "Profiler"]
+
+
+class Profiler:
+    def __init__(self, filename="profile.json"):
+        self.filename = filename
+        self.records = []  # (name, start_ns, end_ns, thread_id)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter_ns()
+
+    def record(self, name, start_ns, end_ns):
+        with self._lock:
+            self.records.append((name, start_ns, end_ns,
+                                 threading.get_ident()))
+
+    def dump(self, filename=None):
+        filename = filename or self.filename
+        events = []
+        for name, start, end, tid in self.records:
+            events.append({
+                "name": name, "cat": "operator", "ph": "B",
+                "ts": (start - self._t0) / 1000.0,
+                "pid": 0, "tid": tid % 100000})
+            events.append({
+                "name": name, "cat": "operator", "ph": "E",
+                "ts": (end - self._t0) / 1000.0,
+                "pid": 0, "tid": tid % 100000})
+        with open(filename, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        return filename
+
+
+_state = {"profiler": None, "filename": "profile.json", "jax_logdir": None}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Configure output file (reference MXSetProfilerConfig)."""
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """'run' installs the engine-seam profiler (and starts a JAX trace when
+    MXNET_PROFILER_JAX_LOGDIR is set); 'stop' uninstalls
+    (reference MXSetProfilerState)."""
+    if state == "run":
+        prof = Profiler(_state["filename"])
+        _state["profiler"] = prof
+        _engine.get()._profiler = prof
+        logdir = get_env("MXNET_PROFILER_JAX_LOGDIR")
+        if logdir:
+            import jax
+            jax.profiler.start_trace(logdir)
+            _state["jax_logdir"] = logdir
+    elif state == "stop":
+        _engine.get()._profiler = None
+        if _state["jax_logdir"]:
+            import jax
+            jax.profiler.stop_trace()
+            _state["jax_logdir"] = None
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def dump_profile():
+    """Write the Chrome trace JSON (reference MXDumpProfile)."""
+    prof = _state["profiler"]
+    if prof is not None:
+        return prof.dump()
+    return None
+
+
+if get_env("MXNET_PROFILER_AUTOSTART"):
+    profiler_set_state("run")

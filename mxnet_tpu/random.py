@@ -1,0 +1,37 @@
+"""Global random state.
+
+The reference keeps per-device stateful mshadow PRNG resources seeded from one
+global seed (``src/resource.cc:96-177``, ``mx.random.seed``).  JAX RNG is
+functional (explicit keys), so this module is the bridge: a process-global key
+that every imperative sampling op splits from.  Compiled executors thread keys
+explicitly (SURVEY.md §7 'hard parts': RNG).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_lock = threading.Lock()
+_seed = [0]
+_key = [jax.random.key(0)]
+
+
+def seed(seed_state):
+    """Seed the global PRNG (mx.random.seed equivalent)."""
+    with _lock:
+        _seed[0] = int(seed_state)
+        _key[0] = jax.random.key(int(seed_state))
+
+
+def current_seed():
+    return _seed[0]
+
+
+def next_key():
+    """Split and return a fresh PRNG key (thread-safe)."""
+    with _lock:
+        _key[0], sub = jax.random.split(_key[0])
+        return sub

@@ -1,0 +1,641 @@
+"""Symbol: the symbolic graph frontend.
+
+Reference: ``python/mxnet/symbol.py`` over nnvm's graph IR (compose,
+``infer_shape``/``infer_type`` incl. partial, ``list_arguments/outputs/
+auxiliary_states``, attr get/set, JSON save/load, ``simple_bind``/``bind``).
+
+TPU-native design: the graph is a light Python DAG of ``_Node`` objects, each
+holding a registry ``OpDef`` + typed attrs.  There are no nnvm passes —
+"bind" traces the DAG into one pure JAX function and hands the whole program
+to XLA, whose fusion/buffer-assignment subsumes PlanMemory/bulk-exec
+(SURVEY.md §3.3).  Auxiliary states (BatchNorm moving stats) are modelled as
+trailing variable inputs of their node, which makes JSON serialization and
+executor plumbing uniform.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from .attribute import AttrScope
+from .base import MXNetError, _uid
+from .name import NameManager
+from .ops.registry import get_op, list_ops
+
+__all__ = ["Symbol", "Variable", "Group", "load", "load_json", "var"]
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "extra_attrs", "_nid")
+
+    def __init__(self, op, name, attrs, inputs, extra_attrs=None):
+        self.op = op            # OpDef or None (variable)
+        self.name = name
+        self.attrs = attrs      # typed dict (parsed)
+        self.inputs = inputs    # list of (node, out_idx); args then aux
+        self.extra_attrs = dict(extra_attrs or {})  # __ctx_group__ etc.
+        self._nid = _uid()
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def num_args(self):
+        return len(self.op.arguments(self.attrs)) if self.op else 0
+
+    def aux_inputs(self):
+        return self.inputs[self.num_args():]
+
+    def arg_inputs(self):
+        return self.inputs[:self.num_args()]
+
+
+def _topo_sort(head_nodes):
+    """Post-order DFS over the DAG (stable, iterative)."""
+    order, seen = [], set()
+    stack = [(n, False) for n in reversed(head_nodes)]
+    while stack:
+        node, expanded = stack.pop()
+        if id(node) in seen:
+            continue
+        if expanded:
+            seen.add(id(node))
+            order.append(node)
+        else:
+            stack.append((node, True))
+            for inp, _ in reversed(node.inputs):
+                if id(inp) not in seen:
+                    stack.append((inp, False))
+    return order
+
+
+class Symbol:
+    """An output list over the graph: list of (node, out_idx)."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)
+
+    # -- structure ---------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("output %r not found" % index)
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    def _nodes(self):
+        return _topo_sort([n for n, _ in self._outputs])
+
+    def list_arguments(self):
+        args = []
+        for node in self._nodes():
+            if node.is_variable and not _is_aux_node(node, self):
+                args.append(node.name)
+        return args
+
+    def list_outputs(self):
+        names = []
+        for node, oi in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+            else:
+                outs = node.op.outputs(node.attrs)
+                names.append("%s_%s" % (node.name, outs[oi]))
+        return names
+
+    def list_auxiliary_states(self):
+        aux = []
+        seen = set()
+        for node in self._nodes():
+            if node.is_variable:
+                continue
+            for inp, _ in node.aux_inputs():
+                if id(inp) not in seen:
+                    seen.add(id(inp))
+                    aux.append(inp.name)
+        return aux
+
+    def get_internals(self):
+        """Symbol whose outputs are every node's outputs (reference
+        ``Symbol.get_internals``; names like 'fc1_output')."""
+        outs = []
+        for node in self._nodes():
+            if node.is_variable:
+                outs.append((node, 0))
+            else:
+                for i in range(node.op.num_outputs(node.attrs)):
+                    outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol([(n, oi) for n, oi in node.inputs])
+
+    # -- attrs -------------------------------------------------------------
+    def attr(self, key):
+        node = self._outputs[0][0]
+        return node.extra_attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        node = self._outputs[0][0]
+        for k, v in kwargs.items():
+            node.extra_attrs[k] = str(v)
+
+    def attr_dict(self):
+        ret = {}
+        for node in self._nodes():
+            d = dict(node.extra_attrs)
+            if node.op is not None:
+                d.update(node.op.serialize_attrs(node.attrs))
+            if d:
+                ret[node.name] = d
+        return ret
+
+    def list_attr(self):
+        return dict(self._outputs[0][0].extra_attrs)
+
+    # -- composition -------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Compose: replace variable inputs with new symbols (reference
+        Symbol.__call__ / _compose)."""
+        s = self.__copy__()
+        s._compose(*args, **kwargs)
+        return s
+
+    def __copy__(self):
+        mapping = {}
+        for node in self._nodes():
+            new_inputs = [(mapping[id(i)], oi) for i, oi in node.inputs]
+            mapping[id(node)] = _Node(node.op, node.name, dict(node.attrs)
+                                      if node.attrs else node.attrs,
+                                      new_inputs, node.extra_attrs)
+        return Symbol([(mapping[id(n)], oi) for n, oi in self._outputs])
+
+    def _compose(self, *args, **kwargs):
+        by_name = {}
+        if args:
+            arg_names = self.list_arguments()
+            for nm, s in zip(arg_names, args):
+                by_name[nm] = s
+        by_name.update(kwargs)
+        replace = {}
+        for node in self._nodes():
+            if node.is_variable and node.name in by_name:
+                sub = by_name[node.name]
+                replace[id(node)] = sub._outputs[0]
+        for node in self._nodes():
+            node.inputs = [replace.get(id(i), (i, oi))
+                           for i, oi in node.inputs]
+        self._outputs = [replace.get(id(n), (n, oi))
+                         for n, oi in self._outputs]
+
+    # -- arithmetic sugar ---------------------------------------------------
+    def __add__(self, other):
+        return _sym_binary("elemwise_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _sym_binary("elemwise_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _invoke("_rminus_scalar", [self], {"scalar": other})
+
+    def __mul__(self, other):
+        return _sym_binary("elemwise_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _sym_binary("elemwise_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _invoke("_rdiv_scalar", [self], {"scalar": other})
+
+    def __pow__(self, other):
+        return _sym_binary("_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return _invoke("negative", [self], {})
+
+    # -- inference ---------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        res = self.infer_shape_partial(*args, **kwargs)
+        arg_shapes, out_shapes, aux_shapes = res
+        if arg_shapes is not None and any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None]
+            raise MXNetError("cannot fully infer shapes; unknown for "
+                             "arguments: %s" % missing)
+        return res
+
+    def infer_shape_partial(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for nm, s in zip(arg_names, args):
+                if s is not None:
+                    known[nm] = tuple(s)
+        known.update({k: tuple(v) for k, v in kwargs.items()
+                      if v is not None})
+        shapes = _infer_pass(self, known, kind="shape")
+        return shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for nm, t in zip(arg_names, args):
+                if t is not None:
+                    known[nm] = np.dtype(t).name
+        known.update({k: np.dtype(v).name for k, v in kwargs.items()
+                      if v is not None})
+        return _infer_pass(self, known, kind="type")
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        nodes = self._nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes, arg_nodes = [], []
+        for i, n in enumerate(nodes):
+            if n.is_variable:
+                arg_nodes.append(i)
+                jnodes.append({"op": "null", "name": n.name,
+                               "attrs": dict(n.extra_attrs), "inputs": []})
+            else:
+                attrs = n.op.serialize_attrs(n.attrs)
+                attrs.update(n.extra_attrs)
+                jnodes.append({
+                    "op": n.op.name, "name": n.name, "attrs": attrs,
+                    "inputs": [[nid[id(x)], oi, 0] for x, oi in n.inputs]})
+        heads = [[nid[id(n)], oi, 0] for n, oi in self._outputs]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": [], "heads": heads,
+                           "attrs": {"mxnet_tpu_version": "0.1"}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding ------------------------------------------------------------
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        from . import executor as _executor
+        from . import ndarray as nd
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        if type_dict is None:
+            type_dict = {}
+        arg_types, _, aux_types = self.infer_type(**{
+            k: v for k, v in type_dict.items()})
+        args = [nd.zeros(s, ctx, dtype=t or "float32")
+                for s, t in zip(arg_shapes, arg_types)]
+        aux = [nd.zeros(s, ctx, dtype=t or "float32")
+               for s, t in zip(aux_shapes, aux_types)]
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = dict(grad_req)
+        grads = {n: nd.zeros(s, ctx, dtype=t or "float32")
+                 for n, s, t in zip(arg_names, arg_shapes, arg_types)
+                 if reqs.get(n, "null") != "null"}
+        return _executor.Executor(self, ctx, args, grads, reqs, aux,
+                                  group2ctx=group2ctx,
+                                  shared_exec=shared_exec)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from . import executor as _executor
+        arg_names = self.list_arguments()
+        if isinstance(args, dict):
+            args = [args[n] for n in arg_names]
+        if isinstance(args_grad, dict):
+            grads = dict(args_grad)
+        elif args_grad is None:
+            grads = {}
+        else:
+            grads = {n: g for n, g in zip(arg_names, args_grad)
+                     if g is not None}
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = dict(grad_req)
+        aux_names = self.list_auxiliary_states()
+        if isinstance(aux_states, dict):
+            aux = [aux_states[n] for n in aux_names]
+        else:
+            aux = list(aux_states or [])
+        return _executor.Executor(self, ctx, list(args), grads, reqs, aux,
+                                  group2ctx=group2ctx,
+                                  shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        from .context import current_context
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # -- misc ---------------------------------------------------------------
+    def debug_str(self):
+        lines = []
+        for n in self._nodes():
+            if n.is_variable:
+                lines.append("Variable:%s" % n.name)
+            else:
+                ins = ", ".join(i.name for i, _ in n.inputs)
+                lines.append("Op:%s, Name=%s, Inputs=[%s]"
+                             % (n.op.name, n.name, ins))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or self.list_outputs())
+
+
+def _is_aux_node(node, symbol):
+    """A variable that only feeds aux slots is an auxiliary state."""
+    if not hasattr(symbol, "_aux_cache"):
+        pass
+    aux_ids = set()
+    arg_ids = set()
+    for n in symbol._nodes():
+        if n.is_variable:
+            continue
+        for inp, _ in n.aux_inputs():
+            aux_ids.add(id(inp))
+        for inp, _ in n.arg_inputs():
+            arg_ids.add(id(inp))
+    return id(node) in aux_ids and id(node) not in arg_ids
+
+
+# ---------------------------------------------------------------------------
+# Inference pass (forward propagation + filled-input writeback, iterated to
+# fixpoint — the role of nnvm InferShape/InferType)
+# ---------------------------------------------------------------------------
+def _infer_pass(symbol, known, kind):
+    nodes = symbol._nodes()
+    node_out = {}   # (node_id, out_idx) -> shape/type
+    var_val = {}    # node_id -> value for variables
+
+    for n in nodes:
+        if n.is_variable:
+            v = known.get(n.name)
+            if v is None and kind == "shape":
+                v = n.extra_attrs.get("__shape__")
+                if v is not None:
+                    import ast as _ast
+                    v = tuple(_ast.literal_eval(v))
+            if v is None and kind == "type":
+                v = n.extra_attrs.get("__dtype__")
+            var_val[id(n)] = v
+
+    for _ in range(3):  # fixpoint iterations
+        changed = False
+        for n in nodes:
+            if n.is_variable:
+                node_out[(id(n), 0)] = var_val[id(n)]
+                continue
+            in_vals = [node_out.get((id(i), oi)) for i, oi in n.inputs]
+            n_args = n.num_args()
+            try:
+                if kind == "shape":
+                    ins, outs, aux = n.op.infer_shape(n.attrs,
+                                                      in_vals[:n_args])
+                else:
+                    ins, outs, aux = n.op.infer_type(n.attrs,
+                                                     in_vals[:n_args])
+            except MXNetError:
+                raise
+            filled = list(ins) + list(aux)
+            for (inp, oi), v in zip(n.inputs, filled):
+                if v is None:
+                    continue
+                v = tuple(v) if kind == "shape" else v
+                if inp.is_variable and var_val.get(id(inp)) is None:
+                    var_val[id(inp)] = v
+                    changed = True
+                prev = node_out.get((id(inp), oi))
+                if prev is None:
+                    node_out[(id(inp), oi)] = v
+                    changed = True
+            for oi, v in enumerate(outs):
+                if v is not None:
+                    v = tuple(v) if kind == "shape" else v
+                    if node_out.get((id(n), oi)) is None:
+                        node_out[(id(n), oi)] = v
+                        changed = True
+        if not changed:
+            break
+
+    arg_res, aux_res = [], []
+    aux_names = set(symbol.list_auxiliary_states())
+    for n in nodes:
+        if n.is_variable:
+            if n.name in aux_names:
+                aux_res.append(var_val.get(id(n)))
+            else:
+                arg_res.append(var_val.get(id(n)))
+    out_res = [node_out.get((id(n), oi)) for n, oi in symbol._outputs]
+    return arg_res, out_res, aux_res
+
+
+# ---------------------------------------------------------------------------
+# Construction
+# ---------------------------------------------------------------------------
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, **kwargs):
+    """Create a variable symbol (reference symbol.py Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attr = AttrScope.current().get(attr or {})
+    if shape is not None:
+        attr["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        attr["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attr["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        attr["__dtype__"] = np.dtype(dtype).name
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        attr["__init__"] = init
+    for k, v in kwargs.items():
+        attr["__%s__" % k] = str(v)
+    return Symbol([(_Node(None, name, {}, [], attr), 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol."""
+    outputs = []
+    for s in symbols:
+        outputs.extend(s._outputs)
+    return Symbol(outputs)
+
+
+def _invoke(op_name, sym_inputs, raw_attrs, name=None, aux_syms=None):
+    """Create a node applying op to symbol inputs (the composition core)."""
+    op = get_op(op_name)
+    if op.key_var_num_args and op.key_var_num_args not in raw_attrs:
+        raw_attrs[op.key_var_num_args] = len(sym_inputs)
+    extra = {k: str(v) for k, v in raw_attrs.items() if k.startswith("__")}
+    raw_attrs = {k: v for k, v in raw_attrs.items()
+                 if not k.startswith("__")}
+    attrs = op.parse_attrs(raw_attrs)
+    hint = op_name.lower().lstrip("_")
+    name = NameManager.current().get(name, hint)
+    extra = AttrScope.current().get(extra)
+
+    arg_names = op.arguments(attrs)
+    aux_names = op.aux_states(attrs)
+    inputs = []
+    for i, nm in enumerate(arg_names):
+        if i < len(sym_inputs) and sym_inputs[i] is not None:
+            s = sym_inputs[i]
+            if len(s._outputs) != 1:
+                raise MXNetError(
+                    "op %s input %s: composite symbol with %d outputs used "
+                    "as a single input" % (op_name, nm, len(s._outputs)))
+            inputs.append(s._outputs[0])
+        else:
+            v = Variable("%s_%s" % (name, nm))
+            inputs.append(v._outputs[0])
+    aux_syms = aux_syms or []
+    for i, nm in enumerate(aux_names):
+        if i < len(aux_syms) and aux_syms[i] is not None:
+            inputs.append(aux_syms[i]._outputs[0])
+        else:
+            v = Variable("%s_%s" % (name, nm))
+            inputs.append(v._outputs[0])
+
+    node = _Node(op, name, attrs, inputs, extra)
+    n_out = op.num_outputs(attrs)
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _sym_binary(op_name, scalar_op_name, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return _invoke(op_name, [lhs, rhs], {})
+    return _invoke(scalar_op_name, [lhs], {"scalar": float(rhs)})
+
+
+# ---------------------------------------------------------------------------
+# JSON load
+# ---------------------------------------------------------------------------
+def load_json(json_str):
+    data = json.loads(json_str)
+    jnodes = data["nodes"]
+    nodes = []
+    for jn in jnodes:
+        attrs = jn.get("attrs", jn.get("attr", jn.get("param", {}))) or {}
+        if jn["op"] == "null":
+            nodes.append(_Node(None, jn["name"], {}, [], attrs))
+        else:
+            op = get_op(jn["op"])
+            extra = {k: v for k, v in attrs.items() if k.startswith("__")}
+            raw = {k: v for k, v in attrs.items() if not k.startswith("__")}
+            parsed = op.parse_attrs(raw)
+            inputs = [(nodes[i], oi) for i, oi, *_ in jn["inputs"]]
+            nodes.append(_Node(op, jn["name"], parsed, inputs, extra))
+    heads = data.get("heads") or [[len(nodes) - 1, 0, 0]]
+    return Symbol([(nodes[i], oi) for i, oi, *_ in heads])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Auto-generated op symbols (reference _init_symbol_module)
+# ---------------------------------------------------------------------------
+def _make_sym_func(op_name):
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_inputs = [a for a in args if isinstance(a, Symbol)]
+        raw_attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                pass
+            else:
+                raw_attrs[k] = v
+        op = get_op(op_name)
+        # keyword symbol inputs, ordered by op argument names
+        probe = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        if probe:
+            if op.key_var_num_args and op.key_var_num_args not in raw_attrs:
+                raw_attrs[op.key_var_num_args] = \
+                    len(sym_inputs) + len(probe)
+            attrs_parsed = op.parse_attrs(
+                {k: v for k, v in raw_attrs.items()
+                 if not k.startswith("__")})
+            arg_names = op.arguments(attrs_parsed)
+            aux_names = op.aux_states(attrs_parsed)
+            ordered = list(sym_inputs)
+            for nm in arg_names[len(sym_inputs):]:
+                ordered.append(probe.get(nm))
+            aux_list = [probe.get(nm) for nm in aux_names]
+            if attr:
+                raw_attrs.update({k: v for k, v in attr.items()})
+            return _invoke(op_name, ordered, raw_attrs, name=name,
+                           aux_syms=aux_list)
+        if attr:
+            raw_attrs.update({k: v for k, v in attr.items()})
+        return _invoke(op_name, sym_inputs, raw_attrs, name=name)
+
+    fn.__name__ = op_name
+    fn.__doc__ = get_op(op_name).doc or \
+        "%s symbol (auto-generated from registry)." % op_name
+    return fn
+
+
+def _init_symbol_module():
+    mod = sys.modules[__name__]
+    for name in list_ops():
+        if not hasattr(mod, name):
+            setattr(mod, name, _make_sym_func(name))
+
+
+_init_symbol_module()
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _invoke("_zeros", [], {"shape": shape, "dtype": dtype}, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _invoke("_ones", [], {"shape": shape, "dtype": dtype}, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
+    return _invoke("_arange", [], {"start": start, "stop": stop,
+                                   "step": step, "repeat": repeat,
+                                   "dtype": dtype}, name=name)

@@ -1,0 +1,182 @@
+"""Network visualization: print_summary + plot_network.
+
+Reference: ``python/mxnet/visualization.py``.  ``plot_network`` needs
+graphviz; ``print_summary`` is dependency-free.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
+                                                                  .74, 1.)):
+    """Print a layer-by-layer summary table (reference print_summary)."""
+    if shape is not None:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if shape is not None:
+                        key = input_name + "_output"
+                        if key in shape_dict:
+                            shape1 = shape_dict[key]
+                            if len(shape1) > 1:
+                                pre_filter = pre_filter + int(shape1[1])
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == "Convolution":
+            num_group = int(attrs.get("num_group", "1"))
+            ks = attrs["kernel"].strip("()").split(",")
+            cur_param = pre_filter * int(attrs["num_filter"]) // num_group
+            for k in ks:
+                if k.strip():
+                    cur_param *= int(k)
+            cur_param += int(attrs["num_filter"])
+        elif op == "FullyConnected":
+            if attrs.get("no_bias", "False") in ("True", "1", "true"):
+                cur_param = pre_filter * int(attrs["num_hidden"])
+            else:
+                cur_param = (pre_filter + 1) * int(attrs["num_hidden"])
+        elif op == "BatchNorm":
+            key = node["name"] + "_output"
+            if shape is not None and key in shape_dict:
+                num_filter = shape_dict[key][1]
+                cur_param = int(num_filter) * 2
+        if not pre_node:
+            first_connection = ""
+        else:
+            first_connection = pre_node[0]
+        fields = [node["name"] + "(" + op + ")",
+                  "x".join([str(x) for x in out_shape]),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        if len(pre_node) > 1:
+            for i in range(1, len(pre_node)):
+                fields = ["", "", "", pre_node[i]]
+                print_row(fields, positions)
+        total_params[0] += cur_param
+
+    heads = set(x[0] for x in conf["heads"])
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if shape is not None:
+                key = node["name"] + "_output"
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print("Total params: %s" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot of the network (reference plot_network).  Requires the
+    `graphviz` python package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires the graphviz package")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    if node_attrs:
+        node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = {"shape": "oval"}
+        label = name
+        if op == "null":
+            if name.endswith("_weight") or name.endswith("_bias") or \
+                    name.endswith("_gamma") or name.endswith("_beta") or \
+                    name.endswith("_moving_mean") or \
+                    name.endswith("_moving_var"):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                    continue
+            attrs["fillcolor"] = "#8dd3c7"
+        elif op == "Convolution":
+            a = node["attrs"]
+            label = "Convolution\n%s/%s, %s" % (
+                a.get("kernel"), a.get("stride", "(1,1)"),
+                a.get("num_filter"))
+            attrs["fillcolor"] = "#fb8072"
+        elif op == "FullyConnected":
+            label = "FullyConnected\n%s" % node["attrs"]["num_hidden"]
+            attrs["fillcolor"] = "#fb8072"
+        elif op == "BatchNorm":
+            attrs["fillcolor"] = "#bebada"
+        elif op == "Activation" or op == "LeakyReLU":
+            label = "%s\n%s" % (op, node["attrs"].get("act_type", ""))
+            attrs["fillcolor"] = "#ffffb3"
+        elif op == "Pooling":
+            a = node["attrs"]
+            label = "Pooling\n%s, %s/%s" % (
+                a.get("pool_type"), a.get("kernel"), a.get("stride",
+                                                           "(1,1)"))
+            attrs["fillcolor"] = "#80b1d3"
+        elif op in ("Concat", "Flatten", "Reshape"):
+            attrs["fillcolor"] = "#fdb462"
+        elif op == "Softmax" or op == "SoftmaxOutput":
+            attrs["fillcolor"] = "#b3de69"
+        else:
+            attrs["fillcolor"] = "#fccde5"
+        attrs["label"] = label
+        dot.node(name=name, **dict(node_attr, **attrs))
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for item in node["inputs"]:
+            input_node = nodes[item[0]]
+            if input_node["name"] not in hidden_nodes:
+                dot.edge(tail_name=input_node["name"],
+                         head_name=node["name"])
+    return dot

@@ -1,0 +1,31 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+Mirrors the reference's test strategy (SURVEY.md §4): CPU contexts stand in
+for the device mesh, so multi-device/sharding tests run anywhere; the bench
+path runs on real TPU hardware separately.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The axon TPU plugin prepends itself to jax_platforms at import regardless
+# of the env var; override through the config API before any backend use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as _np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    """Deterministic tests (reference test suite seeds similarly)."""
+    _np.random.seed(0)
+    import mxnet_tpu as _mx
+    _mx.random.seed(0)
+    yield

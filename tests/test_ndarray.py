@@ -1,0 +1,141 @@
+"""NDArray tests (reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32 or str(a.dtype) == "float32"
+    assert a.size == 4
+    b = nd.zeros((3, 4))
+    assert b.asnumpy().sum() == 0
+    c = nd.ones((2, 2))
+    assert c.asnumpy().sum() == 4
+    d = nd.full((2,), 7)
+    assert (d.asnumpy() == 7).all()
+    e = nd.arange(0, 10, 2)
+    assert list(e.asnumpy()) == [0, 2, 4, 6, 8]
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert list((a + b).asnumpy()) == [5, 7, 9]
+    assert list((b - a).asnumpy()) == [3, 3, 3]
+    assert list((a * b).asnumpy()) == [4, 10, 18]
+    np.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    assert list((a + 1).asnumpy()) == [2, 3, 4]
+    assert list((2 * a).asnumpy()) == [2, 4, 6]
+    assert list((-a).asnumpy()) == [-1, -2, -3]
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+
+
+def test_inplace():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    assert list(a.asnumpy()) == [2, 3]
+    a *= 2
+    assert list(a.asnumpy()) == [4, 6]
+    a[:] = 0
+    assert list(a.asnumpy()) == [0, 0]
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert a[1].shape == (4,)
+    assert a[1:3].shape == (2, 4)
+    a[0] = 5
+    assert (a.asnumpy()[0] == 5).all()
+    s = a.slice(1, 3)
+    assert s.shape == (2, 4)
+    sa = a.slice_axis(1, 0, 2)
+    assert sa.shape == (3, 2)
+
+
+def test_views():
+    a = nd.array(np.arange(6).reshape(2, 3))
+    assert a.reshape((3, 2)).shape == (3, 2)
+    assert a.T.shape == (3, 2)
+    assert a.astype("int32").dtype == np.int32
+    assert a.expand_dims(0).shape == (1, 2, 3)
+    assert a.flatten().shape == (2, 3)
+
+
+def test_reduce_methods():
+    a = nd.array(np.arange(6).reshape(2, 3).astype("float32"))
+    assert a.sum().asscalar() == 15
+    assert a.max().asscalar() == 5
+    assert a.min().asscalar() == 0
+    np.testing.assert_allclose(a.sum(axis=0).asnumpy(), [3, 5, 7])
+
+
+def test_generated_ops():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(nd.exp(a).asnumpy(), np.exp(a.asnumpy()),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.dot(a, a).asnumpy(), a.asnumpy() @ a.asnumpy(), rtol=1e-6)
+    out = nd.zeros((2, 2))
+    nd.square(a, out=out)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() ** 2)
+
+
+def test_broadcast_ops():
+    a = nd.array(np.ones((2, 3)))
+    b = nd.array(np.arange(3).astype("float32"))
+    c = nd.broadcast_add(a, b.reshape((1, 3)))
+    np.testing.assert_allclose(c.asnumpy(), 1 + np.arange(3) * np.ones(
+        (2, 3)))
+
+
+def test_copyto_context():
+    a = nd.array([1.0, 2.0])
+    b = nd.zeros((2,))
+    a.copyto(b)
+    assert list(b.asnumpy()) == [1, 2]
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type == "cpu"
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays")
+    d = {"w": nd.array([1.0, 2.0]), "b": nd.array([[3.0]])}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), [1, 2])
+    lst = [nd.array([1.0]), nd.array([2.0, 3.0])]
+    nd.save(fname + "2", lst)
+    loaded2 = nd.load(fname + "2")
+    assert len(loaded2) == 2
+    np.testing.assert_allclose(loaded2[1].asnumpy(), [2, 3])
+
+
+def test_onehot():
+    idx = nd.array([0, 2, 1])
+    out = nd.zeros((3, 3))
+    nd.onehot_encode(idx, out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               [[1, 0, 0], [0, 0, 1], [0, 1, 0]])
+
+
+def test_waitall():
+    a = nd.array([1.0])
+    b = a + 1
+    nd.waitall()
+    assert b.asscalar() == 2
+
+
+def test_sampling_ops():
+    mx.random.seed(42)
+    u = nd.uniform(low=0, high=1, shape=(100,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    mx.random.seed(42)
+    u2 = nd.uniform(low=0, high=1, shape=(100,))
+    np.testing.assert_allclose(u.asnumpy(), u2.asnumpy())
+    n = nd.normal(loc=0, scale=1, shape=(500,))
+    assert abs(float(n.asnumpy().mean())) < 0.3

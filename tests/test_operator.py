@@ -1,0 +1,399 @@
+"""Operator numeric-correctness tests vs numpy references + finite
+differences (reference tests/python/unittest/test_operator.py and the §4
+test strategy: per-op numpy oracles + check_numeric_gradient)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient,
+                                  check_symbolic_backward,
+                                  check_symbolic_forward, simple_forward)
+
+
+def test_elemwise_unary_forward():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                      ("square", np.square), ("abs", np.abs),
+                      ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                      ("tanh", np.tanh), ("relu", lambda v:
+                                          np.maximum(v, 0))]:
+        s = getattr(sym, name)(sym.Variable("data"))
+        out = simple_forward(s, data=x)
+        np.testing.assert_allclose(out, ref(x), rtol=1e-5, atol=1e-6)
+
+
+def test_elemwise_binary():
+    a = np.random.randn(2, 3).astype("float32")
+    b = np.random.randn(2, 3).astype("float32")
+    s = sym.elemwise_add(sym.Variable("lhs"), sym.Variable("rhs"))
+    np.testing.assert_allclose(simple_forward(s, lhs=a, rhs=b), a + b,
+                               rtol=1e-6)
+
+
+def test_scalar_ops():
+    a = np.random.randn(4).astype("float32")
+    s = sym.Variable("a") * 3 + 1
+    np.testing.assert_allclose(simple_forward(s, a=a), a * 3 + 1, rtol=1e-6)
+
+
+def test_dot_and_grad():
+    a = np.random.randn(3, 4).astype("float32")
+    b = np.random.randn(4, 5).astype("float32")
+    s = sym.dot(sym.Variable("lhs"), sym.Variable("rhs"))
+    check_symbolic_forward(s, {"lhs": a, "rhs": b}, [a @ b], rtol=1e-5)
+    og = np.ones((3, 5), dtype="float32")
+    check_symbolic_backward(s, {"lhs": a, "rhs": b}, [og],
+                            {"lhs": og @ b.T, "rhs": a.T @ og}, rtol=1e-4)
+
+
+def test_dot_transpose():
+    a = np.random.randn(4, 3).astype("float32")
+    b = np.random.randn(5, 4).astype("float32")
+    s = sym.dot(sym.Variable("lhs"), sym.Variable("rhs"), transpose_a=True,
+                transpose_b=True)
+    np.testing.assert_allclose(simple_forward(s, lhs=a, rhs=b), a.T @ b.T,
+                               rtol=1e-5)
+
+
+def test_batch_dot():
+    a = np.random.randn(2, 3, 4).astype("float32")
+    b = np.random.randn(2, 4, 5).astype("float32")
+    s = sym.batch_dot(sym.Variable("lhs"), sym.Variable("rhs"))
+    np.testing.assert_allclose(simple_forward(s, lhs=a, rhs=b),
+                               np.matmul(a, b), rtol=1e-5)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4, 5).astype("float32")
+    for name, ref in [("sum", np.sum), ("mean", np.mean), ("max", np.max),
+                      ("min", np.min), ("prod", np.prod)]:
+        s = getattr(sym, name)(sym.Variable("data"), axis=1)
+        np.testing.assert_allclose(simple_forward(s, data=x),
+                                   ref(x, axis=1), rtol=1e-4, atol=1e-5)
+    s = sym.sum(sym.Variable("data"), axis=(0, 2), keepdims=True)
+    np.testing.assert_allclose(simple_forward(s, data=x),
+                               x.sum(axis=(0, 2), keepdims=True), rtol=1e-4)
+
+
+def test_argmax_argmin():
+    x = np.random.randn(3, 7).astype("float32")
+    s = sym.argmax(sym.Variable("data"), axis=1)
+    np.testing.assert_allclose(simple_forward(s, data=x),
+                               np.argmax(x, axis=1))
+
+
+def test_reshape_codes():
+    x = np.arange(24).reshape(2, 3, 4).astype("float32")
+    s = sym.Reshape(sym.Variable("data"), shape=(-1,))
+    assert simple_forward(s, data=x).shape == (24,)
+    s = sym.Reshape(sym.Variable("data"), shape=(0, -1))
+    assert simple_forward(s, data=x).shape == (2, 12)
+    s = sym.Reshape(sym.Variable("data"), shape=(-2,))
+    assert simple_forward(s, data=x).shape == (2, 3, 4)
+    s = sym.Reshape(sym.Variable("data"), shape=(-3, 4))
+    assert simple_forward(s, data=x).shape == (6, 4)
+    s = sym.Reshape(sym.Variable("data"), shape=(-4, 1, 2, 3, 4))
+    assert simple_forward(s, data=x).shape == (1, 2, 3, 4)
+
+
+def test_transpose_slice():
+    x = np.arange(24).reshape(2, 3, 4).astype("float32")
+    s = sym.transpose(sym.Variable("data"), axes=(2, 0, 1))
+    np.testing.assert_allclose(simple_forward(s, data=x),
+                               x.transpose(2, 0, 1))
+    s = sym.slice(sym.Variable("data"), begin=(0, 1), end=(2, 3))
+    np.testing.assert_allclose(simple_forward(s, data=x), x[0:2, 1:3])
+    s = sym.slice_axis(sym.Variable("data"), axis=2, begin=1, end=3)
+    np.testing.assert_allclose(simple_forward(s, data=x), x[:, :, 1:3])
+
+
+def test_clip_tile_repeat_reverse():
+    x = np.random.randn(2, 3).astype("float32")
+    np.testing.assert_allclose(
+        simple_forward(sym.clip(sym.Variable("data"), a_min=-0.5,
+                                a_max=0.5), data=x), np.clip(x, -0.5, 0.5))
+    np.testing.assert_allclose(
+        simple_forward(sym.tile(sym.Variable("data"), reps=(2, 2)), data=x),
+        np.tile(x, (2, 2)))
+    np.testing.assert_allclose(
+        simple_forward(sym.repeat(sym.Variable("data"), repeats=2, axis=1),
+                       data=x), np.repeat(x, 2, axis=1))
+    np.testing.assert_allclose(
+        simple_forward(sym.reverse(sym.Variable("data"), axis=(1,)),
+                       data=x), x[:, ::-1])
+
+
+def test_concat_split():
+    a = np.random.randn(2, 3).astype("float32")
+    b = np.random.randn(2, 5).astype("float32")
+    s = sym.Concat(sym.Variable("a"), sym.Variable("b"), dim=1)
+    np.testing.assert_allclose(simple_forward(s, a=a, b=b),
+                               np.concatenate([a, b], axis=1))
+    x = np.random.randn(2, 6).astype("float32")
+    s = sym.SliceChannel(sym.Variable("data"), num_outputs=3, axis=1)
+    outs = simple_forward(s, data=x)
+    np.testing.assert_allclose(outs[1], x[:, 2:4])
+
+
+def test_where():
+    c = np.array([[1, 0], [0, 1]], dtype="float32")
+    x = np.ones((2, 2), dtype="float32")
+    y = np.zeros((2, 2), dtype="float32")
+    s = sym.where(sym.Variable("condition"), sym.Variable("x"),
+                  sym.Variable("y"))
+    np.testing.assert_allclose(simple_forward(s, condition=c, x=x, y=y), c)
+
+
+def test_fully_connected_numeric_grad():
+    s = sym.FullyConnected(sym.Variable("data"), num_hidden=3, name="fc")
+    data = np.random.randn(4, 5).astype("float32")
+    weight = np.random.randn(3, 5).astype("float32")
+    bias = np.random.randn(3).astype("float32")
+    check_numeric_gradient(s, {"data": data, "fc_weight": weight,
+                               "fc_bias": bias}, numeric_eps=1e-2,
+                           rtol=5e-2, atol=5e-2)
+
+
+def test_convolution_forward():
+    x = np.random.randn(1, 1, 5, 5).astype("float32")
+    w = np.random.randn(1, 1, 3, 3).astype("float32")
+    s = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=1,
+                        no_bias=True, name="conv")
+    out = simple_forward(s, data=x, conv_weight=w)
+    # direct correlation reference
+    ref = np.zeros((1, 1, 3, 3), dtype="float32")
+    for i in range(3):
+        for j in range(3):
+            ref[0, 0, i, j] = (x[0, 0, i:i + 3, j:j + 3] * w[0, 0]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_grad():
+    s = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=2,
+                        pad=(1, 1), name="conv")
+    data = np.random.randn(2, 3, 5, 5).astype("float32")
+    w = np.random.randn(2, 3, 3, 3).astype("float32") * 0.1
+    b = np.zeros(2, dtype="float32")
+    check_numeric_gradient(s, {"data": data, "conv_weight": w,
+                               "conv_bias": b},
+                           grad_nodes=["conv_weight", "conv_bias"],
+                           numeric_eps=1e-2, rtol=8e-2, atol=8e-2)
+
+
+def test_pooling():
+    x = np.random.randn(1, 2, 4, 4).astype("float32")
+    s = sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2),
+                    pool_type="max")
+    out = simple_forward(s, data=x)
+    assert out.shape == (1, 2, 2, 2)
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    s = sym.Pooling(sym.Variable("data"), pool_type="avg", global_pool=True)
+    out = simple_forward(s, data=x)
+    np.testing.assert_allclose(out.reshape(1, 2),
+                               x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_batchnorm_train_stats():
+    x = np.random.randn(8, 3, 2, 2).astype("float32") * 2 + 1
+    s = sym.BatchNorm(sym.Variable("data"), fix_gamma=False, name="bn")
+    ex = s.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = 1
+    ex.arg_dict["bn_beta"][:] = 0
+    ex.aux_dict["bn_moving_var"][:] = 1
+    out = ex.forward(is_train=True)[0].asnumpy()
+    # normalized output has ~zero mean / unit variance per channel
+    assert abs(out.mean(axis=(0, 2, 3))).max() < 1e-4
+    np.testing.assert_allclose(out.var(axis=(0, 2, 3)), 1.0, atol=1e-2)
+    # moving stats updated toward batch stats
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert abs(mm).sum() > 0
+
+
+def test_batchnorm_inference_uses_moving():
+    x = np.random.randn(4, 2).astype("float32")
+    s = sym.BatchNorm(sym.Variable("data"), fix_gamma=True, name="bn")
+    ex = s.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    ex.aux_dict["bn_moving_mean"][:] = 0
+    ex.aux_dict["bn_moving_var"][:] = 1
+    out = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-2, atol=1e-2)
+
+
+def test_dropout():
+    x = np.ones((100, 100), dtype="float32")
+    s = sym.Dropout(sym.Variable("data"), p=0.5)
+    ex = s.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    out_train = ex.forward(is_train=True)[0].asnumpy()
+    frac_zero = (out_train == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+    # scaled: surviving entries are 1/keep
+    assert np.allclose(out_train[out_train > 0], 2.0, rtol=1e-5)
+    out_test = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_test, x)
+
+
+def test_softmax_output_backward():
+    n, c = 4, 3
+    x = np.random.randn(n, c).astype("float32")
+    label = np.array([0, 1, 2, 1], dtype="float32")
+    s = sym.SoftmaxOutput(sym.Variable("data"), sym.Variable("label"),
+                          name="sm")
+    grads = check_symbolic_backward(
+        s, {"data": x, "label": label}, None,
+        {"data": _softmax(x) - _onehot(label, c)}, rtol=1e-4,
+        grad_req={"data": "write", "label": "null"})
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _onehot(label, c):
+    out = np.zeros((len(label), c), dtype="float32")
+    out[np.arange(len(label)), label.astype(int)] = 1
+    return out
+
+
+def test_linear_regression_output():
+    x = np.random.randn(4, 2).astype("float32")
+    y = np.random.randn(4, 2).astype("float32")
+    s = sym.LinearRegressionOutput(sym.Variable("data"),
+                                   sym.Variable("label"))
+    check_symbolic_forward(s, {"data": x, "label": y}, [x])
+    # reference scales by grad_scale / num_output (outputs per sample = 2)
+    check_symbolic_backward(s, {"data": x, "label": y}, None,
+                            {"data": (x - y) / 2}, rtol=1e-5,
+                            grad_req={"data": "write", "label": "null"})
+
+
+def test_block_grad():
+    x = np.random.randn(3).astype("float32")
+    a = sym.Variable("a")
+    s = sym.make_loss(sym.sum(sym.BlockGrad(a * 2) + a))
+    g = check_symbolic_backward(s, {"a": x}, None,
+                                {"a": np.ones(3, dtype="float32")},
+                                rtol=1e-5)
+
+
+def test_embedding():
+    data = np.array([1, 0, 2], dtype="float32")
+    weight = np.random.randn(3, 4).astype("float32")
+    s = sym.Embedding(sym.Variable("data"), input_dim=3, output_dim=4,
+                      name="embed")
+    out = simple_forward(s, data=data, embed_weight=weight)
+    np.testing.assert_allclose(out, weight[[1, 0, 2]])
+
+
+def test_take_one_hot():
+    a = np.random.randn(5, 3).astype("float32")
+    idx = np.array([0, 4, 2], dtype="float32")
+    s = sym.take(sym.Variable("a"), sym.Variable("indices"))
+    np.testing.assert_allclose(simple_forward(s, a=a, indices=idx),
+                               a[[0, 4, 2]])
+    s = sym.one_hot(sym.Variable("indices"), depth=5)
+    out = simple_forward(s, indices=idx)
+    assert out.shape == (3, 5)
+    assert out[1, 4] == 1
+
+
+def test_topk_sort():
+    x = np.random.randn(3, 6).astype("float32")
+    s = sym.topk(sym.Variable("data"), k=2, ret_typ="value")
+    out = simple_forward(s, data=x)
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    s = sym.sort(sym.Variable("data"), axis=1)
+    np.testing.assert_allclose(simple_forward(s, data=x),
+                               np.sort(x, axis=1), rtol=1e-6)
+    s = sym.argsort(sym.Variable("data"), axis=1)
+    np.testing.assert_allclose(simple_forward(s, data=x),
+                               np.argsort(x, axis=1))
+
+
+def test_sequence_ops():
+    x = np.random.randn(4, 2, 3).astype("float32")  # [T, N, C]
+    slen = np.array([2, 4], dtype="float32")
+    s = sym.SequenceLast(sym.Variable("data"),
+                         sym.Variable("sequence_length"),
+                         use_sequence_length=True)
+    out = simple_forward(s, data=x, sequence_length=slen)
+    np.testing.assert_allclose(out[0], x[1, 0])
+    np.testing.assert_allclose(out[1], x[3, 1])
+
+    s = sym.SequenceMask(sym.Variable("data"),
+                         sym.Variable("sequence_length"),
+                         use_sequence_length=True, value=-1)
+    out = simple_forward(s, data=x, sequence_length=slen)
+    assert (out[2:, 0] == -1).all()
+    np.testing.assert_allclose(out[:, 1], x[:, 1])
+
+    s = sym.SequenceReverse(sym.Variable("data"),
+                            sym.Variable("sequence_length"),
+                            use_sequence_length=True)
+    out = simple_forward(s, data=x, sequence_length=slen)
+    np.testing.assert_allclose(out[0, 0], x[1, 0])
+    np.testing.assert_allclose(out[0, 1], x[3, 1])
+
+
+def test_leaky_relu():
+    x = np.array([-2.0, -0.5, 0.5, 2.0], dtype="float32")
+    s = sym.LeakyReLU(sym.Variable("data"), act_type="leaky", slope=0.1)
+    np.testing.assert_allclose(simple_forward(s, data=x),
+                               np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    s = sym.LeakyReLU(sym.Variable("data"), act_type="elu", slope=1.0)
+    np.testing.assert_allclose(simple_forward(s, data=x),
+                               np.where(x > 0, x, np.expm1(x)), rtol=1e-5)
+
+
+def test_cast():
+    x = np.array([1.5, 2.5], dtype="float32")
+    s = sym.Cast(sym.Variable("data"), dtype="int32")
+    out = simple_forward(s, data=x)
+    assert out.dtype == np.int32
+
+
+def test_optimizer_ops():
+    from mxnet_tpu import ndarray as nd
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.1])
+    nd.sgd_update(w, g, lr=1.0, out=w)
+    np.testing.assert_allclose(w.asnumpy(), [0.9, 1.9], rtol=1e-6)
+    # momentum: state mutated in place
+    mom = nd.zeros((2,))
+    nd.sgd_mom_update(w, g, mom, lr=1.0, momentum=0.9, out=w)
+    np.testing.assert_allclose(mom.asnumpy(), [-0.1, -0.1], rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), [0.8, 1.8], rtol=1e-6)
+
+
+def test_l2_normalization():
+    x = np.random.randn(3, 4).astype("float32")
+    s = sym.L2Normalization(sym.Variable("data"), mode="instance")
+    out = simple_forward(s, data=x)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1),
+                               np.ones(3), rtol=1e-4)
+
+
+def test_upsampling():
+    x = np.random.randn(1, 2, 3, 3).astype("float32")
+    s = sym.UpSampling(sym.Variable("data"), scale=2, sample_type="nearest",
+                       num_args=1)
+    out = simple_forward(s, data=x)
+    assert out.shape == (1, 2, 6, 6)
+    np.testing.assert_allclose(out[0, 0, ::2, ::2], x[0, 0])
+
+
+def test_pad():
+    x = np.random.randn(1, 1, 2, 2).astype("float32")
+    s = sym.Pad(sym.Variable("data"), mode="constant",
+                pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=5.0)
+    out = simple_forward(s, data=x)
+    assert out.shape == (1, 1, 4, 4)
+    assert out[0, 0, 0, 0] == 5.0
+    np.testing.assert_allclose(out[0, 0, 1:3, 1:3], x[0, 0])

@@ -1,0 +1,167 @@
+"""Symbol tests (reference tests/python/unittest/test_symbol.py +
+test_infer_shape.py + test_attr.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net = sym.Activation(data=net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=10)
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    return net
+
+
+def test_compose_basic():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_auto_naming():
+    with mx.NameManager():
+        data = sym.Variable("data")
+        a = sym.FullyConnected(data, num_hidden=2)
+        b = sym.FullyConnected(a, num_hidden=2)
+        assert a.name == "fullyconnected0"
+        assert b.name == "fullyconnected1"
+
+
+def test_prefix():
+    with mx.Prefix("stage1_"):
+        data = sym.Variable("data")
+        a = sym.FullyConnected(data, num_hidden=2)
+    assert a.name.startswith("stage1_")
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (10, 100)
+    assert d["fc1_bias"] == (10,)
+    assert d["fc2_weight"] == (10, 10)
+    assert d["softmax_label"] == (32,)
+    assert out_shapes == [(32, 10)]
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes == [None]
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 32, 32))
+    d = dict(zip(conv.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (8, 3, 3, 3)
+    assert out_shapes == [(2, 8, 32, 32)]
+
+
+def test_infer_type():
+    net = _mlp()
+    arg_types, out_types, _ = net.infer_type(data="float32")
+    assert out_types == ["float32"]
+
+
+def test_getitem_and_group():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=2, name="fc")
+    act = sym.Activation(fc, act_type="relu", name="act")
+    grp = sym.Group([fc, act])
+    assert len(grp) == 2
+    assert grp[1].list_outputs() == ["act_output"]
+    assert grp["fc_output"].name == "fc"
+
+
+def test_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    assert "relu1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_attrs():
+    data = sym.Variable("data", lr_mult=2.0)
+    assert data.attr("__lr_mult__") == "2.0"
+    with mx.AttrScope(ctx_group="stage1"):
+        fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    assert fc.attr("__ctx_group__") == "stage1"
+    ad = fc.attr_dict()
+    assert ad["fc"]["__ctx_group__"] == "stage1"
+    assert ad["fc"]["num_hidden"] == "3"
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(8, 50))
+    a2, o2, _ = net2.infer_shape(data=(8, 50))
+    assert o1 == o2 and a1 == a2
+
+
+def test_save_load_file(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net2 = sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_compose_call():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data, num_hidden=4, name="fc_a")
+    data2 = sym.Variable("data2")
+    net2 = sym.Activation(sym.Variable("data"), act_type="relu")
+    composed = net2(data=net1)
+    assert "fc_a_weight" in composed.list_arguments()
+
+
+def test_arithmetic_sugar():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    d = a * 2 + b / 2 - 1
+    ex = d.bind(mx.cpu(), {"a": mx.nd.array([2.0]),
+                           "b": mx.nd.array([4.0])})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [5.0])
+
+
+def test_multi_output_ops():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=2, axis=1, name="sliced")
+    assert len(parts) == 2
+    _, out_shapes, _ = parts.infer_shape(data=(2, 4))
+    assert out_shapes == [(2, 2), (2, 2)]
+
+
+def test_bn_aux_states():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert "bn_gamma" in bn.list_arguments()
+    assert "bn_moving_mean" not in bn.list_arguments()
+
+
+def test_variable_shape_attr():
+    data = sym.Variable("data", shape=(4, 8))
+    fc = sym.FullyConnected(data, num_hidden=2, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape()
+    assert out_shapes == [(4, 2)]
