@@ -30,9 +30,29 @@ from .ndarray import NDArray
 __all__ = ["Executor"]
 
 
-def _apply_pure(node, *xs):
+def _apply_pure(node, attrs, *xs):
     """Stateless op application (rematerialization-eligible)."""
-    return node.op.apply(node.attrs, xs, (), False, None)[0]
+    return node.op.apply(attrs, xs, (), False, None)[0]
+
+
+def shape_overrides(symbol, known_shapes):
+    """Specialized attrs for 0-wildcard init ops.
+
+    The reference lets TShape dim 0 mean 'infer me' (e.g. RNN begin_state
+    zeros of shape (0, H)); XLA needs static shapes, so bind-time inference
+    resolves them and ops get a substituted concrete ``shape`` attr."""
+    from .symbol import infer_node_shapes
+    hints = infer_node_shapes(symbol, dict(known_shapes))
+    overrides = {}
+    for node in symbol._nodes():
+        if node.is_variable:
+            continue
+        s = node.attrs.get("shape")
+        if s is not None and 0 in s:
+            hint = hints.get((id(node), 0))
+            if hint is not None and 0 not in hint:
+                overrides[id(node)] = dict(node.attrs, shape=tuple(hint))
+    return overrides
 
 
 class Executor:
@@ -63,6 +83,9 @@ class Executor:
                           and self.grad_arrays[i] is not None]
 
         self._build_maps()
+        self._attr_overrides = shape_overrides(
+            symbol, {n: a.shape for n, a in zip(self._arg_names,
+                                                self.arg_arrays)})
         self._compile()
 
         # placeholder outputs carry the inferred shapes so output_shapes is
@@ -111,12 +134,13 @@ class Executor:
             need_rng = node.op.needs_rng or node.op.stateful
             r = jax.random.fold_in(rng, idx) if (need_rng and
                                                  rng is not None) else None
+            attrs = self._attr_overrides.get(id(node), node.attrs)
             if remat and not node.op.stateful and not node.op.needs_rng:
                 outs = jax.checkpoint(
-                    functools.partial(_apply_pure, node))(*ins)
+                    functools.partial(_apply_pure, node, attrs))(*ins)
                 upd = ()
             else:
-                outs, upd = node.op.apply(node.attrs, ins, aux_in,
+                outs, upd = node.op.apply(attrs, ins, aux_in,
                                           is_train, r)
             for oi, o in enumerate(outs):
                 vals[(id(node), oi)] = o

@@ -16,5 +16,8 @@ from . import ordering      # noqa: F401
 from . import sampling      # noqa: F401
 from . import sequence      # noqa: F401
 from . import optimizer_op  # noqa: F401
+from . import vision        # noqa: F401
+from . import contrib       # noqa: F401
+from . import rnn_op        # noqa: F401
 
 __all__ = ["get_op", "list_ops", "register", "OpDef"]

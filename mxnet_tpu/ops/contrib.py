@@ -1,0 +1,556 @@
+"""Contrib operators: SSD MultiBox family, Faster-RCNN Proposal, CTC loss,
+CountSketch, FFT, quantization.
+
+Reference: ``src/operator/contrib/`` — multibox_prior/target/detection
+(SSD, example/ssd), proposal (RCNN), ctc_loss (vendored warp-ctc),
+count_sketch, fft/ifft (cuFFT/hipFFT), quantize/dequantize.
+
+TPU-native notes: NMS loops become ``lax.fori_loop`` over a fixed top-k
+(static shapes); CTC is a log-space forward recursion under ``lax.scan``
+whose gradient falls out of autodiff — no hand-written backward kernel.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import (Bool, Float, FloatTuple, Int, Shape, Str, register,
+                       register_alias)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior (reference multibox_prior-inl.h)
+# ---------------------------------------------------------------------------
+def _multibox_prior_fc(attrs, data):
+    _, _, in_h, in_w = data.shape
+    sizes = attrs["sizes"]
+    ratios = attrs["ratios"]
+    steps = attrs["steps"]
+    offsets = attrs["offsets"]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / in_h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / in_w
+
+    cy = (jnp.arange(in_h) + offsets[0]) * step_y
+    cx = (jnp.arange(in_w) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+
+    # anchor set per pixel: sizes with ratio[0], then ratios[1:] with size[0]
+    ws, hs = [], []
+    for s in sizes:
+        ws.append(s * np.sqrt(ratios[0]) / 2)
+        hs.append(s / np.sqrt(ratios[0]) / 2)
+    for r in ratios[1:]:
+        ws.append(sizes[0] * np.sqrt(r) / 2)
+        hs.append(sizes[0] / np.sqrt(r) / 2)
+    ws = jnp.asarray(ws)  # (A,) half-widths
+    hs = jnp.asarray(hs)
+
+    xmin = cx[:, :, None] - ws[None, None, :]
+    ymin = cy[:, :, None] - hs[None, None, :]
+    xmax = cx[:, :, None] + ws[None, None, :]
+    ymax = cy[:, :, None] + hs[None, None, :]
+    anchors = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # (H, W, A, 4)
+    if attrs["clip"]:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors.reshape(1, -1, 4).astype(data.dtype)
+
+
+def _multibox_prior_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    num = len(attrs["sizes"]) + len(attrs["ratios"]) - 1
+    return in_shapes, [(1, ds[2] * ds[3] * num, 4)], []
+
+
+register("_contrib_MultiBoxPrior", fcompute=_multibox_prior_fc,
+         attrs={"sizes": FloatTuple((1.0,)), "ratios": FloatTuple((1.0,)),
+                "clip": Bool(False), "steps": FloatTuple((-1.0, -1.0)),
+                "offsets": FloatTuple((0.5, 0.5))},
+         infer_shape=_multibox_prior_infer)
+register_alias("_contrib_MultiBoxPrior", "MultiBoxPrior")
+
+
+# ---------------------------------------------------------------------------
+# box helpers
+# ---------------------------------------------------------------------------
+def _iou(boxes_a, boxes_b):
+    """(A, 4) x (B, 4) -> (A, B) IoU (corner format)."""
+    ax1, ay1, ax2, ay2 = [boxes_a[:, i] for i in range(4)]
+    bx1, by1, bx2, by2 = [boxes_b[:, i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0.0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_boxes(anchors, gt, variances):
+    """SSD box encoding: (center-offset / variance)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    eps = 1e-8
+    tx = (gcx - acx) / jnp.maximum(aw, eps) / variances[0]
+    ty = (gcy - acy) / jnp.maximum(ah, eps) / variances[1]
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, eps), eps)) / variances[2]
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, eps), eps)) / variances[3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+def _decode_boxes(anchors, deltas, variances, clip):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = deltas[:, 0] * variances[0] * aw + acx
+    cy = deltas[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(deltas[:, 2] * variances[2]) * aw / 2
+    h = jnp.exp(deltas[:, 3] * variances[3]) * ah / 2
+    out = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (reference multibox_target-inl.h; anchors + labels →
+# loc_target / loc_mask / cls_target)
+# ---------------------------------------------------------------------------
+def _multibox_target_one(anchors, label, variances, overlap_threshold,
+                         ignore_label, negative_mining_ratio,
+                         negative_mining_thresh, cls_pred):
+    """anchors: (A, 4); label: (M, 5+) [cls, x1, y1, x2, y2]; cls_pred:
+    (num_class+1, A)."""
+    A = anchors.shape[0]
+    valid_gt = label[:, 0] >= 0            # (M,)
+    gt_boxes = label[:, 1:5]
+    ious = _iou(anchors, gt_boxes)         # (A, M)
+    ious = jnp.where(valid_gt[None, :], ious, -1.0)
+
+    best_gt = jnp.argmax(ious, axis=1)       # (A,)
+    best_iou = jnp.max(ious, axis=1)
+
+    # force-match: each gt's best anchor is positive
+    best_anchor_per_gt = jnp.argmax(ious, axis=0)  # (M,)
+    forced = jnp.zeros((A,), dtype=bool)
+    forced = forced.at[best_anchor_per_gt].set(valid_gt)
+
+    positive = forced | (best_iou >= overlap_threshold)
+    matched_gt = best_gt
+
+    cls_target = jnp.where(
+        positive, label[matched_gt, 0] + 1.0, 0.0)
+    # negative mining: keep hardest negatives up to ratio * num_pos
+    if negative_mining_ratio > 0:
+        num_pos = jnp.sum(positive)
+        max_neg = (negative_mining_ratio * num_pos).astype(jnp.int32)
+        neg_cand = (~positive) & (best_iou < negative_mining_thresh)
+        # hardness = background prob deficit = max prob - background prob
+        bg_prob = cls_pred[0]
+        hardness = jnp.where(neg_cand, -bg_prob, -jnp.inf)
+        order = jnp.argsort(-hardness)
+        rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+        keep_neg = neg_cand & (rank < max_neg)
+        cls_target = jnp.where(positive, cls_target,
+                               jnp.where(keep_neg, 0.0, ignore_label))
+
+    loc_t = _encode_boxes(anchors, gt_boxes[matched_gt], variances)
+    loc_target = jnp.where(positive[:, None], loc_t, 0.0).reshape(-1)
+    loc_mask = jnp.where(positive[:, None],
+                         jnp.ones_like(loc_t), 0.0).reshape(-1)
+    return loc_target, loc_mask, cls_target
+
+
+def _multibox_target_fc(attrs, anchor, label, cls_pred):
+    anchors = anchor.reshape(-1, 4)
+    variances = jnp.asarray(attrs["variances"])
+    fn = functools.partial(
+        _multibox_target_one, anchors,
+        variances=variances,
+        overlap_threshold=attrs["overlap_threshold"],
+        ignore_label=attrs["ignore_label"],
+        negative_mining_ratio=attrs["negative_mining_ratio"],
+        negative_mining_thresh=attrs["negative_mining_thresh"])
+    loc_t, loc_m, cls_t = jax.vmap(
+        lambda lbl, cp: fn(lbl, cls_pred=cp))(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+def _multibox_target_infer(attrs, in_shapes):
+    anchor_s, label_s, cls_s = in_shapes
+    if anchor_s is None or label_s is None:
+        return in_shapes, [None, None, None], []
+    A = anchor_s[1]
+    n = label_s[0]
+    return in_shapes, [(n, A * 4), (n, A * 4), (n, A)], []
+
+
+register("_contrib_MultiBoxTarget", fcompute=_multibox_target_fc,
+         arguments=("anchor", "label", "cls_pred"),
+         outputs=("loc_target", "loc_mask", "cls_target"), num_outputs=3,
+         attrs={"overlap_threshold": Float(0.5), "ignore_label": Float(-1.0),
+                "negative_mining_ratio": Float(-1.0),
+                "negative_mining_thresh": Float(0.5),
+                "minimum_negative_samples": Int(0),
+                "variances": FloatTuple((0.1, 0.1, 0.2, 0.2))},
+         infer_shape=_multibox_target_infer)
+register_alias("_contrib_MultiBoxTarget", "MultiBoxTarget")
+
+
+# ---------------------------------------------------------------------------
+# NMS via fori_loop (static shapes)
+# ---------------------------------------------------------------------------
+def _nms(boxes, scores, classes, nms_threshold, force_suppress):
+    """Greedy NMS over all candidates; returns keep mask."""
+    A = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    ious = _iou(boxes, boxes)
+
+    rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+
+    def body(i, keep):
+        idx = order[i]
+        alive = keep[idx] & (scores[idx] > 0)
+        same_cls = (classes == classes[idx]) | force_suppress
+        suppress = (ious[idx] > nms_threshold) & same_cls & \
+            (jnp.arange(A) != idx) & (rank > i)
+        return jnp.where(alive & suppress, jnp.zeros_like(keep), keep)
+
+    keep = jnp.ones((A,), dtype=jnp.bool_)
+    keep = jax.lax.fori_loop(0, A, body, keep)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (reference multibox_detection-inl.h)
+# ---------------------------------------------------------------------------
+def _multibox_detection_one(cls_prob, loc_pred, anchors, attrs_t):
+    (threshold, background_id, nms_threshold, force_suppress, clip,
+     variances, nms_topk) = attrs_t
+    num_class_p1, A = cls_prob.shape
+    boxes = _decode_boxes(anchors, loc_pred.reshape(-1, 4), variances, clip)
+    # best non-background class per anchor
+    fg = jnp.concatenate([cls_prob[:background_id],
+                          cls_prob[background_id + 1:]], axis=0)
+    cls_id = jnp.argmax(fg, axis=0)        # (A,) in fg index space
+    score = jnp.max(fg, axis=0)
+    valid = score > threshold
+    score = jnp.where(valid, score, 0.0)
+    cls_out = jnp.where(valid, cls_id.astype(jnp.float32), -1.0)
+    keep = _nms(boxes, score, cls_id, nms_threshold, force_suppress)
+    score = jnp.where(keep, score, 0.0)
+    cls_out = jnp.where(keep, cls_out, -1.0)
+    out = jnp.concatenate([cls_out[:, None], score[:, None], boxes],
+                          axis=1)          # (A, 6)
+    order = jnp.argsort(-score)
+    return out[order]
+
+
+def _multibox_detection_fc(attrs, cls_prob, loc_pred, anchor):
+    anchors = anchor.reshape(-1, 4)
+    attrs_t = (attrs["threshold"], attrs["background_id"],
+               attrs["nms_threshold"], attrs["force_suppress"],
+               attrs["clip"], jnp.asarray(attrs["variances"]),
+               attrs["nms_topk"])
+    return jax.vmap(lambda cp, lp: _multibox_detection_one(
+        cp, lp, anchors, attrs_t))(cls_prob, loc_pred)
+
+
+def _multibox_detection_infer(attrs, in_shapes):
+    cls_s = in_shapes[0]
+    if cls_s is None:
+        return in_shapes, [None], []
+    return in_shapes, [(cls_s[0], cls_s[2], 6)], []
+
+
+register("_contrib_MultiBoxDetection", fcompute=_multibox_detection_fc,
+         arguments=("cls_prob", "loc_pred", "anchor"),
+         attrs={"clip": Bool(True), "threshold": Float(0.01),
+                "background_id": Int(0), "nms_threshold": Float(0.5),
+                "force_suppress": Bool(False),
+                "variances": FloatTuple((0.1, 0.1, 0.2, 0.2)),
+                "nms_topk": Int(-1)},
+         infer_shape=_multibox_detection_infer)
+register_alias("_contrib_MultiBoxDetection", "MultiBoxDetection")
+
+
+# ---------------------------------------------------------------------------
+# Proposal (reference contrib/proposal.cc: RPN proposals + NMS)
+# ---------------------------------------------------------------------------
+def _proposal_fc(attrs, cls_prob, bbox_pred, im_info):
+    scales = attrs["scales"]
+    ratios = attrs["ratios"]
+    stride = attrs["feature_stride"]
+    rpn_pre = attrs["rpn_pre_nms_top_n"]
+    rpn_post = attrs["rpn_post_nms_top_n"]
+    thresh = attrs["threshold"]
+    min_size = attrs["rpn_min_size"]
+
+    n, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+
+    # base anchors at (0, 0)
+    base = []
+    base_size = stride
+    for r in ratios:
+        size = base_size * base_size / r
+        ws = np.round(np.sqrt(size))
+        hh = np.round(ws * r)
+        for s in scales:
+            w2 = ws * s / 2.0
+            h2 = hh * s / 2.0
+            cx = (base_size - 1) / 2.0
+            cy = (base_size - 1) / 2.0
+            base.append([cx - w2 + 0.5, cy - h2 + 0.5,
+                         cx + w2 - 0.5, cy + h2 - 0.5])
+    base = jnp.asarray(base)  # (A, 4)
+
+    shift_x = jnp.arange(W) * stride
+    shift_y = jnp.arange(H) * stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    anchors = (base[None] + shifts).reshape(-1, 4)  # (H*W*A, 4)
+
+    def one(scores_map, deltas_map, info):
+        scores = scores_map[A:].transpose(1, 2, 0).reshape(-1)  # fg scores
+        deltas = deltas_map.transpose(1, 2, 0).reshape(-1, 4)
+        # decode (Faster-RCNN parameterization, pixel coords)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + 0.5 * (aw - 1)
+        acy = anchors[:, 1] + 0.5 * (ah - 1)
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                           cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)],
+                          axis=-1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=-1)
+        keep_size = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+            ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        scores = jnp.where(keep_size, scores, 0.0)
+
+        k = min(rpn_pre, scores.shape[0])
+        top_idx = jnp.argsort(-scores)[:k]
+        top_boxes = boxes[top_idx]
+        top_scores = scores[top_idx]
+        keep = _nms(top_boxes, top_scores,
+                    jnp.zeros((k,), jnp.int32), thresh, True)
+        top_scores = jnp.where(keep, top_scores, 0.0)
+        order = jnp.argsort(-top_scores)[:rpn_post]
+        rois = top_boxes[order]
+        return jnp.concatenate([jnp.zeros((rpn_post, 1)), rois], axis=1), \
+            top_scores[order][:, None]
+
+    rois, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    rois = rois.reshape(-1, 5)
+    if attrs["output_score"]:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+def _proposal_infer(attrs, in_shapes):
+    cls_s = in_shapes[0]
+    if cls_s is None:
+        outs = [None, None] if attrs["output_score"] else [None]
+        return in_shapes, outs, []
+    n = cls_s[0]
+    post = attrs["rpn_post_nms_top_n"]
+    outs = [(n * post, 5)]
+    if attrs["output_score"]:
+        outs.append((n * post, 1))
+    return in_shapes, outs, []
+
+
+register("_contrib_Proposal", fcompute=_proposal_fc,
+         arguments=("cls_prob", "bbox_pred", "im_info"),
+         num_outputs=lambda attrs: 2 if attrs["output_score"] else 1,
+         outputs=lambda attrs: (["output", "score"]
+                                if attrs["output_score"] else ["output"]),
+         attrs={"rpn_pre_nms_top_n": Int(6000),
+                "rpn_post_nms_top_n": Int(300), "threshold": Float(0.7),
+                "rpn_min_size": Int(16),
+                "scales": FloatTuple((4.0, 8.0, 16.0, 32.0)),
+                "ratios": FloatTuple((0.5, 1.0, 2.0)),
+                "feature_stride": Int(16), "output_score": Bool(False),
+                "iou_loss": Bool(False)},
+         infer_shape=_proposal_infer)
+register_alias("_contrib_Proposal", "Proposal")
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference contrib/ctc_loss; log-space forward under lax.scan,
+# gradient via autodiff)
+# ---------------------------------------------------------------------------
+def _ctc_loss_single(logits, labels, blank=0):
+    """logits: (T, C) log-probs NOT yet normalized; labels: (L,) with 0 as
+    padding (reference uses 0-padded labels, classes 1..C-1)."""
+    T, C = logits.shape
+    L = labels.shape[0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended label sequence with blanks: length S = 2L + 1
+    S = 2 * L + 1
+    ext = jnp.full((S,), blank, dtype=jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    label_len = jnp.sum(labels > 0)
+    s_len = 2 * label_len + 1
+
+    neg_inf = -1e30
+    alpha0 = jnp.full((S,), neg_inf)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = jnp.where((jnp.arange(S) == 1) & (label_len > 0),
+                       logp[0, ext[1]], alpha0)
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.array([True, True]), ext[2:] == ext[:-2]])
+
+    def step(alpha, logp_t):
+        a = alpha
+        a1 = jnp.concatenate([jnp.array([neg_inf]), alpha[:-1]])
+        a2 = jnp.concatenate([jnp.array([neg_inf, neg_inf]), alpha[:-2]])
+        a2 = jnp.where(same_as_prev2, neg_inf, a2)
+        merged = jnp.logaddexp(jnp.logaddexp(a, a1), a2)
+        new = merged + logp_t[ext]
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, logp[1:])
+    end1 = alpha[jnp.maximum(s_len - 1, 0)]
+    end2 = jnp.where(s_len >= 2, alpha[jnp.maximum(s_len - 2, 0)], neg_inf)
+    return -jnp.logaddexp(end1, end2)
+
+
+def _ctc_loss_fc(attrs, data, label):
+    # data: (T, N, C) activations; label: (N, L) 0-padded
+    def one(logits, lbl):
+        return _ctc_loss_single(logits, lbl)
+    return jax.vmap(one, in_axes=(1, 0))(data, label)
+
+
+def _ctc_loss_infer(attrs, in_shapes):
+    ds, ls = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    return in_shapes, [(ds[1],)], []
+
+
+register("_contrib_CTCLoss", fcompute=_ctc_loss_fc,
+         arguments=("data", "label"), infer_shape=_ctc_loss_infer,
+         doc="Connectionist temporal classification loss; log-space "
+             "forward algorithm under lax.scan, gradient by autodiff "
+             "(reference src/operator/contrib/ctc_loss.cc).")
+register_alias("_contrib_CTCLoss", "CTCLoss")
+register_alias("_contrib_CTCLoss", "ctc_loss")
+
+
+# ---------------------------------------------------------------------------
+# CountSketch (reference contrib/count_sketch.cc) — random projection used
+# by compact bilinear pooling; h/s given as inputs
+# ---------------------------------------------------------------------------
+def _count_sketch_fc(attrs, data, h, s):
+    out_dim = attrs["out_dim"]
+    idx = h.astype(jnp.int32).reshape(-1)          # (in_dim,)
+    sign = s.reshape(-1)                            # (in_dim,)
+    vals = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, idx].add(vals)
+
+
+register("_contrib_count_sketch", fcompute=_count_sketch_fc,
+         arguments=("data", "h", "s"),
+         attrs={"out_dim": Int(required=True),
+                "processing_batch_size": Int(32)},
+         infer_shape=lambda attrs, ins: (
+             ins, [None if ins[0] is None else
+                   (ins[0][0], attrs["out_dim"])], []))
+register_alias("_contrib_count_sketch", "count_sketch")
+
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT (reference contrib/fft.cc — cuFFT; here jnp.fft, output packs
+# complex as interleaved real/imag like the reference)
+# ---------------------------------------------------------------------------
+def _fft_fc(attrs, data):
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (data.shape[-1] * 2,)).astype(
+        jnp.float32)
+
+
+register("_contrib_fft", fcompute=_fft_fc,
+         attrs={"compute_size": Int(128)},
+         infer_shape=lambda attrs, ins: (
+             ins, [None if ins[0] is None else
+                   tuple(ins[0][:-1]) + (ins[0][-1] * 2,)], []))
+register_alias("_contrib_fft", "fft")
+
+
+def _ifft_fc(attrs, data):
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1).real * n
+    return out.astype(jnp.float32)
+
+
+register("_contrib_ifft", fcompute=_ifft_fc,
+         attrs={"compute_size": Int(128)},
+         infer_shape=lambda attrs, ins: (
+             ins, [None if ins[0] is None else
+                   tuple(ins[0][:-1]) + (ins[0][-1] // 2,)], []))
+register_alias("_contrib_ifft", "ifft")
+
+
+# ---------------------------------------------------------------------------
+# Quantize / Dequantize (reference contrib/quantize.cc — int8 experiments)
+# ---------------------------------------------------------------------------
+def _quantize_fc(attrs, data, min_range, max_range):
+    qmin, qmax = 0.0, 255.0
+    scale = (qmax - qmin) / (max_range - min_range)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(jnp.uint8), min_range, max_range
+
+
+register("_contrib_quantize", fcompute=_quantize_fc,
+         arguments=("data", "min_range", "max_range"),
+         outputs=("output", "min_output", "max_output"), num_outputs=3,
+         attrs={"out_type": Str("uint8")},
+         infer_shape=lambda attrs, ins: (
+             ins, [ins[0], (1,), (1,)], []),
+         infer_type=lambda attrs, ts: (
+             ts, ["uint8", "float32", "float32"], []))
+register_alias("_contrib_quantize", "quantize")
+
+
+def _dequantize_fc(attrs, data, min_range, max_range):
+    scale = (max_range - min_range) / 255.0
+    return data.astype(jnp.float32) * scale + min_range
+
+
+register("_contrib_dequantize", fcompute=_dequantize_fc,
+         arguments=("data", "min_range", "max_range"),
+         attrs={"out_type": Str("float32")},
+         infer_shape=lambda attrs, ins: (ins, [ins[0]], []),
+         infer_type=lambda attrs, ts: (ts, ["float32"], []))
+register_alias("_contrib_dequantize", "dequantize")

@@ -51,9 +51,18 @@ def _fc_infer(attrs, in_shapes):
     return in_shapes, [None], []
 
 
+def _fc_infer_backward(attrs, out_shapes, in_shapes):
+    out = out_shapes[0]
+    if out is not None and out[0] != 0:
+        ds = in_shapes[0]
+        if ds is not None:
+            in_shapes[0] = (out[0],) + tuple(ds[1:])
+    return in_shapes
+
+
 register("FullyConnected", fcompute=_fc_fcompute, arguments=_fc_args,
          attrs={"num_hidden": Int(required=True), "no_bias": Bool(False)},
-         infer_shape=_fc_infer,
+         infer_shape=_fc_infer, infer_shape_backward=_fc_infer_backward,
          doc="Y = X·Wᵀ + b (reference src/operator/fully_connected.cc). "
              "Lowers to one MXU matmul.")
 

@@ -92,6 +92,20 @@ def Shape(default=None, required=False, doc=""):
     return AttrSpec(_parse_shape, default, required, doc)
 
 
+def _parse_float_tuple(v):
+    if v is None:
+        return None
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+def FloatTuple(default=None, required=False, doc=""):
+    return AttrSpec(_parse_float_tuple, default, required, doc)
+
+
 def Dtype(default=None, required=False, doc=""):
     return AttrSpec(_parse_dtype, default, required, doc)
 
@@ -114,7 +128,8 @@ class OpDef:
 
     def __init__(self, name, fcompute=None, fstateful=None, attrs=None,
                  arguments=("data",), outputs=("output",), aux_states=(),
-                 infer_shape=None, infer_type=None, num_outputs=1,
+                 infer_shape=None, infer_type=None,
+                 infer_shape_backward=None, num_outputs=1,
                  key_var_num_args=None, needs_rng=False, mutate=(), doc=""):
         self.name = name
         self.fcompute = fcompute
@@ -125,6 +140,7 @@ class OpDef:
         self._aux_states = aux_states
         self._infer_shape = infer_shape
         self._infer_type = infer_type
+        self._infer_shape_backward = infer_shape_backward
         self._num_outputs = num_outputs
         # name of the attr holding the variadic input count (Concat: num_args)
         self.key_var_num_args = key_var_num_args
@@ -215,6 +231,23 @@ class OpDef:
             ins, outs, aux = res
         return list(ins), list(outs), list(aux)
 
+    def infer_shape_backward(self, attrs, out_shapes, in_shapes):
+        """Propagate known output shapes back into inputs (partial is fine).
+
+        The reference's nnvm InferShape is bidirectional; here only ops
+        that need it implement it (elemwise-default ops get it for free:
+        output shape unifies into every input)."""
+        if self._infer_shape_backward is not None:
+            return self._infer_shape_backward(attrs, list(out_shapes),
+                                              list(in_shapes))
+        if self._infer_shape is None:  # elemwise: in == out
+            known = None
+            for s in list(out_shapes) + list(in_shapes):
+                if s is not None:
+                    known = unify_shapes(known, s)
+            return [known] * len(in_shapes)
+        return list(in_shapes)
+
     def infer_type(self, attrs, in_types):
         if self._infer_type is None:
             return elemwise_type_infer(self, attrs, in_types)
@@ -254,17 +287,34 @@ def _as_tuple(x):
 # ---------------------------------------------------------------------------
 # Default inference helpers
 # ---------------------------------------------------------------------------
+def unify_shapes(a, b, where=""):
+    """Merge two partially-known shapes; dim 0 is a wildcard (the reference
+    TShape convention — e.g. RNN begin_state zeros are (0, H))."""
+    if a is None:
+        return tuple(b) if b is not None else None
+    if b is None:
+        return tuple(a)
+    if len(a) != len(b):
+        raise MXNetError("incompatible shapes %s vs %s %s" % (a, b, where))
+    out = []
+    for da, db in zip(a, b):
+        if da == 0:
+            out.append(db)
+        elif db == 0 or da == db:
+            out.append(da)
+        else:
+            raise MXNetError("incompatible shapes %s vs %s %s"
+                             % (a, b, where))
+    return tuple(out)
+
+
 def elemwise_shape_infer(op, attrs, in_shapes):
     """All inputs and outputs share one (broadcast-free) shape."""
-    known = [s for s in in_shapes if s is not None]
-    shape = known[0] if known else None
-    if shape is not None:
-        for s in known:
-            if tuple(s) != tuple(shape):
-                raise MXNetError(
-                    "op %s: inconsistent input shapes %s vs %s"
-                    % (op.name, s, shape))
-    ins = [shape if s is None else s for s in in_shapes]
+    shape = None
+    for s in in_shapes:
+        shape = unify_shapes(shape, s, "(op %s)" % op.name)
+    ins = [shape if s is None else unify_shapes(s, shape)
+           for s in in_shapes]
     outs = [shape] * op.num_outputs(attrs)
     return ins, outs, [None] * len(op.aux_states(attrs))
 

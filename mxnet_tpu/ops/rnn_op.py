@@ -1,0 +1,210 @@
+"""Fused RNN operator.
+
+Reference: ``src/operator/rnn-inl.h`` (the ``RNN`` layer op; CPU forward was
+never implemented — ``rnn-inl.h:302`` is ``LOG(FATAL)``) backed by
+``cudnn_rnn-inl.h`` / MIOpen on GPU.  TPU-native: the whole stacked,
+optionally bidirectional sequence runs as ``lax.scan`` per layer inside one
+XLA program — scan keeps the time loop compiler-friendly (no dynamic python
+control flow) and XLA pipelines the per-step matmuls onto the MXU.
+
+Parameter packing (self-consistent, documented for unpack_weights):
+for each layer l, then direction d: [i2h_weight (G*H, in), h2h_weight
+(G*H, H), i2h_bias (G*H), h2h_bias (G*H)] flattened and concatenated.
+Gate order matches the explicit cells: LSTM i,f,c,o; GRU r,z,o.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import Bool, Float, Int, Str, register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _layer_input_size(layer, input_size, state_size, num_dir):
+    return input_size if layer == 0 else state_size * num_dir
+
+
+def rnn_param_size(num_layers, input_size, state_size, mode,
+                   bidirectional=False):
+    """Total packed parameter count (reference rnn-inl.h GetRnnParamSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        in_sz = _layer_input_size(layer, input_size, state_size, d)
+        per_dir = g * state_size * in_sz + g * state_size * state_size + \
+            2 * g * state_size
+        total += per_dir * d
+    return total
+
+
+def _unpack_params(params, num_layers, input_size, state_size, mode,
+                   bidirectional):
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    out = []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = _layer_input_size(layer, input_size, h, d)
+        dirs = []
+        for _ in range(d):
+            wi = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            wh = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            bi = params[off:off + g * h]
+            off += g * h
+            bh = params[off:off + g * h]
+            off += g * h
+            dirs.append((wi, wh, bi, bh))
+        out.append(dirs)
+    return out
+
+
+def _cell_step(mode, h_prev, c_prev, x_t, wi, wh, bi, bh, state_size):
+    pre = x_t @ wi.T + h_prev @ wh.T + bi + bh
+    if mode == "rnn_relu":
+        h = jnp.maximum(pre, 0)
+        return h, c_prev
+    if mode == "rnn_tanh":
+        h = jnp.tanh(pre)
+        return h, c_prev
+    if mode == "lstm":
+        i, f, c, o = jnp.split(pre, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        c = jnp.tanh(c)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c_prev + i * c
+        return o * jnp.tanh(c_new), c_new
+    if mode == "gru":
+        # r, z, o gate layout; candidate uses reset-gated h2h
+        xr, xz, xo = jnp.split(x_t @ wi.T + bi, 3, axis=-1)
+        hr, hz, ho = jnp.split(h_prev @ wh.T + bh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        cand = jnp.tanh(xo + r * ho)
+        h = (1 - z) * cand + z * h_prev
+        return h, c_prev
+    raise MXNetError("unknown RNN mode %r" % mode)
+
+
+def _run_layer(mode, x_seq, h0, c0, weights, state_size, reverse=False):
+    wi, wh, bi, bh = weights
+
+    def step(carry, x_t):
+        h, c = carry
+        h, c = _cell_step(mode, h, c, x_t, wi, wh, bi, bh, state_size)
+        return (h, c), h
+
+    xs = jnp.flip(x_seq, axis=0) if reverse else x_seq
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+def _rnn_fstateful(attrs, inputs, aux, is_train, rng):
+    mode = attrs["mode"]
+    h = attrs["state_size"]
+    L = attrs["num_layers"]
+    bidir = attrs["bidirectional"]
+    p = attrs["p"]
+    d = 2 if bidir else 1
+
+    if mode == "lstm":
+        data, params, state, state_cell = inputs
+    else:
+        data, params, state = inputs
+        state_cell = jnp.zeros_like(state)
+
+    T, N, I = data.shape
+    layers = _unpack_params(params, L, I, h, mode, bidir)
+
+    x = data
+    h_states, c_states = [], []
+    for li, dirs in enumerate(layers):
+        outs = []
+        for di, weights in enumerate(dirs):
+            idx = li * d + di
+            ys, hT, cT = _run_layer(mode, x, state[idx], state_cell[idx],
+                                    weights, h, reverse=(di == 1))
+            outs.append(ys)
+            h_states.append(hT)
+            c_states.append(cT)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if is_train and p > 0 and li < L - 1 and rng is not None:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, li), keep, x.shape)
+            x = x * mask / keep
+
+    outputs = [x]
+    if attrs["state_outputs"]:
+        outputs.append(jnp.stack(h_states, axis=0))
+        if mode == "lstm":
+            outputs.append(jnp.stack(c_states, axis=0))
+    return tuple(outputs), ()
+
+
+def _rnn_args(attrs):
+    if attrs["mode"] == "lstm":
+        return ["data", "parameters", "state", "state_cell"]
+    return ["data", "parameters", "state"]
+
+
+def _rnn_outputs(attrs):
+    outs = ["output"]
+    if attrs["state_outputs"]:
+        outs.append("state")
+        if attrs["mode"] == "lstm":
+            outs.append("state_cell")
+    return outs
+
+
+def _rnn_num_outputs(attrs):
+    n = 1
+    if attrs["state_outputs"]:
+        n += 2 if attrs["mode"] == "lstm" else 1
+    return n
+
+
+def _rnn_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    mode = attrs["mode"]
+    h = attrs["state_size"]
+    L = attrs["num_layers"]
+    d = 2 if attrs["bidirectional"] else 1
+    n_out = _rnn_num_outputs(attrs)
+    if ds is None:
+        return in_shapes, [None] * n_out, []
+    T, N, I = ds
+    in_shapes[1] = (rnn_param_size(L, I, h, mode, attrs["bidirectional"]),)
+    in_shapes[2] = (L * d, N, h)
+    if mode == "lstm":
+        in_shapes[3] = (L * d, N, h)
+    outs = [(T, N, h * d)]
+    if attrs["state_outputs"]:
+        outs.append((L * d, N, h))
+        if mode == "lstm":
+            outs.append((L * d, N, h))
+    return in_shapes, outs, []
+
+
+register("RNN", fstateful=_rnn_fstateful, arguments=_rnn_args,
+         outputs=_rnn_outputs, num_outputs=_rnn_num_outputs,
+         needs_rng=True,
+         attrs={"state_size": Int(required=True),
+                "num_layers": Int(required=True),
+                "mode": Str(required=True),
+                "bidirectional": Bool(False), "p": Float(0.0),
+                "state_outputs": Bool(False),
+                "pkeep_": Float(1.0), "lstm_q_": Bool(False)},
+         infer_shape=_rnn_infer,
+         doc="Fused stacked RNN/LSTM/GRU over the whole sequence via "
+             "lax.scan (reference rnn-inl.h / cudnn_rnn-inl.h).")

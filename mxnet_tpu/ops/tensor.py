@@ -368,9 +368,20 @@ def _slice_channel_fc(attrs, x):
     return tuple(parts)
 
 
+def _slice_channel_infer_backward(attrs, out_shapes, in_shapes):
+    known = [o for o in out_shapes if o is not None]
+    if known and not attrs["squeeze_axis"]:
+        ax = attrs["axis"]
+        s = list(known[0])
+        s[ax] *= attrs["num_outputs"]
+        in_shapes[0] = tuple(s)
+    return in_shapes
+
+
 register("SliceChannel", fcompute=_slice_channel_fc,
          attrs={"num_outputs": Int(required=True), "axis": Int(1),
                 "squeeze_axis": Bool(False)},
+         infer_shape_backward=_slice_channel_infer_backward,
          outputs=lambda attrs: ["output%d" % i
                                 for i in range(attrs["num_outputs"])],
          num_outputs=lambda attrs: attrs["num_outputs"],

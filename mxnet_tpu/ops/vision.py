@@ -1,0 +1,310 @@
+"""Vision operators: ROI pooling, spatial transformers, correlation, crop.
+
+Reference: ``src/operator/roi_pooling.cc``, ``bilinear_sampler.cc``,
+``grid_generator.cc``, ``spatial_transformer.cc``, ``correlation.cc``,
+``crop.cc``.  These are the reference's hand-written CUDA kernels; here each
+is a static-shape JAX computation (masked reductions / gathers) that XLA
+fuses — the long-tail candidates for Pallas kernels if they ever become hot.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import Bool, Float, Int, Shape, Str, register
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling (reference roi_pooling.cc: max-pool inside each scaled roi)
+# ---------------------------------------------------------------------------
+def _roi_pool_one(data, roi, pooled_h, pooled_w, spatial_scale):
+    """data: (C, H, W); roi: (5,) [batch_idx, x1, y1, x2, y2]."""
+    C, H, W = data.shape
+    x1 = jnp.round(roi[1] * spatial_scale)
+    y1 = jnp.round(roi[2] * spatial_scale)
+    x2 = jnp.round(roi[3] * spatial_scale)
+    y2 = jnp.round(roi[4] * spatial_scale)
+    roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+    bin_h = roi_h / pooled_h
+    bin_w = roi_w / pooled_w
+
+    hs = jnp.arange(H, dtype=jnp.float32)
+    ws = jnp.arange(W, dtype=jnp.float32)
+    ph = jnp.arange(pooled_h, dtype=jnp.float32)
+    pw = jnp.arange(pooled_w, dtype=jnp.float32)
+
+    hstart = jnp.clip(jnp.floor(ph * bin_h) + y1, 0, H)
+    hend = jnp.clip(jnp.ceil((ph + 1) * bin_h) + y1, 0, H)
+    wstart = jnp.clip(jnp.floor(pw * bin_w) + x1, 0, W)
+    wend = jnp.clip(jnp.ceil((pw + 1) * bin_w) + x1, 0, W)
+
+    row_mask = (hs[None, :] >= hstart[:, None]) & \
+        (hs[None, :] < hend[:, None])                     # (PH, H)
+    col_mask = (ws[None, :] >= wstart[:, None]) & \
+        (ws[None, :] < wend[:, None])                     # (PW, W)
+
+    neg = jnp.finfo(data.dtype).min
+    # max over w for each pw: (C, H, PW)
+    tmp = jnp.max(jnp.where(col_mask[None, None, :, :],
+                            data[:, :, None, :], neg), axis=-1)
+    # max over h for each ph: (C, PH, PW)
+    out = jnp.max(jnp.where(row_mask[None, :, :, None],
+                            tmp[:, None, :, :], neg), axis=2)
+    empty = (row_mask.sum(axis=1) == 0)[None, :, None] | \
+        (col_mask.sum(axis=1) == 0)[None, None, :]
+    return jnp.where(empty, 0.0, out).astype(data.dtype)
+
+
+def _roi_pool_fc(attrs, data, rois):
+    pooled_h, pooled_w = attrs["pooled_size"]
+    scale = attrs["spatial_scale"]
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    per_roi_data = data[batch_idx]  # (R, C, H, W)
+    return jax.vmap(
+        lambda d, r: _roi_pool_one(d, r, pooled_h, pooled_w, scale)
+    )(per_roi_data, rois)
+
+
+def _roi_pool_infer(attrs, in_shapes):
+    ds, rs = in_shapes
+    if ds is None or rs is None:
+        return in_shapes, [None], []
+    ph, pw = attrs["pooled_size"]
+    return in_shapes, [(rs[0], ds[1], ph, pw)], []
+
+
+register("ROIPooling", fcompute=_roi_pool_fc, arguments=("data", "rois"),
+         attrs={"pooled_size": Shape(required=True),
+                "spatial_scale": Float(required=True)},
+         infer_shape=_roi_pool_infer)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler (reference bilinear_sampler.cc; grid in [-1, 1])
+# ---------------------------------------------------------------------------
+def _bilinear_sample_one(data, grid):
+    """data: (C, H, W); grid: (2, Ho, Wo) with (x, y) in [-1, 1]."""
+    C, H, W = data.shape
+    x = (grid[0] + 1.0) * (W - 1) / 2.0
+    y = (grid[1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    def gather(yy, xx):
+        inside = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        vals = data[:, yc, xc]          # (C, Ho, Wo)
+        return jnp.where(inside[None], vals, 0.0)
+
+    wa = (x1 - x) * (y1 - y)
+    wb = (x1 - x) * (y - y0)
+    wc = (x - x0) * (y1 - y)
+    wd = (x - x0) * (y - y0)
+    out = (gather(y0, x0) * wa[None] + gather(y1, x0) * wb[None] +
+           gather(y0, x1) * wc[None] + gather(y1, x1) * wd[None])
+    return out.astype(data.dtype)
+
+
+def _bilinear_sampler_fc(attrs, data, grid):
+    return jax.vmap(_bilinear_sample_one)(data, grid)
+
+
+def _bilinear_sampler_infer(attrs, in_shapes):
+    ds, gs = in_shapes
+    if ds is None or gs is None:
+        return in_shapes, [None], []
+    return in_shapes, [(ds[0], ds[1], gs[2], gs[3])], []
+
+
+register("BilinearSampler", fcompute=_bilinear_sampler_fc,
+         arguments=("data", "grid"), infer_shape=_bilinear_sampler_infer)
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator (reference grid_generator.cc: affine / warp → sampling grid)
+# ---------------------------------------------------------------------------
+def _affine_grid(theta, target_shape):
+    """theta: (N, 6) affine params → grid (N, 2, H, W) in [-1, 1]."""
+    h, w = target_shape
+    ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, h), jnp.linspace(-1, 1, w),
+                          indexing="ij")
+    ones = jnp.ones_like(xs)
+    base = jnp.stack([xs, ys, ones], axis=0).reshape(3, -1)  # (3, H*W)
+    t = theta.reshape(-1, 2, 3)
+    out = jnp.einsum("nij,jk->nik", t, base)  # (N, 2, H*W)
+    return out.reshape(-1, 2, h, w)
+
+
+def _grid_generator_fc(attrs, data):
+    if attrs["transform_type"] == "affine":
+        return _affine_grid(data, attrs["target_shape"])
+    # warp: data is (N, 2, H, W) flow field in pixels; add base grid
+    n, _, h, w = data.shape
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=data.dtype),
+                          jnp.arange(w, dtype=data.dtype), indexing="ij")
+    gx = (xs[None] + data[:, 0]) * 2.0 / jnp.maximum(w - 1, 1) - 1.0
+    gy = (ys[None] + data[:, 1]) * 2.0 / jnp.maximum(h - 1, 1) - 1.0
+    return jnp.stack([gx, gy], axis=1)
+
+
+def _grid_generator_infer(attrs, in_shapes):
+    (ds,) = in_shapes
+    if attrs["transform_type"] == "affine":
+        if ds is None:
+            return in_shapes, [None], []
+        h, w = attrs["target_shape"]
+        return in_shapes, [(ds[0], 2, h, w)], []
+    return in_shapes, [ds], []
+
+
+register("GridGenerator", fcompute=_grid_generator_fc,
+         attrs={"transform_type": Str("affine"),
+                "target_shape": Shape((0, 0))},
+         infer_shape=_grid_generator_infer)
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer (reference spatial_transformer.cc: affine + bilinear)
+# ---------------------------------------------------------------------------
+def _spatial_transformer_fc(attrs, data, loc):
+    if attrs["transform_type"] != "affine":
+        raise MXNetError("only affine transform_type is supported")
+    if attrs["sampler_type"] != "bilinear":
+        raise MXNetError("only bilinear sampler_type is supported")
+    h, w = attrs["target_shape"]
+    grid = _affine_grid(loc, (h, w))
+    return jax.vmap(_bilinear_sample_one)(data, grid)
+
+
+def _spatial_transformer_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is not None:
+        in_shapes[1] = (ds[0], 6)
+    if ds is None:
+        return in_shapes, [None], []
+    h, w = attrs["target_shape"]
+    return in_shapes, [(ds[0], ds[1], h, w)], []
+
+
+register("SpatialTransformer", fcompute=_spatial_transformer_fc,
+         arguments=("data", "loc"),
+         attrs={"target_shape": Shape(required=True),
+                "transform_type": Str("affine"),
+                "sampler_type": Str("bilinear")},
+         infer_shape=_spatial_transformer_infer)
+
+
+# ---------------------------------------------------------------------------
+# Crop (reference crop.cc: spatial crop to reference symbol or h_w)
+# ---------------------------------------------------------------------------
+def _crop_args(attrs):
+    return ["data"] if attrs["num_args"] == 1 else ["data", "crop_like"]
+
+
+def _crop_fc(attrs, data, crop_like=None):
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = attrs["h_w"]
+    if attrs["center_crop"]:
+        oy = (data.shape[2] - th) // 2
+        ox = (data.shape[3] - tw) // 2
+    else:
+        oy, ox = attrs["offset"]
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+def _crop_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    if attrs["num_args"] == 2:
+        cs = in_shapes[1]
+        if cs is None:
+            return in_shapes, [None], []
+        th, tw = cs[2], cs[3]
+    else:
+        th, tw = attrs["h_w"]
+    return in_shapes, [(ds[0], ds[1], th, tw)], []
+
+
+register("Crop", fcompute=_crop_fc, arguments=_crop_args,
+         attrs={"num_args": Int(1), "offset": Shape((0, 0)),
+                "h_w": Shape((0, 0)), "center_crop": Bool(False)},
+         infer_shape=_crop_infer)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (reference correlation.cc: FlowNet cost volume)
+# ---------------------------------------------------------------------------
+def _correlation_fc(attrs, data1, data2):
+    k = attrs["kernel_size"]
+    maxd = attrs["max_displacement"]
+    s1 = attrs["stride1"]
+    s2 = attrs["stride2"]
+    pad = attrs["pad_size"]
+    multiply = attrs["is_multiply"]
+
+    n, c, h, w = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    bradius = (k - 1) // 2
+    border = maxd + bradius
+    out_h = int(np.ceil((ph - border * 2) / s1))
+    out_w = int(np.ceil((pw - border * 2) / s1))
+    grid_radius = maxd // s2
+    disp = range(-grid_radius, grid_radius + 1)
+
+    ys = border + jnp.arange(out_h) * s1
+    xs = border + jnp.arange(out_w) * s1
+
+    outs = []
+    ksize = k * k * c
+    for dy in disp:
+        for dx in disp:
+            dy_px, dx_px = dy * s2, dx * s2
+            acc = 0.0
+            for ky in range(-bradius, bradius + 1):
+                for kx in range(-bradius, bradius + 1):
+                    a = p1[:, :, ys[:, None] + ky, xs[None, :] + kx]
+                    b = p2[:, :, ys[:, None] + ky + dy_px,
+                           xs[None, :] + kx + dx_px]
+                    if multiply:
+                        acc = acc + jnp.sum(a * b, axis=1)
+                    else:
+                        acc = acc + jnp.sum(jnp.abs(a - b), axis=1)
+            outs.append(acc / ksize)
+    return jnp.stack(outs, axis=1)
+
+
+def _correlation_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    if in_shapes[1] is None:
+        in_shapes[1] = ds
+    k = attrs["kernel_size"]
+    maxd = attrs["max_displacement"]
+    s1, s2, pad = attrs["stride1"], attrs["stride2"], attrs["pad_size"]
+    ph, pw = ds[2] + 2 * pad, ds[3] + 2 * pad
+    bradius = (k - 1) // 2
+    border = maxd + bradius
+    out_h = int(np.ceil((ph - border * 2) / s1))
+    out_w = int(np.ceil((pw - border * 2) / s1))
+    d = 2 * (maxd // s2) + 1
+    return in_shapes, [(ds[0], d * d, out_h, out_w)], []
+
+
+register("Correlation", fcompute=_correlation_fc,
+         arguments=("data1", "data2"),
+         attrs={"kernel_size": Int(1), "max_displacement": Int(1),
+                "stride1": Int(1), "stride2": Int(1), "pad_size": Int(0),
+                "is_multiply": Bool(True)},
+         infer_shape=_correlation_infer)

@@ -78,11 +78,19 @@ class DataParallelTrainer:
 
     def __init__(self, symbol, data_shapes, label_shapes=None, mesh=None,
                  optimizer="sgd", optimizer_params=None, initializer=None,
-                 batch_axis="dp", dtype="float32", fixed_params=()):
+                 batch_axis="dp", dtype="float32", compute_dtype=None,
+                 fixed_params=()):
+        """``compute_dtype='bfloat16'`` enables mixed precision: parameters
+        and optimizer state stay fp32 (master weights), the traced forward/
+        backward runs in bf16 on the MXU, and gradients emerge fp32 through
+        the cast's vjp — the TPU-idiomatic replacement for the reference's
+        fp16 model variants (symbols/*_fp16.py)."""
         self.symbol = symbol
         self.mesh = mesh if mesh is not None else local_mesh(batch_axis)
         self.batch_axis = batch_axis
         self._fixed = set(fixed_params)
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype else None)
 
         opt_params = dict(optimizer_params or {})
         lr = opt_params.pop("learning_rate", 0.01)
@@ -137,13 +145,14 @@ class DataParallelTrainer:
         self.aux = aux
 
     def _compile(self):
-        from ..executor import _apply_pure  # noqa: F401 (import check)
+        from ..executor import shape_overrides
         symbol = self.symbol
         nodes = symbol._nodes()
         aux_set = set(self.aux_names)
         head = [(id(n), oi) for n, oi in symbol._outputs]
         param_names = self.param_names
         data_names = self.data_names + self.label_names
+        overrides = shape_overrides(symbol, self._arg_shapes)
 
         def trace(args_map, aux_map, rng, is_train):
             vals = {}
@@ -159,8 +168,9 @@ class DataParallelTrainer:
                                for n, oi in node.aux_inputs())
                 r = jax.random.fold_in(rng, idx) \
                     if (node.op.needs_rng or node.op.stateful) else None
-                outs, upd = node.op.apply(node.attrs, ins, aux_in,
-                                          is_train, r)
+                outs, upd = node.op.apply(
+                    overrides.get(id(node), node.attrs), ins, aux_in,
+                    is_train, r)
                 for oi, o in enumerate(outs):
                     vals[(id(node), oi)] = o
                 for (an, _), u in zip(node.aux_inputs(), upd):
@@ -169,12 +179,23 @@ class DataParallelTrainer:
 
         opt_update = self._opt_update
         fixed = self._fixed
+        cdt = self._compute_dtype
+
+        def _cast(tree):
+            if cdt is None:
+                return tree
+            return {k: (v.astype(cdt) if jnp.issubdtype(v.dtype,
+                                                        jnp.floating)
+                        else v) for k, v in tree.items()}
 
         def train_step(params, opt_state, aux, batch, rng):
             def f(ps):
-                args = dict(batch)
-                args.update(ps)
-                outs, new_aux = trace(args, aux, rng, True)
+                args = _cast(dict(batch))
+                args.update(_cast(ps))
+                outs, new_aux = trace(args, _cast(aux), rng, True)
+                # moving stats stay in their master dtype across steps
+                new_aux = {k: v.astype(aux[k].dtype)
+                           for k, v in new_aux.items()}
                 return outs, new_aux
 
             outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
@@ -193,9 +214,9 @@ class DataParallelTrainer:
             return new_params, new_opt, new_aux, outs
 
         def predict_step(params, aux, batch, rng):
-            args = dict(batch)
-            args.update(params)
-            outs, _ = trace(args, aux, rng, False)
+            args = _cast(dict(batch))
+            args.update(_cast(params))
+            outs, _ = trace(args, _cast(aux), rng, False)
             return outs
 
         self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))

@@ -537,16 +537,19 @@ class ResidualCell(ModifierCell):
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
         self.reset()
-        self.base_cell._modified = False
-        outputs, states = self.base_cell.unroll(
-            length, inputs=inputs, begin_state=begin_state,
-            input_prefix=input_prefix, layout=layout, merge_outputs=False)
-        self.base_cell._modified = True
-        if isinstance(inputs, symbol.Symbol):
+        if inputs is None:
+            inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif isinstance(inputs, symbol.Symbol):
             axis = layout.find("T")
             inputs = list(symbol.SliceChannel(inputs, axis=axis,
                                               num_outputs=length,
                                               squeeze_axis=1))
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state,
+            layout=layout, merge_outputs=False)
+        self.base_cell._modified = True
         outputs = [symbol._invoke("elemwise_add", [out, inp], {})
                    for out, inp in zip(outputs, inputs)]
         if merge_outputs:

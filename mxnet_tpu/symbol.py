@@ -396,7 +396,15 @@ def _is_aux_node(node, symbol):
 # Inference pass (forward propagation + filled-input writeback, iterated to
 # fixpoint — the role of nnvm InferShape/InferType)
 # ---------------------------------------------------------------------------
-def _infer_pass(symbol, known, kind):
+def _merge(kind, prev, v):
+    """Unify two partial results; shapes use the 0-wildcard convention."""
+    if kind == "shape":
+        from .ops.registry import unify_shapes
+        return unify_shapes(prev, v)
+    return prev if prev is not None else v
+
+
+def _infer_pass(symbol, known, kind, with_nodes=False):
     nodes = symbol._nodes()
     node_out = {}   # (node_id, out_idx) -> shape/type
     var_val = {}    # node_id -> value for variables
@@ -413,7 +421,10 @@ def _infer_pass(symbol, known, kind):
                 v = n.extra_attrs.get("__dtype__")
             var_val[id(n)] = v
 
-    for _ in range(3):  # fixpoint iterations
+    def _is_partial(v):
+        return v is None or (kind == "shape" and 0 in v)
+
+    for _ in range(4):  # fixpoint iterations
         changed = False
         for n in nodes:
             if n.is_variable:
@@ -421,32 +432,44 @@ def _infer_pass(symbol, known, kind):
                 continue
             in_vals = [node_out.get((id(i), oi)) for i, oi in n.inputs]
             n_args = n.num_args()
-            try:
-                if kind == "shape":
-                    ins, outs, aux = n.op.infer_shape(n.attrs,
-                                                      in_vals[:n_args])
-                else:
-                    ins, outs, aux = n.op.infer_type(n.attrs,
-                                                     in_vals[:n_args])
-            except MXNetError:
-                raise
+            if kind == "shape":
+                ins, outs, aux = n.op.infer_shape(n.attrs,
+                                                  in_vals[:n_args])
+            else:
+                ins, outs, aux = n.op.infer_type(n.attrs,
+                                                 in_vals[:n_args])
+            if kind == "shape":
+                cur_outs = [node_out.get((id(n), oi))
+                            for oi in range(len(outs))]
+                merged_outs = [_merge("shape", a, b)
+                               for a, b in zip(cur_outs, outs)]
+                back = n.op.infer_shape_backward(n.attrs, merged_outs,
+                                                 ins[:n_args])
+                ins = [_merge("shape", a, b)
+                       for a, b in zip(ins[:n_args], back)] + \
+                    list(ins[n_args:])
             filled = list(ins) + list(aux)
             for (inp, oi), v in zip(n.inputs, filled):
                 if v is None:
                     continue
                 v = tuple(v) if kind == "shape" else v
-                if inp.is_variable and var_val.get(id(inp)) is None:
-                    var_val[id(inp)] = v
-                    changed = True
+                if inp.is_variable:
+                    merged = _merge(kind, var_val.get(id(inp)), v)
+                    if merged != var_val.get(id(inp)):
+                        var_val[id(inp)] = merged
+                        changed = True
                 prev = node_out.get((id(inp), oi))
-                if prev is None:
-                    node_out[(id(inp), oi)] = v
+                merged = _merge(kind, prev, v)
+                if merged != prev:
+                    node_out[(id(inp), oi)] = merged
                     changed = True
             for oi, v in enumerate(outs):
                 if v is not None:
                     v = tuple(v) if kind == "shape" else v
-                    if node_out.get((id(n), oi)) is None:
-                        node_out[(id(n), oi)] = v
+                    prev = node_out.get((id(n), oi))
+                    merged = _merge(kind, prev, v)
+                    if merged != prev:
+                        node_out[(id(n), oi)] = merged
                         changed = True
         if not changed:
             break
@@ -460,7 +483,16 @@ def _infer_pass(symbol, known, kind):
             else:
                 arg_res.append(var_val.get(id(n)))
     out_res = [node_out.get((id(n), oi)) for n, oi in symbol._outputs]
+    if with_nodes:
+        return arg_res, out_res, aux_res, node_out
     return arg_res, out_res, aux_res
+
+
+def infer_node_shapes(symbol, known):
+    """Per-node output shapes: {(node_id, out_idx): shape} (used by the
+    executor to specialize 0-wildcard init ops like RNN begin_state zeros)."""
+    _, _, _, node_out = _infer_pass(symbol, known, "shape", with_nodes=True)
+    return node_out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,160 @@
+"""RNN tests: cells, unroll, fused RNN op consistency
+(reference tests/python/unittest/test_rnn.py: cell unroll vs fused)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import rnn, symbol as sym
+from mxnet_tpu.ops.rnn_op import rnn_param_size
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = rnn.RNNCell(num_hidden=8, prefix="rnn_")
+    outputs, states = cell.unroll(3, input_prefix="t_")
+    assert len(outputs) == 3
+    out = sym.Group(outputs)
+    args = out.list_arguments()
+    assert "rnn_i2h_weight" in args
+    _, out_shapes, _ = out.infer_shape(
+        **{"t_t%d_data" % i: (4, 5) for i in range(3)},
+        **{"rnn_begin_state_0": (4, 8)})
+    assert out_shapes == [(4, 8)] * 3
+
+
+def test_lstm_cell_forward():
+    cell = rnn.LSTMCell(num_hidden=4, prefix="lstm_")
+    outputs, states = cell.unroll(2, input_prefix="t_")
+    out = sym.Group(outputs + states)
+    shapes = {"t_t0_data": (1, 3), "t_t1_data": (1, 3),
+              "lstm_begin_state_0": (1, 4), "lstm_begin_state_1": (1, 4)}
+    arg_shapes, out_shapes, _ = out.infer_shape(**shapes)
+    assert out_shapes[0] == (1, 4)
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["lstm_i2h_weight"] == (16, 3)
+    assert d["lstm_h2h_weight"] == (16, 4)
+
+
+def test_gru_cell_runs():
+    cell = rnn.GRUCell(num_hidden=4, prefix="gru_")
+    outputs, _ = cell.unroll(2, input_prefix="t_")
+    out = sym.Group(outputs)
+    ex = out.simple_bind(mx.cpu(), t_t0_data=(2, 3), t_t1_data=(2, 3),
+                         gru_begin_state_0=(2, 4))
+    res = ex.forward()
+    assert res[0].shape == (2, 4)
+
+
+def test_fused_rnn_op_shapes():
+    T, N, I, H, L = 5, 2, 3, 4, 2
+    psize = rnn_param_size(L, I, H, "lstm")
+    s = sym.RNN(sym.Variable("data"), sym.Variable("parameters"),
+                sym.Variable("state"), sym.Variable("state_cell"),
+                state_size=H, num_layers=L, mode="lstm",
+                state_outputs=True)
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(T, N, I))
+    d = dict(zip(s.list_arguments(), arg_shapes))
+    assert d["parameters"] == (psize,)
+    assert d["state"] == (L, N, H)
+    assert out_shapes == [(T, N, H), (L, N, H), (L, N, H)]
+
+
+def test_fused_lstm_matches_explicit_cell():
+    """Fused RNN op vs explicit LSTMCell unroll with the same weights
+    (reference test_rnn.py fused-vs-cell consistency)."""
+    T, N, I, H = 4, 2, 3, 5
+    rng = np.random.RandomState(0)
+    wi = rng.randn(4 * H, I).astype("float32") * 0.3
+    wh = rng.randn(4 * H, H).astype("float32") * 0.3
+    bi = rng.randn(4 * H).astype("float32") * 0.1
+    bh = rng.randn(4 * H).astype("float32") * 0.1
+    x = rng.randn(T, N, I).astype("float32")
+
+    packed = np.concatenate([wi.ravel(), wh.ravel(), bi, bh])
+    s = sym.RNN(sym.Variable("data"), sym.Variable("parameters"),
+                sym.Variable("state"), sym.Variable("state_cell"),
+                state_size=H, num_layers=1, mode="lstm",
+                state_outputs=True)
+    ex = s.bind(mx.cpu(), {
+        "data": nd.array(x), "parameters": nd.array(packed),
+        "state": nd.zeros((1, N, H)), "state_cell": nd.zeros((1, N, H))},
+        grad_req="null")
+    fused_out = ex.forward()[0].asnumpy()
+
+    # explicit per-step (numpy)
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((N, H), dtype="float32")
+    c = np.zeros((N, H), dtype="float32")
+    outs = []
+    for t in range(T):
+        pre = x[t] @ wi.T + h @ wh.T + bi + bh
+        i, f, g, o = np.split(pre, 4, axis=-1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+        h = sigmoid(o) * np.tanh(c)
+        outs.append(h.copy())
+    ref = np.stack(outs)
+    np.testing.assert_allclose(fused_out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rnn_bidirectional():
+    T, N, I, H, L = 3, 2, 4, 5, 1
+    psize = rnn_param_size(L, I, H, "gru", bidirectional=True)
+    s = sym.RNN(sym.Variable("data"), sym.Variable("parameters"),
+                sym.Variable("state"), state_size=H, num_layers=L,
+                mode="gru", bidirectional=True)
+    ex = s.simple_bind(mx.cpu(), data=(T, N, I))
+    assert ex.arg_dict["parameters"].shape == (psize,)
+    out = ex.forward()
+    assert out[0].shape == (T, N, 2 * H)
+
+
+def test_fused_rnn_cell_api():
+    """FusedRNNCell unrolls through the explicit stack (shared math)."""
+    cell = rnn.FusedRNNCell(num_hidden=6, num_layers=2, mode="lstm")
+    outputs, states = cell.unroll(3, input_prefix="t_")
+    assert len(outputs) == 3
+    unfused = cell.unfuse()
+    outputs2, _ = unfused.unroll(3, input_prefix="t_")
+    assert len(outputs2) == 3
+
+
+def test_bidirectional_cell():
+    bcell = rnn.BidirectionalCell(rnn.LSTMCell(4, prefix="l_"),
+                                  rnn.LSTMCell(4, prefix="r_"))
+    outputs, states = bcell.unroll(3, input_prefix="t_")
+    out = sym.Group(outputs)
+    ex = out.simple_bind(mx.cpu(), **{"t_t%d_data" % i: (2, 3)
+                                      for i in range(3)},
+                         **{"l_begin_state_0": (2, 4),
+                            "l_begin_state_1": (2, 4),
+                            "r_begin_state_0": (2, 4),
+                            "r_begin_state_1": (2, 4)})
+    res = ex.forward()
+    assert res[0].shape == (2, 8)
+
+
+def test_residual_and_dropout_cells():
+    base = rnn.RNNCell(num_hidden=3, prefix="base_")
+    res = rnn.ResidualCell(base)
+    outputs, _ = res.unroll(2, input_prefix="t_")
+    out = sym.Group(outputs)
+    ex = out.simple_bind(mx.cpu(), t_t0_data=(1, 3), t_t1_data=(1, 3),
+                         base_begin_state_0=(1, 3))
+    r = ex.forward()
+    assert r[0].shape == (1, 3)
+
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.RNNCell(num_hidden=3, prefix="s0_"))
+    stack.add(rnn.DropoutCell(0.5))
+    outputs, _ = stack.unroll(2, input_prefix="u_")
+    assert len(outputs) == 2
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [2, 3], [1, 2, 3, 4, 5], [3, 4], [1, 2]] * 4
+    it = rnn.BucketSentenceIter(sentences, batch_size=2, buckets=[3, 6])
+    batch = next(iter(it))
+    assert batch.data[0].shape[0] == 2
+    assert batch.bucket_key in (3, 6)

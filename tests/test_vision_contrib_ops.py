@@ -1,0 +1,226 @@
+"""Vision + contrib operator tests (reference tests exercise these through
+example/ssd, example/rcnn; here direct numpy-oracle checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import simple_forward
+
+
+def test_roi_pooling():
+    data = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], dtype="float32")  # whole image
+    s = sym.ROIPooling(sym.Variable("data"), sym.Variable("rois"),
+                       pooled_size=(2, 2), spatial_scale=1.0)
+    out = simple_forward(s, data=data, rois=rois)
+    assert out.shape == (1, 1, 2, 2)
+    # max of each quadrant
+    np.testing.assert_allclose(out[0, 0], [[27, 31], [59, 63]])
+
+
+def test_roi_pooling_scale():
+    data = np.random.randn(2, 3, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 0, 15, 15], [1, 0, 0, 7, 7]], dtype="float32")
+    s = sym.ROIPooling(sym.Variable("data"), sym.Variable("rois"),
+                       pooled_size=(4, 4), spatial_scale=0.5)
+    out = simple_forward(s, data=data, rois=rois)
+    assert out.shape == (2, 3, 4, 4)
+
+
+def test_bilinear_sampler_identity():
+    data = np.random.randn(1, 2, 5, 5).astype("float32")
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype("float32")
+    s = sym.BilinearSampler(sym.Variable("data"), sym.Variable("grid"))
+    out = simple_forward(s, data=data, grid=grid)
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    data = np.random.randn(2, 1, 6, 6).astype("float32")
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], dtype="float32"), (2, 1))
+    s = sym.SpatialTransformer(sym.Variable("data"), sym.Variable("loc"),
+                               target_shape=(6, 6))
+    out = simple_forward(s, data=data, loc=theta)
+    np.testing.assert_allclose(out, data, atol=1e-4)
+
+
+def test_grid_generator_affine():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], dtype="float32")
+    s = sym.GridGenerator(sym.Variable("data"), transform_type="affine",
+                          target_shape=(4, 4))
+    out = simple_forward(s, data=theta)
+    assert out.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(out[0, 0, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+def test_crop():
+    data = np.random.randn(1, 2, 8, 8).astype("float32")
+    s = sym.Crop(sym.Variable("data"), h_w=(4, 4), offset=(2, 2),
+                 num_args=1)
+    out = simple_forward(s, data=data)
+    np.testing.assert_allclose(out, data[:, :, 2:6, 2:6])
+
+
+def test_correlation_self():
+    data = np.random.randn(1, 4, 6, 6).astype("float32")
+    s = sym.Correlation(sym.Variable("data1"), sym.Variable("data2"),
+                        kernel_size=1, max_displacement=0, stride1=1,
+                        stride2=1, pad_size=0)
+    out = simple_forward(s, data1=data, data2=data)
+    # zero displacement self-correlation = mean of squares over channels
+    ref = (data * data).sum(axis=1) / 4
+    np.testing.assert_allclose(out[:, 0], ref, rtol=1e-4)
+
+
+def test_multibox_prior():
+    data = np.zeros((1, 8, 4, 4), dtype="float32")
+    s = sym.MultiBoxPrior(sym.Variable("data"), sizes=(0.5, 0.25),
+                          ratios=(1.0, 2.0))
+    out = simple_forward(s, data=data)
+    assert out.shape == (1, 4 * 4 * 3, 6 - 2)
+    # first anchor centered at (0.125, 0.125) with size 0.5
+    np.testing.assert_allclose(out[0, 0],
+                               [0.125 - 0.25, 0.125 - 0.25,
+                                0.125 + 0.25, 0.125 + 0.25], atol=1e-6)
+
+
+def test_multibox_target():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], dtype="float32")
+    # one GT box matching anchor 0
+    label = np.array([[[1, 0.05, 0.05, 0.45, 0.45]]], dtype="float32")
+    cls_pred = np.ones((1, 3, 3), dtype="float32") / 3
+    s = sym.MultiBoxTarget(sym.Variable("anchor"), sym.Variable("label"),
+                           sym.Variable("cls_pred"))
+    ex = s.bind(mx.cpu(), {"anchor": nd.array(anchors),
+                           "label": nd.array(label),
+                           "cls_pred": nd.array(cls_pred)},
+                grad_req="null")
+    loc_t, loc_m, cls_t = [o.asnumpy() for o in ex.forward()]
+    assert cls_t.shape == (1, 3)
+    assert cls_t[0, 0] == 2.0           # class 1 + 1
+    assert cls_t[0, 1] == 0.0           # background
+    assert loc_m[0, :4].sum() == 4      # anchor 0 mask on
+
+
+def test_multibox_detection():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], dtype="float32")
+    cls_prob = np.array([[[0.1, 0.8], [0.9, 0.2]]], dtype="float32")
+    cls_prob = np.concatenate([cls_prob, 1 - cls_prob], axis=1)[:, :2]
+    # background row + one class row: anchor0 fg prob .9, anchor1 .2
+    cls_prob = np.array([[[0.1, 0.8], [0.9, 0.2]]], dtype="float32")
+    loc_pred = np.zeros((1, 8), dtype="float32")
+    s = sym.MultiBoxDetection(sym.Variable("cls_prob"),
+                              sym.Variable("loc_pred"),
+                              sym.Variable("anchor"), threshold=0.5)
+    out = simple_forward(s, cls_prob=cls_prob, loc_pred=loc_pred,
+                         anchor=anchors)
+    assert out.shape == (1, 2, 6)
+    # top detection: class 0, score .9, box = anchor0
+    np.testing.assert_allclose(out[0, 0, :2], [0, 0.9], atol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 2:], [0.1, 0.1, 0.4, 0.4],
+                               atol=1e-5)
+    assert out[0, 1, 0] == -1           # below threshold → invalid
+
+
+def test_proposal_shapes():
+    n, A, H, W = 1, 3, 4, 4
+    cls_prob = np.random.uniform(0, 1, (n, 2 * A, H, W)).astype("float32")
+    bbox_pred = np.random.randn(n, 4 * A, H, W).astype("float32") * 0.1
+    im_info = np.array([[64, 64, 1.0]], dtype="float32")
+    s = sym.Proposal(sym.Variable("cls_prob"), sym.Variable("bbox_pred"),
+                     sym.Variable("im_info"), feature_stride=16,
+                     scales=(8.0,), ratios=(0.5, 1.0, 2.0),
+                     rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5)
+    out = simple_forward(s, cls_prob=cls_prob, bbox_pred=bbox_pred,
+                         im_info=im_info)
+    assert out.shape == (5, 5)
+
+
+def test_ctc_loss():
+    # single sequence, T=4, C=3 (blank=0)
+    T, N, C = 4, 1, 3
+    logits = np.random.randn(T, N, C).astype("float32")
+    label = np.array([[1, 2]], dtype="float32")
+    s = sym.CTCLoss(sym.Variable("data"), sym.Variable("label"))
+    out = simple_forward(s, data=logits, label=label)
+    assert out.shape == (1,)
+    assert np.isfinite(out).all() and out[0] > 0
+
+    # brute-force reference: sum over all alignments of len 4 mapping to
+    # [1, 2]
+    import itertools
+    logp = logits[:, 0]
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+
+    def collapse(path):
+        out_, prev = [], None
+        for p in path:
+            if p != prev and p != 0:
+                out_.append(p)
+            prev = p
+        return out_
+
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == [1, 2]:
+            lp = sum(logp[t, p] for t, p in enumerate(path))
+            total = np.logaddexp(total, lp)
+    np.testing.assert_allclose(out[0], -total, rtol=1e-4)
+
+
+def test_ctc_loss_grad():
+    T, N, C = 5, 2, 4
+    logits = nd.array(np.random.randn(T, N, C).astype("float32"))
+    label = nd.array(np.array([[1, 2, 0], [3, 0, 0]], dtype="float32"))
+    logits.attach_grad()
+    with mx.autograd.record():
+        loss = nd.CTCLoss(logits, label)
+        total = nd.sum(loss)
+    total.backward()
+    g = logits.grad.asnumpy()
+    assert np.isfinite(g).all() and abs(g).sum() > 0
+
+
+def test_fft_ifft_roundtrip():
+    x = np.random.randn(2, 8).astype("float32")
+    f = simple_forward(sym.fft(sym.Variable("data")), data=x)
+    assert f.shape == (2, 16)
+    rec = simple_forward(sym.ifft(sym.Variable("data")), data=f) / 8
+    np.testing.assert_allclose(rec, x, atol=1e-4)
+
+
+def test_quantize_dequantize():
+    x = np.array([[0.0, 0.5, 1.0]], dtype="float32")
+    mn = np.array([0.0], dtype="float32")
+    mxr = np.array([1.0], dtype="float32")
+    q = simple_forward(sym.quantize(sym.Variable("data"),
+                                    sym.Variable("min_range"),
+                                    sym.Variable("max_range")),
+                       data=x, min_range=mn, max_range=mxr)
+    assert q[0].dtype == np.uint8
+    deq = simple_forward(sym.dequantize(sym.Variable("data"),
+                                        sym.Variable("min_range"),
+                                        sym.Variable("max_range")),
+                         data=q[0].astype("float32") * 0 + q[0],
+                         min_range=mn, max_range=mxr)
+
+
+def test_count_sketch():
+    x = np.random.randn(2, 6).astype("float32")
+    h = np.array([0, 1, 0, 2, 1, 3], dtype="float32")
+    s_sign = np.array([1, -1, 1, 1, -1, 1], dtype="float32")
+    out = simple_forward(
+        sym.count_sketch(sym.Variable("data"), sym.Variable("h"),
+                         sym.Variable("s"), out_dim=4),
+        data=x, h=h, s=s_sign)
+    ref = np.zeros((2, 4), dtype="float32")
+    for j in range(6):
+        ref[:, int(h[j])] += x[:, j] * s_sign[j]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
