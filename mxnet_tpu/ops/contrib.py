@@ -134,7 +134,8 @@ def _decode_boxes(anchors, deltas, variances, clip):
 # ---------------------------------------------------------------------------
 def _multibox_target_one(anchors, label, variances, overlap_threshold,
                          ignore_label, negative_mining_ratio,
-                         negative_mining_thresh, cls_pred):
+                         negative_mining_thresh,
+                         minimum_negative_samples, cls_pred):
     """anchors: (A, 4); label: (M, 5+) [cls, x1, y1, x2, y2]; cls_pred:
     (num_class+1, A)."""
     A = anchors.shape[0]
@@ -156,10 +157,13 @@ def _multibox_target_one(anchors, label, variances, overlap_threshold,
 
     cls_target = jnp.where(
         positive, label[matched_gt, 0] + 1.0, 0.0)
-    # negative mining: keep hardest negatives up to ratio * num_pos
+    # negative mining: keep hardest negatives up to
+    # max(ratio * num_pos, minimum_negative_samples)
     if negative_mining_ratio > 0:
         num_pos = jnp.sum(positive)
-        max_neg = (negative_mining_ratio * num_pos).astype(jnp.int32)
+        max_neg = jnp.maximum(
+            (negative_mining_ratio * num_pos).astype(jnp.int32),
+            minimum_negative_samples)
         neg_cand = (~positive) & (best_iou < negative_mining_thresh)
         # hardness = background prob deficit = max prob - background prob
         bg_prob = cls_pred[0]
@@ -186,7 +190,8 @@ def _multibox_target_fc(attrs, anchor, label, cls_pred):
         overlap_threshold=attrs["overlap_threshold"],
         ignore_label=attrs["ignore_label"],
         negative_mining_ratio=attrs["negative_mining_ratio"],
-        negative_mining_thresh=attrs["negative_mining_thresh"])
+        negative_mining_thresh=attrs["negative_mining_thresh"],
+        minimum_negative_samples=attrs["minimum_negative_samples"])
     loc_t, loc_m, cls_t = jax.vmap(
         lambda lbl, cp: fn(lbl, cls_pred=cp))(label, cls_pred)
     return loc_t, loc_m, cls_t
@@ -252,6 +257,13 @@ def _multibox_detection_one(cls_prob, loc_pred, anchors, attrs_t):
     score = jnp.max(fg, axis=0)
     valid = score > threshold
     score = jnp.where(valid, score, 0.0)
+    if nms_topk > 0:
+        # only the top-k scored candidates participate in NMS; the rest
+        # are discarded outright (reference multibox_detection-inl.h)
+        order = jnp.argsort(-score)
+        rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+        score = jnp.where(rank < nms_topk, score, 0.0)
+        valid = valid & (rank < nms_topk)
     cls_out = jnp.where(valid, cls_id.astype(jnp.float32), -1.0)
     keep = _nms(boxes, score, cls_id, nms_threshold, force_suppress)
     score = jnp.where(keep, score, 0.0)
@@ -360,11 +372,15 @@ def _proposal_fc(attrs, cls_prob, bbox_pred, im_info):
         top_scores = jnp.where(keep, top_scores, 0.0)
         order = jnp.argsort(-top_scores)[:rpn_post]
         rois = top_boxes[order]
-        return jnp.concatenate([jnp.zeros((rpn_post, 1)), rois], axis=1), \
-            top_scores[order][:, None]
+        return rois, top_scores[order][:, None]
 
     rois, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
-    rois = rois.reshape(-1, 5)
+    # per-image batch index in column 0 (ROIPooling keys on rois[:, 0])
+    n = rois.shape[0]
+    batch_idx = jnp.broadcast_to(
+        jnp.arange(n, dtype=rois.dtype)[:, None, None],
+        (n, rois.shape[1], 1))
+    rois = jnp.concatenate([batch_idx, rois], axis=2).reshape(-1, 5)
     if attrs["output_score"]:
         return rois, scores.reshape(-1, 1)
     return rois

@@ -4,11 +4,19 @@ This package is the TPU-native replacement for the reference's parallelism
 machinery (SURVEY.md §2.3): KVStore reduce/broadcast and ps-lite push/pull
 become XLA collectives (psum / all_gather / ppermute) over a
 ``jax.sharding.Mesh``; ``ctx_group`` model parallelism becomes sharding
-annotations; and beyond-reference sequence parallelism (ring attention)
-lives here too.
+annotations (tp rules / pipeline stages); and beyond-reference sequence
+parallelism (ring attention) and expert parallelism live here too.
 """
 from .mesh import make_mesh, data_parallel_sharding, local_mesh
 from .dp import DataParallelTrainer
+from .tp import ShardingRules, MeshTrainer, megatron_rules_for_mlp
+from .sp import ring_attention, ring_self_attention, blockwise_attention
+from .pp import spmd_pipeline, pipelined, stack_stage_params
+from .ep import moe_ffn, top1_dispatch, init_moe_params
 
 __all__ = ["make_mesh", "data_parallel_sharding", "local_mesh",
-           "DataParallelTrainer"]
+           "DataParallelTrainer", "ShardingRules", "MeshTrainer",
+           "megatron_rules_for_mlp", "ring_attention",
+           "ring_self_attention", "blockwise_attention", "spmd_pipeline",
+           "pipelined", "stack_stage_params", "moe_ffn", "top1_dispatch",
+           "init_moe_params"]

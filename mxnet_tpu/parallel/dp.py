@@ -125,16 +125,22 @@ class DataParallelTrainer:
         self._compile()
 
     # ------------------------------------------------------------------
+    def _sharding_for(self, name):
+        """Sharding of parameter ``name`` (replicated for pure DP;
+        MeshTrainer overrides with tensor-parallel rules)."""
+        return self._replicated
+
     def _init_params(self, initializer):
         attrs = self.symbol.attr_dict()
         params = {}
         for name in self.param_names:
             arr = nd.zeros(self._arg_shapes[name], dtype=self._dtype)
             initializer(InitDesc(name, attrs.get(name)), arr)
-            params[name] = jax.device_put(arr._data, self._replicated)
+            params[name] = jax.device_put(arr._data,
+                                          self._sharding_for(name))
         self.params = params
         self.opt_state = {n: tuple(
-            jax.device_put(s, self._replicated)
+            jax.device_put(s, self._sharding_for(n))
             for s in self._opt_init(params[n])) for n in self.param_names}
         aux = {}
         init_aux = nd.zeros((1,))
@@ -180,12 +186,16 @@ class DataParallelTrainer:
         opt_update = self._opt_update
         fixed = self._fixed
         cdt = self._compute_dtype
+        label_set = set(self.label_names)
 
         def _cast(tree):
             if cdt is None:
                 return tree
+            # labels stay in their master dtype: class ids >= 256 are not
+            # representable in bf16's 8-bit significand
             return {k: (v.astype(cdt) if jnp.issubdtype(v.dtype,
                                                         jnp.floating)
+                        and k not in label_set
                         else v) for k, v in tree.items()}
 
         def train_step(params, opt_state, aux, batch, rng):

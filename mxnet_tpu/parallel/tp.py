@@ -1,0 +1,90 @@
+"""Tensor parallelism: parameter-sharding rules over a dp×tp mesh.
+
+The reference has no tensor parallelism (SURVEY.md §2.3.6) — its closest
+surface is ctx_group model parallelism, which cuts the *graph*, not the
+*tensors*.  The TPU-native design follows the GSPMD recipe ("How to Scale
+Your Model"): annotate the weight shardings (Megatron-style column/row
+splits expressed as ``PartitionSpec`` rules keyed on parameter names), put
+the batch on the ``dp`` axis, and let XLA propagate shardings through the
+graph and insert the all-gathers / reduce-scatters / psums on ICI.  No
+collective is written by hand; the rules ARE the parallelism.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .dp import DataParallelTrainer
+from .mesh import make_mesh
+
+__all__ = ["ShardingRules", "MeshTrainer", "megatron_rules_for_mlp"]
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) parameter sharding rules.
+
+    >>> rules = ShardingRules([
+    ...     (r"fc1_weight", P("tp", None)),   # column-parallel: out features
+    ...     (r"fc2_weight", P(None, "tp")),   # row-parallel: in features
+    ... ])
+    First match wins; no match → replicated.
+    """
+
+    def __init__(self, rules=()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, name, shape=None):
+        for pat, spec in self.rules:
+            if pat.search(name):
+                if shape is not None and len(spec) > len(shape):
+                    raise ValueError(
+                        "rule %s for %s has more axes than shape %s"
+                        % (spec, name, shape))
+                return spec
+        return P()
+
+    def add(self, pattern, spec):
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+
+def megatron_rules_for_mlp(hidden_layers, tp_axis="tp"):
+    """Classic Megatron MLP split for a stack of FullyConnected layers:
+    odd layers column-parallel, even layers row-parallel, so the pair
+    needs a single reduce at the end (XLA inserts it)."""
+    rules = []
+    for i, name in enumerate(hidden_layers):
+        if i % 2 == 0:
+            rules.append((r"%s_weight$" % name, P(tp_axis, None)))
+            rules.append((r"%s_bias$" % name, P(tp_axis)))
+        else:
+            rules.append((r"%s_weight$" % name, P(None, tp_axis)))
+    return ShardingRules(rules)
+
+
+class MeshTrainer(DataParallelTrainer):
+    """dp×tp fused trainer: batch sharded on ``dp``, parameters sharded per
+    ``ShardingRules`` on ``tp`` (or any other mesh axes the rules name).
+    The whole step — forward, backward, grad reduction over dp, sharded
+    optimizer update — is one XLA program; gradients of tp-sharded weights
+    are born sharded (reduce-scatter, not all-reduce), which is also the
+    ZeRO-ish memory story: optimizer state lives sharded too.
+    """
+
+    def __init__(self, symbol, data_shapes, label_shapes=None, mesh=None,
+                 rules=None, batch_axis="dp", **kw):
+        self._rules = rules if rules is not None else ShardingRules()
+        if mesh is None:
+            n = len(jax.devices())
+            tp = 2 if n % 2 == 0 else 1
+            mesh = make_mesh({batch_axis: n // tp, "tp": tp})
+        self._mesh_for_rules = mesh
+        super().__init__(symbol, data_shapes, label_shapes=label_shapes,
+                         mesh=mesh, batch_axis=batch_axis, **kw)
+
+    def _sharding_for(self, name):
+        spec = self._rules.spec_for(name,
+                                    self._arg_shapes.get(name))
+        return NamedSharding(self._mesh_for_rules, spec)
