@@ -37,6 +37,7 @@ class Engine:
     def __init__(self):
         self._naive = get_env("MXNET_ENGINE_TYPE") == "NaiveEngine"
         self._profiler = None  # set by profiler module when recording
+        self._host = None  # lazily-created native host-task engine
 
     @staticmethod
     def get():
@@ -72,13 +73,32 @@ class Engine:
             prof.record(name, t0, time.perf_counter_ns())
         return out
 
+    # -- host-task engine ---------------------------------------------------
+    @property
+    def host(self):
+        """Native C++ dependency engine for HOST work (IO, decode,
+        checkpoint writes): the reference's ThreadedEngine semantics —
+        ``push(fn, const_vars, mutable_vars, priority)`` with per-var
+        read/write serialization (``src/engine/threaded_engine.cc``).
+        Device work needs no such engine: XLA dispatch is already async.
+        Returns None when no native toolchain is available."""
+        if self._host is None:
+            with Engine._lock:
+                if self._host is None:
+                    from . import native
+                    if native.available():
+                        self._host = native.NativeEngine()
+        return self._host
+
     # -- sync points --------------------------------------------------------
     @staticmethod
     def wait_for_var(arr):
         jax.block_until_ready(arr)
 
-    @staticmethod
-    def wait_for_all():
+    def wait_for_all(self):
+        # Drain host-engine tasks first (they may feed device work).
+        if self._host is not None:
+            self._host.wait_all()
         # Drain all outstanding async work on every device.
         for d in jax.devices():
             try:
@@ -101,4 +121,4 @@ def is_naive():
 
 def waitall():
     """Block until all queued device work completes (mx.nd.waitall)."""
-    Engine.wait_for_all()
+    Engine.get().wait_for_all()

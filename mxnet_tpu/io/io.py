@@ -390,6 +390,27 @@ class MNISTIter(NDArrayIter):
                          label_name="softmax_label")
 
 
+class _NativeRecordStream:
+    """Background-prefetched sequential record stream (native runtime)."""
+
+    def __init__(self, path, capacity=16):
+        from .. import native
+        self._native = native
+        self._path = path
+        self._cap = capacity
+        self._pf = native.NativePrefetcher(path, capacity)
+
+    def read(self):
+        try:
+            return next(self._pf)
+        except StopIteration:
+            return None
+
+    def reset(self):
+        self._pf.close()
+        self._pf = self._native.NativePrefetcher(self._path, self._cap)
+
+
 class ImageRecordIter(DataIter):
     """RecordIO image iterator (reference iter_image_recordio_2.cc).
 
@@ -400,12 +421,18 @@ class ImageRecordIter(DataIter):
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
-                 rand_crop=False, rand_mirror=False, **kwargs):
+                 rand_crop=False, rand_mirror=False, prefetch_buffer=16,
+                 **kwargs):
         super().__init__(batch_size)
         from . import recordio
         from .image_util import decode_record_image
         self._decode = decode_record_image
-        self.record = recordio.MXRecordIO(path_imgrec, "r")
+        if recordio._use_native():
+            # native reader thread + bounded queue (dmlc::ThreadedIter
+            # analog) overlaps record IO with decode/augment
+            self.record = _NativeRecordStream(path_imgrec, prefetch_buffer)
+        else:
+            self.record = recordio.MXRecordIO(path_imgrec, "r")
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle

@@ -25,55 +25,20 @@ _LFLAG_BITS = 29
 _LENGTH_MASK = (1 << _LFLAG_BITS) - 1
 
 
-class MXRecordIO:
-    """Sequential reader/writer of dmlc RecordIO files."""
+def _use_native():
+    if os.environ.get("MXNET_USE_NATIVE_IO", "1") == "0":
+        return False
+    from .. import native
+    return native.available()
 
-    def __init__(self, uri, flag):
-        self.uri = uri
-        self.flag = flag
-        self.fid = None
-        self.open()
 
-    def open(self):
-        if self.flag == "w":
-            self.fid = open(self.uri, "wb")
-            self.writable = True
-        elif self.flag == "r":
-            self.fid = open(self.uri, "rb")
-            self.writable = False
-        else:
-            raise ValueError("Invalid flag %s" % self.flag)
-        self.is_open = True
+class _PyReader:
+    """Pure-python fallback backend (same framing as the native reader)."""
 
-    def close(self):
-        if self.is_open:
-            self.fid.close()
-            self.is_open = False
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
-
-    def reset(self):
-        self.close()
-        self.open()
-
-    def tell(self):
-        return self.fid.tell()
-
-    def write(self, buf):
-        assert self.writable
-        length = len(buf)
-        self.fid.write(struct.pack("<II", _MAGIC, length & _LENGTH_MASK))
-        self.fid.write(buf)
-        pad = (4 - (length % 4)) % 4
-        if pad:
-            self.fid.write(b"\x00" * pad)
+    def __init__(self, uri):
+        self.fid = open(uri, "rb")
 
     def read(self):
-        assert not self.writable
         header = self.fid.read(8)
         if len(header) < 8:
             return None
@@ -87,6 +52,97 @@ class MXRecordIO:
         if pad:
             self.fid.read(pad)
         return buf
+
+    def seek(self, pos):
+        self.fid.seek(pos)
+
+    def tell(self):
+        return self.fid.tell()
+
+    def close(self):
+        self.fid.close()
+
+
+class _PyWriter:
+    def __init__(self, uri):
+        self.fid = open(uri, "wb")
+
+    def write(self, buf):
+        pos = self.fid.tell()
+        length = len(buf)
+        self.fid.write(struct.pack("<II", _MAGIC, length & _LENGTH_MASK))
+        self.fid.write(buf)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fid.write(b"\x00" * pad)
+        return pos
+
+    def tell(self):
+        return self.fid.tell()
+
+    def close(self):
+        self.fid.close()
+
+
+class MXRecordIO:
+    """Sequential reader/writer of dmlc RecordIO files.
+
+    Backed by the native C++ reader/writer (``mxnet_tpu/native``) when the
+    toolchain is available — the reference's equivalent split is
+    ``python/mxnet/recordio.py`` over dmlc-core's C++ RecordIO — with a
+    pure-python fallback."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self._backend = None
+        self.open()
+
+    def open(self):
+        native_ok = _use_native()
+        if self.flag == "w":
+            if native_ok:
+                from .. import native
+                self._backend = native.NativeRecordWriter(self.uri)
+            else:
+                self._backend = _PyWriter(self.uri)
+            self.writable = True
+        elif self.flag == "r":
+            if native_ok:
+                from .. import native
+                self._backend = native.NativeRecordReader(self.uri)
+            else:
+                self._backend = _PyReader(self.uri)
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self._backend.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self._backend.tell()
+
+    def write(self, buf):
+        assert self.writable
+        return self._backend.write(buf)
+
+    def read(self):
+        assert not self.writable
+        return self._backend.read()
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -122,7 +178,7 @@ class MXIndexedRecordIO(MXRecordIO):
     def seek(self, idx):
         assert not self.writable
         pos = self.idx[idx]
-        self.fid.seek(pos)
+        self._backend.seek(pos)
 
     def read_idx(self, idx):
         self.seek(idx)
@@ -130,8 +186,7 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)
-        pos = self.tell()
-        self.write(buf)
+        pos = self.write(buf)
         self.idx[key] = pos
         self.keys.append(key)
 

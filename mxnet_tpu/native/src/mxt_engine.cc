@@ -1,0 +1,283 @@
+// Threaded dependency engine — TPU-native analog of the reference's core
+// runtime (src/engine/threaded_engine.{h,cc} + threaded_engine_perdevice.cc).
+//
+// Same semantics, rebuilt for the host side of a JAX/XLA framework: XLA owns
+// device scheduling, so this engine schedules HOST work — record IO, decode/
+// augment pipelines, checkpoint writes, python callbacks — with the
+// reference's var/read-write-set dependency model:
+//   * each Var serializes writers and allows concurrent readers in FIFO order
+//     (reference ThreadedVar::AppendReadDependency / AppendWriteDependency,
+//     threaded_engine.cc:32,53);
+//   * an op runs when every var in its read/write set grants access
+//     (wait-count hits zero, reference OprBlock::wait);
+//   * completion triggers dependents (CompleteReadDependency /
+//     CompleteWriteDependency, threaded_engine.cc:84,103);
+//   * a priority thread pool executes ready ops (reference
+//     ThreadedEnginePerDevice worker pools, MXNET_CPU_WORKER_NTHREADS).
+//
+// Exposed as a C ABI for ctypes (the reference's equivalent boundary is
+// include/mxnet/c_api.h).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+typedef void (*mxt_fn)(void *ctx);
+}
+
+namespace {
+
+struct OpBlock {
+  mxt_fn fn = nullptr;
+  void *ctx = nullptr;
+  std::vector<int64_t> const_vars;
+  std::vector<int64_t> mutable_vars;
+  std::atomic<int> wait{0};
+  int priority = 0;
+  uint64_t seq = 0;  // FIFO tiebreak within a priority level
+};
+
+struct Token {
+  OpBlock *op;
+  bool is_write;
+  bool dispatched = false;
+};
+
+// Per-var FIFO of access tokens. Invariant: the dispatched prefix is either
+// a run of consecutive reads or a single write.
+struct Var {
+  std::deque<Token> q;
+};
+
+struct OpCompare {
+  bool operator()(OpBlock *a, OpBlock *b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;  // lower seq first
+  }
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads) {
+    if (num_threads <= 0) num_threads = 4;
+    for (int i = 0; i < num_threads; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    WaitAll();
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      stop_ = true;
+    }
+    pool_cv_.notify_all();
+    for (auto &t : workers_) t.join();
+    for (auto &kv : vars_) delete kv.second;
+  }
+
+  int64_t NewVar() {
+    std::lock_guard<std::mutex> lk(var_mu_);
+    int64_t id = next_var_++;
+    vars_[id] = new Var();
+    return id;
+  }
+
+  void Push(mxt_fn fn, void *ctx, const int64_t *cvars, int nc,
+            const int64_t *mvars, int nm, int priority) {
+    OpBlock *op = new OpBlock();
+    op->fn = fn;
+    op->ctx = ctx;
+    op->const_vars.assign(cvars, cvars + nc);
+    op->mutable_vars.assign(mvars, mvars + nm);
+    op->priority = priority;
+    op->seq = seq_.fetch_add(1);
+    op->wait.store(nc + nm + 1);  // +1 guard: all tokens appended first
+    pending_.fetch_add(1);
+
+    {
+      std::lock_guard<std::mutex> lk(var_mu_);
+      for (int64_t v : op->const_vars) AppendToken(v, op, false);
+      for (int64_t v : op->mutable_vars) AppendToken(v, op, true);
+      // grant access for every var whose token is immediately runnable
+      for (int64_t v : op->const_vars) Advance(v);
+      for (int64_t v : op->mutable_vars) Advance(v);
+    }
+    FinishDep(op);  // drop the guard
+  }
+
+  void WaitForVar(int64_t var) {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    struct Ctx { std::mutex *mu; std::condition_variable *cv; bool *done; };
+    Ctx c{&mu, &cv, &done};
+    // a write op on the var: runs only after everything queued before it
+    Push([](void *p) {
+      Ctx *c = static_cast<Ctx *>(p);
+      std::lock_guard<std::mutex> lk(*c->mu);
+      *c->done = true;
+      c->cv->notify_all();
+    }, &c, nullptr, 0, &var, 1, 1 << 20);
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(all_mu_);
+    all_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+  void DeleteVar(int64_t var) {
+    // Defer removal until all queued ops on the var have drained.
+    struct Ctx { Engine *e; int64_t v; };
+    Ctx *c = new Ctx{this, var};
+    Push([](void *p) {
+      Ctx *c = static_cast<Ctx *>(p);
+      std::lock_guard<std::mutex> lk(c->e->var_mu_);
+      auto it = c->e->vars_.find(c->v);
+      if (it != c->e->vars_.end()) {
+        delete it->second;
+        c->e->vars_.erase(it);
+      }
+      delete c;
+    }, c, nullptr, 0, &var, 1, 1 << 20);
+  }
+
+  int64_t pending() const { return pending_.load(); }
+
+ private:
+  void AppendToken(int64_t vid, OpBlock *op, bool is_write) {
+    Var *v = vars_.at(vid);
+    v->q.push_back(Token{op, is_write, false});
+  }
+
+  // Dispatch every runnable, not-yet-dispatched token at the front of the
+  // var's queue (all leading reads, or one leading write). var_mu_ held.
+  void Advance(int64_t vid) {
+    auto it = vars_.find(vid);
+    if (it == vars_.end()) return;
+    Var *v = it->second;
+    for (auto &tok : v->q) {
+      if (tok.is_write) {
+        // a write runs alone: only if it is the very front token
+        if (&tok == &v->q.front() && !tok.dispatched) {
+          tok.dispatched = true;
+          FinishDep(tok.op);
+        }
+        break;  // nothing past a write may run
+      }
+      if (!tok.dispatched) {
+        tok.dispatched = true;
+        FinishDep(tok.op);
+      }
+    }
+  }
+
+  // One var dependency satisfied; when all are, the op is ready.
+  void FinishDep(OpBlock *op) {
+    if (op->wait.fetch_sub(1) == 1) {
+      {
+        std::lock_guard<std::mutex> lk(pool_mu_);
+        ready_.push(op);
+      }
+      pool_cv_.notify_one();
+    }
+  }
+
+  void OnComplete(OpBlock *op) {
+    {
+      std::lock_guard<std::mutex> lk(var_mu_);
+      for (int64_t vid : op->const_vars) RemoveToken(vid, op);
+      for (int64_t vid : op->mutable_vars) RemoveToken(vid, op);
+    }
+    delete op;
+    if (pending_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(all_mu_);
+      all_cv_.notify_all();
+    }
+  }
+
+  void RemoveToken(int64_t vid, OpBlock *op) {
+    auto it = vars_.find(vid);
+    if (it == vars_.end()) return;
+    Var *v = it->second;
+    for (auto qit = v->q.begin(); qit != v->q.end(); ++qit) {
+      if (qit->op == op) {
+        v->q.erase(qit);
+        break;
+      }
+    }
+    Advance(vid);
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      OpBlock *op;
+      {
+        std::unique_lock<std::mutex> lk(pool_mu_);
+        pool_cv_.wait(lk, [this] { return stop_ || !ready_.empty(); });
+        if (stop_ && ready_.empty()) return;
+        op = ready_.top();
+        ready_.pop();
+      }
+      op->fn(op->ctx);
+      OnComplete(op);
+    }
+  }
+
+  std::mutex var_mu_;
+  std::unordered_map<int64_t, Var *> vars_;
+  int64_t next_var_ = 1;
+
+  std::mutex pool_mu_;
+  std::condition_variable pool_cv_;
+  std::priority_queue<OpBlock *, std::vector<OpBlock *>, OpCompare> ready_;
+  bool stop_ = false;
+  std::vector<std::thread> workers_;
+
+  std::atomic<uint64_t> seq_{0};
+  std::atomic<int64_t> pending_{0};
+  std::mutex all_mu_;
+  std::condition_variable all_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void *mxt_engine_create(int num_threads) { return new Engine(num_threads); }
+
+void mxt_engine_destroy(void *e) { delete static_cast<Engine *>(e); }
+
+int64_t mxt_engine_new_var(void *e) {
+  return static_cast<Engine *>(e)->NewVar();
+}
+
+void mxt_engine_delete_var(void *e, int64_t var) {
+  static_cast<Engine *>(e)->DeleteVar(var);
+}
+
+void mxt_engine_push(void *e, mxt_fn fn, void *ctx, const int64_t *cvars,
+                     int nc, const int64_t *mvars, int nm, int priority) {
+  static_cast<Engine *>(e)->Push(fn, ctx, cvars, nc, mvars, nm, priority);
+}
+
+void mxt_engine_wait_var(void *e, int64_t var) {
+  static_cast<Engine *>(e)->WaitForVar(var);
+}
+
+void mxt_engine_wait_all(void *e) { static_cast<Engine *>(e)->WaitAll(); }
+
+int64_t mxt_engine_pending(void *e) {
+  return static_cast<Engine *>(e)->pending();
+}
+
+}  // extern "C"

@@ -1,0 +1,195 @@
+// RecordIO reader/writer + threaded prefetch queue — native data-IO layer.
+//
+// Byte-compatible with the dmlc RecordIO framing the reference uses
+// (dmlc-core recordio: magic 0xced7230a, 4-byte little-endian length with
+// the upper 3 bits reserved for the continuation flag, payload padded to a
+// 4-byte boundary; consumed by src/io/iter_image_recordio_2.cc).  The
+// prefetcher mirrors dmlc::ThreadedIter's producer/consumer double
+// buffering (reference iter_prefetcher.h, kMaxPrefetchBuffer).
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLengthMask = (1u << 29) - 1;
+
+struct Reader {
+  FILE *f = nullptr;
+  std::vector<char> buf;
+
+  explicit Reader(const char *path) { f = std::fopen(path, "rb"); }
+  ~Reader() {
+    if (f) std::fclose(f);
+  }
+
+  // Returns pointer/size valid until the next Read; size<0 on EOF/error.
+  int64_t Read(const char **data) {
+    uint32_t header[2];
+    if (std::fread(header, 4, 2, f) != 2) return -1;
+    if (header[0] != kMagic) return -2;
+    uint32_t len = header[1] & kLengthMask;
+    buf.resize(len);
+    if (len && std::fread(buf.data(), 1, len, f) != len) return -1;
+    uint32_t pad = (4 - (len % 4)) % 4;
+    if (pad) std::fseek(f, pad, SEEK_CUR);
+    *data = buf.data();
+    return static_cast<int64_t>(len);
+  }
+
+  void Seek(uint64_t pos) { std::fseek(f, static_cast<long>(pos), SEEK_SET); }
+  uint64_t Tell() { return static_cast<uint64_t>(std::ftell(f)); }
+};
+
+struct Writer {
+  FILE *f = nullptr;
+  explicit Writer(const char *path) { f = std::fopen(path, "wb"); }
+  ~Writer() {
+    if (f) std::fclose(f);
+  }
+
+  uint64_t Write(const char *data, uint64_t size) {
+    uint64_t pos = static_cast<uint64_t>(std::ftell(f));
+    uint32_t header[2] = {kMagic,
+                          static_cast<uint32_t>(size) & kLengthMask};
+    std::fwrite(header, 4, 2, f);
+    std::fwrite(data, 1, size, f);
+    static const char zeros[4] = {0, 0, 0, 0};
+    uint32_t pad = (4 - (size % 4)) % 4;
+    if (pad) std::fwrite(zeros, 1, pad, f);
+    return pos;
+  }
+};
+
+// Bounded producer/consumer queue of records read by a background thread.
+struct Prefetcher {
+  Reader reader;
+  size_t capacity;
+  std::deque<std::string> queue;
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  bool eof = false, stop = false;
+  std::thread producer;
+  std::string current;  // last record handed to the consumer
+
+  int64_t err = -1;  // status reported at end of stream (-1 eof, -2 corrupt)
+
+  Prefetcher(const char *path, int cap)
+      : reader(path), capacity(cap > 0 ? cap : 16) {
+    // the producer thread is started by Start() only after the caller has
+    // verified the file opened — reading through a null FILE* is UB
+  }
+
+  void Start() { producer = std::thread([this] { Loop(); }); }
+
+  ~Prefetcher() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv_produce.notify_all();
+    cv_consume.notify_all();
+    if (producer.joinable()) producer.join();
+  }
+
+  void Loop() {
+    for (;;) {
+      const char *data;
+      int64_t n = reader.Read(&data);
+      std::unique_lock<std::mutex> lk(mu);
+      if (n < 0) {
+        err = n;  // distinguish clean EOF (-1) from corruption (-2)
+        eof = true;
+        cv_consume.notify_all();
+        return;
+      }
+      cv_produce.wait(lk, [this] { return stop || queue.size() < capacity; });
+      if (stop) return;
+      queue.emplace_back(data, static_cast<size_t>(n));
+      cv_consume.notify_one();
+    }
+  }
+
+  // Returns size; -1 on clean end of stream; -2 on corrupt magic.
+  int64_t Next(const char **data) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_consume.wait(lk, [this] { return stop || eof || !queue.empty(); });
+    if (queue.empty()) return err;
+    current = std::move(queue.front());
+    queue.pop_front();
+    cv_produce.notify_one();
+    *data = current.data();
+    return static_cast<int64_t>(current.size());
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *mxt_recio_reader_create(const char *path) {
+  Reader *r = new Reader(path);
+  if (!r->f) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+void mxt_recio_reader_destroy(void *r) { delete static_cast<Reader *>(r); }
+
+int64_t mxt_recio_read(void *r, const char **data) {
+  return static_cast<Reader *>(r)->Read(data);
+}
+
+void mxt_recio_reader_seek(void *r, uint64_t pos) {
+  static_cast<Reader *>(r)->Seek(pos);
+}
+
+uint64_t mxt_recio_reader_tell(void *r) {
+  return static_cast<Reader *>(r)->Tell();
+}
+
+void *mxt_recio_writer_create(const char *path) {
+  Writer *w = new Writer(path);
+  if (!w->f) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+void mxt_recio_writer_destroy(void *w) { delete static_cast<Writer *>(w); }
+
+uint64_t mxt_recio_write(void *w, const char *data, uint64_t size) {
+  return static_cast<Writer *>(w)->Write(data, size);
+}
+
+uint64_t mxt_recio_writer_tell(void *w) {
+  return static_cast<uint64_t>(std::ftell(static_cast<Writer *>(w)->f));
+}
+
+void *mxt_prefetch_create(const char *path, int capacity) {
+  Prefetcher *p = new Prefetcher(path, capacity);
+  if (!p->reader.f) {
+    delete p;
+    return nullptr;
+  }
+  p->Start();
+  return p;
+}
+
+void mxt_prefetch_destroy(void *p) { delete static_cast<Prefetcher *>(p); }
+
+int64_t mxt_prefetch_next(void *p, const char **data) {
+  return static_cast<Prefetcher *>(p)->Next(data);
+}
+
+}  // extern "C"

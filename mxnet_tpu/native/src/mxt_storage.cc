@@ -1,0 +1,93 @@
+// Pooled host-memory storage manager — native analog of the reference's
+// storage layer (src/storage/pooled_storage_manager.h GPUPooledStorageManager
+// + src/storage/cpu_device_storage.h).
+//
+// Same policy, applied to host staging buffers (the TPU equivalent of the
+// reference's pinned-host memory used by data pipelines): recycle freed
+// blocks by exact size (the reference's free_pool_ keyed on size), 64-byte
+// alignment (reference CPUDeviceStorage::alignment_ = 16, widened for
+// cacheline/AVX), DirectFree bypassing the pool, and ReleaseAll.
+#include <cstdint>
+#include <cstdlib>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 64;
+
+struct Pool {
+  std::mutex mu;
+  std::unordered_map<uint64_t, std::vector<void *>> free_pool;
+  uint64_t used_bytes = 0;
+  uint64_t pooled_bytes = 0;
+
+  void *Alloc(uint64_t size) {
+    if (size == 0) size = kAlign;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      auto it = free_pool.find(size);
+      if (it != free_pool.end() && !it->second.empty()) {
+        void *p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes -= size;
+        used_bytes += size;
+        return p;
+      }
+      used_bytes += size;
+    }
+    uint64_t rounded = (size + kAlign - 1) / kAlign * kAlign;
+    return std::aligned_alloc(kAlign, rounded);
+  }
+
+  void Free(void *p, uint64_t size) {
+    if (!p) return;
+    if (size == 0) size = kAlign;
+    std::lock_guard<std::mutex> lk(mu);
+    free_pool[size].push_back(p);
+    used_bytes -= size;
+    pooled_bytes += size;
+  }
+
+  void DirectFree(void *p, uint64_t size) {
+    if (!p) return;
+    if (size == 0) size = kAlign;
+    std::free(p);
+    std::lock_guard<std::mutex> lk(mu);
+    used_bytes -= size;
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu);
+    for (auto &kv : free_pool)
+      for (void *p : kv.second) std::free(p);
+    free_pool.clear();
+    pooled_bytes = 0;
+  }
+};
+
+Pool *Global() {
+  static Pool pool;
+  return &pool;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *mxt_storage_alloc(uint64_t size) { return Global()->Alloc(size); }
+
+void mxt_storage_free(void *p, uint64_t size) { Global()->Free(p, size); }
+
+void mxt_storage_direct_free(void *p, uint64_t size) {
+  Global()->DirectFree(p, size);
+}
+
+void mxt_storage_release_all() { Global()->ReleaseAll(); }
+
+uint64_t mxt_storage_used_bytes() { return Global()->used_bytes; }
+
+uint64_t mxt_storage_pooled_bytes() { return Global()->pooled_bytes; }
+
+}  // extern "C"
