@@ -6,7 +6,12 @@ from .resnet import get_symbol as resnet
 from .alexnet import get_symbol as alexnet
 from .vgg import get_symbol as vgg
 from .inception_bn import get_symbol as inception_bn
+from .googlenet import get_symbol as googlenet
+from .inception_v3 import get_symbol as inception_v3
+from .resnext import get_symbol as resnext
+from .inception_resnet_v2 import get_symbol as inception_resnet_v2
 from .lstm_lm import get_symbol as lstm_lm
 
 __all__ = ["mlp", "lenet", "resnet", "alexnet", "vgg", "inception_bn",
+           "googlenet", "inception_v3", "resnext", "inception_resnet_v2",
            "lstm_lm"]

@@ -1,0 +1,52 @@
+"""Model-zoo symbol builders: shape inference + small forward passes.
+
+Mirrors the reference's use of ``tests/python/common/models.py`` fixtures:
+every symbol must build, infer shapes end-to-end, and (for the cheap ones)
+run a forward pass.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.mark.parametrize("name,kwargs,dshape", [
+    ("mlp", {}, (4, 784)),
+    ("lenet", {"num_classes": 10}, (4, 1, 28, 28)),
+    ("alexnet", {"num_classes": 1000}, (2, 3, 224, 224)),
+    ("vgg", {"num_classes": 1000, "num_layers": 11}, (2, 3, 224, 224)),
+    ("inception_bn", {}, (2, 3, 224, 224)),
+    ("googlenet", {}, (2, 3, 224, 224)),
+    ("inception_v3", {}, (2, 3, 299, 299)),
+    ("resnet", {"num_classes": 1000, "num_layers": 50}, (2, 3, 224, 224)),
+    ("resnext", {"num_classes": 1000, "num_layers": 50}, (2, 3, 224, 224)),
+    ("inception_resnet_v2", {}, (2, 3, 299, 299)),
+])
+def test_model_infer_shape(name, kwargs, dshape):
+    net = getattr(mx.models, name)(**kwargs)
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=dshape, softmax_label=(dshape[0],))
+    nc = kwargs.get("num_classes", 1000 if len(dshape) == 4 else 10)
+    assert out_shapes[0] == (dshape[0], nc)
+    assert len(arg_shapes) > 2
+
+
+@pytest.mark.parametrize("name,kwargs,dshape,nc", [
+    ("googlenet", {"num_classes": 10}, (2, 3, 64, 64), 10),
+    ("resnext", {"num_classes": 10, "num_layers": 50,
+                 "image_shape": "3,64,64", "num_group": 8}, (1, 3, 64, 64),
+     10),
+])
+def test_model_forward(name, kwargs, dshape, nc):
+    net = getattr(mx.models, name)(**kwargs)
+    ex = net.simple_bind(mx.cpu(), grad_req="null", data=dshape,
+                         softmax_label=(dshape[0],))
+    for arr in ex.arg_arrays:
+        if arr.shape != dshape:
+            arr[:] = np.random.uniform(-0.05, 0.05, arr.shape)
+    ex.arg_dict["data"][:] = np.random.uniform(-1, 1, dshape)
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (dshape[0], nc)
+    # softmax rows sum to 1
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(dshape[0]),
+                               rtol=1e-4)
