@@ -1,0 +1,267 @@
+"""Python-defined operators (CustomOp API).
+
+Reference: ``python/mxnet/operator.py`` (855 LoC) — ``CustomOp`` /
+``CustomOpProp`` + ``register`` (the modern style), plus the legacy
+``PythonOp`` family (``NumpyOp``, ``NDArrayOp``).  The reference marshals
+callbacks through ``MXCustomOpRegister`` / ``MXCallbackList`` into a C++
+async worker thread (``src/operator/custom/custom-inl.h:34-99``); here the
+device↔host seam is ``jax.pure_callback`` inside the registered ``Custom``
+operator (``mxnet_tpu/ops/custom.py``) — the op participates in symbolic
+graphs, ``simple_bind`` shape inference, autograd, and jit-compiled
+executors like any built-in.
+
+Usage (identical to the reference)::
+
+    class Sigmoid(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], 1 / (1 + mx.nd.exp(-in_data[0])))
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    @mx.operator.register("sigmoid")
+    class SigmoidProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+    y = mx.symbol.Custom(data=x, op_type="sigmoid")
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_registered_op",
+           "PythonOp", "NumpyOp", "NDArrayOp"]
+
+
+class CustomOp:
+    """Base class for operators implemented in python
+    (reference python/mxnet/operator.py:396)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Forward interface; fill ``out_data`` via ``self.assign``."""
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Backward interface; fill ``in_grad`` via ``self.assign``."""
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Assign ``src`` to ``dst`` honoring the write request type."""
+        from .ndarray import NDArray
+        if req in ("null", None):
+            return
+        if isinstance(src, NDArray):
+            src = src._data
+        if req in ("write", "inplace"):
+            dst._data = _like(src, dst)
+        elif req == "add":
+            dst._data = dst._data + _like(src, dst)
+        else:
+            raise MXNetError("unknown req %r" % (req,))
+
+
+def _like(src, dst):
+    import jax.numpy as jnp
+    return jnp.asarray(src, dtype=dst.dtype).reshape(dst.shape)
+
+
+class CustomOpProp:
+    """Operator property: structure + inference for a custom op
+    (reference python/mxnet/operator.py:442)."""
+
+    def __init__(self, need_top_grad=False):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        """Default: all inputs share shape; one output of in[0]'s shape."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Declare tensors the backward reads (memory-planning hint in the
+        reference; retained for API parity — XLA plans memory itself)."""
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_REGISTRY: dict = {}
+_registry_lock = threading.Lock()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``reg_name``; usable via
+    ``mx.sym.Custom(op_type=reg_name)`` / ``mx.nd.Custom``."""
+    def do_register(prop_cls):
+        with _registry_lock:
+            _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_registered_op(reg_name):
+    prop_cls = _REGISTRY.get(reg_name)
+    if prop_cls is None:
+        raise MXNetError("custom op type %r is not registered "
+                         "(use mxnet_tpu.operator.register)" % (reg_name,))
+    return prop_cls
+
+
+# ---------------------------------------------------------------------------
+# Legacy PythonOp family (reference python/mxnet/operator.py:19-394).
+# Deprecated in the reference in favor of CustomOp; kept for API parity.
+# Implemented as adapters onto the CustomOp path.
+# ---------------------------------------------------------------------------
+class PythonOp:
+    """Base class for (deprecated) python operators; instances are callable
+    and return a Symbol (reference operator.py:19-125)."""
+
+    _count = [0]
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+        self._reg_name = None
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("Must override this")
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError("Must override this")
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError("Must override this")
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # -- adapter machinery -------------------------------------------------
+    def _register_as_custom(self, as_numpy):
+        # one registration per op instance: repeated get_symbol calls on the
+        # same instance reuse the name instead of growing the registry
+        if self._reg_name is not None:
+            return self._reg_name
+        legacy = self
+
+        class _Adapter(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                if as_numpy:
+                    ins = [np.array(x.asnumpy()) for x in in_data]
+                    outs = [np.array(x.asnumpy()) for x in out_data]
+                    legacy.forward(in_data=ins, out_data=outs)
+                    for dst, r, o in zip(out_data, req, outs):
+                        self.assign(dst, r, o)
+                else:
+                    legacy.forward(in_data=in_data, out_data=out_data)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                if as_numpy:
+                    og = [np.array(x.asnumpy()) for x in out_grad]
+                    ins = [np.array(x.asnumpy()) for x in in_data]
+                    outs = [np.array(x.asnumpy()) for x in out_data]
+                    ig = [np.array(x.asnumpy()) for x in in_grad]
+                    legacy.backward(out_grad=og, in_data=ins, out_data=outs,
+                                    in_grad=ig)
+                    for dst, r, g in zip(in_grad, req, ig):
+                        self.assign(dst, r, g)
+                else:
+                    legacy.backward(out_grad=out_grad, in_data=in_data,
+                                    out_data=out_data, in_grad=in_grad)
+
+        class _AdapterProp(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=legacy.need_top_grad_)
+
+            def infer_shape(self, in_shape):
+                res = legacy.infer_shape(in_shape)
+                if len(res) == 2:
+                    return res[0], res[1], []
+                return res
+
+            def list_outputs(self):
+                return legacy.list_outputs()
+
+            def list_arguments(self):
+                return legacy.list_arguments()
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                return _Adapter()
+
+        PythonOp._count[0] += 1
+        name = "_python_op%d" % PythonOp._count[0]
+        register(name)(_AdapterProp)
+        self._reg_name = name
+        return name
+
+
+class NumpyOp(PythonOp):
+    """Legacy python op operating on numpy arrays
+    (reference operator.py:126-225)."""
+
+    def __init__(self, need_top_grad=True):
+        super().__init__(need_top_grad)
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+        op_type = self._register_as_custom(as_numpy=True)
+        return sym.Custom(*args, op_type=op_type, **kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """Legacy python op operating on NDArrays
+    (reference operator.py:226-394)."""
+
+    def __init__(self, need_top_grad=True):
+        super().__init__(need_top_grad)
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+        op_type = self._register_as_custom(as_numpy=False)
+        return sym.Custom(*args, op_type=op_type, **kwargs)
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps

@@ -19,5 +19,6 @@ from . import optimizer_op  # noqa: F401
 from . import vision        # noqa: F401
 from . import contrib       # noqa: F401
 from . import rnn_op        # noqa: F401
+from . import custom        # noqa: F401
 
 __all__ = ["get_op", "list_ops", "register", "OpDef"]

@@ -1,0 +1,172 @@
+"""The ``Custom`` operator: python-defined ops inside traced graphs.
+
+Reference: ``src/operator/custom/custom-inl.h:34-99`` runs python callbacks
+on an async worker thread, marshalled through ``MXCallbackList``; the python
+side is ``python/mxnet/operator.py`` (``CustomOp``/``CustomOpProp`` +
+``register``).
+
+TPU-native design: the user's python ``forward``/``backward`` are host
+callbacks escaping the XLA program via ``jax.pure_callback`` — the same
+host/device seam the reference crosses with its callback thread.  Gradients
+flow through a ``jax.custom_vjp`` whose backward rule is a second host
+callback into the user's ``backward``.  Everything else in the graph stays
+compiled; XLA schedules the callback like any other async host transfer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import Str, register
+
+
+def _prop_for(attrs):
+    """Instantiate (with caching) the registered CustomOpProp for attrs."""
+    from .. import operator as _operator
+    op_type = attrs.get("op_type")
+    if not op_type:
+        raise MXNetError("Custom op requires op_type=")
+    prop_cls = _operator.get_registered_op(op_type)
+    key = tuple(sorted((k, v) for k, v in attrs.items()
+                       if k != "op_type" and v is not None))
+    # keyed on the class itself so re-registering an op_type (common in
+    # notebooks/test reruns) invalidates the cached instance
+    cache = _prop_for._cache
+    if (prop_cls, key) not in cache:
+        kwargs = dict(key)
+        cache[(prop_cls, key)] = prop_cls(**kwargs)
+    return cache[(prop_cls, key)]
+
+
+_prop_for._cache = {}
+
+
+def _shapes3(prop, in_shapes):
+    res = prop.infer_shape([list(s) for s in in_shapes])
+    if len(res) == 2:
+        ins, outs = res
+        aux = []
+    else:
+        ins, outs, aux = res
+    t = lambda ss: [tuple(int(d) for d in s) for s in ss]
+    return t(ins), t(outs), t(aux)
+
+
+def _types3(prop, in_types):
+    res = prop.infer_type(list(in_types))
+    if len(res) == 2:
+        ins, outs = res
+        aux = [in_types[0]] * len(prop.list_auxiliary_states())
+    else:
+        ins, outs, aux = res
+    return list(ins), list(outs), list(aux)
+
+
+def _custom_infer_shape(attrs, in_shapes):
+    prop = _prop_for(attrs)
+    if any(s is None for s in in_shapes):
+        return (in_shapes, [None] * len(prop.list_outputs()),
+                [None] * len(prop.list_auxiliary_states()))
+    return _shapes3(prop, in_shapes)
+
+
+def _custom_infer_type(attrs, in_types):
+    prop = _prop_for(attrs)
+    args = [t or "float32" for t in in_types]
+    return _types3(prop, args)
+
+
+def _custom_fstateful(attrs, inputs, aux, is_train, rng):
+    from ..context import current_context
+    from ..ndarray import NDArray
+    prop = _prop_for(attrs)
+    n_in, n_out = len(inputs), len(prop.list_outputs())
+    n_aux = len(aux)
+
+    in_shapes = [tuple(int(d) for d in x.shape) for x in inputs]
+    in_types = [np.dtype(x.dtype).name for x in inputs]
+    _, out_shapes, _ = _shapes3(prop, in_shapes)
+    _, out_types, _ = _types3(prop, in_types)
+    aux_shapes = [tuple(int(d) for d in a.shape) for a in aux]
+    aux_types = [np.dtype(a.dtype).name for a in aux]
+
+    op_inst = prop.create_operator(current_context(), in_shapes, in_types)
+
+    fwd_result_spec = tuple(
+        [jax.ShapeDtypeStruct(s, np.dtype(t))
+         for s, t in zip(out_shapes, out_types)] +
+        [jax.ShapeDtypeStruct(s, np.dtype(t))
+         for s, t in zip(aux_shapes, aux_types)])
+    bwd_result_spec = tuple(
+        jax.ShapeDtypeStruct(s, np.dtype(t))
+        for s, t in zip(in_shapes, in_types))
+
+    def _wrap(arrs):
+        return [NDArray(jnp.asarray(a)) for a in arrs]
+
+    def _fwd_cb(*flat):
+        in_nd = _wrap(flat[:n_in])
+        aux_nd = _wrap(flat[n_in:])
+        out_nd = [NDArray(jnp.zeros(s, dtype=t))
+                  for s, t in zip(out_shapes, out_types)]
+        op_inst.forward(is_train=is_train, req=["write"] * n_out,
+                        in_data=in_nd, out_data=out_nd, aux=aux_nd)
+        return tuple(
+            [np.asarray(o.asnumpy(), dtype=t)
+             for o, t in zip(out_nd, out_types)] +
+            [np.asarray(a.asnumpy(), dtype=t)
+             for a, t in zip(aux_nd, aux_types)])
+
+    def _bwd_cb(*flat):
+        og = _wrap(flat[:n_out])
+        in_nd = _wrap(flat[n_out:n_out + n_in])
+        out_nd = _wrap(flat[n_out + n_in:n_out + n_in + n_out])
+        aux_nd = _wrap(flat[n_out + n_in + n_out:])
+        ig = [NDArray(jnp.zeros(s, dtype=t))
+              for s, t in zip(in_shapes, in_types)]
+        op_inst.backward(req=["write"] * n_in, out_grad=og, in_data=in_nd,
+                         out_data=out_nd, in_grad=ig, aux=aux_nd)
+        return tuple(np.asarray(g.asnumpy(), dtype=t)
+                     for g, t in zip(ig, in_types))
+
+    @jax.custom_vjp
+    def run(ins, auxs):
+        res = jax.pure_callback(_fwd_cb, fwd_result_spec, *ins, *auxs)
+        return tuple(res)
+
+    def run_fwd(ins, auxs):
+        res = run(ins, auxs)
+        return res, (ins, res[:n_out], auxs)
+
+    def run_bwd(resid, cot):
+        ins, outs, auxs = resid
+        ograds = cot[:n_out]
+        igrads = jax.pure_callback(_bwd_cb, bwd_result_spec,
+                                   *ograds, *ins, *outs, *auxs)
+        d_aux = tuple(jnp.zeros(s, dtype=t)
+                      for s, t in zip(aux_shapes, aux_types))
+        return tuple(igrads), d_aux
+
+    run.defvjp(run_fwd, run_bwd)
+
+    res = run(tuple(inputs), tuple(aux))
+    return tuple(res[:n_out]), tuple(res[n_out:])
+
+
+register(
+    "Custom",
+    fstateful=_custom_fstateful,
+    attrs={"op_type": Str(required=True,
+                          doc="Registered name of the CustomOpProp.")},
+    arguments=lambda attrs: list(_prop_for(attrs).list_arguments()),
+    outputs=lambda attrs: list(_prop_for(attrs).list_outputs()),
+    aux_states=lambda attrs: list(_prop_for(attrs).list_auxiliary_states()),
+    num_outputs=lambda attrs: len(_prop_for(attrs).list_outputs()),
+    infer_shape=_custom_infer_shape,
+    infer_type=_custom_infer_type,
+    free_attrs=True,
+    doc="Apply a python-defined custom operator (operator.register).",
+)

@@ -130,7 +130,8 @@ class OpDef:
                  arguments=("data",), outputs=("output",), aux_states=(),
                  infer_shape=None, infer_type=None,
                  infer_shape_backward=None, num_outputs=1,
-                 key_var_num_args=None, needs_rng=False, mutate=(), doc=""):
+                 key_var_num_args=None, needs_rng=False, mutate=(),
+                 free_attrs=False, doc=""):
         self.name = name
         self.fcompute = fcompute
         self.fstateful = fstateful
@@ -149,6 +150,10 @@ class OpDef:
         # handles by imperative_invoke (reference FMutateInputs — optimizer
         # update ops mutate their state inputs, op_attr_types.h)
         self.mutate = tuple(mutate)
+        # accept arbitrary extra kwargs as strings (reference: Custom op
+        # forwards unparsed kwargs to the python CustomOpProp constructor,
+        # src/operator/custom/custom-inl.h)
+        self.free_attrs = free_attrs
         self.stateful = fstateful is not None
         self.doc = doc
 
@@ -168,7 +173,10 @@ class OpDef:
         unknown = set(raw) - set(self.attr_specs)
         # Symbol-level annotations (__ctx_group__, __lr_mult__...) pass through
         unknown = {k for k in unknown if not k.startswith("__")}
-        if unknown:
+        if unknown and self.free_attrs:
+            for k in sorted(unknown):
+                out[k] = str(raw[k])
+        elif unknown:
             raise MXNetError("op %s: unknown attributes %s"
                              % (self.name, sorted(unknown)))
         return out

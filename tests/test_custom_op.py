@@ -1,0 +1,176 @@
+"""CustomOp tests (reference tests/python/unittest/test_operator.py
+test_custom_op and example/numpy-ops/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g * y * (1.0 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Sigmoid()
+
+
+class Square(mx.operator.CustomOp):
+    def __init__(self, scale=1.0):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0],
+                    self.scale * in_data[0].asnumpy() ** 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2.0 * self.scale *
+                    in_data[0].asnumpy() * out_grad[0].asnumpy())
+
+
+@mx.operator.register("test_square")
+class SquareProp(mx.operator.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Square(self.scale)
+
+
+def test_custom_imperative():
+    x = mx.nd.array(np.array([[-1.0, 0.0, 2.0]], dtype=np.float32))
+    y = mx.nd.Custom(x, op_type="test_sigmoid")
+    expect = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    assert_almost_equal(y.asnumpy(), expect)
+
+
+def test_custom_symbolic_forward_backward():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data=data, op_type="test_sigmoid", name="sig")
+    # compose with built-in ops: custom op sits inside a compiled graph
+    net = mx.sym.sum(net * net)
+    xs = np.random.RandomState(0).uniform(-2, 2, (4, 5)).astype(np.float32)
+    ex = net.simple_bind(mx.cpu(), data=xs.shape)
+    ex.arg_dict["data"][:] = xs
+    out = ex.forward(is_train=True)[0].asnumpy()
+    sig = 1.0 / (1.0 + np.exp(-xs))
+    assert_almost_equal(out, np.sum(sig * sig), rtol=1e-4, atol=1e-5)
+    ex.backward()
+    # d/dx sum(sig^2) = 2 sig * sig' = 2 sig^2 (1 - sig)
+    expect = 2 * sig * sig * (1 - sig)
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), expect,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_custom_shape_inference():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data=data, op_type="test_square")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3))
+    assert out_shapes[0] == (2, 3)
+    assert net.list_arguments() == ["data"]
+
+
+def test_custom_kwargs_to_prop():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data=data, op_type="test_square", scale="3.0")
+    ex = net.simple_bind(mx.cpu(), data=(2, 2))
+    ex.arg_dict["data"][:] = 2.0
+    out = ex.forward()[0].asnumpy()
+    # scale=3.0 must reach the prop constructor: 3 * 2^2 = 12
+    assert_almost_equal(out, np.full((2, 2), 12.0, dtype=np.float32))
+
+
+def test_custom_in_module_fit():
+    # custom op inside a Module training loop end-to-end
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    act = mx.sym.Custom(data=fc, op_type="test_sigmoid", name="csig")
+    out = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(out, name="softmax")
+    mod = mx.Module(net, data_names=("data",),
+                    label_names=("softmax_label",), context=mx.cpu())
+    rs = np.random.RandomState(0)
+    xs = rs.uniform(-1, 1, (16, 4)).astype(np.float32)
+    ys = (xs.sum(axis=1) > 0).astype(np.float32)
+    batch = mx.io.DataBatch(data=[mx.nd.array(xs)],
+                            label=[mx.nd.array(ys)])
+    mod.bind(data_shapes=[("data", xs.shape)],
+             label_shapes=[("softmax_label", ys.shape)])
+    mod.init_params(initializer=mx.initializer.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    first_loss = None
+    for _ in range(10):
+        mod.forward(batch, is_train=True)
+        probs = mod.get_outputs()[0].asnumpy()
+        loss = -np.log(probs[np.arange(16), ys.astype(int)] + 1e-8).mean()
+        if first_loss is None:
+            first_loss = loss
+        mod.backward()
+        mod.update()
+    assert loss < first_loss
+
+
+class NumpySoftmax(mx.operator.NumpyOp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        y = out_data[0]
+        y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        l = in_data[1].astype(int)
+        y = out_data[0]
+        dx = in_grad[0]
+        dx[:] = y
+        dx[np.arange(l.shape[0]), l] -= 1.0
+
+
+def test_legacy_numpy_op():
+    mysoftmax = NumpySoftmax()
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    net = mysoftmax(data=data, label=label, name="softmax")
+    xs = np.random.RandomState(1).uniform(-1, 1, (4, 3)).astype(np.float32)
+    ls = np.array([0, 1, 2, 1], dtype=np.float32)
+    ex = net.simple_bind(mx.cpu(), data=xs.shape, label=ls.shape,
+                         grad_req={"data": "write", "label": "null"})
+    ex.arg_dict["data"][:] = xs
+    ex.arg_dict["label"][:] = ls
+    out = ex.forward(is_train=True)[0].asnumpy()
+    e = np.exp(xs - xs.max(axis=1, keepdims=True))
+    expect = e / e.sum(axis=1, keepdims=True)
+    assert_almost_equal(out, expect, rtol=1e-5, atol=1e-6)
+    ex.backward()
+    dx = expect.copy()
+    dx[np.arange(4), ls.astype(int)] -= 1.0
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), dx,
+                        rtol=1e-4, atol=1e-5)
