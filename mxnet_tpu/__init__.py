@@ -47,6 +47,7 @@ from . import visualization as viz  # noqa: E402
 from . import parallel  # noqa: E402
 from . import models  # noqa: E402
 from . import operator  # noqa: E402
+from . import image  # noqa: E402
 from . import test_utils  # noqa: E402
 from . import contrib  # noqa: E402
 

@@ -1,0 +1,458 @@
+"""Read/transform images for neural nets: the pure-python image pipeline.
+
+Reference: ``python/mxnet/image.py`` (559 LoC) — cv2-backed ``ImageIter``
+with composable augmenter closures, plus the free-function crop/resize/
+normalize zoo.  Host-side work stays on the host here too (augmentation is
+branchy, per-sample, uint8 — wrong shape for the MXU); the TPU sees only
+the final dense batch.  Backend is PIL+numpy (this image has no cv2);
+arrays are HWC uint8/float32 numpy until ``postprocess_data`` transposes
+to CHW.
+
+Interp codes follow cv2 numbering like the reference (0=NEAREST, 1=LINEAR,
+2=CUBIC ("AREA" in cv2 — mapped to PIL's closest), 3=LANCZOS).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+
+import numpy as np
+
+from .base import MXNetError
+from .io import io as _io_mod
+from .io.image_util import _require_pil
+from .io import recordio
+
+__all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "ResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+           "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+
+
+def _pil_filter(interp):
+    from PIL import Image
+    return {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+            3: Image.LANCZOS, 4: Image.BOX}.get(int(interp), Image.BICUBIC)
+
+
+def imdecode(buf, to_rgb=1, flag=1, **kwargs):
+    """Decode an image byte buffer to an HWC numpy array (reference
+    image.py:26 wraps cv2.imdecode)."""
+    _require_pil()
+    from PIL import Image
+    import io as _bio
+    img = Image.open(_bio.BytesIO(bytes(buf)))
+    if flag == 0:
+        img = img.convert("L")
+        arr = np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = np.asarray(img)
+        if not to_rgb:
+            arr = arr[:, :, ::-1]  # cv2 default is BGR
+    return arr
+
+
+def scale_down(src_size, size):
+    """Scale `size` down proportionally so it fits in `src_size`
+    (reference image.py:62)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def _resize(src, w, h, interp=2):
+    _require_pil()
+    from PIL import Image
+    dtype = src.dtype
+    arr = np.asarray(src)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        arr = arr[:, :, 0]
+    img = Image.fromarray(arr.astype(np.uint8))
+    out = np.asarray(img.resize((int(w), int(h)), _pil_filter(interp)))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out.astype(dtype)
+
+
+def resize_short(src, size, interp=2):
+    """Resize the shorter edge to `size` (reference image.py:73)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return _resize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop [y0:y0+h, x0:x0+w], optionally resize to `size` (w, h)
+    (reference image.py:83)."""
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of aspect-preserving `size`; returns (out, (x0, y0, w, h))
+    (reference image.py:91)."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop (reference image.py:103)."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std, HWC (reference image.py:115)."""
+    src = src.astype(np.float32) - np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        src = src / np.asarray(std, dtype=np.float32)
+    return src
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect crop, the Inception-style augmentation
+    (reference image.py:123)."""
+    h, w = src.shape[:2]
+    area = w * h
+    for _ in range(10):
+        new_area = random.uniform(min_area, 1.0) * area
+        new_ratio = random.uniform(*ratio)
+        new_w = int(round(np.sqrt(new_area * new_ratio)))
+        new_h = int(round(np.sqrt(new_area / new_ratio)))
+        if random.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return random_crop(src, size, interp)
+
+
+# ---------------------------------------------------------------------------
+# Augmenter closures (each returns a list of outputs, reference style)
+# ---------------------------------------------------------------------------
+def ResizeAug(size, interp=2):
+    """Make a resize-shorter-edge augmenter (reference image.py:147)."""
+    def aug(src):
+        return [resize_short(src, size, interp)]
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomOrderAug(ts):
+    """Apply augmenters in random order (reference image.py:187)."""
+    def aug(src):
+        src = [src]
+        ts_ = ts[:]
+        random.shuffle(ts_)
+        for t in ts_:
+            src = [j for i in src for j in t(i)]
+        return src
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Random brightness/contrast/saturation jitter (reference
+    image.py:201)."""
+    ts = []
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+    if brightness > 0:
+        def baug(src):
+            alpha = np.float32(1.0 + random.uniform(-brightness, brightness))
+            return [src * alpha]
+        ts.append(baug)
+    if contrast > 0:
+        def caug(src):
+            alpha = np.float32(1.0 + random.uniform(-contrast, contrast))
+            gray = src * coef
+            gray = np.float32((3.0 * (1.0 - alpha) / gray.size) * np.sum(gray))
+            return [src * alpha + gray]
+        ts.append(caug)
+    if saturation > 0:
+        def saug(src):
+            alpha = np.float32(1.0 + random.uniform(-saturation, saturation))
+            gray = np.sum(src * coef, axis=2, keepdims=True)
+            return [src * alpha + gray * np.float32(1.0 - alpha)]
+        ts.append(saug)
+    return RandomOrderAug(ts)
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    """PCA-noise lighting augmentation (reference image.py:241)."""
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(eigvec * alpha, eigval).astype(np.float32)
+        return [src + rgb.reshape(1, 1, 3)]
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    def aug(src):
+        return [color_normalize(src, mean, std)]
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if random.random() < p:
+            return [src[:, ::-1]]
+        return [src]
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [src.astype(np.float32)]
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Build the standard augmenter list (reference image.py:289)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0,
+                                                           4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        assert std is not None
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io_mod.DataIter):
+    """Image iterator with pipelined loading, partition support and
+    python augmenters; reads .rec files or image lists
+    (reference image.py:338)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise MXNetError("data_shape must be (3, height, width)")
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+
+        if path_imgrec:
+            logging.info("ImageIter: loading recordio %s...", path_imgrec)
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        if path_imglist:
+            logging.info("ImageIter: loading image list %s...", path_imglist)
+            result = {}
+            with open(path_imglist) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    label = np.array([float(i) for i in line[1:-1]],
+                                     dtype=np.float32)
+                    result[int(line[0])] = (label, line[-1])
+            self.imglist = result
+        elif isinstance(imglist, list):
+            result = {}
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                if isinstance(img[0], (list, np.ndarray)):
+                    label = np.array(img[0], dtype=np.float32)
+                else:
+                    label = np.array([img[0]], dtype=np.float32)
+                result[key] = (label, img[1])
+            self.imglist = result
+        self.path_root = path_root
+
+        if self.imglist is not None:
+            self.seq = list(self.imglist.keys())
+        elif self.imgrec is not None and self.imgidx is not None:
+            self.seq = self.imgidx
+
+        if (shuffle or num_parts > 1) and self.seq is None:
+            raise MXNetError("shuffle/partitioning a .rec requires "
+                             "path_imgidx (no random access without it)")
+        if num_parts > 1:
+            assert 0 <= part_index < num_parts
+            N = len(self.seq)
+            C = N // num_parts
+            self.seq = self.seq[part_index * C:(part_index + 1) * C]
+
+        self.shuffle = shuffle
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.provide_data = [_io_mod.DataDesc(data_name,
+                                              (batch_size,) + self.data_shape)]
+        if label_width > 1:
+            self.provide_label = [_io_mod.DataDesc(
+                label_name, (batch_size, label_width))]
+        else:
+            self.provide_label = [_io_mod.DataDesc(label_name, (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            random.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Return (label, decoded HWC image)."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is not None:
+                    # combined mode: imglist relabels the rec contents
+                    return self.imglist[idx][0], imdecode(img)
+                return header.label, imdecode(img)
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, imdecode(img)
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+        if self.label_width > 1:
+            batch_label = np.zeros((batch_size, self.label_width),
+                                   dtype=np.float32)
+        else:
+            batch_label = np.zeros((batch_size,), dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, data = self.next_sample()
+                data = self.augmentation_transform(data)
+                for datum in data:
+                    assert i < batch_size, \
+                        "Batch size must be multiple of augmenter output"
+                    batch_data[i] = self.postprocess_data(datum)
+                    batch_label[i] = label
+                    i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        from .ndarray import array
+        pad = batch_size - i
+        return _io_mod.DataBatch(data=[array(batch_data)],
+                                 label=[array(batch_label)], pad=pad)
+
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError("data_shape should have length 3, with "
+                             "dimensions CxHxW")
+        if not data_shape[0] == 3:
+            raise ValueError("This iterator expects inputs to have 3 "
+                             "channels.")
+
+    def check_valid_image(self, data):
+        if len(data[0].shape) == 0:
+            raise RuntimeError("Data shape is wrong")
+
+    def imdecode(self, s):
+        return imdecode(s)
+
+    def read_image(self, fname):
+        path = os.path.join(self.path_root, fname) if self.path_root \
+            else fname
+        with open(path, "rb") as fin:
+            return imdecode(fin.read())
+
+    def augmentation_transform(self, data):
+        data = [data]
+        for aug in self.auglist:
+            data = [ret for src in data for ret in aug(src)]
+        return data
+
+    def postprocess_data(self, datum):
+        """HWC -> CHW float32."""
+        return np.ascontiguousarray(
+            np.asarray(datum, dtype=np.float32).transpose(2, 0, 1))

@@ -139,7 +139,9 @@ def _custom_fstateful(attrs, inputs, aux, is_train, rng):
 
     def run_fwd(ins, auxs):
         res = run(ins, auxs)
-        return res, (ins, res[:n_out], auxs)
+        # residual aux = post-forward values (res[n_out:]), so a backward
+        # that reads state written during forward sees the updated contents
+        return res, (ins, res[:n_out], res[n_out:])
 
     def run_bwd(resid, cot):
         ins, outs, auxs = resid

@@ -1,0 +1,123 @@
+"""image.py + im2rec tests (reference tests/python/unittest/test_io.py,
+test_image coverage came later upstream; oracle here is numpy/PIL)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_images(root, n_classes=2, per_class=4, size=(40, 48)):
+    rs = np.random.RandomState(0)
+    for c in range(n_classes):
+        d = os.path.join(root, "class%d" % c)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rs.randint(0, 255, size + (3,), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, "img%d.jpg" % i))
+
+
+def test_resize_crop_normalize():
+    rs = np.random.RandomState(1)
+    src = rs.randint(0, 255, (60, 80, 3)).astype(np.uint8)
+    out = image.resize_short(src, 30)
+    assert min(out.shape[:2]) == 30
+    out2, (x0, y0, w, h) = image.center_crop(src, (32, 24))
+    assert out2.shape == (24, 32, 3)
+    out3, _ = image.random_crop(src, (32, 24))
+    assert out3.shape == (24, 32, 3)
+    norm = image.color_normalize(src.astype(np.float32),
+                                 np.array([100.0, 100.0, 100.0]),
+                                 np.array([50.0, 50.0, 50.0]))
+    assert np.allclose(norm, (src.astype(np.float32) - 100.0) / 50.0)
+
+
+def test_augmenter_list():
+    augs = image.CreateAugmenter((3, 28, 28), resize=32, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, pca_noise=0.1)
+    rs = np.random.RandomState(2)
+    src = rs.randint(0, 255, (50, 64, 3)).astype(np.uint8)
+    data = [src]
+    for aug in augs:
+        data = [ret for s in data for ret in aug(s)]
+    assert len(data) == 1
+    assert data[0].shape == (28, 28, 3)
+    assert data[0].dtype == np.float32
+
+
+def test_image_iter_imglist(tmp_path):
+    root = str(tmp_path)
+    _make_images(root)
+    imglist = []
+    for c in range(2):
+        for i in range(4):
+            imglist.append([float(c), "class%d/img%d.jpg" % (c, i)])
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                            imglist=imglist, path_root=root, shuffle=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 24, 24)
+    assert batches[0].label[0].shape == (4,)
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_im2rec_roundtrip_and_rec_iter(tmp_path):
+    root = str(tmp_path / "imgs")
+    os.makedirs(root)
+    _make_images(root)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import im2rec
+    finally:
+        sys.path.pop(0)
+    prefix = str(tmp_path / "data")
+    # make list (recursive over class dirs)
+    args = im2rec.parse_args([prefix, root, "--recursive", "1",
+                              "--list", "1"])
+    im2rec.make_list(args)
+    assert os.path.exists(prefix + ".lst")
+    # pack into .rec
+    args = im2rec.parse_args([prefix, root, "--quality", "90"])
+    n = im2rec.convert(args, prefix + ".lst")
+    assert n == 8
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+
+    # read back through the python ImageIter (indexed rec + shuffle)
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=prefix + ".rec",
+                            path_imgidx=prefix + ".idx", shuffle=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert sorted(set(labels.tolist())) == [0.0, 1.0]
+
+    # and through the C++-backed ImageRecordIter (io module)
+    it2 = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                                data_shape=(3, 32, 32), batch_size=4)
+    b = next(iter(it2))
+    assert b.data[0].shape == (4, 3, 32, 32)
+
+
+def test_imdecode_grayscale_and_bgr():
+    arr = np.random.RandomState(3).randint(0, 255, (10, 12, 3),
+                                           dtype=np.uint8)
+    from mxnet_tpu.io.image_util import encode_image
+    buf = encode_image(arr, fmt=".png")
+    rgb = image.imdecode(buf)
+    assert rgb.shape == (10, 12, 3)
+    assert np.array_equal(image.imdecode(buf, to_rgb=0), rgb[:, :, ::-1])
+    gray = image.imdecode(buf, flag=0)
+    assert gray.shape == (10, 12, 1)

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""im2rec: make image lists and pack images into RecordIO files.
+
+Reference: ``tools/im2rec.py`` (cv2 + multiprocessing) / ``tools/im2rec.cc``.
+Same CLI surface and .lst/.rec formats; PIL-backed (no cv2 in this image).
+The .rec output is byte-compatible with the reference's recordio framing
+(see mxnet_tpu/io/recordio.py), so files produced here feed either stack.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+curr_path = os.path.abspath(os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(curr_path, ".."))
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu.io import recordio  # noqa: E402
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) triples for images under root."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda x: x[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for item in image_list:
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = "_%d" % i if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def read_list(path_in):
+    """Yield (index, relpath, *labels) tuples from a .lst file."""
+    with open(path_in) as fin:
+        for line in fin:
+            line = [i.strip() for i in line.strip().split("\t")]
+            item = [int(line[0])] + [line[-1]] + [float(i)
+                                                  for i in line[1:-1]]
+            yield item
+
+
+def image_encode(args, item, img_path):
+    """Read, transform and pack one image into a record buffer."""
+    from PIL import Image
+    if len(item) > 3 or args.pack_label:
+        header = recordio.IRHeader(0, np.array(item[2:], dtype=np.float32),
+                                   item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[2], item[0], 0)
+
+    if args.pass_through:
+        with open(img_path, "rb") as fin:
+            return recordio.pack(header, fin.read())
+
+    img = Image.open(img_path)
+    if args.color == 0:
+        img = img.convert("L")
+    elif args.color == 1:
+        img = img.convert("RGB")
+    # color == -1: keep the source mode (cv2 IMREAD_UNCHANGED)
+    if args.center_crop:
+        w, h = img.size
+        c = min(w, h)
+        img = img.crop(((w - c) // 2, (h - c) // 2,
+                        (w - c) // 2 + c, (h - c) // 2 + c))
+    if args.resize:
+        w, h = img.size
+        if min(w, h) > args.resize:
+            if w > h:
+                size = (args.resize * w // h, args.resize)
+            else:
+                size = (args.resize, args.resize * h // w)
+            img = img.resize(size, Image.BICUBIC)
+    arr = np.asarray(img)
+    from mxnet_tpu.io.image_util import encode_image
+    if arr.ndim == 2:
+        arr = np.stack([arr] * 3, axis=-1)
+    buf = encode_image(arr, quality=args.quality, fmt=args.encoding)
+    return recordio.pack(header, buf)
+
+
+def convert(args, path_in):
+    """Pack every image in the list into prefix.rec (+ .idx)."""
+    fname = os.path.basename(path_in)
+    fname_rec = os.path.splitext(fname)[0] + ".rec"
+    fname_idx = os.path.splitext(fname)[0] + ".idx"
+    out_dir = os.path.dirname(path_in) or "."
+    record = recordio.MXIndexedRecordIO(
+        os.path.join(out_dir, fname_idx),
+        os.path.join(out_dir, fname_rec), "w")
+    tic = time.time()
+    cnt = 0
+    for item in read_list(path_in):
+        img_path = os.path.join(args.root, item[1])
+        try:
+            buf = image_encode(args, item, img_path)
+        except Exception as exc:  # mirror reference: log + continue
+            print("imread error, skipping %s: %s" % (img_path, exc))
+            continue
+        record.write_idx(item[0], buf)
+        cnt += 1
+        if cnt % 1000 == 0:
+            print("time: %.3f count: %d" % (time.time() - tic, cnt))
+            tic = time.time()
+    record.close()
+    return cnt
+
+
+def _str2bool(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Create an image list or RecordIO file "
+                    "(reference tools/im2rec.py CLI).")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="root of the image folder")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", type=_str2bool, default=False,
+                        help="make a list instead of a record")
+    cgroup.add_argument("--exts", type=str, nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", type=_str2bool, default=False)
+    rgroup = parser.add_argument_group("Options for creating database")
+    rgroup.add_argument("--pass-through", type=_str2bool, default=False,
+                        help="skip transform and copy bytes")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", type=_str2bool, default=False)
+    rgroup.add_argument("--quality", type=int, default=80)
+    rgroup.add_argument("--num-thread", type=int, default=1)
+    rgroup.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    rgroup.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    rgroup.add_argument("--shuffle", type=_str2bool, default=True)
+    rgroup.add_argument("--pack-label", type=_str2bool, default=False)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+        return
+    files = []
+    working_dir = os.path.dirname(args.prefix) or "."
+    prefix_base = os.path.basename(args.prefix)
+    for fname in sorted(os.listdir(working_dir)):
+        if fname.startswith(prefix_base) and fname.endswith(".lst"):
+            files.append(os.path.join(working_dir, fname))
+    if not files:
+        print("no .lst files found with prefix %s" % args.prefix)
+        return
+    for path in files:
+        print("Creating .rec file from", path)
+        convert(args, path)
+
+
+if __name__ == "__main__":
+    main()
