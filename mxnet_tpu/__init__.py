@@ -32,6 +32,8 @@ from . import lr_scheduler  # noqa: E402
 from . import metric  # noqa: E402
 from . import kvstore as kvs  # noqa: E402
 from .kvstore import KVStore, create as create_kvstore  # noqa: E402
+from . import kvstore_server  # noqa: E402  (role hijack runs at kvstore
+# creation, not import — see kvstore_server._init_kvstore_server_module)
 from . import io  # noqa: E402
 from . import module  # noqa: E402
 from .module import Module  # noqa: E402

@@ -75,6 +75,16 @@ def _resize(src, w, h, interp=2):
     arr = np.asarray(src)
     if arr.ndim == 3 and arr.shape[2] == 1:
         arr = arr[:, :, 0]
+    if np.issubdtype(dtype, np.floating):
+        # float inputs (post-normalize pipelines) must not round-trip
+        # through uint8 — resize each channel in PIL's 32-bit float mode
+        chans = arr[..., None] if arr.ndim == 2 else arr
+        out = np.stack([
+            np.asarray(Image.fromarray(chans[:, :, c].astype(np.float32),
+                                       mode="F")
+                       .resize((int(w), int(h)), _pil_filter(interp)))
+            for c in range(chans.shape[2])], axis=2)
+        return out.astype(dtype)
     img = Image.fromarray(arr.astype(np.uint8))
     out = np.asarray(img.resize((int(w), int(h)), _pil_filter(interp)))
     if out.ndim == 2:
@@ -347,6 +357,11 @@ class ImageIter(_io_mod.DataIter):
         if (shuffle or num_parts > 1) and self.seq is None:
             raise MXNetError("shuffle/partitioning a .rec requires "
                              "path_imgidx (no random access without it)")
+        if self.imgrec is not None and self.seq is not None and \
+                self.imgidx is None:
+            raise MXNetError("combining path_imgrec with an image list "
+                             "requires path_imgidx (records are looked up "
+                             "by list index)")
         if num_parts > 1:
             assert 0 <= part_index < num_parts
             N = len(self.seq)

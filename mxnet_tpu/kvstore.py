@@ -141,11 +141,90 @@ class KVStore:
         parity."""
 
 
+class KVStoreDist(KVStore):
+    """Worker-side distributed kvstore over the parameter-server backend
+    (reference KVStoreDist, src/kvstore/kvstore_dist.h; transport/server in
+    mxnet_tpu/kvstore_dist.py)."""
+
+    def __init__(self, kv_type):
+        super().__init__(kv_type)
+        import os
+        from . import kvstore_dist as ksd
+        self._client = ksd.WorkerClient()
+        self._rank = self._client.rank
+        self._size = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._shapes = {}
+        self._closed = False
+        # rank0 flips servers to bulk-sync unless async
+        # (reference kvstore.cc:34-42)
+        if "async" not in kv_type:
+            if self._rank == 0:
+                self._client.send_command("sync_mode", b"")
+            self._client.barrier()
+        import atexit
+        atexit.register(self.close)
+
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            self._shapes[k] = vv.shape
+            if self._rank == 0:
+                # rank0 pushes initial weights (kvstore_dist.h:62-80)
+                self._client.init(k, self._flat(vv))
+        self._client.barrier()
+
+    def _flat(self, v):
+        import numpy as np
+        return np.asarray(v.asnumpy(), dtype=np.float32).reshape(-1)
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            merged = _ctx_group_sum(list(vals))
+            self._client.push(k, self._flat(merged))
+
+    def pull(self, key, out=None, priority=0):
+        import numpy as np
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            shape = self._shapes.get(k, targets[0].shape)
+            size = int(np.prod(shape)) if shape else 1
+            flat = self._client.pull(k, size)
+            src = NDArray(flat.reshape(shape))
+            for t in targets:
+                src.copyto(t)
+
+    def set_optimizer(self, optimizer):
+        """Ship the pickled optimizer to the servers (command 0) — the
+        update then runs server-side (python/mxnet/kvstore.py:226-249)."""
+        body = pickle.dumps(optimizer)
+        if self._rank == 0:
+            self._client.send_command(0, body)
+        self._client.barrier()
+
+    def barrier(self):
+        self._client.barrier()
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        return self._client.get_num_dead_node()
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._client.barrier()
+            self._client.finalize(self._rank == 0)
+
+
 def create(name="local"):
     """Factory (reference kvstore.cc:17-45): 'local', 'device', 'dist_sync',
     'dist_async', 'dist_device_sync' are all accepted; device placement and
     sync mode are handled by XLA collectives rather than distinct C++
-    implementations."""
+    implementations.  'dist_*' with a ps environment (DMLC_ROLE=worker)
+    returns the parameter-server-backed store; without one it degenerates
+    to rank0/size1 local (how the reference behaves with no tracker)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "device", "local_allreduce_cpu",
@@ -153,4 +232,13 @@ def create(name="local"):
              "dist_device_sync", "dist_sync_device", "dist")
     if name not in valid:
         raise MXNetError("unknown kvstore type %r" % name)
+    if "dist" in name:
+        import os
+        role = os.environ.get("DMLC_ROLE", "worker")
+        if role in ("server", "scheduler"):
+            # non-worker roles block in their run loop and exit here
+            from . import kvstore_server
+            kvstore_server._init_kvstore_server_module()
+        if role == "worker" and os.environ.get("DMLC_PS_ROOT_URI"):
+            return KVStoreDist(name)
     return KVStore(name)

@@ -1,0 +1,453 @@
+"""Distributed KVStore: multi-process parameter-server backend.
+
+Reference: ``src/kvstore/kvstore_dist.h`` (worker), ``kvstore_dist_server.h``
+(server), ps-lite's ZMQ van + Postoffice (scheduler, barriers, membership).
+Semantics preserved:
+
+* roles from env — ``DMLC_ROLE`` in {scheduler, server, worker},
+  ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``, ``DMLC_NUM_WORKER``,
+  ``DMLC_NUM_SERVER`` (reference §3.5 boot sequence; same vars as
+  ``tools/launch.py``).
+* ``dist_sync`` — bulk-synchronous per key: the server withholds push
+  replies until every worker's push for that key arrived, runs the updater
+  ONCE on the merged gradient, then releases all workers
+  (``kvstore_dist_server.h:164-198``).
+* ``dist_async`` — updater per push, replies immediately (hogwild,
+  ``:199-207``).
+* key→server sharding — small arrays go whole to ``hash(key) % S``; arrays
+  bigger than ``MXNET_KVSTORE_BIGARRAY_BOUND`` (default 1e6 elements) are
+  range-partitioned across ALL servers (``EncodeKey``,
+  ``kvstore_dist.h:276-314``).
+* server-side optimizer — ``set_optimizer`` pickles the optimizer and ships
+  it via command 0 (``python/mxnet/kvstore.py:226-249``); the server
+  unpickles and installs ``opt.get_updater`` (``kvstore_server.py:38``).
+  Updater calls are serialized by a lock (the reference uses a
+  single-thread Executor because the updater is python).
+* ``Barrier`` — counted at the scheduler across the worker group.
+
+Transport is ``multiprocessing.connection`` (length-framed pickle over
+TCP) instead of ZMQ — same wire role, stdlib only.  This is the DCN-class
+control path; the TPU data path (gradient reduction inside one compiled
+step) lives in ``mxnet_tpu.parallel`` as XLA collectives over ICI — on a
+pod you'd use that; the PS backend exists for API/semantics parity and for
+CPU-host clusters, exactly like the reference nightly tests run it as N
+local processes (``tests/nightly/dist_sync_kvstore.py``).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from multiprocessing.connection import Client, Listener
+
+import numpy as np
+
+from .base import MXNetError
+
+_AUTHKEY = b"mxnet_tpu_ps"
+_BIGARRAY_DEFAULT = 1000000
+
+
+def _env(name, default=None):
+    v = os.environ.get(name)
+    return v if v is not None else default
+
+
+def _root_addr():
+    uri = _env("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(_env("DMLC_PS_ROOT_PORT", "9091"))
+    return (uri, port)
+
+
+def _connect(addr, retries=600, delay=0.1):
+    last = None
+    for _ in range(retries):
+        try:
+            return Client(addr, authkey=_AUTHKEY)
+        except (ConnectionRefusedError, OSError) as exc:
+            last = exc
+            time.sleep(delay)
+    raise MXNetError("cannot connect to %s: %s" % (addr, last))
+
+
+# ---------------------------------------------------------------------------
+# Scheduler (ps-lite Postoffice root: membership + barriers)
+# ---------------------------------------------------------------------------
+class Scheduler:
+    def __init__(self):
+        self.num_workers = int(_env("DMLC_NUM_WORKER", "1"))
+        self.num_servers = int(_env("DMLC_NUM_SERVER", "1"))
+        self.listener = Listener(_root_addr(), authkey=_AUTHKEY)
+        self.lock = threading.Condition()
+        self.server_addrs = [None] * self.num_servers
+        self.worker_conns = {}
+        self.next_server = 0
+        self.next_worker = 0
+        self.barrier_count = 0
+        self.barrier_gen = 0
+        self.stopped = False
+
+    def run(self):
+        """Serve until every worker has deregistered."""
+        threads = []
+        done = threading.Event()
+        expected = self.num_workers + self.num_servers
+
+        def handle(conn):
+            try:
+                while True:
+                    try:
+                        msg = conn.recv()
+                    except (EOFError, OSError):
+                        return
+                    kind = msg[0]
+                    if kind == "register_server":
+                        with self.lock:
+                            rank = self.next_server
+                            self.next_server += 1
+                            self.server_addrs[rank] = msg[1]
+                            self.lock.notify_all()
+                        conn.send(("assigned", rank))
+                    elif kind == "register_worker":
+                        with self.lock:
+                            rank = self.next_worker
+                            self.next_worker += 1
+                            while any(a is None for a in self.server_addrs):
+                                self.lock.wait()
+                        conn.send(("assigned", rank,
+                                   list(self.server_addrs)))
+                    elif kind == "barrier":
+                        with self.lock:
+                            gen = self.barrier_gen
+                            self.barrier_count += 1
+                            if self.barrier_count == self.num_workers:
+                                self.barrier_count = 0
+                                self.barrier_gen += 1
+                                self.lock.notify_all()
+                            else:
+                                while self.barrier_gen == gen:
+                                    self.lock.wait()
+                        conn.send(("barrier_done",))
+                    elif kind == "num_dead":
+                        conn.send(("num_dead", 0))
+                    elif kind == "finalize":
+                        conn.send(("bye",))
+                        return
+            finally:
+                conn.close()
+                with self.lock:
+                    handle.exits += 1
+                    if handle.exits >= expected:
+                        done.set()
+
+        handle.exits = 0
+        accept_thread = threading.Thread(target=self._accept,
+                                         args=(handle, threads, done),
+                                         daemon=True)
+        accept_thread.start()
+        done.wait()
+        self.listener.close()
+
+    def _accept(self, handle, threads, done):
+        while not done.is_set():
+            try:
+                conn = self.listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=handle, args=(conn,), daemon=True)
+            t.start()
+            threads.append(t)
+
+
+# ---------------------------------------------------------------------------
+# Server (KVStoreDistServer)
+# ---------------------------------------------------------------------------
+def _node_host():
+    """Address this node is reachable at by peers.
+
+    DMLC_NODE_HOST overrides (same var the reference tracker uses);
+    loopback root => single-host job => loopback; otherwise the address
+    the kernel routes toward the scheduler."""
+    host = _env("DMLC_NODE_HOST")
+    if host:
+        return host
+    root_uri = _root_addr()[0]
+    if root_uri in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    import socket
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((root_uri, 9))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+class Server:
+    def __init__(self):
+        self.num_workers = int(_env("DMLC_NUM_WORKER", "1"))
+        self.listener = Listener((_node_host(), 0), authkey=_AUTHKEY)
+        self.store = {}
+        self.merge = {}          # key -> (buf, count, [pending conns])
+        self.lock = threading.Lock()
+        self.updater = None
+        self.sync_mode = False
+        self.stop_event = threading.Event()
+
+    def _default_update(self, key, recved, stored):
+        stored += recved
+
+    def _do_update(self, key, recved):
+        stored = self.store[key]
+        if self.updater is not None:
+            # python updater works on NDArrays (the reference server calls
+            # the unpickled python optimizer the same way)
+            import jax.numpy as jnp
+            from .ndarray import NDArray
+            w = NDArray(jnp.asarray(stored))
+            g = NDArray(jnp.asarray(recved))
+            self.updater(key, g, w)
+            stored[:] = np.asarray(w.asnumpy())
+        else:
+            self._default_update(key, recved, stored)
+
+    def run(self):
+        # register with scheduler
+        sched = _connect(_root_addr())
+        sched.send(("register_server", self.listener.address))
+        _, self.rank = sched.recv()
+
+        conns = []
+        accept_t = threading.Thread(target=self._accept, args=(conns,),
+                                    daemon=True)
+        accept_t.start()
+        self.stop_event.wait()
+        self.listener.close()
+        sched.send(("finalize",))
+        try:
+            sched.recv()
+        except (EOFError, OSError):
+            pass
+        sched.close()
+
+    def _accept(self, conns):
+        while not self.stop_event.is_set():
+            try:
+                conn = self.listener.accept()
+            except OSError:
+                return
+            conns.append(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            kind = msg[0]
+            if kind == "init":
+                _, key, arr = msg
+                with self.lock:
+                    self.store[key] = np.array(arr, dtype=np.float32)
+                conn.send(("ok",))
+            elif kind == "push":
+                _, key, arr = msg
+                self._handle_push(key, arr, conn)
+            elif kind == "pull":
+                _, key = msg
+                with self.lock:
+                    val = self.store.get(key)
+                if val is None:
+                    conn.send(("err", "key %r not initialized" % (key,)))
+                else:
+                    conn.send(("val", val))
+            elif kind == "command":
+                _, head, body = msg
+                self._handle_command(head, body)
+                conn.send(("ok",))
+            elif kind == "stop":
+                conn.send(("ok",))
+                self.stop_event.set()
+                return
+
+    def _handle_push(self, key, arr, conn):
+        arr = np.asarray(arr, dtype=np.float32)
+        if not self.sync_mode:
+            with self.lock:
+                self._do_update(key, arr)
+            conn.send(("ok",))
+            return
+        # bulk-synchronous: merge; Nth worker push triggers one updater run
+        # and releases everyone (kvstore_dist_server.h:179-198)
+        with self.lock:
+            buf, cnt, pending = self.merge.get(key, (None, 0, []))
+            buf = arr if buf is None else buf + arr
+            pending.append(conn)
+            cnt += 1
+            if cnt == self.num_workers:
+                self._do_update(key, buf)
+                for c in pending:
+                    c.send(("ok",))
+                self.merge[key] = (None, 0, [])
+            else:
+                self.merge[key] = (buf, cnt, pending)
+
+    def _handle_command(self, head, body):
+        """Command 0 carries a pickled optimizer (reference controller at
+        kvstore_dist_server.h:87-115); 'sync_mode' flips bulk-sync on."""
+        if head == 0:
+            from . import optimizer as opt
+            optimizer = pickle.loads(body)
+            self.updater = opt.get_updater(optimizer)
+        elif head == "sync_mode":
+            self.sync_mode = True
+
+
+# ---------------------------------------------------------------------------
+# Worker client
+# ---------------------------------------------------------------------------
+class WorkerClient:
+    """ps::KVWorker: key sharding + push/pull to all servers."""
+
+    def __init__(self):
+        self.sched = _connect(_root_addr())
+        self.sched_lock = threading.Lock()
+        self.sched.send(("register_worker",))
+        msg = self.sched.recv()
+        self.rank = msg[1]
+        self.server_addrs = msg[2]
+        self.servers = [_connect(a) for a in self.server_addrs]
+        self.server_locks = [threading.Lock() for _ in self.servers]
+        self.bigarray_bound = int(_env("MXNET_KVSTORE_BIGARRAY_BOUND",
+                                       str(_BIGARRAY_DEFAULT)))
+
+    @property
+    def num_servers(self):
+        return len(self.servers)
+
+    def _shard(self, key, size):
+        """Return [(server_idx, subkey, start, stop), ...] covering [0, size).
+
+        Small arrays: one hashed server gets the whole range; big arrays:
+        even range partition over all servers (EncodeKey semantics)."""
+        S = self.num_servers
+        if size < self.bigarray_bound or S == 1:
+            # deterministic across processes (python's str hash is salted)
+            import zlib
+            sid = zlib.crc32(str(key).encode()) % S
+            return [(sid, (key, 0), 0, size)]
+        out = []
+        step = (size + S - 1) // S
+        for i in range(S):
+            lo, hi = i * step, min((i + 1) * step, size)
+            if lo >= hi:
+                break
+            out.append((i, (key, i), lo, hi))
+        return out
+
+    def _rpc(self, sid, msg):
+        with self.server_locks[sid]:
+            self.servers[sid].send(msg)
+            return self.servers[sid].recv()
+
+    def init(self, key, flat):
+        for sid, subkey, lo, hi in self._shard(key, flat.size):
+            r = self._rpc(sid, ("init", subkey, flat[lo:hi]))
+            if r[0] != "ok":
+                raise MXNetError(str(r))
+
+    def _fanout(self, shards, fn):
+        """Run fn(shard) per shard in parallel; re-raise the first failure
+        in the caller (a daemon-thread exception must not be silently
+        dropped — a missing range would otherwise train on garbage)."""
+        if len(shards) == 1:
+            return fn(shards[0])
+        errs = []
+
+        def run(s):
+            try:
+                fn(s)
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                errs.append(exc)
+
+        ts = [threading.Thread(target=run, args=(s,)) for s in shards]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def push(self, key, flat):
+        def one(shard):
+            sid, subkey, lo, hi = shard
+            r = self._rpc(sid, ("push", subkey, flat[lo:hi]))
+            if r[0] != "ok":
+                raise MXNetError(str(r))
+
+        self._fanout(self._shard(key, flat.size), one)
+
+    def pull(self, key, size):
+        out = np.empty((size,), dtype=np.float32)
+        filled = []
+
+        def one(shard):
+            sid, subkey, lo, hi = shard
+            r = self._rpc(sid, ("pull", subkey))
+            if r[0] != "val":
+                raise MXNetError(str(r))
+            out[lo:hi] = r[1]
+            filled.append(hi - lo)
+
+        self._fanout(self._shard(key, size), one)
+        if sum(filled) != size:
+            raise MXNetError("pull(%r): covered %d of %d elements"
+                             % (key, sum(filled), size))
+        return out
+
+    def send_command(self, head, body):
+        for sid in range(self.num_servers):
+            self._rpc(sid, ("command", head, body))
+
+    def barrier(self):
+        with self.sched_lock:
+            self.sched.send(("barrier",))
+            self.sched.recv()
+
+    def get_num_dead_node(self):
+        with self.sched_lock:
+            self.sched.send(("num_dead",))
+            return self.sched.recv()[1]
+
+    def finalize(self, is_root):
+        """rank0 stops the servers (reference kStopServer, kvstore_dist.h:47-59)."""
+        if is_root:
+            for sid in range(self.num_servers):
+                try:
+                    self._rpc(sid, ("stop",))
+                except (EOFError, OSError):
+                    pass
+        with self.sched_lock:
+            try:
+                self.sched.send(("finalize",))
+                self.sched.recv()
+            except (EOFError, OSError):
+                pass
+            self.sched.close()
+        for s in self.servers:
+            s.close()
+
+
+def role():
+    return _env("DMLC_ROLE", "")
+
+
+def run_scheduler():
+    Scheduler().run()
+
+
+def run_server():
+    Server().run()

@@ -1,0 +1,49 @@
+"""Server-role entry: blocks in the PS run loop when DMLC_ROLE says so.
+
+Reference: ``python/mxnet/kvstore_server.py`` — importing mxnet in a
+process launched with ``DMLC_ROLE=server`` enters ``KVStoreServer.run``
+(blocking in ``MXKVStoreRunServer``) and exits when the root worker sends
+kStopServer; the scheduler role blocks in the Postoffice.  Same protocol
+here: ``tools/launch.py`` runs the *user's own command* for every role and
+this module hijacks server/scheduler processes at import.
+"""
+from __future__ import annotations
+
+import sys
+
+from . import kvstore_dist as _ksd
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer:
+    """The key-value store server (reference kvstore_server.py:10-55)."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def run(self):
+        """Run the server, blocking until the root worker stops us."""
+        _ksd.run_server()
+
+
+def _init_kvstore_server_module():
+    """Run the blocking server/scheduler loop for non-worker roles.
+
+    The reference triggers this at ``import mxnet``.  Here it runs from
+    ``kvstore.create('dist_*')`` instead: a python server thread must be
+    able to import/unpickle ``mxnet_tpu.*`` (the shipped optimizer), and
+    blocking while the package is still mid-import would deadlock every
+    such import on the package's import lock.  The launcher runs the same
+    user command for every role either way — the role hijack just happens
+    at the kvstore-creation line of the user script rather than its import
+    line."""
+    role = _ksd.role()
+    if role == "server":
+        server = KVStoreServer()
+        server.run()
+        sys.exit(0)
+    elif role == "scheduler":
+        _ksd.run_scheduler()
+        sys.exit(0)

@@ -1,0 +1,69 @@
+"""Worker script for the distributed kvstore test; run under
+tools/launch.py (reference tests/nightly/dist_sync_kvstore.py — expected
+values are closed-form functions of nworkers/rate/rounds)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402  (server roles block+exit inside)
+
+
+def main():
+    kv = mx.create_kvstore("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw > 1, "expected a multi-worker ps environment"
+
+    shape = (4, 4)
+    big_shape = (17, 19)  # > MXNET_KVSTORE_BIGARRAY_BOUND in the test env
+
+    # --- default (accumulate) updater, small + sharded big arrays --------
+    kv.init(3, mx.nd.ones(shape))
+    kv.init(99, mx.nd.ones(big_shape))
+    nrepeat, rate = 3, 2
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones(shape) * rate)
+        kv.push(99, mx.nd.ones(big_shape) * rate)
+    expected = 1 + rate * nw * nrepeat
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out)
+    assert np.allclose(out.asnumpy(), expected), \
+        (rank, out.asnumpy().ravel()[0], expected)
+    out_b = mx.nd.zeros(big_shape)
+    kv.pull(99, out_b)
+    assert np.allclose(out_b.asnumpy(), expected), \
+        (rank, out_b.asnumpy().ravel()[0], expected)
+    kv.barrier()
+
+    # --- server-side optimizer (pickled over command 0) ------------------
+    lr = 0.1
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=lr, wd=0.0,
+                                      rescale_grad=1.0))
+    kv.init(7, mx.nd.ones(shape))
+    kv.init(98, mx.nd.ones(big_shape))
+    kv.push(7, mx.nd.ones(shape))
+    kv.push(98, mx.nd.ones(big_shape))
+    out2 = mx.nd.zeros(shape)
+    kv.pull(7, out2)
+    expected2 = 1.0 - lr * nw
+    assert np.allclose(out2.asnumpy(), expected2, atol=1e-6), \
+        (rank, out2.asnumpy().ravel()[0], expected2)
+    out2b = mx.nd.zeros(big_shape)
+    kv.pull(98, out2b)
+    assert np.allclose(out2b.asnumpy(), expected2, atol=1e-6), \
+        (rank, out2b.asnumpy().ravel()[0], expected2)
+
+    assert kv.get_num_dead_node(0) == 0
+    kv.close()
+    print("dist_sync_kvstore OK rank=%d/%d" % (rank, nw))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

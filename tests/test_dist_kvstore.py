@@ -1,0 +1,28 @@
+"""Distributed kvstore: N local processes through the launch.py tracker
+(reference tests/nightly/test_all.sh runs dist_sync_kvstore.py via
+`tools/launch.py -n 4`)."""
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dist_sync_kvstore_local_processes():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    env = {
+        # force the big-array range-partitioned path for (17,19)=323 elems
+        "MXNET_KVSTORE_BIGARRAY_BOUND": "100",
+        "JAX_PLATFORMS": "cpu",
+    }
+    rc = launch.launch_local(
+        num_workers=2, num_servers=2,
+        command=[sys.executable,
+                 os.path.join(REPO, "tests", "dist_sync_kvstore.py")],
+        env=env)
+    assert rc == 0

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Launch a distributed (parameter-server) job.
+
+Reference: ``tools/launch.py`` + dmlc-core tracker — spawns 1 scheduler,
+S servers and W workers with ``DMLC_*`` env vars, over ssh/mpi/sge/yarn.
+This launcher implements the ``local`` cluster mode (the one the reference
+nightly suite uses: N processes on one host through the same env protocol);
+remote launchers belong to the cluster layer, not the framework.
+
+Usage:
+    python tools/launch.py -n 4 -s 2 python train.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers, num_servers, command, env=None):
+    """Spawn scheduler + servers + workers locally; returns worker rcs."""
+    base = dict(os.environ)
+    if env:
+        base.update(env)
+    base.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(_free_port()),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
+    })
+
+    procs = []
+
+    def spawn(role):
+        e = dict(base)
+        e["DMLC_ROLE"] = role
+        # server/scheduler processes run the same command; importing
+        # mxnet_tpu hijacks them into the PS run loop (kvstore_server.py)
+        p = subprocess.Popen(command, env=e)
+        procs.append((role, p))
+        return p
+
+    spawn("scheduler")
+    for _ in range(num_servers):
+        spawn("server")
+    workers = [spawn("worker") for _ in range(num_workers)]
+
+    rc = 0
+    for w in workers:
+        rc |= w.wait()
+    # workers done -> scheduler/servers should have exited; reap or kill
+    for role, p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                print("killed stuck %s" % role, file=sys.stderr)
+    return rc
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (reference tools/launch.py).")
+    parser.add_argument("-n", "--num-workers", required=True, type=int)
+    parser.add_argument("-s", "--num-servers", type=int)
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh", "mpi", "sge", "yarn"])
+    parser.add_argument("command", nargs="+")
+    args, unknown = parser.parse_known_args()
+    if args.num_servers is None:
+        args.num_servers = args.num_workers
+    if args.launcher != "local":
+        sys.exit("launcher %r is a cluster-infrastructure concern; this "
+                 "tree ships the local tracker (same env protocol)"
+                 % args.launcher)
+    sys.exit(launch_local(args.num_workers, args.num_servers,
+                          args.command + unknown))
+
+
+if __name__ == "__main__":
+    main()
