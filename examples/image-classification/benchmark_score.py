@@ -1,0 +1,71 @@
+"""Benchmark the inference (scoring) performance of the model zoo.
+
+Reference: ``example/image-classification/benchmark_score.py`` — forward-
+only images/sec per network per batch size (the perf.md inference tables,
+BASELINE.md)."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+
+
+def get_symbol(network, num_layers=None):
+    if network == "resnet":
+        return mx.models.resnet(num_classes=1000, num_layers=num_layers or 50)
+    if network == "vgg":
+        return mx.models.vgg(num_classes=1000, num_layers=num_layers or 16)
+    return getattr(mx.models, network)(num_classes=1000)
+
+
+def score(network, dev, batch_size, num_batches, num_layers=None,
+          image_shape=(3, 224, 224), dtype="float32"):
+    sym = get_symbol(network, num_layers)
+    data_shape = [("data", (batch_size,) + tuple(image_shape))]
+    mod = mx.Module(symbol=sym, context=dev, label_names=None)
+    mod.bind(for_training=False, inputs_need_grad=False,
+             data_shapes=data_shape)
+    mod.init_params(initializer=mx.initializer.Xavier(magnitude=2.0))
+    rs = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.uniform(-1, 1,
+                                     (batch_size,) + tuple(image_shape))
+                          .astype(dtype))], label=[])
+    # warmup (compile)
+    for _ in range(2):
+        mod.forward(batch, is_train=False)
+    for o in mod.get_outputs():
+        o.wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+    for o in mod.get_outputs():
+        o.wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="score model zoo speed")
+    parser.add_argument("--networks", type=str,
+                        default="alexnet,vgg,inception_bn,inception_v3,"
+                                "resnet")
+    parser.add_argument("--batch-sizes", type=str, default="1,32")
+    parser.add_argument("--num-batches", type=int, default=10)
+    args = parser.parse_args()
+    dev = mx.current_context()
+    for net in args.networks.split(","):
+        logging.info("network: %s", net)
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            speed = score(net, dev, b, args.num_batches)
+            logging.info("batch size %2d, image/sec: %f", b, speed)

@@ -1,0 +1,89 @@
+"""Data providers for the image-classification examples.
+
+Reference: ``example/image-classification/common/data.py`` — builds
+ImageRecordIters from .rec files.  Here: .rec paths when given, else a
+synthetic iterator (the reference's ``train_imagenet.py --benchmark 1``
+path) so every example runs without datasets (this image has no egress)."""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """Deterministic random batches living on device (benchmark protocol)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        self.batch_size = data_shape[0]
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        self.dtype = dtype
+        label = np.random.randint(0, num_classes, (self.batch_size,))
+        data = np.random.uniform(-1, 1, data_shape)
+        self.data = mx.nd.array(data.astype(dtype))
+        self.label = mx.nd.array(label.astype(np.float32))
+        self.provide_data = [mx.io.DataDesc("data", data_shape)]
+        self.provide_label = [mx.io.DataDesc("softmax_label",
+                                             (self.batch_size,))]
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter > self.max_iter:
+            raise StopIteration
+        return mx.io.DataBatch(data=[self.data], label=[self.label], pad=0)
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data")
+    data.add_argument("--data-train", type=str, help="the training .rec")
+    data.add_argument("--data-val", type=str, help="the validation .rec")
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--num-classes", type=int, default=1000)
+    data.add_argument("--num-examples", type=int, default=1281167)
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="use synthetic data to measure speed")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Augmentation")
+    aug.add_argument("--random-crop", type=int, default=1)
+    aug.add_argument("--random-mirror", type=int, default=1)
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0)
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0)
+    return aug
+
+
+def get_rec_iter(args, kv=None):
+    """(train, val) iterators; synthetic when benchmarking or no .rec."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    data_shape = (args.batch_size,) + image_shape
+    rank = kv.rank if kv else 0
+    nworker = kv.num_workers if kv else 1
+    if args.benchmark or not args.data_train:
+        train = SyntheticDataIter(
+            args.num_classes, data_shape,
+            max(1, args.num_examples // args.batch_size))
+        return train, None
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=image_shape,
+        batch_size=args.batch_size, shuffle=True,
+        rand_crop=args.random_crop, rand_mirror=args.random_mirror,
+        num_parts=nworker, part_index=rank)
+    if not args.data_val:
+        return train, None
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val, data_shape=image_shape,
+        batch_size=args.batch_size, shuffle=False,
+        num_parts=nworker, part_index=rank)
+    return train, val

@@ -1,0 +1,39 @@
+"""Score a saved checkpoint on a dataset (reference score.py)."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, CURR)
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from common import data as common_data  # noqa: E402
+
+
+def score(model_prefix, epoch, data_iter, metrics, ctx):
+    sym, arg_params, aux_params = mx.model.load_checkpoint(model_prefix,
+                                                           epoch)
+    mod = mx.Module(symbol=sym, context=ctx)
+    mod.bind(for_training=False, data_shapes=data_iter.provide_data,
+             label_shapes=data_iter.provide_label)
+    mod.set_params(arg_params, aux_params)
+    return mod.score(data_iter, metrics)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="score a model")
+    parser.add_argument("--model-prefix", type=str, required=True)
+    parser.add_argument("--load-epoch", type=int, required=True)
+    parser.add_argument("--batch-size", type=int, default=32)
+    common_data.add_data_args(parser)
+    args = parser.parse_args()
+    _, val = common_data.get_rec_iter(args)
+    res = score(args.model_prefix, args.load_epoch,
+                val, ["accuracy"], mx.current_context())
+    for name, value in res:
+        logging.info("%s = %f", name, value)

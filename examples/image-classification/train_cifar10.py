@@ -1,0 +1,37 @@
+"""Train on CIFAR-10 (reference train_cifar10.py); .rec files when given,
+synthetic otherwise."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, CURR)
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from common import fit as common_fit  # noqa: E402
+from common import data as common_data  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    common_fit.add_fit_args(parser)
+    common_data.add_data_args(parser)
+    common_data.add_data_aug_args(parser)
+    parser.set_defaults(
+        network="resnet", num_layers=110, num_classes=10,
+        num_examples=50000, image_shape="3,28,28",
+        batch_size=128, num_epochs=300, lr=0.05,
+        lr_step_epochs="200,250", kv_store="device")
+    args = parser.parse_args()
+
+    if args.network == "resnet":
+        sym = mx.models.resnet(num_classes=args.num_classes,
+                               num_layers=args.num_layers,
+                               image_shape=args.image_shape)
+    else:
+        sym = getattr(mx.models, args.network)(num_classes=args.num_classes)
+    common_fit.fit(args, sym, common_data.get_rec_iter)

@@ -1,0 +1,48 @@
+"""Train on ImageNet-1k (reference train_imagenet.py).
+
+``--benchmark 1`` runs on synthetic data — the measurement protocol behind
+the north-star throughput numbers (BASELINE.md)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, CURR)
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from common import fit as common_fit  # noqa: E402
+from common import data as common_data  # noqa: E402
+
+
+def build_network(args):
+    kwargs = {"num_classes": args.num_classes}
+    name = args.network
+    if name == "resnet":
+        return mx.models.resnet(num_layers=args.num_layers or 50, **kwargs)
+    if name == "resnext":
+        return mx.models.resnext(num_layers=args.num_layers or 50, **kwargs)
+    if name == "vgg":
+        return mx.models.vgg(num_layers=args.num_layers or 16, **kwargs)
+    builder = getattr(mx.models, name)
+    return builder(**kwargs)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet-1k",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    common_fit.add_fit_args(parser)
+    common_data.add_data_args(parser)
+    common_data.add_data_aug_args(parser)
+    parser.set_defaults(
+        network="resnet", num_layers=50, num_classes=1000,
+        num_examples=1281167, image_shape="3,224,224",
+        batch_size=32, num_epochs=80, lr=0.1,
+        lr_step_epochs="30,60,80", kv_store="device")
+    args = parser.parse_args()
+
+    sym = build_network(args)
+    common_fit.fit(args, sym, common_data.get_rec_iter)

@@ -1,0 +1,76 @@
+"""Train LeNet/MLP on MNIST (reference
+example/image-classification/train_mnist.py, BASELINE config #1).
+
+Uses the MNIST idx files in --data-dir when present; otherwise a
+deterministic synthetic digit-like dataset (class-dependent gaussian
+blobs) so the example runs in a no-egress environment."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, CURR)
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from common import fit as common_fit  # noqa: E402
+from common import data as common_data  # noqa: E402
+
+
+def _synthetic_mnist(num, seed):
+    """Class-separable 28x28 'digits': blob position/intensity per class."""
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 10, num)
+    x = rs.rand(num, 1, 28, 28).astype(np.float32) * 0.1
+    for i in range(num):
+        c = y[i]
+        r0, c0 = 2 + (c % 5) * 5, 2 + (c // 5) * 12
+        x[i, 0, r0:r0 + 5, c0:c0 + 10] += 0.9
+    return x, y.astype(np.float32)
+
+
+def get_mnist_iter(args, kv):
+    data_dir = getattr(args, "data_dir", None)
+    if data_dir and os.path.exists(os.path.join(data_dir,
+                                                "train-images-idx3-ubyte")):
+        train = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "train-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True, flat=False)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=False, flat=False)
+        return train, val
+    ntrain = min(args.num_examples, 60000)
+    xs, ys = _synthetic_mnist(ntrain, seed=42)
+    xv, yv = _synthetic_mnist(max(args.batch_size, ntrain // 6), seed=43)
+    train = mx.io.NDArrayIter(xs, ys, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xv, yv, args.batch_size)
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-dir", type=str, default="mnist/")
+    parser.add_argument("--add_stn", action="store_true")
+    common_fit.add_fit_args(parser)
+    parser.set_defaults(
+        network="mlp", num_epochs=10, lr=0.05, lr_step_epochs="10",
+        batch_size=64, kv_store="local")
+    args = parser.parse_args()
+
+    if args.network == "mlp":
+        sym = mx.models.mlp(num_classes=args.num_classes)
+    else:
+        sym = mx.models.lenet(num_classes=args.num_classes)
+
+    common_fit.fit(args, sym, get_mnist_iter)

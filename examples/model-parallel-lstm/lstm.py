@@ -1,0 +1,122 @@
+"""Model-parallel multi-layer LSTM: each layer group pinned to its own
+device via ctx_group/group2ctx.
+
+Reference: ``example/model-parallel-lstm/lstm.py:48-112`` tags symbols
+with ``mx.AttrScope(ctx_group='layerN')`` and binds with
+``group2ctx={'layerN': ctx}``; the async engine pipelines timesteps across
+devices (``docs/how_to/model_parallel_lstm.md``).  Here the partitioning
+maps to sharding hints inside one XLA program — same API, the compiler
+schedules the pipeline.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def lstm_unroll(num_layers, seq_len, num_hidden, num_embed, vocab_size,
+                group_size=1):
+    """Unrolled stacked LSTM with per-layer ctx groups (reference
+    lstm.py lstm_unroll)."""
+    embed_group = "layer0"
+    with mx.AttrScope(ctx_group=embed_group):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=num_embed, name="embed")
+        hidden = mx.sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
+                                     squeeze_axis=1)
+        hidden = list(hidden)
+
+    for layer in range(num_layers):
+        group = "layer%d" % (layer // group_size)
+        with mx.AttrScope(ctx_group=group):
+            cell = mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                   prefix="lstm_l%d_" % layer)
+            states = cell.begin_state()
+            outs = []
+            for t in range(seq_len):
+                out, states = cell(hidden[t], states)
+                outs.append(out)
+            hidden = outs
+
+    last_group = "layer%d" % ((num_layers - 1) // group_size)
+    with mx.AttrScope(ctx_group=last_group):
+        concat = mx.sym.Concat(*[mx.sym.Reshape(h, shape=(0, 1, -1))
+                                 for h in hidden], dim=1, num_args=seq_len)
+        pred = mx.sym.FullyConnected(
+            mx.sym.Reshape(concat, shape=(-1, num_hidden)),
+            num_hidden=vocab_size, name="pred")
+        sm = mx.sym.SoftmaxOutput(data=pred,
+                                  label=mx.sym.Reshape(label, shape=(-1,)),
+                                  name="softmax")
+    return sm
+
+
+def main():
+    parser = argparse.ArgumentParser(description="model-parallel LSTM")
+    parser.add_argument("--num-layers", type=int, default=4)
+    parser.add_argument("--group-size", type=int, default=2,
+                        help="LSTM layers per ctx group")
+    parser.add_argument("--seq-len", type=int, default=16)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--vocab-size", type=int, default=200)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-batches", type=int, default=20)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    sym = lstm_unroll(args.num_layers, args.seq_len, args.num_hidden,
+                      args.num_embed, args.vocab_size, args.group_size)
+
+    # one Context per layer group; with one real chip these all map to it,
+    # on a mesh each group lands on its own device (PlaceDevice ≡ sharding)
+    ngroups = (args.num_layers + args.group_size - 1) // args.group_size
+    devices = mx.devices() if hasattr(mx, "devices") else None
+    group2ctx = {"layer%d" % i: mx.current_context() for i in range(ngroups)}
+
+    ex = sym.simple_bind(mx.current_context(), grad_req="write",
+                         group2ctx=group2ctx,
+                         data=(args.batch_size, args.seq_len),
+                         softmax_label=(args.batch_size, args.seq_len))
+
+    rs = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rs.uniform(-0.1, 0.1, arr.shape)
+
+    # synthetic next-token task: label[t] = (data[t]*3+1) % vocab
+    for i in range(args.num_batches):
+        xs = rs.randint(1, args.vocab_size,
+                        (args.batch_size, args.seq_len))
+        ys = (xs * 3 + 1) % args.vocab_size
+        ex.arg_dict["data"][:] = xs.astype(np.float32)
+        ex.arg_dict["softmax_label"][:] = ys.astype(np.float32)
+        ex.forward(is_train=True)
+        probs = ex.outputs[0].asnumpy()
+        nll = -np.log(probs[np.arange(probs.shape[0]),
+                            ys.reshape(-1).astype(int)] + 1e-8).mean()
+        ex.backward()
+        for name, arr in ex.arg_dict.items():
+            g = ex.grad_dict.get(name)
+            if g is not None and name not in ("data", "softmax_label"):
+                arr[:] = arr.asnumpy() - args.lr * g.asnumpy()
+        if i % 5 == 0:
+            logging.info("batch %d nll %.4f", i, nll)
+    logging.info("final nll %.4f", nll)
+    return nll
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Bucketing LSTM language model (reference example/rnn/lstm_bucketing.py:
+3-layer LSTM on PTB with BucketingModule; BASELINE LSTM config).
+
+Reads PTB-format text files when given; otherwise trains on a synthetic
+integer corpus so the example runs without datasets."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = f.readlines()
+    sentences = [l.split() for l in lines]
+    if vocab is None:
+        vocab = {}
+        idx = start_label
+        for s in sentences:
+            for w in s:
+                if w not in vocab:
+                    vocab[w] = idx
+                    idx += 1
+    return [[vocab.get(w, invalid_label) for w in s]
+            for s in sentences], vocab
+
+
+def synthetic_corpus(num_sentences, vocab_size, seed):
+    """Markov-ish synthetic sentences with learnable structure."""
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(num_sentences):
+        ln = rs.randint(5, 35)
+        start = rs.randint(1, vocab_size)
+        s = [start]
+        for _ in range(ln - 1):
+            s.append((s[-1] * 7 + 3) % vocab_size if rs.rand() < 0.8
+                     else rs.randint(1, vocab_size))
+        out.append(s)
+    return out
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Train an LSTM language model with bucketing")
+    parser.add_argument("--train-data", type=str)
+    parser.add_argument("--valid-data", type=str)
+    parser.add_argument("--num-layers", type=int, default=3)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--vocab-size", type=int, default=1000)
+    parser.add_argument("--num-sentences", type=int, default=2000)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--mom", type=float, default=0.0)
+    parser.add_argument("--wd", type=float, default=1e-5)
+    parser.add_argument("--kv-store", type=str, default="device")
+    parser.add_argument("--disp-batches", type=int, default=50)
+    args = parser.parse_args()
+
+    buckets = [10, 20, 30, 40]
+
+    if args.train_data:
+        train_sent, vocab = tokenize_text(args.train_data, start_label=1)
+        val_sent, _ = tokenize_text(args.valid_data or args.train_data,
+                                    vocab=vocab)
+        vocab_size = len(vocab) + 1
+    else:
+        vocab_size = args.vocab_size
+        train_sent = synthetic_corpus(args.num_sentences, vocab_size, 7)
+        val_sent = synthetic_corpus(max(args.batch_size * 4,
+                                        args.num_sentences // 10),
+                                    vocab_size, 8)
+
+    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=buckets)
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=buckets)
+
+    from mxnet_tpu.models.lstm_lm import sym_gen_factory
+    sym_gen = sym_gen_factory(num_layers=args.num_layers,
+                              num_hidden=args.num_hidden,
+                              num_embed=args.num_embed,
+                              vocab_size=vocab_size)
+
+    model = mx.module.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=mx.current_context())
+
+    import logging
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    model.fit(
+        train_data=data_train,
+        eval_data=data_val,
+        eval_metric=mx.metric.Perplexity(ignore_label=None),
+        kvstore=args.kv_store,
+        optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                          "wd": args.wd},
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))

@@ -5,6 +5,15 @@ Public surface mirrors ``python/mxnet/__init__.py``: nd/ndarray, sym/symbol,
 Context helpers, io, module, optimizer, metric, initializer, kvstore, autograd,
 random, callback, lr_scheduler, profiler.
 """
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # honor the standard env var: the axon TPU plugin re-prepends itself to
+    # jax_platforms at import, silently overriding JAX_PLATFORMS=cpu; that
+    # breaks subprocess tests with mixed CPU/TPU array placement
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \

@@ -179,6 +179,12 @@ class KVStoreDist(KVStore):
         return np.asarray(v.asnumpy(), dtype=np.float32).reshape(-1)
 
     def push(self, key, value, priority=0):
+        """Push (sum-reduced) values.
+
+        In sync mode this BLOCKS until every worker pushed the same key
+        (the reference queues pushes in the async engine instead); all
+        workers must therefore push the same keys in the same order —
+        which Module/model.py's fixed per-parameter order guarantees."""
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vals = v if isinstance(v, (list, tuple)) else [v]
@@ -214,8 +220,16 @@ class KVStoreDist(KVStore):
     def close(self):
         if not self._closed:
             self._closed = True
-            self._client.barrier()
-            self._client.finalize(self._rank == 0)
+            # runs from atexit too: a dead peer/scheduler must produce a
+            # nonzero exit, not an unhandled exception or a hang here
+            try:
+                self._client.barrier(timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self._client.finalize(self._rank == 0)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def create(name="local"):

@@ -50,8 +50,7 @@ _BIGARRAY_DEFAULT = 1000000
 
 
 def _env(name, default=None):
-    v = os.environ.get(name)
-    return v if v is not None else default
+    return os.environ.get(name, default)
 
 
 def _root_addr():
@@ -81,16 +80,13 @@ class Scheduler:
         self.listener = Listener(_root_addr(), authkey=_AUTHKEY)
         self.lock = threading.Condition()
         self.server_addrs = [None] * self.num_servers
-        self.worker_conns = {}
         self.next_server = 0
         self.next_worker = 0
         self.barrier_count = 0
         self.barrier_gen = 0
-        self.stopped = False
 
     def run(self):
         """Serve until every worker has deregistered."""
-        threads = []
         done = threading.Event()
         expected = self.num_workers + self.num_servers
 
@@ -143,21 +139,20 @@ class Scheduler:
 
         handle.exits = 0
         accept_thread = threading.Thread(target=self._accept,
-                                         args=(handle, threads, done),
+                                         args=(handle, done),
                                          daemon=True)
         accept_thread.start()
         done.wait()
         self.listener.close()
 
-    def _accept(self, handle, threads, done):
+    def _accept(self, handle, done):
         while not done.is_set():
             try:
                 conn = self.listener.accept()
             except OSError:
                 return
-            t = threading.Thread(target=handle, args=(conn,), daemon=True)
-            t.start()
-            threads.append(t)
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
 
 
 # ---------------------------------------------------------------------------
@@ -247,31 +242,51 @@ class Server:
                 msg = conn.recv()
             except (EOFError, OSError):
                 return
-            kind = msg[0]
-            if kind == "init":
-                _, key, arr = msg
-                with self.lock:
-                    self.store[key] = np.array(arr, dtype=np.float32)
-                conn.send(("ok",))
-            elif kind == "push":
-                _, key, arr = msg
+            try:
+                if self._serve_one(msg, conn):
+                    return
+            except Exception as exc:  # noqa: BLE001 — a dead serve thread
+                # would hang the pushing worker forever; reply the error
+                try:
+                    conn.send(("err", repr(exc)))
+                except (EOFError, OSError):
+                    return
+
+    def _serve_one(self, msg, conn):
+        """Handle one request; returns True when the server should stop."""
+        kind = msg[0]
+        if kind == "init":
+            _, key, arr = msg
+            with self.lock:
+                self.store[key] = np.array(arr, dtype=np.float32)
+            conn.send(("ok",))
+        elif kind == "push":
+            _, key, arr = msg
+            with self.lock:
+                known = key in self.store
+            if not known:
+                conn.send(("err", "key %r has not been initialized"
+                           % (key,)))
+            else:
                 self._handle_push(key, arr, conn)
-            elif kind == "pull":
-                _, key = msg
-                with self.lock:
-                    val = self.store.get(key)
-                if val is None:
-                    conn.send(("err", "key %r not initialized" % (key,)))
-                else:
-                    conn.send(("val", val))
-            elif kind == "command":
-                _, head, body = msg
-                self._handle_command(head, body)
-                conn.send(("ok",))
-            elif kind == "stop":
-                conn.send(("ok",))
-                self.stop_event.set()
-                return
+        elif kind == "pull":
+            _, key = msg
+            with self.lock:
+                val = self.store.get(key)
+            if val is None:
+                conn.send(("err", "key %r has not been initialized"
+                           % (key,)))
+            else:
+                conn.send(("val", val))
+        elif kind == "command":
+            _, head, body = msg
+            self._handle_command(head, body)
+            conn.send(("ok",))
+        elif kind == "stop":
+            conn.send(("ok",))
+            self.stop_event.set()
+            return True
+        return False
 
     def _handle_push(self, key, arr, conn):
         arr = np.asarray(arr, dtype=np.float32)
@@ -412,9 +427,17 @@ class WorkerClient:
         for sid in range(self.num_servers):
             self._rpc(sid, ("command", head, body))
 
-    def barrier(self):
+    def barrier(self, timeout=None):
+        """Worker-group barrier; times out (MXNET_KVSTORE_BARRIER_TIMEOUT
+        seconds, default 600) instead of hanging forever when a peer died
+        before reaching it."""
+        if timeout is None:
+            timeout = float(_env("MXNET_KVSTORE_BARRIER_TIMEOUT", "600"))
         with self.sched_lock:
             self.sched.send(("barrier",))
+            if not self.sched.poll(timeout):
+                raise MXNetError("barrier timed out after %.0fs (a peer "
+                                 "likely died)" % timeout)
             self.sched.recv()
 
     def get_num_dead_node(self):

