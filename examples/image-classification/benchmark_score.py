@@ -14,24 +14,18 @@ import time
 import numpy as np
 
 CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, CURR)
 sys.path.insert(0, os.path.join(CURR, "..", ".."))
 
 import mxnet_tpu as mx  # noqa: E402
+from common.modelzoo import get_network  # noqa: E402
 
 logging.basicConfig(level=logging.INFO)
 
 
-def get_symbol(network, num_layers=None):
-    if network == "resnet":
-        return mx.models.resnet(num_classes=1000, num_layers=num_layers or 50)
-    if network == "vgg":
-        return mx.models.vgg(num_classes=1000, num_layers=num_layers or 16)
-    return getattr(mx.models, network)(num_classes=1000)
-
-
 def score(network, dev, batch_size, num_batches, num_layers=None,
           image_shape=(3, 224, 224), dtype="float32"):
-    sym = get_symbol(network, num_layers)
+    sym = get_network(network, num_classes=1000, num_layers=num_layers)
     data_shape = [("data", (batch_size,) + tuple(image_shape))]
     mod = mx.Module(symbol=sym, context=dev, label_names=None)
     mod.bind(for_training=False, inputs_need_grad=False,

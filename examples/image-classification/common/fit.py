@@ -32,6 +32,8 @@ def _get_lr_scheduler(args, kv):
                      lr, begin_epoch)
     steps = [epoch_size * (x - begin_epoch) for x in step_epochs
              if x - begin_epoch > 0]
+    if not steps:  # resumed at/after the last step: lr already final
+        return (lr, None)
     return (lr, mx.lr_scheduler.MultiFactorScheduler(step=steps,
                                                      factor=args.lr_factor))
 

@@ -1,0 +1,24 @@
+"""Network-name → symbol dispatch shared by the example scripts
+(train_imagenet.py, benchmark_score.py, score.py)."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+_DEPTH_DEFAULT = {"resnet": 50, "resnext": 50, "vgg": 16}
+
+
+def get_network(name, num_classes=1000, num_layers=None, **kwargs):
+    """Build a model-zoo symbol; depth-parameterized families honor
+    num_layers."""
+    if name in _DEPTH_DEFAULT:
+        builder = getattr(mx.models, name)
+        return builder(num_classes=num_classes,
+                       num_layers=num_layers or _DEPTH_DEFAULT[name],
+                       **kwargs)
+    builder = getattr(mx.models, name)
+    return builder(num_classes=num_classes, **kwargs)

@@ -30,9 +30,13 @@ if __name__ == "__main__":
     parser.add_argument("--model-prefix", type=str, required=True)
     parser.add_argument("--load-epoch", type=int, required=True)
     parser.add_argument("--batch-size", type=int, default=32)
-    common_data.add_data_args(parser)
+    parser.add_argument("--data-val", type=str, required=True)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
     args = parser.parse_args()
-    _, val = common_data.get_rec_iter(args)
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    val = mx.io.ImageRecordIter(path_imgrec=args.data_val,
+                                data_shape=image_shape,
+                                batch_size=args.batch_size, shuffle=False)
     res = score(args.model_prefix, args.load_epoch,
                 val, ["accuracy"], mx.current_context())
     for name, value in res:

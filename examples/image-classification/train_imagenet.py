@@ -15,19 +15,7 @@ sys.path.insert(0, os.path.join(CURR, "..", ".."))
 import mxnet_tpu as mx  # noqa: E402
 from common import fit as common_fit  # noqa: E402
 from common import data as common_data  # noqa: E402
-
-
-def build_network(args):
-    kwargs = {"num_classes": args.num_classes}
-    name = args.network
-    if name == "resnet":
-        return mx.models.resnet(num_layers=args.num_layers or 50, **kwargs)
-    if name == "resnext":
-        return mx.models.resnext(num_layers=args.num_layers or 50, **kwargs)
-    if name == "vgg":
-        return mx.models.vgg(num_layers=args.num_layers or 16, **kwargs)
-    builder = getattr(mx.models, name)
-    return builder(**kwargs)
+from common.modelzoo import get_network  # noqa: E402
 
 
 if __name__ == "__main__":
@@ -44,5 +32,6 @@ if __name__ == "__main__":
         lr_step_epochs="30,60,80", kv_store="device")
     args = parser.parse_args()
 
-    sym = build_network(args)
+    sym = get_network(args.network, num_classes=args.num_classes,
+                      num_layers=args.num_layers)
     common_fit.fit(args, sym, common_data.get_rec_iter)

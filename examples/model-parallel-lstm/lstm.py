@@ -83,7 +83,6 @@ def main():
     # one Context per layer group; with one real chip these all map to it,
     # on a mesh each group lands on its own device (PlaceDevice ≡ sharding)
     ngroups = (args.num_layers + args.group_size - 1) // args.group_size
-    devices = mx.devices() if hasattr(mx, "devices") else None
     group2ctx = {"layer%d" % i: mx.current_context() for i in range(ngroups)}
 
     ex = sym.simple_bind(mx.current_context(), grad_req="write",

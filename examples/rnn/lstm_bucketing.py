@@ -81,10 +81,14 @@ if __name__ == "__main__":
                                         args.num_sentences // 10),
                                     vocab_size, 8)
 
+    # pad with label 0 and score with Perplexity(ignore_label=0) so pad
+    # positions neither train nor count (reference uses invalid_label=0
+    # with start_label=1 tokenization)
     data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
-                                           buckets=buckets)
+                                           buckets=buckets,
+                                           invalid_label=0)
     data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
-                                         buckets=buckets)
+                                         buckets=buckets, invalid_label=0)
 
     from mxnet_tpu.models.lstm_lm import sym_gen_factory
     sym_gen = sym_gen_factory(num_layers=args.num_layers,
@@ -103,7 +107,7 @@ if __name__ == "__main__":
     model.fit(
         train_data=data_train,
         eval_data=data_val,
-        eval_metric=mx.metric.Perplexity(ignore_label=None),
+        eval_metric=mx.metric.Perplexity(ignore_label=0),
         kvstore=args.kv_store,
         optimizer="sgd",
         optimizer_params={"learning_rate": args.lr, "momentum": args.mom,

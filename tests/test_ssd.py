@@ -1,0 +1,88 @@
+"""SSD-VGG16 detection pipeline tests (reference example/ssd +
+tests via MultiBox op coverage in test_vision_contrib_ops)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ssd_anchor_count():
+    # SSD-300 canonical anchor count (38^2*4 + 19^2*6 + 10^2*6 + 5^2*6
+    # + 3^2*4 + 1*4 = 8732)
+    net = mx.models.ssd_train(num_classes=20)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 300, 300),
+                                       label=(1, 2, 5))
+    outs = dict(zip(net.list_outputs(), out_shapes))
+    assert outs["cls_label_output"] == (1, 8732)
+    assert outs["cls_prob_output"] == (1, 21, 8732)
+    assert outs["loc_loss_output"] == (1, 8732 * 4)
+    assert outs["det_out_output"][2] == 6
+
+
+def test_ssd_train_step():
+    """One fused forward/backward on a tiny batch: losses finite, grads
+    flow into both heads and the backbone."""
+    net = mx.models.ssd_train(num_classes=3)
+    batch = 1
+    greq = {n: "write" for n in net.list_arguments()}
+    greq["data"] = greq["label"] = "null"
+    ex = net.simple_bind(mx.cpu(), grad_req=greq,
+                         data=(batch, 3, 300, 300), label=(batch, 2, 5))
+    rs = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            arr[:] = (rs.uniform(-0.05, 0.05, arr.shape)
+                      .astype(np.float32))
+    ex.arg_dict["data"][:] = rs.uniform(-1, 1, (batch, 3, 300, 300))
+    # one gt box per image: [cls, xmin, ymin, xmax, ymax], padded with -1
+    label = np.full((batch, 2, 5), -1.0, dtype=np.float32)
+    label[:, 0] = [1.0, 0.3, 0.3, 0.7, 0.7]
+    ex.arg_dict["label"][:] = label
+
+    outs = ex.forward(is_train=True)
+    cls_prob = outs[0].asnumpy()
+    loc_loss = outs[1].asnumpy()
+    assert np.isfinite(cls_prob).all()
+    assert np.isfinite(loc_loss).all()
+    ex.backward()
+    g = ex.grad_dict["conv_fc7_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    g43 = ex.grad_dict["conv4_3_weight"].asnumpy()
+    assert np.isfinite(g43).all() and np.abs(g43).sum() > 0
+
+
+def test_multibox_encode_decode_roundtrip():
+    """loc_target from MultiBoxTarget fed as loc_pred into
+    MultiBoxDetection must reproduce the GT box exactly — the invariant
+    that makes SSD localization learnable."""
+    from mxnet_tpu import ndarray as nd
+    sym = mx.sym
+    anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], dtype="float32")
+    gt = [0.25, 0.15, 0.65, 0.55]
+    label = np.array([[[0] + gt]], dtype="float32")
+    cls_pred = np.ones((1, 2, 1), dtype="float32") / 2
+    s = sym.MultiBoxTarget(sym.Variable("anchor"), sym.Variable("label"),
+                           sym.Variable("cls_pred"))
+    ex = s.bind(mx.cpu(), {"anchor": nd.array(anchors),
+                           "label": nd.array(label),
+                           "cls_pred": nd.array(cls_pred)},
+                grad_req="null")
+    loc_t = ex.forward()[0].asnumpy()
+    cls_prob = np.array([[[0.1], [0.9]]], dtype="float32")
+    d = sym.MultiBoxDetection(sym.Variable("cls_prob"),
+                              sym.Variable("loc_pred"),
+                              sym.Variable("anchor"), threshold=0.5)
+    ex2 = d.bind(mx.cpu(), {"cls_prob": nd.array(cls_prob),
+                            "loc_pred": nd.array(loc_t),
+                            "anchor": nd.array(anchors)}, grad_req="null")
+    out = ex2.forward()[0].asnumpy()
+    assert_almost_equal(out[0, 0, 2:], np.array(gt, dtype=np.float32),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_ssd_inference_detection_format():
+    net = mx.models.ssd(num_classes=3, nms_thresh=0.45)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 300, 300))
+    # [id, score, xmin, ymin, xmax, ymax] rows
+    assert out_shapes[0] == (1, 8732, 6)
