@@ -59,6 +59,8 @@ from . import parallel  # noqa: E402
 from . import models  # noqa: E402
 from . import operator  # noqa: E402
 from . import image  # noqa: E402
+from . import rtc  # noqa: E402
+from . import pallas_ops  # noqa: E402
 from . import test_utils  # noqa: E402
 from . import contrib  # noqa: E402
 

@@ -1,0 +1,11 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+The XLA compiler fuses the vast majority of what the reference hand-wrote
+in CUDA (SURVEY.md §2.2 TPU mapping note); these kernels cover the cases
+where explicit VMEM blocking beats XLA's default schedule — starting with
+flash attention (the quadratic-memory softmax-attention pattern XLA will
+not re-block on its own).
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

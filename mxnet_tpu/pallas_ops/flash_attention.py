@@ -1,0 +1,158 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Forward is a hand-blocked online-softmax kernel: for each (batch·head,
+q-block) grid cell, K/V stream through VMEM in ``block_k`` chunks, the two
+matmuls hit the MXU in fp32 accumulation, and the running (m, l, acc)
+recurrence keeps memory at O(L·block) instead of O(L²).  Backward
+recomputes through the scan-based ``blockwise_attention`` (same
+recurrence, XLA-scheduled) — no O(L²) residuals are ever materialized.
+
+The reference has no counterpart (its attention era was RNNs); this is
+the TPU-first hot-op path promised by the framework design.  Off-TPU the
+same kernel runs in Pallas interpret mode, so CPU tests exercise the real
+kernel code path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on pure-CPU jaxlib builds)
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["flash_attention"]
+
+_NEG = -1e30
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
+               causal, lk):
+    """One (batch·head, q-block) grid cell of the flash recurrence."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)           # (BQ, D)
+    d = q.shape[-1]
+    nk = lk // block_k
+
+    def body(i, carry):
+        m, l, acc = carry                       # (BQ,1), (BQ,1), (BQ,D)
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                  # fully-masked rows: exp(0)=1
+        if causal:
+            p = jnp.where(qpos >= kpos, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot(
+            p, vb, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    a0 = jnp.zeros((block_q, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    block_q = min(block_q, Lq)
+    block_k = min(block_k, Lk)
+    assert Lq % block_q == 0 and Lk % block_k == 0, \
+        "sequence lengths must divide the block sizes"
+    qr = q.reshape(B * H, Lq, D)
+    kr = k.reshape(B * H, Lk, D)
+    vr = v.reshape(B * H, Lk, D)
+
+    kernel = functools.partial(_fa_kernel, scale=scale, block_q=block_q,
+                               block_k=block_k, causal=causal, lk=Lk)
+    kw = {}
+    if _VMEM is not None:
+        kw["in_specs"] = [
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0),
+                         memory_space=_VMEM),
+        ]
+        kw["out_specs"] = pl.BlockSpec((1, block_q, D),
+                                       lambda b, i: (b, i, 0),
+                                       memory_space=_VMEM)
+    else:  # pragma: no cover
+        kw["in_specs"] = [
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
+        ]
+        kw["out_specs"] = pl.BlockSpec((1, block_q, D),
+                                       lambda b, i: (b, i, 0))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        grid=(B * H, Lq // block_q),
+        interpret=interpret,
+        **kw)(qr, kr, vr)
+    return out.reshape(B, H, Lq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    from ..parallel.sp import blockwise_attention
+    # memory-efficient backward: re-run the scan recurrence under vjp
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, scale=scale, block_size=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Flash attention over [B, H, L, D] tensors.
+
+    ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
+    Pallas interpret mode elsewhere (slow but exact — for tests)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash(q, k, v, causal, float(scale), int(block_q),
+                  int(block_k), bool(interpret))

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
-__all__ = ["ring_attention", "ring_self_attention", "blockwise_attention"]
+__all__ = ["ring_attention", "ring_self_attention", "blockwise_attention",
+           "local_attention"]
 
 _NEG = -1e30
 
@@ -89,6 +90,29 @@ def blockwise_attention(q, k, v, causal=False, scale=None, block_size=None):
     return out.astype(q.dtype)
 
 
+def _pick_block(length):
+    """Largest Mosaic-tileable block (multiple of the 16-sublane bf16 min)
+    dividing ``length``; None if the length can't be tiled."""
+    for b in (128, 64, 32, 16):
+        if length % b == 0:
+            return b
+    return None
+
+
+def local_attention(q, k, v, causal=False, scale=None):
+    """Single-device attention: the hand-blocked Pallas flash kernel on
+    TPU (pallas_ops/flash_attention.py), the scan recurrence elsewhere
+    (and for shapes the kernel's tiling can't cover)."""
+    from ..pallas_ops.flash_attention import _on_tpu
+    Lq, Lk = q.shape[2], k.shape[2]
+    bq, bk = _pick_block(Lq), _pick_block(Lk)
+    if _on_tpu() and bq and bk and q.shape[3] % 8 == 0:
+        from ..pallas_ops import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=bq, block_k=bk, interpret=False)
+    return blockwise_attention(q, k, v, causal=causal, scale=scale)
+
+
 def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     """Ring attention body — call INSIDE shard_map/pjit with the sequence
     axis of q/k/v sharded over ``axis_name``.
@@ -98,6 +122,9 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     """
     B, H, Lc, D = q.shape
     sp = jax.lax.axis_size(axis_name)
+    if sp == 1:
+        # degenerate ring: pure local attention (flash kernel on TPU)
+        return local_attention(q, k, v, causal=causal, scale=scale)
     idx = jax.lax.axis_index(axis_name)
     if scale is None:
         scale = 1.0 / (D ** 0.5)

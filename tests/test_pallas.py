@@ -1,0 +1,112 @@
+"""Pallas kernels: flash attention vs oracles; rtc PallasKernel API.
+
+Runs the REAL kernel code in Pallas interpret mode on CPU (SURVEY §4:
+one suite parameterized over contexts; the compiled Mosaic path runs on
+TPU hardware in bench)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.pallas_ops import flash_attention
+from mxnet_tpu.parallel.sp import blockwise_attention
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _naive_attention(q, k, v, causal=False, scale=None):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        Lq, Lk = q.shape[2], k.shape[2]
+        mask = np.tril(np.ones((Lq, Lk), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_forward(causal):
+    rs = np.random.RandomState(0)
+    B, H, L, D = 2, 3, 16, 8
+    q = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                          interpret=True)
+    ref = _naive_attention(q, k, v, causal=causal)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_matches_blockwise():
+    rs = np.random.RandomState(1)
+    B, H, L, D = 1, 2, 32, 8
+    q = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                          interpret=True)
+    ref = blockwise_attention(q, k, v, causal=True, block_size=16)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grad(causal):
+    rs = np.random.RandomState(2)
+    B, H, L, D = 1, 2, 16, 8
+    q = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=8, block_k=8,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_naive_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        assert_almost_equal(np.asarray(a), np.asarray(b),
+                            rtol=1e-3, atol=1e-4,
+                            names=("flash_d" + name, "ref_d" + name))
+
+
+def test_flash_attention_bf16():
+    rs = np.random.RandomState(3)
+    B, H, L, D = 1, 1, 16, 8
+    q = jnp.asarray(rs.randn(B, H, L, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, H, L, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, H, L, D), jnp.bfloat16)
+    out = flash_attention(q, k, v, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _naive_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                           v.astype(jnp.float32))
+    assert_almost_equal(np.asarray(out, dtype=np.float32),
+                        np.asarray(ref), rtol=5e-2, atol=5e-2)
+
+
+def test_rtc_pallas_kernel():
+    def kern(x_ref, y_ref, o_ref):
+        o_ref[:] = x_ref[:] * y_ref[:] + 1.0
+
+    rtc = mx.rtc.PallasKernel("fma1", kern, interpret=True)
+    x = mx.nd.array(np.arange(24, dtype=np.float32).reshape(4, 6))
+    y = mx.nd.array(np.full((4, 6), 2.0, dtype=np.float32))
+    out = mx.nd.empty((4, 6))
+    rtc.push([x, y], [out])
+    assert_almost_equal(out.asnumpy(), x.asnumpy() * 2.0 + 1.0)
+    # functional form + shape/dtype cache reuse
+    out2 = rtc.push([x, y], [mx.nd.empty((4, 6))])
+    assert_almost_equal(out2.asnumpy(), out.asnumpy())
+
+
+def test_rtc_cuda_source_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.MXRtc("abc", [], [], "__global__ void abc() {}")
