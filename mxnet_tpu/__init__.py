@@ -60,6 +60,9 @@ from . import models  # noqa: E402
 from . import operator  # noqa: E402
 from . import image  # noqa: E402
 from . import rtc  # noqa: E402
+from . import predictor  # noqa: E402
+from .predictor import Predictor  # noqa: E402
+from . import executor_manager  # noqa: E402
 from . import pallas_ops  # noqa: E402
 from . import test_utils  # noqa: E402
 from . import contrib  # noqa: E402

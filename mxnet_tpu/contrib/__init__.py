@@ -1,4 +1,5 @@
 """contrib namespace (reference python/mxnet/contrib/)."""
 from . import autograd
+from . import tensorboard
 
-__all__ = ["autograd"]
+__all__ = ["autograd", "tensorboard"]

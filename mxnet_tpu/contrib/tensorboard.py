@@ -1,0 +1,66 @@
+"""TensorBoard logging callback.
+
+Reference: ``python/mxnet/contrib/tensorboard.py`` — ``LogMetricsCallback``
+pushes EvalMetric values to a SummaryWriter.  The tensorboard/tensorboardX
+packages aren't in this image; when absent, scalars append to a JSONL
+events file the user can tail or convert (same callback surface either
+way).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class _JsonlWriter:
+    """Fallback writer: one {wall_time, tag, step, value} JSON per line."""
+
+    def __init__(self, logging_dir):
+        os.makedirs(logging_dir, exist_ok=True)
+        self._f = open(os.path.join(logging_dir, "scalars.jsonl"), "a")
+
+    def add_scalar(self, tag, value, global_step=None):
+        self._f.write(json.dumps({"wall_time": time.time(), "tag": tag,
+                                  "step": global_step,
+                                  "value": float(value)}) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _make_writer(logging_dir):
+    try:  # pragma: no cover - tensorboard not in this image
+        from tensorboardX import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:  # pragma: no cover
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        return _JsonlWriter(logging_dir)
+
+
+class LogMetricsCallback:
+    """Log metrics each batch-end to TensorBoard (or the JSONL fallback)
+    (reference contrib/tensorboard.py LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        """Callback for batch-end with `param.eval_metric`."""
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        name_value = param.eval_metric.get_name_value()
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -1,0 +1,120 @@
+"""Legacy executor manager (pre-Module data-parallel helper).
+
+Reference: ``python/mxnet/executor_manager.py`` — ``_split_input_slice``
+(:14), ``DataParallelExecutorManager`` (:278).  ``FeedForward`` (model.py)
+trained through this before Module existed; kept for API parity, backed by
+the same ``DataParallelExecutorGroup`` the Module layer uses.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+from .module.executor_group import DataParallelExecutorGroup
+
+__all__ = ["_split_input_slice", "DataParallelExecutorManager"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Split a batch into per-device slices proportional to work load
+    (reference executor_manager.py:14)."""
+    total = sum(work_load_list)
+    if total <= 0:
+        raise MXNetError("Invalid work load")
+    batch_num_list = [round(batch_size * (float(w) / total))
+                      for w in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise MXNetError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _check_arguments(symbol):
+    """Reject duplicate argument/aux names (reference :51)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        raise MXNetError("Find duplicated argument name, please make the "
+                         "weight name non-duplicated, arguments are %s"
+                         % str(arg_names))
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        raise MXNetError("Find duplicated auxiliary param name, aux are %s"
+                         % str(aux_names))
+
+
+class DataParallelExecutorManager:
+    """Helper to manage multiple executors for data parallelism
+    (reference executor_manager.py:278)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names, param_names,
+                 aux_names, work_load_list=None, logger=None,
+                 sym_gen=None):
+        if logger is None:
+            logger = logging
+        num_device = len(ctx)
+        logger.info("Start training with %s", str(ctx))
+        if work_load_list is None:
+            work_load_list = [1] * num_device
+        assert isinstance(work_load_list, list) and \
+            len(work_load_list) == num_device
+        _check_arguments(symbol)
+
+        self.ctx = ctx
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.symbol = symbol
+        self.sym_gen = sym_gen
+
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, ctx, work_load_list,
+            data_shapes=train_data.provide_data,
+            label_shapes=train_data.provide_label,
+            param_names=param_names, for_training=True,
+            inputs_need_grad=False)
+        self.curr_execgrp = self.execgrp
+        self._cur_batch = None
+
+    def install_monitor(self, monitor):
+        for ex in self.curr_execgrp.execs:
+            monitor.install(ex)
+
+    def set_params(self, arg_params, aux_params):
+        self.curr_execgrp.set_params(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        self.curr_execgrp.get_params(arg_params, aux_params)
+
+    @property
+    def param_arrays(self):
+        return self.curr_execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.curr_execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.curr_execgrp.aux_arrays
+
+    def load_data_batch(self, data_batch):
+        self._cur_batch = data_batch
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(self._cur_batch, is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.curr_execgrp.update_metric(metric, labels)

@@ -1,10 +1,12 @@
 """Flash attention as a Pallas TPU kernel.
 
-Forward is a hand-blocked online-softmax kernel: for each (batch·head,
-q-block) grid cell, K/V stream through VMEM in ``block_k`` chunks, the two
-matmuls hit the MXU in fp32 accumulation, and the running (m, l, acc)
-recurrence keeps memory at O(L·block) instead of O(L²).  Backward
-recomputes through the scan-based ``blockwise_attention`` (same
+Hand-blocked online-softmax: the grid is (batch·head, q-blocks,
+k-blocks); Pallas pipelines one (block_q, D) Q tile and one (block_k, D)
+K/V tile through VMEM per cell — never the full sequence — while the
+running (m, l, acc) recurrence lives in VMEM scratch across the k steps
+(grid's innermost dimension is sequential on TPU).  Both matmuls hit the
+MXU with fp32 accumulation; memory stays O(block) per core at any L.
+Backward recomputes through the scan-based ``blockwise_attention`` (same
 recurrence, XLA-scheduled) — no O(L²) residuals are ever materialized.
 
 The reference has no counterpart (its attention era was RNNs); this is
@@ -27,54 +29,72 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "_on_tpu", "_VMEM", "pltpu"]
 
 _NEG = -1e30
 
 
 def _on_tpu():
+    """True when the default jax backend is a TPU (shared probe — rtc.py
+    and parallel/sp.py import this rather than re-implementing it)."""
     try:
         return jax.default_backend() == "tpu"
     except RuntimeError:  # pragma: no cover
         return False
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
-               causal, lk):
-    """One (batch·head, q-block) grid cell of the flash recurrence."""
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)           # (BQ, D)
-    d = q.shape[-1]
-    nk = lk // block_k
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+               scale, block_q, block_k, causal, nk):
+    """One (batch·head, q-block, k-block) grid cell.
 
-    def body(i, carry):
-        m, l, acc = carry                       # (BQ,1), (BQ,1), (BQ,D)
-        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+    m/l/acc are VMEM scratch carrying the online-softmax state across the
+    sequential k dimension; the normalized output is written on the last
+    k step."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: skip blocks entirely above the diagonal
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)        # (BQ, D)
+        kb = k_ref[0].astype(jnp.float32)       # (BK, D)
+        vb = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (BQ, BK)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = i * block_k + jax.lax.broadcasted_iota(
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                  # fully-masked rows: exp(0)=1
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
         if causal:
             p = jnp.where(qpos >= kpos, p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot(
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
             p, vb, preferred_element_type=jnp.float32)
-        return m_new, l, acc
 
-    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    a0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
-    o_ref[0] = (acc / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        o_ref[0] = (acc_ref[:] /
+                    jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
 
 
 def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -84,39 +104,45 @@ def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     block_k = min(block_k, Lk)
     assert Lq % block_q == 0 and Lk % block_k == 0, \
         "sequence lengths must divide the block sizes"
+    nk = Lk // block_k
     qr = q.reshape(B * H, Lq, D)
     kr = k.reshape(B * H, Lk, D)
     vr = v.reshape(B * H, Lk, D)
 
     kernel = functools.partial(_fa_kernel, scale=scale, block_q=block_q,
-                               block_k=block_k, causal=causal, lk=Lk)
-    kw = {}
-    if _VMEM is not None:
-        kw["in_specs"] = [
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
-                         memory_space=_VMEM),
-            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0),
-                         memory_space=_VMEM),
-            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0),
-                         memory_space=_VMEM),
-        ]
-        kw["out_specs"] = pl.BlockSpec((1, block_q, D),
-                                       lambda b, i: (b, i, 0),
-                                       memory_space=_VMEM)
+                               block_k=block_k, causal=causal, nk=nk)
+
+    def _spec(shape, index_map):
+        if _VMEM is not None:
+            return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+        return pl.BlockSpec(shape, index_map)  # pragma: no cover
+
+    in_specs = [
+        _spec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # Q tile
+        _spec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # K tile
+        _spec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # V tile
+    ]
+    out_specs = _spec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    if pltpu is not None:
+        scratch = [pltpu.VMEM((block_q, 1), jnp.float32),
+                   pltpu.VMEM((block_q, 1), jnp.float32),
+                   pltpu.VMEM((block_q, D), jnp.float32)]
+        params = dict(compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")))
     else:  # pragma: no cover
-        kw["in_specs"] = [
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
-        ]
-        kw["out_specs"] = pl.BlockSpec((1, block_q, D),
-                                       lambda b, i: (b, i, 0))
+        scratch = [pl.MemoryRef((block_q, 1), jnp.float32),
+                   pl.MemoryRef((block_q, 1), jnp.float32),
+                   pl.MemoryRef((block_q, D), jnp.float32)]
+        params = {}
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-        grid=(B * H, Lq // block_q),
+        grid=(B * H, Lq // block_q, nk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
         interpret=interpret,
-        **kw)(qr, kr, vr)
+        **params)(qr, kr, vr)
     return out.reshape(B, H, Lq, D)
 
 

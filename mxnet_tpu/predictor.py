@@ -1,0 +1,141 @@
+"""Self-contained prediction API.
+
+Reference: ``include/mxnet/c_predict_api.h`` + ``src/c_api/c_predict_api.cc``
+— the minimal deploy ABI (create from symbol JSON + param bytes, set
+input, forward, get output) that amalgamation compiles into one file for
+mobile.  Python-surface equivalent here: ``Predictor`` carries no training
+machinery, loads the reference-style checkpoint pair, jit-compiles one
+forward, and exposes the same verbs.
+
+    pred = Predictor(open("m-symbol.json").read(), open("m-0010.params","rb").read(),
+                     {"data": (1, 3, 224, 224)})
+    pred.set_input("data", x)      # or pred.forward(data=x)
+    pred.forward()
+    y = pred.get_output(0)
+"""
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+
+def load_ndarray_file(nd_bytes):
+    """Load a serialized NDArray dict from bytes (MXNDArrayLoad semantics,
+    reference c_predict_api.cc MXNDListCreate)."""
+    from . import ndarray as nd
+    buf = io.BytesIO(nd_bytes)
+    data = np.load(buf, allow_pickle=False)
+    return {k: np.asarray(v) for k, v in data.items()}
+
+
+class Predictor:
+    """Inference-only executor over a symbol-JSON + params checkpoint
+    (reference MXPredCreate, c_predict_api.h:59)."""
+
+    def __init__(self, symbol_json_str, param_raw_bytes, input_shapes,
+                 dev_type="cpu", dev_id=0):
+        from . import context, symbol as sym_mod
+        from . import ndarray as nd
+
+        if isinstance(symbol_json_str, bytes):
+            symbol_json_str = symbol_json_str.decode()
+        self._symbol = sym_mod.load_json(symbol_json_str)
+        self._ctx = getattr(context, dev_type)(dev_id) \
+            if hasattr(context, dev_type) else context.cpu(dev_id)
+
+        params = load_ndarray_file(param_raw_bytes) \
+            if isinstance(param_raw_bytes, (bytes, bytearray)) \
+            else dict(param_raw_bytes)
+        arg_params, aux_params = {}, {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+
+        self._input_names = list(input_shapes)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        shapes = dict(input_shapes)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(
+            **shapes)
+        args = []
+        self._inputs = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in input_shapes:
+                a = nd.zeros(tuple(input_shapes[name]), self._ctx)
+                self._inputs[name] = a
+            elif name in arg_params:
+                a = nd.array(arg_params[name], self._ctx)
+            elif shape is not None:
+                # non-parameter aux inputs (labels) get zeros — inference
+                # never reads them
+                a = nd.zeros(tuple(shape), self._ctx)
+            else:
+                raise MXNetError("argument %r is neither an input nor in "
+                                 "the params file, and its shape cannot "
+                                 "be inferred" % name)
+            args.append(a)
+        aux = []
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in aux_params:
+                aux.append(nd.array(aux_params[name], self._ctx))
+            elif shape is not None:
+                aux.append(nd.zeros(tuple(shape), self._ctx))
+            else:
+                raise MXNetError("auxiliary state %r is not in the params "
+                                 "file and its shape cannot be inferred"
+                                 % name)
+        self._exec = self._symbol.bind(self._ctx,
+                                       dict(zip(arg_names, args)),
+                                       grad_req="null",
+                                       aux_states=dict(zip(aux_names,
+                                                           aux)))
+        self._outputs = None
+
+    def set_input(self, name, data):
+        """MXPredSetInput (c_predict_api.h:125)."""
+        if name not in self._inputs:
+            raise MXNetError("unknown input %r (have %s)"
+                             % (name, self._input_names))
+        self._inputs[name][:] = np.asarray(data)
+
+    def forward(self, **inputs):
+        """MXPredForward; kwargs are a convenience for set_input."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._outputs = self._exec.forward(is_train=False)
+        return self._outputs
+
+    def get_output(self, index):
+        """MXPredGetOutput -> numpy (c_predict_api.h:160)."""
+        if self._outputs is None:
+            self.forward()
+        return self._outputs[index].asnumpy()
+
+    def get_output_shape(self, index):
+        """Static output shape from executor metadata — no device transfer
+        (reference MXPredGetOutputShape)."""
+        return tuple(self._exec.outputs[index].shape)
+
+    @staticmethod
+    def from_checkpoint(prefix, epoch, input_shapes, dev_type="cpu",
+                        dev_id=0):
+        """Build from a `prefix-symbol.json` + `prefix-NNNN.params` pair
+        (model.save_checkpoint layout)."""
+        with open("%s-symbol.json" % prefix) as f:
+            sym_json = f.read()
+        from .model import load_checkpoint
+        _, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        params = {"arg:%s" % k: v.asnumpy() for k, v in arg_params.items()}
+        params.update({"aux:%s" % k: v.asnumpy()
+                       for k, v in aux_params.items()})
+        return Predictor(sym_json, params, input_shapes, dev_type, dev_id)

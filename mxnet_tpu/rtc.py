@@ -24,22 +24,9 @@ from jax.experimental import pallas as pl
 
 from .base import MXNetError
 from .ndarray import NDArray
-
-try:
-    from jax.experimental.pallas import tpu as pltpu
-    _VMEM = pltpu.VMEM
-except ImportError:  # pragma: no cover
-    pltpu = None
-    _VMEM = None
+from .pallas_ops.flash_attention import _VMEM, _on_tpu
 
 __all__ = ["PallasKernel", "MXRtc"]
-
-
-def _on_tpu():
-    try:
-        return jax.default_backend() == "tpu"
-    except RuntimeError:  # pragma: no cover
-        return False
 
 
 class PallasKernel:

@@ -1,0 +1,116 @@
+"""Predictor / executor_manager / tensorboard tests (reference
+c_predict_api.h deploy path + executor_manager.py legacy layer)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _train_tiny(tmp_path):
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2,
+                                                     name="fc"),
+                               name="softmax")
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 3)
+    return prefix, X, mod
+
+
+def test_predictor_matches_module(tmp_path):
+    prefix, X, mod = _train_tiny(tmp_path)
+    pred = mx.Predictor.from_checkpoint(prefix, 3,
+                                        {"data": (8, 6)})
+    pred.forward(data=X[:8])
+    out = pred.get_output(0)
+    assert out.shape == (8, 2)
+    # same result as scoring through the Module path
+    mod2 = mx.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (8, 6))], for_training=False,
+              label_shapes=None)
+    mod2.forward(mx.io.DataBatch(data=[mx.nd.array(X[:8])], label=[]),
+                 is_train=False)
+    ref = mod2.get_outputs()[0].asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+    assert pred.get_output_shape(0) == (8, 2)
+
+
+def test_predictor_raw_bytes_roundtrip(tmp_path):
+    prefix, X, _ = _train_tiny(tmp_path)
+    import io as _io
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    _, arg_params, aux_params = mx.model.load_checkpoint(prefix, 3)
+    buf = _io.BytesIO()
+    np.savez(buf, **{"arg:%s" % k: v.asnumpy()
+                     for k, v in arg_params.items()})
+    pred = mx.Predictor(sym_json, buf.getvalue(), {"data": (4, 6)})
+    pred.set_input("data", X[:4])
+    pred.forward()
+    assert pred.get_output(0).shape == (4, 2)
+
+
+def test_split_input_slice():
+    from mxnet_tpu.executor_manager import _split_input_slice
+    slices = _split_input_slice(10, [1, 1])
+    assert slices == [slice(0, 5), slice(5, 10)]
+    slices = _split_input_slice(10, [2, 1])
+    assert slices[0].stop - slices[0].start > \
+        slices[1].stop - slices[1].start
+
+
+def test_executor_manager_forward():
+    rs = np.random.RandomState(1)
+    X = rs.randn(32, 4).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2,
+                                                     name="fc"),
+                               name="softmax")
+    arg_names = net.list_arguments()
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    mgr = mx.executor_manager.DataParallelExecutorManager(
+        net, [mx.cpu()], it, arg_names, param_names,
+        net.list_auxiliary_states())
+    arg_params = {n: mx.nd.array(rs.uniform(-0.1, 0.1, (2, 4)) if "weight"
+                                 in n else np.zeros(2, np.float32))
+                  for n in param_names}
+    mgr.set_params(arg_params, {})
+    batch = next(iter(it))
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    metric = mx.metric.create("acc")
+    mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] >= 0.0
+
+
+def test_tensorboard_jsonl_callback(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    from collections import namedtuple
+    cb = LogMetricsCallback(str(tmp_path / "tb"))
+    metric = mx.metric.create("acc")
+    metric.update([mx.nd.array([1.0, 0.0])],
+                  [mx.nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    P = namedtuple("P", ["eval_metric"])
+    cb(P(eval_metric=metric))
+    cb(P(eval_metric=metric))
+    path = tmp_path / "tb" / "scalars.jsonl"
+    if path.exists():  # JSONL fallback writer
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) == 2 and lines[0]["tag"] == "accuracy"
+        assert lines[0]["value"] == 1.0
+    else:  # a real SummaryWriter (torch/tensorboardX) wrote event files
+        assert any((tmp_path / "tb").iterdir())

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown table (reference tools/parse_log.py)."""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    res = [re.compile(r".*Epoch\[(\d+)\] Train.*=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Valid.*=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")]
+    data = {}
+    for line in lines:
+        for i, r in enumerate(res):
+            m = r.match(line)
+            if m is None:
+                continue
+            epoch = int(m.groups()[0])
+            val = float(m.groups()[1])
+            if epoch not in data:
+                data[epoch] = [0.0] * len(res) * 2
+            data[epoch][i * 2] += val
+            data[epoch][i * 2 + 1] += 1
+            break
+    return data
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Parse training log")
+    parser.add_argument("logfile", nargs=1, type=str)
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none"])
+    args = parser.parse_args()
+    with open(args.logfile[0]) as f:
+        data = parse(f.readlines())
+
+    if args.format == "markdown":
+        print("| epoch | train-accuracy | valid-accuracy | time |")
+        print("| --- | --- | --- | --- |")
+        fmt = "| %d | %f | %f | %.1f |"
+    else:
+        fmt = "%d %f %f %.1f"
+    for k, v in sorted(data.items()):
+        print(fmt % (k,
+                     v[0] / v[1] if v[1] else float("nan"),
+                     v[2] / v[3] if v[3] else float("nan"),
+                     v[4] / v[5] if v[5] else float("nan")))
+
+
+if __name__ == "__main__":
+    main()
