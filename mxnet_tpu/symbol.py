@@ -152,8 +152,15 @@ class Symbol:
 
     # -- attrs -------------------------------------------------------------
     def attr(self, key):
+        """Get an attribute; bare keys are wrapped to the stored
+        ``__key__`` form like the reference C API
+        (c_api_symbolic.cc:193)."""
         node = self._outputs[0][0]
-        return node.extra_attrs.get(key)
+        if key in node.extra_attrs:
+            return node.extra_attrs[key]
+        if not key.startswith("__"):
+            return node.extra_attrs.get("__%s__" % key)
+        return None
 
     def _set_attr(self, **kwargs):
         node = self._outputs[0][0]
@@ -171,7 +178,15 @@ class Symbol:
         return ret
 
     def list_attr(self):
-        return dict(self._outputs[0][0].extra_attrs)
+        """Attributes with the ``__key__`` wrapping stripped (reference
+        MXSymbolListAttrShallow unwraps the same way)."""
+        out = {}
+        for k, v in self._outputs[0][0].extra_attrs.items():
+            if k.startswith("__") and k.endswith("__"):
+                out[k[2:-2]] = v
+            else:
+                out[k] = v
+        return out
 
     # -- composition -------------------------------------------------------
     def __call__(self, *args, **kwargs):

@@ -53,12 +53,20 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
                 input_name = input_node["name"]
                 if input_node["op"] != "null" or item[0] in heads:
                     pre_node.append(input_name)
-                    if shape is not None:
-                        key = input_name + "_output"
-                        if key in shape_dict:
-                            shape1 = shape_dict[key]
-                            if len(shape1) > 1:
-                                pre_filter = pre_filter + int(shape1[1])
+                if shape is not None:
+                    # variables appear in shape_dict under their own name
+                    # (param counting must see the data input's channels
+                    # even though it isn't displayed as a previous layer)
+                    key = input_name + "_output" \
+                        if input_node["op"] != "null" else input_name
+                    if key in shape_dict and input_node["op"] == "null" \
+                            and input_name.endswith(("weight", "bias",
+                                                     "gamma", "beta")):
+                        continue
+                    if key in shape_dict:
+                        shape1 = shape_dict[key]
+                        if len(shape1) > 1:
+                            pre_filter = pre_filter + int(shape1[1])
         cur_param = 0
         attrs = node.get("attrs", {})
         if op == "Convolution":

@@ -1,0 +1,129 @@
+"""Symbol attributes + executor behaviors (reference test_attr.py,
+test_executor.py, test_multi_device_exec.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_attr_basic():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_attr_scope():
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.sym.Variable("data", attr={"dtype": "data",
+                                             "group": "1"})
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"  # explicit beats scope
+
+    with mx.AttrScope(ctx_group="stage1"):
+        net = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2,
+                                    name="fc")
+    assert net.attr("ctx_group") == "stage1" or \
+        net.attr("__ctx_group__") == "stage1"
+
+
+def test_list_attr():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    assert data.list_attr().get("mood") == "angry"
+
+
+def test_executor_copy_params_and_reshape():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3))
+    rs = np.random.RandomState(0)
+    w = rs.randn(4, 3).astype(np.float32)
+    ex.copy_params_from({"fc_weight": mx.nd.array(w),
+                         "fc_bias": mx.nd.zeros((4,))})
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    out = ex.forward()[0].asnumpy()
+    assert_almost_equal(out, np.ones((2, 3)) @ w.T, rtol=1e-5, atol=1e-6)
+    # reshape to a larger batch reuses weights
+    ex2 = ex.reshape(allow_up_sizing=True, data=(5, 3))
+    ex2.arg_dict["data"][:] = np.ones((5, 3), np.float32)
+    out2 = ex2.forward()[0].asnumpy()
+    assert out2.shape == (5, 4)
+    assert_almost_equal(out2[0], out[0], rtol=1e-5, atol=1e-6)
+
+
+def test_executor_output_dict():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(data, act_type="relu", name="act")
+    ex = net.simple_bind(mx.cpu(), data=(2, 2))
+    assert "act_output" in ex.output_dict
+
+
+def test_ctx_group_multi_device():
+    """One graph split across two ctx groups — CPU contexts with fake
+    device ids stand in for a mesh (reference
+    test_multi_device_exec.py:4)."""
+    with mx.AttrScope(ctx_group="stage1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=8)
+        act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    with mx.AttrScope(ctx_group="stage2"):
+        fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=4)
+        net = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+    texec = net.simple_bind(mx.cpu(0),
+                            group2ctx={"stage1": mx.cpu(1),
+                                       "stage2": mx.cpu(2)},
+                            data=(4, 10), softmax_label=(4,))
+    rs = np.random.RandomState(0)
+    for name, arr in texec.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rs.uniform(-0.1, 0.1, arr.shape)
+    texec.arg_dict["data"][:] = rs.randn(4, 10)
+    texec.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 3],
+                                                  np.float32)
+    out = texec.forward(is_train=True)[0].asnumpy()
+    assert out.shape == (4, 4)
+    assert_almost_equal(out.sum(axis=1), np.ones(4), rtol=1e-5,
+                        atol=1e-5)
+    texec.backward()
+    g = texec.grad_dict["fc1_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_model_parallel_gradient_math():
+    """Cross-device gradient correctness (reference
+    test_model_parallel.py:12): same numbers as single-device."""
+    def build():
+        with mx.AttrScope(ctx_group="dev1"):
+            data = mx.sym.Variable("data")
+            fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=6)
+        with mx.AttrScope(ctx_group="dev2"):
+            act = mx.sym.Activation(fc1, act_type="tanh")
+            out = mx.sym.sum(act * act)
+        return out
+
+    net = build()
+    rs = np.random.RandomState(3)
+    xs = rs.randn(3, 5).astype(np.float32)
+    w = rs.randn(6, 5).astype(np.float32)
+    b = rs.randn(6).astype(np.float32)
+
+    def run(group2ctx):
+        ex = net.simple_bind(mx.cpu(), group2ctx=group2ctx,
+                             data=(3, 5),
+                             grad_req={"data": "null",
+                                       "fc1_weight": "write",
+                                       "fc1_bias": "write"})
+        ex.arg_dict["data"][:] = xs
+        ex.arg_dict["fc1_weight"][:] = w
+        ex.arg_dict["fc1_bias"][:] = b
+        ex.forward(is_train=True)
+        ex.backward()
+        return ex.grad_dict["fc1_weight"].asnumpy()
+
+    g_multi = run({"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    g_single = run(None)
+    assert_almost_equal(g_multi, g_single, rtol=1e-5, atol=1e-6)

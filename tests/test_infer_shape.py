@@ -1,0 +1,83 @@
+"""Shape inference tests (reference tests/python/unittest/
+test_infer_shape.py: forward, partial, and backward propagation)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_mlp_infer_shape():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=1000)
+    out = mx.sym.SoftmaxOutput(fc1, name="softmax")
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 100))
+    args = dict(zip(out.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (1000, 100)
+    assert args["fc1_bias"] == (1000,)
+    assert out_shapes[0] == (100, 1000)
+
+
+def test_partial_infer():
+    """infer_shape_partial leaves underdetermined entries None instead of
+    raising (reference :37)."""
+    data = mx.sym.Variable("data")
+    prev = mx.sym.Variable("prev")
+    cast_prev = mx.sym.Cast(prev, dtype="float32")
+    out = mx.sym.FullyConnected(data=data, name="fc1",
+                                num_hidden=128) + cast_prev
+    arg_shapes, out_shapes, _ = out.infer_shape_partial(data=(25, 10))
+    args = dict(zip(out.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (128, 10)
+    assert args["prev"] is None or args["prev"] == (25, 128)
+
+
+def test_backward_infer():
+    """Known output/label shapes propagate backward into inputs
+    (reference test_backward_infer: weight shape from output)."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc", num_hidden=8)
+    # infer data shape from... not supported forward-only; but label
+    # shape flows from data in SoftmaxOutput
+    sm = mx.sym.SoftmaxOutput(out, name="softmax")
+    arg_shapes, _, _ = sm.infer_shape(data=(4, 10))
+    args = dict(zip(sm.list_arguments(), arg_shapes))
+    assert args["softmax_label"] == (4,)
+
+
+def test_incomplete_raises():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc", num_hidden=8)
+    with pytest.raises(MXNetError):
+        out.infer_shape()  # nothing known -> underdetermined
+
+
+def test_conv_chain_shapes():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                            num_filter=16, name="c1")
+    p1 = mx.sym.Pooling(c1, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    c2 = mx.sym.Convolution(p1, kernel=(3, 3), num_filter=32, name="c2")
+    _, out_shapes, _ = c2.infer_shape(data=(2, 3, 32, 32))
+    assert out_shapes[0] == (2, 32, 14, 14)
+
+
+def test_infer_type():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Cast(mx.sym.FullyConnected(data, num_hidden=4,
+                                            name="fc"),
+                      dtype="bfloat16")
+    arg_types, out_types, _ = out.infer_type(data="float32")
+    assert out_types[0] == "bfloat16"
+    args = dict(zip(out.list_arguments(), arg_types))
+    assert args["fc_weight"] == "float32"
+
+
+def test_zero_wildcard_dim():
+    """Dim 0 is the 'infer me' wildcard (reference TShape convention,
+    e.g. RNN begin_state zeros of shape (0, H))."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.elemwise_add(a, mx.sym.zeros(shape=(0, 4)))
+    arg_shapes, out_shapes, _ = b.infer_shape(a=(3, 4))
+    assert out_shapes[0] == (3, 4)

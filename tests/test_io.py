@@ -1,0 +1,141 @@
+"""Data IO tests (reference tests/python/unittest/test_io.py +
+test_recordio.py)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import recordio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter_pad_and_shuffle():
+    X = np.arange(50).reshape(10, 5).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=4, shuffle=False,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    seen = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(seen[:10].astype(int).tolist()) == set(range(10))
+    it2 = mx.io.NDArrayIter(X, y, batch_size=5, shuffle=True)
+    lab = np.concatenate([b.label[0].asnumpy() for b in it2])
+    assert sorted(lab.astype(int).tolist()) == list(range(10))
+
+
+def test_ndarray_iter_dict_data():
+    it = mx.io.NDArrayIter({"a": np.zeros((6, 2), np.float32),
+                            "b": np.ones((6, 3), np.float32)},
+                           np.arange(6, dtype=np.float32), batch_size=3)
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ["a", "b"]
+    b0 = next(iter(it))
+    assert b0.data[0].shape in ((3, 2), (3, 3))
+
+
+def test_csv_iter(tmp_path):
+    path = tmp_path / "d.csv"
+    rs = np.random.RandomState(0)
+    arr = rs.uniform(0, 1, (20, 4)).astype(np.float32)
+    np.savetxt(path, arr, delimiter=",", fmt="%.6f")
+    lpath = tmp_path / "l.csv"
+    labs = np.arange(20, dtype=np.float32)
+    np.savetxt(lpath, labs, delimiter=",", fmt="%.1f")
+    it = mx.io.CSVIter(data_csv=str(path), data_shape=(4,),
+                       label_csv=str(lpath), batch_size=5)
+    batches = list(it)
+    assert len(batches) == 4
+    got = np.concatenate([b.data[0].asnumpy() for b in batches])
+    assert_almost_equal(got, arr, rtol=1e-4, atol=1e-5)
+
+
+def _write_mnist(tmp_path, n=32):
+    rs = np.random.RandomState(0)
+    imgs = rs.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+    labs = rs.randint(0, 10, (n,)).astype(np.uint8)
+    ipath = tmp_path / "train-images-idx3-ubyte"
+    lpath = tmp_path / "train-labels-idx1-ubyte"
+    with open(ipath, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lpath, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labs.tobytes())
+    return str(ipath), str(lpath), imgs, labs
+
+
+def test_mnist_iter(tmp_path):
+    ipath, lpath, imgs, labs = _write_mnist(tmp_path)
+    it = mx.io.MNISTIter(image=ipath, label=lpath, batch_size=8,
+                         shuffle=False, flat=False)
+    batches = list(it)
+    assert len(batches) == 4
+    got_lab = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert np.array_equal(got_lab.astype(np.uint8), labs)
+    got0 = batches[0].data[0].asnumpy()
+    assert got0.shape == (8, 1, 28, 28)
+    assert_almost_equal(got0[0, 0], imgs[0].astype(np.float32) / 255.0,
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(b"record%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == b"record%d" % i
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(5):
+        w.write_idx(i * 10, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.read_idx(30) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    assert sorted(r.keys) == [0, 10, 20, 30, 40]
+    r.close()
+
+
+def test_pack_unpack_header():
+    label = np.array([1.0, 2.5], dtype=np.float32)
+    h = recordio.IRHeader(0, label, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert_almost_equal(h2.label, label)
+    assert h2.id == 7
+    # scalar label roundtrip
+    s = recordio.pack(recordio.IRHeader(0, 3.0, 9, 0), b"x")
+    h3, p3 = recordio.unpack(s)
+    assert h3.label == 3.0 and h3.id == 9 and p3 == b"x"
+
+
+def test_resize_iter():
+    X = np.zeros((20, 2), np.float32)
+    it = mx.io.NDArrayIter(X, np.arange(20, dtype=np.float32),
+                           batch_size=4)
+    rit = mx.io.ResizeIter(it, 2)
+    assert len(list(rit)) == 2
+    rit.reset()
+    assert len(list(rit)) == 2
+
+
+def test_prefetching_iter():
+    X = np.random.RandomState(0).randn(16, 3).astype(np.float32)
+    it = mx.io.NDArrayIter(X, np.arange(16, dtype=np.float32),
+                           batch_size=4)
+    pit = mx.io.PrefetchingIter(it)
+    labs = np.concatenate([b.label[0].asnumpy() for b in pit])
+    assert sorted(labs.astype(int).tolist()) == list(range(16))

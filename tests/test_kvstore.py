@@ -1,0 +1,89 @@
+"""Single-process kvstore tests (reference tests/python/unittest/
+test_kvstore.py: init/push/pull aggregation with N fake devices)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kv_type="local"):
+    kv = mx.create_kvstore(kv_type)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = _init_kv()
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE))
+
+
+def test_aggregator():
+    """4 'devices' push to one key -> values sum (reference :50)."""
+    kv = _init_kv("device")
+    num_devs = 4
+    vals = [mx.nd.ones(SHAPE) for _ in range(num_devs)]
+    kv.push(3, vals)
+    outs = [mx.nd.empty(SHAPE) for _ in range(num_devs)]
+    kv.pull(3, out=outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.full(SHAPE, num_devs))
+    # list keys
+    kv.push(KEYS, [[mx.nd.ones(SHAPE) * 2.0] * num_devs] * len(KEYS))
+    outs = [[mx.nd.empty(SHAPE) for _ in range(num_devs)]
+            for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for row in outs:
+        for o in row:
+            assert_almost_equal(o.asnumpy(), np.full(SHAPE, 2.0 * num_devs))
+
+
+def test_updater():
+    """Custom updater runs on merged push values (reference :77)."""
+    kv = _init_kv()
+    updates = []
+
+    def updater(key, recv, stored):
+        updates.append(key)
+        stored += recv * 2.0
+
+    kv.set_updater(updater)
+    kv.push(3, [mx.nd.ones(SHAPE)] * 4)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert updates == [3]
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 8.0))
+
+
+def test_get_type_and_rank():
+    kv = mx.create_kvstore("local")
+    assert kv.type == "local"
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    assert kv.get_num_dead_node(0) == 0
+
+
+def test_set_optimizer_runs_updates():
+    kv = _init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, wd=0.0,
+                                      rescale_grad=1.0))
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, -0.1), rtol=1e-5)
+
+
+def test_optimizer_state_save_load(tmp_path):
+    kv = _init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(3, mx.nd.ones(SHAPE))
+    f = str(tmp_path / "states")
+    kv.save_optimizer_states(f)
+    kv.load_optimizer_states(f)
+    kv.push(3, mx.nd.ones(SHAPE))  # must keep working after reload

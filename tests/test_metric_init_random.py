@@ -1,0 +1,158 @@
+"""Metrics, initializers, RNG (reference test_metric-ish coverage in
+test_operator.py, test_init.py, test_random.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+def test_accuracy_and_topk():
+    preds = [mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])]
+    labels = [mx.nd.array([1.0, 0.0, 0.0])]
+    acc = mx.metric.create("acc")
+    acc.update(labels, preds)
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+    topk = mx.metric.create("top_k_accuracy", top_k=2)
+    topk.update(labels, preds)
+    assert topk.get()[1] == 1.0
+
+
+def test_mse_mae_rmse():
+    preds = [mx.nd.array([[1.0], [2.0]])]
+    labels = [mx.nd.array([[0.0], [4.0]])]
+    for name, expect in [("mse", (1 + 4) / 2.0),
+                         ("mae", (1 + 2) / 2.0),
+                         ("rmse", np.sqrt((1 + 4) / 2.0))]:
+        m = mx.metric.create(name)
+        m.update(labels, preds)
+        assert abs(m.get()[1] - expect) < 1e-6, name
+
+
+def test_f1():
+    preds = [mx.nd.array([[0.3, 0.7], [0.8, 0.2], [0.4, 0.6]])]
+    labels = [mx.nd.array([1.0, 0.0, 0.0])]
+    f1 = mx.metric.create("f1")
+    f1.update(labels, preds)
+    # tp=1 fp=1 fn=0 -> precision .5 recall 1 -> f1 = 2/3
+    assert abs(f1.get()[1] - 2.0 / 3) < 1e-6
+
+
+def test_perplexity_ignores_label():
+    probs = np.array([[0.5, 0.5], [0.9, 0.1]], dtype=np.float32)
+    m = mx.metric.Perplexity(ignore_label=0)
+    m.update([mx.nd.array([1.0, 0.0])], [mx.nd.array(probs)])
+    # only row 0 counts: ppl = exp(-log(0.5))
+    assert abs(m.get()[1] - 2.0) < 1e-5
+
+
+def test_custom_metric_and_composite():
+    def fmin(label, pred):
+        return float(np.min(pred))
+
+    cm = mx.metric.CustomMetric(fmin, name="fmin")
+    cm.update([mx.nd.array([0.0])], [mx.nd.array([[0.25, 0.75]])])
+    assert abs(cm.get()[1] - 0.25) < 1e-6
+    comp = mx.metric.CompositeEvalMetric(metrics=[mx.metric.create("acc"),
+                                                  mx.metric.create("mse")])
+    comp.update([mx.nd.array([1.0])], [mx.nd.array([[0.2, 0.8]])])
+    names, vals = comp.get()
+    assert len(names) == 2
+
+
+def test_cross_entropy_metric():
+    probs = np.array([[0.25, 0.75]], dtype=np.float32)
+    ce = mx.metric.create("ce")
+    ce.update([mx.nd.array([1.0])], [mx.nd.array(probs)])
+    assert abs(ce.get()[1] + np.log(0.75)) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+def _init_arr(init, name="fc_weight", shape=(64, 32)):
+    arr = mx.nd.zeros(shape)
+    desc = mx.initializer.InitDesc(name)
+    init(desc, arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert _init_arr(mx.initializer.Zero()).sum() == 0
+    assert (_init_arr(mx.initializer.One()) == 1).all()
+    assert (_init_arr(mx.initializer.Constant(2.5)) == 2.5).all()
+
+
+def test_uniform_normal_ranges():
+    u = _init_arr(mx.initializer.Uniform(0.3))
+    assert np.abs(u).max() <= 0.3 and np.abs(u).std() > 0
+    n = _init_arr(mx.initializer.Normal(2.0), shape=(200, 100))
+    assert 1.8 < n.std() < 2.2
+
+
+def test_xavier_magnitude():
+    x = _init_arr(mx.initializer.Xavier(rnd_type="uniform",
+                                        factor_type="avg", magnitude=3),
+                  shape=(100, 50))
+    bound = np.sqrt(3.0 / ((100 + 50) / 2))
+    assert np.abs(x).max() <= bound + 1e-6
+
+
+def test_orthogonal():
+    # scale=1 => orthonormal rows (default 1.414 scales the basis)
+    o = _init_arr(mx.initializer.Orthogonal(scale=1.0), shape=(32, 32))
+    eye = o @ o.T
+    assert_almost_equal(eye, np.eye(32), rtol=1e-3, atol=1e-3)
+
+
+def test_bilinear_upsample_kernel():
+    b = _init_arr(mx.initializer.Bilinear(), name="upsample_weight",
+                  shape=(1, 1, 4, 4))
+    assert abs(b[0, 0, 1, 1] - 0.5625) < 1e-6  # classic bilinear value
+
+
+def test_default_rules():
+    """bias->zero, gamma->one, moving_var->one (reference
+    Initializer.__call__ dispatch)."""
+    init = mx.initializer.Uniform(5.0)
+    bias = mx.nd.ones((4,)) * 9
+    init(mx.initializer.InitDesc("fc_bias"), bias)
+    assert (bias.asnumpy() == 0).all()
+    gamma = mx.nd.zeros((4,))
+    init(mx.initializer.InitDesc("bn_gamma"), gamma)
+    assert (gamma.asnumpy() == 1).all()
+
+
+def test_mixed_initializer():
+    init = mx.initializer.Mixed([".*bias", ".*"],
+                                [mx.initializer.Zero(),
+                                 mx.initializer.Uniform(0.1)])
+    b = mx.nd.ones((4,))
+    init(mx.initializer.InitDesc("fc_bias"), b)
+    assert (b.asnumpy() == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# RNG
+# ---------------------------------------------------------------------------
+def test_seed_determinism():
+    mx.random.seed(128)
+    a = mx.nd.uniform(low=0, high=1, shape=(10,)).asnumpy()
+    mx.random.seed(128)
+    b = mx.nd.uniform(low=0, high=1, shape=(10,)).asnumpy()
+    assert_almost_equal(a, b)
+    mx.random.seed(129)
+    c = mx.nd.uniform(low=0, high=1, shape=(10,)).asnumpy()
+    assert np.abs(a - c).max() > 0
+
+
+def test_distribution_moments():
+    mx.random.seed(7)
+    n = mx.nd.normal(loc=1.0, scale=2.0, shape=(100000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.05 and abs(n.std() - 2.0) < 0.05
+    u = mx.nd.uniform(low=-1, high=3, shape=(100000,)).asnumpy()
+    assert abs(u.mean() - 1.0) < 0.05
+    assert u.min() >= -1 and u.max() <= 3

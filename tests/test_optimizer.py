@@ -1,0 +1,151 @@
+"""Optimizer tests: fused update ops vs numpy reference math
+(reference tests/python/unittest/test_optimizer.py compares python
+optimizer vs the fused sgd/adam update kernels)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (7, 9)
+
+
+def _setup(seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.uniform(-1, 1, SHAPE).astype(np.float32)
+    g = rs.uniform(-1, 1, SHAPE).astype(np.float32)
+    return w, g
+
+
+def _run(opt, w, g, steps=3):
+    weight = mx.nd.array(w)
+    grad = mx.nd.array(g)
+    state = opt.create_state(0, weight)
+    for _ in range(steps):
+        opt.update(0, weight, grad, state)
+    return weight.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w, g = _setup()
+    lr, wd, mom, rescale = 0.1, 0.01, 0.9, 0.5
+    out = _run(mx.optimizer.SGD(learning_rate=lr, wd=wd, momentum=mom,
+                                rescale_grad=rescale), w, g)
+    wn = w.copy()
+    m = np.zeros_like(w)
+    for _ in range(3):
+        gn = rescale * g + wd * wn
+        m = mom * m - lr * gn
+        wn = wn + m
+    assert_almost_equal(out, wn, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_clip_gradient():
+    w, g = _setup(1)
+    lr, clip = 0.1, 0.2
+    out = _run(mx.optimizer.SGD(learning_rate=lr, wd=0.0,
+                                clip_gradient=clip, rescale_grad=1.0),
+               w, g, steps=1)
+    wn = w - lr * np.clip(g, -clip, clip)
+    assert_almost_equal(out, wn, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w, g = _setup(2)
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    out = _run(mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                                 epsilon=eps, wd=0.0, rescale_grad=1.0),
+               w, g)
+    wn = w.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in range(1, 4):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        wn = wn - lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(out, wn, rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop_matches_numpy():
+    w, g = _setup(3)
+    lr, gamma1, eps = 0.01, 0.9, 1e-8
+    out = _run(mx.optimizer.RMSProp(learning_rate=lr, gamma1=gamma1,
+                                    epsilon=eps, wd=0.0, rescale_grad=1.0,
+                                    centered=False), w, g, steps=2)
+    wn = w.copy()
+    n = np.zeros_like(w)
+    for _ in range(2):
+        n = (1 - gamma1) * g * g + gamma1 * n
+        wn = wn - lr * g / np.sqrt(n + eps)
+    assert_almost_equal(out, wn, rtol=1e-4, atol=1e-6)
+
+
+def test_adagrad_matches_numpy():
+    w, g = _setup(4)
+    lr, eps = 0.1, 1e-7
+    out = _run(mx.optimizer.AdaGrad(learning_rate=lr, eps=eps, wd=0.0,
+                                    rescale_grad=1.0), w, g, steps=2)
+    wn = w.copy()
+    h = np.zeros_like(w)
+    for _ in range(2):
+        h += g * g
+        wn = wn - lr * g / (np.sqrt(h) + eps)
+    assert_almost_equal(out, wn, rtol=1e-4, atol=1e-6)
+
+
+def test_lr_wd_mult():
+    """__lr_mult__/__wd_mult__ symbol attrs scale per-parameter lr/wd
+    (reference optimizer.py set_lr_mult via param attrs)."""
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.0, rescale_grad=1.0,
+                           param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    opt.set_lr_mult({"fc_weight": 0.5})
+    w, g = _setup(5)
+    w0 = mx.nd.array(w)
+    opt.update(0, w0, mx.nd.array(g), opt.create_state(0, w0))
+    w1 = mx.nd.array(w)
+    opt.update(1, w1, mx.nd.array(g), opt.create_state(1, w1))
+    assert_almost_equal(w0.asnumpy(), w - 0.05 * g, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(w1.asnumpy(), w - 0.1 * g, rtol=1e-5, atol=1e-6)
+
+
+def test_lr_scheduler():
+    # reference semantics: lr drops when num_update EXCEEDS the step
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(1) == 1.0
+    assert sched(2) == 1.0
+    assert sched(3) == 0.5
+    assert sched(5) == 0.25
+    msched = mx.lr_scheduler.MultiFactorScheduler(step=[2, 4], factor=0.1)
+    msched.base_lr = 1.0
+    assert msched(1) == 1.0
+    assert abs(msched(3) - 0.1) < 1e-12
+    assert abs(msched(5) - 0.01) < 1e-12
+
+
+def test_updater_states_pickle_roundtrip():
+    # SGD-momentum: the whole update state lives in the updater states
+    # blob (Adam's bias-correction step count is optimizer-side, as in
+    # the reference)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w, g = _setup(6)
+    weight = mx.nd.array(w)
+    upd(0, mx.nd.array(g), weight)
+    blob = upd.get_states()
+    upd2 = mx.optimizer.get_updater(mx.optimizer.SGD(learning_rate=0.1,
+                                                     momentum=0.9))
+    upd2.set_states(blob)
+    w2 = mx.nd.array(weight.asnumpy())
+    upd(0, mx.nd.array(g), weight)
+    upd2(0, mx.nd.array(g), w2)
+    assert_almost_equal(weight.asnumpy(), w2.asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_create_registry():
+    for name in ("sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
+                 "nag", "sgld", "dcasgd"):
+        opt = mx.optimizer.create(name, learning_rate=0.1)
+        assert isinstance(opt, mx.optimizer.Optimizer), name
