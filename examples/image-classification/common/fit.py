@@ -118,7 +118,9 @@ def fit(args, network, data_loader, **kwargs):
 
     lr, lr_scheduler = _get_lr_scheduler(args, kv)
 
-    model = mx.Module(context=devs, symbol=network)
+    model = mx.Module(context=devs, symbol=network,
+                      compute_dtype=("bfloat16" if args.dtype == "bfloat16"
+                                     else None))
 
     optimizer_params = {
         "learning_rate": lr,

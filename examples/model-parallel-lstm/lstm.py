@@ -80,10 +80,14 @@ def main():
     sym = lstm_unroll(args.num_layers, args.seq_len, args.num_hidden,
                       args.num_embed, args.vocab_size, args.group_size)
 
-    # one Context per layer group; with one real chip these all map to it,
-    # on a mesh each group lands on its own device (PlaceDevice ≡ sharding)
+    # one Context per layer group: each group lands on its own device when
+    # several exist (the executor stage-splits the graph and inserts
+    # cross-device copies at cut edges); with one chip they all map to it
     ngroups = (args.num_layers + args.group_size - 1) // args.group_size
-    group2ctx = {"layer%d" % i: mx.current_context() for i in range(ngroups)}
+    ndev = mx.context.num_devices(mx.current_context().device_type)
+    ctx_type = mx.current_context().device_type
+    group2ctx = {"layer%d" % i: mx.Context(ctx_type, i % ndev)
+                 for i in range(ngroups)}
 
     ex = sym.simple_bind(mx.current_context(), grad_req="write",
                          group2ctx=group2ctx,

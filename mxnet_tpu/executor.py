@@ -55,6 +55,23 @@ def shape_overrides(symbol, known_shapes):
     return overrides
 
 
+class _Segment:
+    """One contiguous same-device run of ops (ctx_group staged execution —
+    the unit that replaces the reference's per-device engine streams)."""
+
+    __slots__ = ("device", "nodes", "in_keys", "out_keys", "aux_idx",
+                 "jit_fwd", "jit_bwd")
+
+    def __init__(self, device, nodes, in_keys, out_keys, aux_idx):
+        self.device = device
+        self.nodes = nodes          # [(global_topo_idx, node)]
+        self.in_keys = in_keys      # value keys consumed from outside
+        self.out_keys = out_keys    # value keys visible outside
+        self.aux_idx = aux_idx      # aux array indices updated here
+        self.jit_fwd = None
+        self.jit_bwd = None
+
+
 class Executor:
     def __init__(self, symbol, ctx, args, grads, reqs, aux, group2ctx=None,
                  shared_exec=None):
@@ -86,6 +103,14 @@ class Executor:
         self._attr_overrides = shape_overrides(
             symbol, {n: a.shape for n, a in zip(self._arg_names,
                                                 self.arg_arrays)})
+        # ctx_group model parallelism (reference AssignContext →
+        # PlaceDevice → _CrossDeviceCopy splicing,
+        # graph_executor.cc:242-331): ops whose __ctx_group__ maps to
+        # distinct devices run as per-device compiled segments with
+        # explicit device_put transfers at cut edges
+        self._stage_plan = self._build_stage_plan()
+        if self._stage_plan is not None:
+            self._place_arrays()
         self._compile()
 
         # placeholder outputs carry the inferred shapes so output_shapes is
@@ -99,6 +124,7 @@ class Executor:
         self.outputs = [NDArray(jnp.zeros(tuple(s) if s else ()))
                         for s in out_shapes]
         self._last_state = None
+        self._last_staged = None
 
     # ------------------------------------------------------------------
     def _build_maps(self):
@@ -117,31 +143,37 @@ class Executor:
                     self._var_map[id(node)] = ("arg", arg_order[node.name])
         self._head = [(id(n), oi) for n, oi in symbol._outputs]
 
+    def _eval_node(self, node, idx, vals, is_train, rng):
+        """Apply one op node given the value environment; returns
+        (outputs, aux_updates).  ``idx`` is the node's global topo index —
+        the RNG fold key, so staged and single-program execution produce
+        identical randomness."""
+        remat = get_env("MXNET_BACKWARD_DO_MIRROR")
+        ins = [vals[(id(n), oi)] for n, oi in node.arg_inputs()]
+        aux_in = tuple(vals[(id(n), oi)] for n, oi in node.aux_inputs())
+        need_rng = node.op.needs_rng or node.op.stateful
+        r = jax.random.fold_in(rng, idx) if (need_rng and
+                                             rng is not None) else None
+        attrs = self._attr_overrides.get(id(node), node.attrs)
+        if remat and not node.op.stateful and not node.op.needs_rng:
+            outs = jax.checkpoint(
+                functools.partial(_apply_pure, node, attrs))(*ins)
+            upd = ()
+        else:
+            outs, upd = node.op.apply(attrs, ins, aux_in, is_train, r)
+        return outs, upd
+
     def _trace(self, arg_vals, aux_vals, is_train, rng, tap=None):
         """Pure traced evaluation of the DAG."""
         vals = {}
         new_aux = list(aux_vals)
-        remat = get_env("MXNET_BACKWARD_DO_MIRROR")
         for idx, node in enumerate(self._nodes):
             if node.is_variable:
                 kind, i = self._var_map[id(node)]
                 vals[(id(node), 0)] = (arg_vals[i] if kind == "arg"
                                        else aux_vals[i])
                 continue
-            ins = [vals[(n_id, oi)] for n_id, oi in
-                   ((id(n), oi) for n, oi in node.arg_inputs())]
-            aux_in = tuple(vals[(id(n), oi)] for n, oi in node.aux_inputs())
-            need_rng = node.op.needs_rng or node.op.stateful
-            r = jax.random.fold_in(rng, idx) if (need_rng and
-                                                 rng is not None) else None
-            attrs = self._attr_overrides.get(id(node), node.attrs)
-            if remat and not node.op.stateful and not node.op.needs_rng:
-                outs = jax.checkpoint(
-                    functools.partial(_apply_pure, node, attrs))(*ins)
-                upd = ()
-            else:
-                outs, upd = node.op.apply(attrs, ins, aux_in,
-                                          is_train, r)
+            outs, upd = self._eval_node(node, idx, vals, is_train, rng)
             for oi, o in enumerate(outs):
                 vals[(id(node), oi)] = o
             for (an, _), u in zip(node.aux_inputs(), upd):
@@ -150,6 +182,212 @@ class Executor:
                 tap(node, outs)
         outputs = tuple(vals[k] for k in self._head)
         return outputs, tuple(new_aux)
+
+    # -- ctx_group staged execution ------------------------------------
+    def _build_stage_plan(self):
+        """Partition the DAG into per-device compiled segments when
+        group2ctx maps ctx groups to ≥2 distinct devices.
+
+        Reference: ``AssignContext`` runs nnvm PlaceDevice keyed on the
+        ``__ctx_group__`` attr and splices ``_CrossDeviceCopy`` at cut
+        edges (graph_executor.cc:242-331, src/operator/cross_device_copy.cc).
+        Here each maximal same-device run of ops becomes one jit-compiled
+        program pinned to its device; cut edges become explicit async
+        ``jax.device_put`` transfers, and the per-segment dispatch pipeline
+        plays the role of the reference's async engine overlap."""
+        if not self._group2ctx:
+            return None
+        try:
+            dev_of_group = {g: c.jax_device()
+                            for g, c in self._group2ctx.items()}
+        except MXNetError:
+            return None
+        default_dev = self._ctx.jax_device()
+        node_dev = {}
+        for node in self._nodes:
+            if node.is_variable:
+                continue
+            grp = node.extra_attrs.get("__ctx_group__")
+            node_dev[id(node)] = dev_of_group.get(grp, default_dev)
+        if len(set(node_dev.values())) < 2:
+            return None
+
+        # variables live where their first consumer runs (AssignContext
+        # assigns inputs to the consuming op's device)
+        var_dev = {}
+        for node in self._nodes:
+            if node.is_variable:
+                continue
+            d = node_dev[id(node)]
+            for n, _ in node.inputs:
+                if n.is_variable and id(n) not in var_dev:
+                    var_dev[id(n)] = d
+        for node in self._nodes:
+            if node.is_variable and id(node) not in var_dev:
+                var_dev[id(node)] = default_dev
+
+        # maximal contiguous same-device runs (topo order)
+        segments = []
+        cur_dev = None
+        for idx, node in enumerate(self._nodes):
+            if node.is_variable:
+                continue
+            d = node_dev[id(node)]
+            if cur_dev is None or d != cur_dev:
+                segments.append({"device": d, "nodes": []})
+                cur_dev = d
+            segments[-1]["nodes"].append((idx, node))
+
+        # consumers of each value key, for out_keys
+        consumed_by = {}   # key -> set of segment indices (or "head")
+        seg_of_node = {}
+        for si, seg in enumerate(segments):
+            for _, node in seg["nodes"]:
+                seg_of_node[id(node)] = si
+        for si, seg in enumerate(segments):
+            for _, node in seg["nodes"]:
+                for n, oi in node.inputs:
+                    key = (id(n), oi)
+                    consumed_by.setdefault(key, set()).add(si)
+        for key in self._head:
+            consumed_by.setdefault(key, set()).add("head")
+
+        plan = []
+        for si, seg in enumerate(segments):
+            internal = {id(n) for _, n in seg["nodes"]}
+            in_keys, seen = [], set()
+            for _, node in seg["nodes"]:
+                for n, oi in node.inputs:
+                    key = (id(n), oi)
+                    if id(n) in internal:
+                        continue
+                    if key not in seen:
+                        seen.add(key)
+                        in_keys.append(key)
+            out_keys = []
+            aux_idx = []
+            for _, node in seg["nodes"]:
+                n_out = len(node.op.outputs(node.attrs))
+                for oi in range(n_out):
+                    key = (id(node), oi)
+                    users = consumed_by.get(key, set())
+                    if "head" in users or any(u != si for u in users
+                                              if u != "head"):
+                        out_keys.append(key)
+                for an, _ in node.aux_inputs():
+                    ai = self._var_map[id(an)][1]
+                    if ai not in aux_idx:
+                        aux_idx.append(ai)
+            plan.append(_Segment(seg["device"], seg["nodes"], in_keys,
+                                 out_keys, aux_idx))
+        self._var_dev = var_dev
+        for seg in plan:
+            self._compile_segment(seg)
+        return plan
+
+    def _compile_segment(self, seg):
+        eval_node = self._eval_node
+        var_map = self._var_map
+
+        def seg_trace(ins, rng, is_train):
+            vals = dict(zip(seg.in_keys, ins))
+            aux_upd = {}
+            for idx, node in seg.nodes:
+                outs, upd = eval_node(node, idx, vals, is_train, rng)
+                for oi, o in enumerate(outs):
+                    vals[(id(node), oi)] = o
+                for (an, _), u in zip(node.aux_inputs(), upd):
+                    aux_upd[var_map[id(an)][1]] = u
+            return (tuple(vals[k] for k in seg.out_keys),
+                    tuple(aux_upd.get(ai) for ai in seg.aux_idx))
+
+        def seg_bwd(ins, rng, cots):
+            def f(ins_):
+                return seg_trace(ins_, rng, True)
+            outs, vjp, auxu = jax.vjp(f, ins, has_aux=True)
+            in_grads = vjp(cots)[0]
+            return outs, auxu, in_grads
+
+        seg.jit_fwd = jax.jit(seg_trace, static_argnums=(2,))
+        seg.jit_bwd = jax.jit(seg_bwd)
+
+    def _place_arrays(self):
+        """Commit arg/grad/aux arrays to their assigned devices (the
+        reference allocates bound arrays on their AssignContext device)."""
+        id_of_arg = {}
+        for node in self._nodes:
+            if node.is_variable:
+                kind, i = self._var_map[id(node)]
+                id_of_arg[(kind, i)] = id(node)
+        self._arg_devs = []
+        for i, arr in enumerate(self.arg_arrays):
+            dev = self._var_dev.get(id_of_arg.get(("arg", i)),
+                                    self._ctx.jax_device())
+            self._arg_devs.append(dev)
+            arr._data = jax.device_put(arr._data, dev)
+            if self.grad_arrays[i] is not None:
+                self.grad_arrays[i]._data = jax.device_put(
+                    self.grad_arrays[i]._data, dev)
+        for i, arr in enumerate(self.aux_arrays):
+            dev = self._var_dev.get(id_of_arg.get(("aux", i)),
+                                    self._ctx.jax_device())
+            arr._data = jax.device_put(arr._data, dev)
+
+    def _staged_forward(self, arg_vals, aux_vals, rng, is_train):
+        env = {}
+        for node in self._nodes:
+            if node.is_variable:
+                kind, i = self._var_map[id(node)]
+                env[(id(node), 0)] = (arg_vals[i] if kind == "arg"
+                                     else aux_vals[i])
+        new_aux = list(aux_vals)
+        saved = []
+        for seg in self._stage_plan:
+            ins = tuple(jax.device_put(env[k], seg.device)
+                        for k in seg.in_keys)
+            saved.append(ins)
+            outs, auxu = seg.jit_fwd(ins, rng, bool(is_train))
+            for k, v in zip(seg.out_keys, outs):
+                env[k] = v
+            for ai, v in zip(seg.aux_idx, auxu):
+                if v is not None:
+                    new_aux[ai] = v
+        outputs = tuple(env[k] for k in self._head)
+        return outputs, tuple(new_aux), saved, env
+
+    def _staged_backward(self, saved, env, rng, ograds):
+        cot = {}
+        for k, og in zip(self._head, ograds):
+            base = jnp.ones_like(env[k]) if og is None else og
+            cot[k] = cot[k] + base if k in cot else base
+        id2arg = {}
+        for node in self._nodes:
+            if node.is_variable:
+                id2arg[id(node)] = self._var_map[id(node)]
+        arg_grads = {}
+        for seg, ins in zip(reversed(self._stage_plan), reversed(saved)):
+            cots = tuple(
+                jax.device_put(cot[k] if k in cot
+                               else jnp.zeros_like(env[k]), seg.device)
+                for k in seg.out_keys)
+            _, _, in_grads = seg.jit_bwd(ins, rng, cots)
+            for k, g in zip(seg.in_keys, in_grads):
+                if g is None or g.dtype == jax.dtypes.float0:
+                    continue
+                info = id2arg.get(k[0])
+                if info is not None and info[0] == "aux":
+                    continue
+                if k in cot:
+                    cot[k] = cot[k] + jax.device_put(
+                        g, next(iter(cot[k].devices())))
+                else:
+                    cot[k] = g
+        for node in self._nodes:
+            if node.is_variable:
+                kind, i = self._var_map[id(node)]
+                if kind == "arg" and (id(node), 0) in cot:
+                    arg_grads[i] = cot[(id(node), 0)]
+        return arg_grads
 
     def _compile(self):
         trace = self._trace
@@ -190,14 +428,24 @@ class Executor:
             if k not in self._arg_names:
                 raise MXNetError("unknown argument %r in forward" % k)
             i = self._arg_names.index(k)
+            dev = (self._arg_devs[i] if self._stage_plan is not None
+                   else self._ctx.jax_device())
             self.arg_arrays[i]._data = jax.device_put(
-                v._data if isinstance(v, NDArray) else jnp.asarray(v),
-                self._ctx.jax_device())
+                v._data if isinstance(v, NDArray) else jnp.asarray(v), dev)
         arg_vals, aux_vals = self._gather()
         rng = _random.next_key()
         if self._monitor_cb is not None:
             outs, new_aux = self._forward_monitored(arg_vals, aux_vals,
                                                     is_train, rng)
+            if self._stage_plan is not None and is_train:
+                # staged backward will recompute saved inputs from
+                # _last_state (monitored forward has no segment record)
+                self._last_staged = None
+        elif self._stage_plan is not None:
+            outs, new_aux, saved, env = self._staged_forward(
+                arg_vals, aux_vals, rng, is_train)
+            if is_train:
+                self._last_staged = (saved, env, rng)
         else:
             outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng,
                                           bool(is_train))
@@ -212,6 +460,12 @@ class Executor:
     def _forward_monitored(self, arg_vals, aux_vals, is_train, rng):
         """Eager forward that reports every op output to the monitor callback
         (reference graph_executor.cc:758-778 monitor install)."""
+        if self._stage_plan is not None:
+            # monitor is a debug path: gather everything onto the default
+            # device so the eager trace never mixes committed devices
+            dev = self._ctx.jax_device()
+            arg_vals = tuple(jax.device_put(v, dev) for v in arg_vals)
+            aux_vals = tuple(jax.device_put(v, dev) for v in aux_vals)
         records = []
 
         def tap(node, outs):
@@ -242,6 +496,8 @@ class Executor:
             ograds = tuple(g._data if isinstance(g, NDArray) else
                            (None if g is None else jnp.asarray(g))
                            for g in out_grads)
+        if self._stage_plan is not None:
+            return self._backward_staged(ograds)
         outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals, rng,
                                                  ograds)
         for o_nd, o in zip(self.outputs, outs):
@@ -258,8 +514,37 @@ class Executor:
                 gbuf._data = g
         return [self.grad_arrays[i] for i in self._diff_idx]
 
+    def _backward_staged(self, ograds):
+        """ctx_group backward: reverse sweep over the device segments,
+        cotangents crossing devices via device_put."""
+        if self._last_staged is None:
+            # monitored forward doesn't record segments; rebuild from the
+            # saved train-mode inputs
+            arg_vals, aux_vals, rng = self._last_state
+            _, _, saved, env = self._staged_forward(arg_vals, aux_vals,
+                                                    rng, True)
+            self._last_staged = (saved, env, rng)
+        saved, env, rng = self._last_staged
+        arg_grads = self._staged_backward(saved, env, rng, ograds)
+        for i in self._diff_idx:
+            g = arg_grads.get(i)
+            if g is None:
+                continue
+            name = self._arg_names[i]
+            req = self.grad_req.get(name, "write")
+            gbuf = self.grad_arrays[i]
+            g = jax.device_put(g, self._arg_devs[i])
+            if req == "add":
+                gbuf._data = gbuf._data + g
+            else:
+                gbuf._data = g
+        return [self.grad_arrays[i] for i in self._diff_idx]
+
     def forward_backward(self, out_grads=None, **kwargs):
         """Fused train step: one compiled program for forward+backward."""
+        if self._stage_plan is not None:
+            self.forward(is_train=True, **kwargs)
+            return self.backward(out_grads)
         self.forward_prepare(**kwargs)
         arg_vals, aux_vals = self._gather()
         rng = _random.next_key()

@@ -9,7 +9,7 @@ from .. import symbol as sym
 
 
 def residual_unit(data, num_filter, stride, dim_match, name,
-                  bottle_neck=True, bn_mom=0.9, workspace=256, memonger=False):
+                  bottle_neck=True, bn_mom=0.9):
     if bottle_neck:
         bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=2e-5,
                             momentum=bn_mom, name=name + "_bn1")
@@ -17,7 +17,7 @@ def residual_unit(data, num_filter, stride, dim_match, name,
                               name=name + "_relu1")
         conv1 = sym.Convolution(data=act1, num_filter=int(num_filter * 0.25),
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv1")
         bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=2e-5,
                             momentum=bn_mom, name=name + "_bn2")
@@ -25,7 +25,7 @@ def residual_unit(data, num_filter, stride, dim_match, name,
                               name=name + "_relu2")
         conv2 = sym.Convolution(data=act2, num_filter=int(num_filter * 0.25),
                                 kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv2")
         bn3 = sym.BatchNorm(data=conv2, fix_gamma=False, eps=2e-5,
                             momentum=bn_mom, name=name + "_bn3")
@@ -33,14 +33,14 @@ def residual_unit(data, num_filter, stride, dim_match, name,
                               name=name + "_relu3")
         conv3 = sym.Convolution(data=act3, num_filter=num_filter,
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv3")
         if dim_match:
             shortcut = data
         else:
             shortcut = sym.Convolution(data=act1, num_filter=num_filter,
                                        kernel=(1, 1), stride=stride,
-                                       no_bias=True, workspace=workspace,
+                                       no_bias=True,
                                        name=name + "_sc")
         return conv3 + shortcut
     else:
@@ -50,7 +50,7 @@ def residual_unit(data, num_filter, stride, dim_match, name,
                               name=name + "_relu1")
         conv1 = sym.Convolution(data=act1, num_filter=num_filter,
                                 kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv1")
         bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, momentum=bn_mom,
                             eps=2e-5, name=name + "_bn2")
@@ -58,20 +58,20 @@ def residual_unit(data, num_filter, stride, dim_match, name,
                               name=name + "_relu2")
         conv2 = sym.Convolution(data=act2, num_filter=num_filter,
                                 kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv2")
         if dim_match:
             shortcut = data
         else:
             shortcut = sym.Convolution(data=act1, num_filter=num_filter,
                                        kernel=(1, 1), stride=stride,
-                                       no_bias=True, workspace=workspace,
+                                       no_bias=True,
                                        name=name + "_sc")
         return conv2 + shortcut
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, workspace=256, memonger=False):
+           bottle_neck=True, bn_mom=0.9):
     num_unit = len(units)
     assert num_unit == num_stages
     data = sym.Variable(name="data")
@@ -81,13 +81,11 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
     if height <= 32:  # cifar
         body = sym.Convolution(data=data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, name="conv0",
-                               workspace=workspace)
+                               no_bias=True, name="conv0")
     else:  # imagenet
         body = sym.Convolution(data=data, num_filter=filter_list[0],
                                kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, name="conv0",
-                               workspace=workspace)
+                               no_bias=True, name="conv0")
         body = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
                              momentum=bn_mom, name="bn0")
         body = sym.Activation(data=body, act_type="relu", name="relu0")
@@ -98,13 +96,11 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = residual_unit(body, filter_list[i + 1],
                              (1 if i == 0 else 2, 1 if i == 0 else 2),
                              False, name="stage%d_unit%d" % (i + 1, 1),
-                             bottle_neck=bottle_neck, workspace=workspace,
-                             memonger=memonger)
+                             bottle_neck=bottle_neck)
         for j in range(units[i] - 1):
             body = residual_unit(body, filter_list[i + 1], (1, 1), True,
                                  name="stage%d_unit%d" % (i + 1, j + 2),
-                                 bottle_neck=bottle_neck,
-                                 workspace=workspace, memonger=memonger)
+                                 bottle_neck=bottle_neck)
     bn1 = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
                         momentum=bn_mom, name="bn1")
     relu1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
@@ -115,8 +111,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
     return sym.SoftmaxOutput(data=fc1, name="softmax")
 
 
-def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
-               conv_workspace=256, **kwargs):
+def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224", **kwargs):
     """Build a ResNet symbol (reference resnet.py get_symbol)."""
     if isinstance(image_shape, str):
         image_shape = [int(x) for x in image_shape.split(",")]
@@ -163,5 +158,4 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
 
     return resnet(units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,
-                  image_shape=image_shape, bottle_neck=bottle_neck,
-                  workspace=conv_workspace)
+                  image_shape=image_shape, bottle_neck=bottle_neck)

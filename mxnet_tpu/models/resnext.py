@@ -9,12 +9,11 @@ from .. import symbol as sym
 
 
 def residual_unit(data, num_filter, stride, dim_match, name,
-                  bottle_neck=True, num_group=32, bn_mom=0.9,
-                  workspace=256, memonger=False):
+                  bottle_neck=True, num_group=32, bn_mom=0.9):
     if bottle_neck:
         conv1 = sym.Convolution(data=data, num_filter=int(num_filter * 0.5),
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv1")
         bn1 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=2e-5,
                             momentum=bn_mom, name=name + "_bn1")
@@ -22,15 +21,14 @@ def residual_unit(data, num_filter, stride, dim_match, name,
                               name=name + "_relu1")
         conv2 = sym.Convolution(data=act1, num_filter=int(num_filter * 0.5),
                                 num_group=num_group, kernel=(3, 3),
-                                stride=stride, pad=(1, 1), no_bias=True,
-                                workspace=workspace, name=name + "_conv2")
+                                stride=stride, pad=(1, 1), no_bias=True, name=name + "_conv2")
         bn2 = sym.BatchNorm(data=conv2, fix_gamma=False, eps=2e-5,
                             momentum=bn_mom, name=name + "_bn2")
         act2 = sym.Activation(data=bn2, act_type="relu",
                               name=name + "_relu2")
         conv3 = sym.Convolution(data=act2, num_filter=num_filter,
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv3")
         bn3 = sym.BatchNorm(data=conv3, fix_gamma=False, eps=2e-5,
                             momentum=bn_mom, name=name + "_bn3")
@@ -38,18 +36,15 @@ def residual_unit(data, num_filter, stride, dim_match, name,
             shortcut = data
         else:
             sc = sym.Convolution(data=data, num_filter=num_filter,
-                                 kernel=(1, 1), stride=stride, no_bias=True,
-                                 workspace=workspace, name=name + "_sc")
+                                 kernel=(1, 1), stride=stride, no_bias=True, name=name + "_sc")
             shortcut = sym.BatchNorm(data=sc, fix_gamma=False, eps=2e-5,
                                      momentum=bn_mom, name=name + "_sc_bn")
-        if memonger:
-            shortcut._set_attr(mirror_stage="True")
         return sym.Activation(data=bn3 + shortcut, act_type="relu",
                               name=name + "_relu")
     else:
         conv1 = sym.Convolution(data=data, num_filter=num_filter,
                                 kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv1")
         bn1 = sym.BatchNorm(data=conv1, fix_gamma=False, momentum=bn_mom,
                             eps=2e-5, name=name + "_bn1")
@@ -57,7 +52,7 @@ def residual_unit(data, num_filter, stride, dim_match, name,
                               name=name + "_relu1")
         conv2 = sym.Convolution(data=act1, num_filter=num_filter,
                                 kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                                no_bias=True, workspace=workspace,
+                                no_bias=True,
                                 name=name + "_conv2")
         bn2 = sym.BatchNorm(data=conv2, fix_gamma=False, momentum=bn_mom,
                             eps=2e-5, name=name + "_bn2")
@@ -65,19 +60,15 @@ def residual_unit(data, num_filter, stride, dim_match, name,
             shortcut = data
         else:
             sc = sym.Convolution(data=data, num_filter=num_filter,
-                                 kernel=(1, 1), stride=stride, no_bias=True,
-                                 workspace=workspace, name=name + "_sc")
+                                 kernel=(1, 1), stride=stride, no_bias=True, name=name + "_sc")
             shortcut = sym.BatchNorm(data=sc, fix_gamma=False, eps=2e-5,
                                      momentum=bn_mom, name=name + "_sc_bn")
-        if memonger:
-            shortcut._set_attr(mirror_stage="True")
         return sym.Activation(data=bn2 + shortcut, act_type="relu",
                               name=name + "_relu")
 
 
 def resnext(units, num_stages, filter_list, num_classes, num_group,
-            image_shape, bottle_neck=True, bn_mom=0.9, workspace=256,
-            memonger=False):
+            image_shape, bottle_neck=True, bn_mom=0.9):
     assert len(units) == num_stages
     data = sym.Variable(name="data")
     data = sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5,
@@ -86,13 +77,11 @@ def resnext(units, num_stages, filter_list, num_classes, num_group,
     if height <= 32:
         body = sym.Convolution(data=data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, name="conv0",
-                               workspace=workspace)
+                               no_bias=True, name="conv0")
     else:
         body = sym.Convolution(data=data, num_filter=filter_list[0],
                                kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, name="conv0",
-                               workspace=workspace)
+                               no_bias=True, name="conv0")
         body = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
                              momentum=bn_mom, name="bn0")
         body = sym.Activation(data=body, act_type="relu", name="relu0")
@@ -104,14 +93,12 @@ def resnext(units, num_stages, filter_list, num_classes, num_group,
         body = residual_unit(body, filter_list[i + 1], stride, False,
                              name="stage%d_unit%d" % (i + 1, 1),
                              bottle_neck=bottle_neck, num_group=num_group,
-                             bn_mom=bn_mom, workspace=workspace,
-                             memonger=memonger)
+                             bn_mom=bn_mom)
         for j in range(units[i] - 1):
             body = residual_unit(body, filter_list[i + 1], (1, 1), True,
                                  name="stage%d_unit%d" % (i + 1, j + 2),
                                  bottle_neck=bottle_neck,
-                                 num_group=num_group, bn_mom=bn_mom,
-                                 workspace=workspace, memonger=memonger)
+                                 num_group=num_group, bn_mom=bn_mom)
     pool1 = sym.Pooling(data=body, global_pool=True, kernel=(7, 7),
                         pool_type="avg", name="pool1")
     flat = sym.Flatten(data=pool1)
@@ -120,7 +107,7 @@ def resnext(units, num_stages, filter_list, num_classes, num_group,
 
 
 def get_symbol(num_classes, num_layers, image_shape="3,224,224",
-               num_group=32, conv_workspace=256, **kwargs):
+               num_group=32, **kwargs):
     image_shape = [int(l) for l in image_shape.split(",")]
     (nchannel, height, width) = image_shape
     if height <= 32:
@@ -152,5 +139,4 @@ def get_symbol(num_classes, num_layers, image_shape="3,224,224",
                              % num_layers)
         units = units_map[num_layers]
     return resnext(units, num_stages, filter_list, num_classes, num_group,
-                   image_shape, bottle_neck=bottle_neck,
-                   workspace=conv_workspace)
+                   image_shape, bottle_neck=bottle_neck)

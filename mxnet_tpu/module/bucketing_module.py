@@ -116,6 +116,10 @@ class BucketingModule(BaseModule):
                         work_load_list=self._work_load_list,
                         fixed_param_names=self._fixed_param_names,
                         state_names=self._state_names)
+        # bucket modules share executor memory across shape variants; the
+        # fused single-program path doesn't apply (params must live in the
+        # shared executor pool)
+        module._fused_disabled = True
         module.bind(data_shapes, label_shapes, for_training,
                     inputs_need_grad, force_rebind=False,
                     shared_module=None, grad_req=grad_req)

@@ -23,12 +23,25 @@ from ..io.io import DataDesc
 
 
 def _split_input_slice(batch_size, work_load_list):
-    """Slice the batch by workload (reference executor_manager.py:14)."""
+    """Slice the batch by workload (reference executor_manager.py:14).
+
+    Floors per-device counts then distributes the remainder, so an
+    indivisible batch never produces an empty slice (the reference raises
+    'Too many slices' there; giving the first devices one extra row keeps
+    every executor non-empty)."""
     total = sum(work_load_list)
-    batch_num_list = [round(batch_size * w / total) for w in work_load_list]
-    # fix rounding drift
-    diff = batch_size - sum(batch_num_list)
-    batch_num_list[-1] += diff
+    exact = [batch_size * w / total for w in work_load_list]
+    batch_num_list = [int(e) for e in exact]
+    rem = batch_size - sum(batch_num_list)
+    by_frac = sorted(range(len(exact)),
+                     key=lambda i: exact[i] - batch_num_list[i],
+                     reverse=True)
+    for i in range(rem):
+        batch_num_list[by_frac[i]] += 1
+    if min(batch_num_list) == 0:
+        raise MXNetError(
+            "Too many slices: batch size %d cannot cover %d devices"
+            % (batch_size, len(work_load_list)))
     slices = []
     start = 0
     for n in batch_num_list:

@@ -5,10 +5,22 @@ DataParallelExecutorGroup, init_params with InitDesc dispatch,
 init_optimizer with kvstore routing (``_create_kvstore``), update via
 kvstore push/pull with layer-priority overlap (``model.py:88-118``),
 save/load_checkpoint with optimizer states.
+
+TPU fast path: when the training setup is expressible as one compiled XLA
+program — local/device kvstore semantics, ``grad_req='write'``, an
+optimizer with an in-graph equivalent, uniform workload — ``init_optimizer``
+routes ``fit``'s forward_backward/update through a fused
+``parallel.DataParallelTrainer`` step (forward+backward+psum+update in one
+program over the device mesh), which is what makes ``Module.fit`` hit the
+benchmark numbers.  Anything that needs per-op access (monitor, explicit
+``forward(is_train=True)``/``backward()``, shared bind, dist kvstore)
+keeps or falls back to full executor-group reference semantics.
 """
 from __future__ import annotations
 
 import logging
+import os
+import pickle
 
 from .. import ndarray as nd
 from .. import optimizer as opt
@@ -26,8 +38,15 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None,
-                 fixed_param_names=None, state_names=None):
+                 fixed_param_names=None, state_names=None,
+                 compute_dtype=None):
+        """``compute_dtype='bfloat16'`` (TPU extension) runs the fused
+        fast-path step in mixed precision: fp32 master weights and
+        optimizer state, bf16 MXU compute — the role the reference's
+        ``*_fp16`` symbol variants play on GPU.  Ignored on the
+        executor-group fallback path."""
         super().__init__(logger=logger)
+        self._compute_dtype = compute_dtype
         if context is None:
             context = [current_context()]
         if not isinstance(context, (list, tuple)):
@@ -71,6 +90,14 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+
+        self._fused = None
+        self._fused_disabled = False
+        self._fused_batch = None
+        self._fused_outputs = None
+        self._monitor = None
+        self._grad_req = "write"
+        self._kvstore_arg = None
 
     # -- checkpointing -----------------------------------------------------
     @staticmethod
@@ -116,7 +143,18 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._fused is not None:
+            # Updater.states pickle keyed by plain param index — the
+            # update_on_kvstore layout, which the fused path semantically
+            # is (one shared update per parameter).  Like the reference,
+            # files are not portable to the update_on_kvstore=False
+            # multi-device host-updater layout (index*num_device+k).
+            from ..optimizer import _state_to_host
+            states = {i: _state_to_host(v) for i, v in
+                      self._fused.get_updater_states().items()}
+            with open(fname, "wb") as fout:
+                fout.write(pickle.dumps(states))
+        elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
             with open(fname, "wb") as fout:
@@ -124,7 +162,10 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._fused is not None:
+            with open(fname, "rb") as f:
+                self._fused.set_updater_states(pickle.loads(f.read()))
+        elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as f:
@@ -206,6 +247,8 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params)
+        if self._fused is not None:
+            self._fused.set_params(self._arg_params, self._aux_params)
 
     def _param_shapes(self):
         ex0 = self._exec_group.execs[0]
@@ -218,6 +261,9 @@ class Module(BaseModule):
                 for name in self._aux_names}
 
     def _sync_params_from_devices(self):
+        if self._fused is not None:
+            self._sync_from_trainer(self._fused)
+            return
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
@@ -234,6 +280,10 @@ class Module(BaseModule):
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
+        self._grad_req = grad_req
+        if shared_module is not None:
+            # shared-memory bind (bucketing) keeps executor-group semantics
+            self._fused_disabled = True
 
         if not for_training:
             assert not inputs_need_grad
@@ -267,15 +317,39 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused = None
+        self._fused_batch = None
+        self._fused_outputs = None
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
+        if self._fused is not None:
+            self._sync_params_from_devices()
         self._data_shapes = [x if hasattr(x, "name") else _as_data_desc(x)
                              for x in data_shapes]
         self._label_shapes = [x if hasattr(x, "name") else _as_data_desc(x)
                               for x in (label_shapes or [])]
         self._exec_group.reshape(self._data_shapes, self._label_shapes)
         self._exec_group.set_params(self._arg_params, self._aux_params)
+        if self._fused is not None:
+            # rebuild the compiled step for the new shapes, carrying
+            # parameters and optimizer state over; if the new shapes no
+            # longer qualify (e.g. batch not divisible across contexts),
+            # fall back to full executor-group semantics
+            old = self._fused
+            trainer = None
+            batch = self._exec_group.batch_size
+            if batch % len(self._context) == 0:
+                states = old.get_updater_states()
+                self._fused = None
+                trainer = self._build_fused(old.optimizer)
+                if trainer is not None:
+                    trainer.set_updater_states(states)
+            if trainer is not None:
+                self._fused = trainer
+            else:
+                self._fused = old
+                self._defuse("reshape incompatible with fused step")
 
     # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -285,6 +359,27 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
+
+        self._kvstore_arg = kvstore
+        if self._fused is not None and self._params_dirty:
+            # force_init re-init: pull current device params back before
+            # the trainer (and its optimizer state) is rebuilt
+            self._sync_params_from_devices()
+        fused_opt = self._fusible_optimizer(kvstore, optimizer,
+                                            optimizer_params)
+        if fused_opt is not None:
+            trainer = self._build_fused(fused_opt)
+            if trainer is not None:
+                self._fused = trainer
+                self._optimizer = fused_opt
+                self._kvstore = None
+                self._update_on_kvstore = False
+                self._updater = None
+                self.optimizer_initialized = True
+                if self._preload_opt_states is not None:
+                    self.load_optimizer_states(self._preload_opt_states)
+                    self._preload_opt_states = None
+                return
 
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
@@ -335,23 +430,198 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+    # -- fused fast path ---------------------------------------------------
+    def _fusible_optimizer(self, kvstore, optimizer, optimizer_params):
+        """If the training setup qualifies for the fused in-graph step,
+        return the (possibly constructed) Optimizer instance; else None.
+
+        Qualifying = local/device kvstore semantics (single process),
+        grad_req='write', no monitor / input grads / states / shared bind,
+        uniform workload, batch divisible across contexts, batch-major
+        layouts, and an optimizer with an exact in-graph equivalent
+        (parallel.ingraph_opt)."""
+        from ..parallel.ingraph_opt import supports_ingraph
+        if os.environ.get("MXNET_MODULE_FUSED", "1").lower() in \
+                ("0", "false"):
+            return None
+        if (self._fused_disabled or self._monitor is not None or
+                self._state_names or self.inputs_need_grad or
+                not self.for_training or self._grad_req != "write"):
+            return None
+        kv_type = kvstore.type if hasattr(kvstore, "type") else kvstore
+        if kv_type is not None and (not isinstance(kv_type, str) or
+                                    "dist" in kv_type):
+            return None
+        if len(set(self._work_load_list)) > 1:
+            return None
+        if self._exec_group.batch_size % len(self._context) != 0:
+            return None
+        for desc in (self._data_shapes + (self._label_shapes or [])):
+            layout = getattr(desc, "layout", None)
+            if layout is not None and layout.find("N") != 0:
+                return None
+        batch_size = self._exec_group.batch_size
+        if isinstance(optimizer, str):
+            idx2name = dict(enumerate(self._exec_group.param_names))
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name,
+                                   **optimizer_params)
+        elif not isinstance(optimizer, opt.Optimizer):
+            return None
+        if not supports_ingraph(optimizer):
+            return None
+        return optimizer
+
+    def _build_fused(self, optimizer):
+        """Build the DataParallelTrainer over a mesh of this module's
+        contexts, seeded with current params; None if construction fails
+        (falls back to executor-group semantics)."""
+        import numpy as np
+        from jax.sharding import Mesh
+        from ..parallel.dp import DataParallelTrainer
+        try:
+            devices = [ctx.jax_device() for ctx in self._context]
+        except Exception:
+            return None
+        if len(set(devices)) != len(devices):
+            return None
+        data_shapes = {d.name: tuple(d.shape) for d in self._data_shapes}
+        label_shapes = {d.name: tuple(d.shape)
+                        for d in (self._label_shapes or [])}
+        mesh = Mesh(np.asarray(devices), ("dp",))
+        try:
+            trainer = DataParallelTrainer(
+                self._symbol, data_shapes, label_shapes or None, mesh=mesh,
+                optimizer=optimizer,
+                compute_dtype=self._compute_dtype,
+                fixed_params=tuple(self._fixed_param_names))
+        except Exception as e:
+            self.logger.warning("fused fast path unavailable (%s); "
+                                "using executor group", e)
+            return None
+        trainer.set_params(self._arg_params, self._aux_params)
+        return trainer
+
+    def _defuse(self, reason):
+        """Leave the fused fast path: sync params + optimizer state over to
+        the executor-group / host-updater path (full reference semantics)
+        and continue training there."""
+        trainer = self._fused
+        self._fused = None
+        self._fused_disabled = True
+        self.logger.info("leaving fused fast path (%s)", reason)
+        self._sync_from_trainer(trainer)
+        self._exec_group.set_params(self._arg_params, self._aux_params)
+        if not self.optimizer_initialized:
+            return
+        (kvstore, _) = _create_kvstore(
+            self._kvstore_arg, len(self._context), self._arg_params)
+        self._kvstore = kvstore
+        self._update_on_kvstore = False
+        if kvstore:
+            _initialize_kvstore(kvstore=kvstore,
+                                param_arrays=self._exec_group.param_arrays,
+                                arg_params=self._arg_params,
+                                param_names=self._param_names,
+                                update_on_kvstore=False)
+        num_device = len(self._context)
+        # host updater indexes params as index*num_device + k; remap the
+        # optimizer's idx2name, update counts, and replicate per-device
+        # state copies
+        self._optimizer.idx2name = {
+            i * num_device + k: name
+            for i, name in enumerate(self._exec_group.param_names)
+            for k in range(num_device)}
+        old_counts = dict(self._optimizer._index_update_count)
+        self._optimizer._index_update_count = {
+            i * num_device + k: c for i, c in old_counts.items()
+            for k in range(num_device)}
+        self._updater = opt.get_updater(self._optimizer)
+        states = trainer.get_updater_states()
+        for i, state in states.items():
+            for k in range(num_device):
+                # per-device state copy on device k, like create_state
+                # allocates next to its weight
+                self._updater.states[i * num_device + k] = \
+                    _place_state(_clone_state(state), self._context[k])
+
+    def _sync_from_trainer(self, trainer):
+        args, aux = trainer.get_params()
+        for n, v in args.items():
+            self._arg_params[n][:] = v
+        for n, v in aux.items():
+            self._aux_params[n][:] = v
+        self._params_dirty = False
+
+    def _fused_pack_batch(self, data_batch, fill_missing_labels=False):
+        batch = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            batch[name] = arr
+        labels = getattr(data_batch, "label", None) or []
+        for name, arr in zip(self._label_names, labels):
+            batch[name] = arr
+        if fill_missing_labels:
+            for name in self._label_names:
+                if name not in batch:
+                    batch[name] = nd.zeros(
+                        self._fused._arg_shapes[name])
+        return batch
+
+    def _fused_get_outputs(self):
+        if self._fused_outputs is None:
+            assert self._fused_batch is not None, \
+                "no forward has been run"
+            # update() not called yet: forward-only outputs for the
+            # stashed batch (params unchanged, so the later fused step
+            # still computes the same gradients)
+            outs = self._fused.predict(self._fused_batch)
+            self._fused_outputs = [nd.NDArray(o) for o in outs]
+        return self._fused_outputs
+
     # -- computation -------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            if is_train or (is_train is None and self.for_training):
+                self._defuse("explicit forward(is_train=True)")
+            else:
+                batch = self._fused_pack_batch(data_batch,
+                                               fill_missing_labels=True)
+                outs = self._fused.predict(batch)
+                self._fused_outputs = [nd.NDArray(o) for o in outs]
+                # a pending forward_backward stash stays valid: update()
+                # recomputes from it with unchanged params
+                return
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            self._defuse("explicit backward()")
         self._exec_group.backward(out_grads=out_grads)
 
     def forward_backward(self, data_batch):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            self._fused_batch = self._fused_pack_batch(data_batch)
+            self._fused_outputs = None
+            return
         self._exec_group.forward_backward(data_batch)
 
     def update(self):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if self._fused is not None:
+            assert self._fused_batch is not None, \
+                "call forward_backward before update"
+            outs = self._fused.step(self._fused_batch)
+            self._fused_outputs = [nd.NDArray(o) for o in outs]
+            self._fused_batch = None
+            return
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
@@ -365,6 +635,9 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            outs = self._fused_get_outputs()
+            return outs if merge_multi_context else [[o] for o in outs]
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -373,10 +646,16 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        if self._fused is not None:
+            eval_metric.update(labels, self._fused_get_outputs())
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor = mon
+        if self._fused is not None:
+            self._defuse("monitor installed")
         self._exec_group.install_monitor(mon)
 
 
@@ -385,3 +664,25 @@ def _as_data_desc(x):
     if isinstance(x, (list, tuple)) and len(x) == 2:
         return DataDesc(x[0], x[1])
     raise MXNetError("cannot interpret %r as DataDesc" % (x,))
+
+
+def _clone_state(state):
+    """Deep-copy an Updater-layout optimizer state (per-device copies)."""
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(_clone_state(s) for s in state)
+    if isinstance(state, nd.NDArray):
+        return state.copy()
+    return state
+
+
+def _place_state(state, ctx):
+    """Commit an Updater-layout state onto a context's device."""
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(_place_state(s, ctx) for s in state)
+    if isinstance(state, nd.NDArray):
+        return state.copyto(ctx)
+    return state

@@ -26,45 +26,7 @@ from .mesh import local_mesh
 __all__ = ["DataParallelTrainer"]
 
 
-# in-graph optimizer updates, reusing the fused registry op math
-def _sgd_update_fn(opt_params):
-    from ..ops.registry import get_op
-    op = get_op("sgd_mom_update" if opt_params.get("momentum", 0.0) > 0
-                else "sgd_update")
-    attrs = op.parse_attrs({k: v for k, v in opt_params.items()
-                            if k in op.attr_specs})
-
-    def init_state(w):
-        if opt_params.get("momentum", 0.0) > 0:
-            return (jnp.zeros_like(w),)
-        return ()
-
-    def update(w, g, state):
-        if state:
-            new_w, new_m = op.fcompute(attrs, w, g, state[0])
-            return new_w, (new_m,)
-        return op.fcompute(attrs, w, g), ()
-
-    return init_state, update
-
-
-def _adam_update_fn(opt_params):
-    from ..ops.registry import get_op
-    op = get_op("adam_update")
-    attrs = op.parse_attrs({k: v for k, v in opt_params.items()
-                            if k in op.attr_specs})
-
-    def init_state(w):
-        return (jnp.zeros_like(w), jnp.zeros_like(w))
-
-    def update(w, g, state):
-        new_w, new_mean, new_var = op.fcompute(attrs, w, g, *state)
-        return new_w, (new_mean, new_var)
-
-    return init_state, update
-
-
-_OPTIMIZERS = {"sgd": _sgd_update_fn, "adam": _adam_update_fn}
+from .ingraph_opt import InGraphOptimizer
 
 
 class DataParallelTrainer:
@@ -92,18 +54,6 @@ class DataParallelTrainer:
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype else None)
 
-        opt_params = dict(optimizer_params or {})
-        lr = opt_params.pop("learning_rate", 0.01)
-        opt_params["lr"] = lr
-        batch = next(iter(data_shapes.values()))[0]
-        opt_params.setdefault("rescale_grad", 1.0 / batch)
-        if opt_params.get("clip_gradient") is None:
-            opt_params.pop("clip_gradient", None)
-        if optimizer not in _OPTIMIZERS:
-            raise MXNetError("in-graph optimizer %r not supported (have %s)"
-                             % (optimizer, sorted(_OPTIMIZERS)))
-        self._opt_init, self._opt_update = _OPTIMIZERS[optimizer](opt_params)
-
         shapes = dict(data_shapes)
         if label_shapes:
             shapes.update(label_shapes)
@@ -117,6 +67,26 @@ class DataParallelTrainer:
         self._arg_shapes = dict(zip(self.arg_names, arg_shapes))
         self._aux_shapes = dict(zip(self.aux_names, aux_shapes))
         self._dtype = dtype
+
+        # a real host Optimizer instance drives hyperparameters (schedulers,
+        # lr/wd multipliers, update counts); its update math is compiled
+        # into the step via InGraphOptimizer (reference: update_on_kvstore
+        # runs the python optimizer server-side — here it runs in-graph)
+        from .. import optimizer as opt_mod
+        if isinstance(optimizer, str):
+            opt_params = dict(optimizer_params or {})
+            batch = next(iter(data_shapes.values()))[0]
+            opt_params.setdefault("rescale_grad", 1.0 / batch)
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=dict(enumerate(self.param_names)),
+                sym=symbol, **opt_params)
+        self.optimizer = optimizer
+        self._ingraph = InGraphOptimizer(optimizer)
+        self._opt_init = self._ingraph.init_state
+        self._opt_update = self._ingraph.update
+        # indices (positions in param_names) that actually get updates
+        self._live_idx = [i for i, n in enumerate(self.param_names)
+                          if n not in self._fixed]
 
         self._replicated = NamedSharding(self.mesh, P())
         self._batched = NamedSharding(self.mesh, P(batch_axis))
@@ -198,7 +168,7 @@ class DataParallelTrainer:
                         and k not in label_set
                         else v) for k, v in tree.items()}
 
-        def train_step(params, opt_state, aux, batch, rng):
+        def train_step(params, opt_state, aux, batch, lrs, wds, rng):
             def f(ps):
                 args = _cast(dict(batch))
                 args.update(_cast(ps))
@@ -212,13 +182,15 @@ class DataParallelTrainer:
             cots = tuple(jnp.ones_like(o) for o in outs)
             grads = vjp(cots)[0]
             new_params, new_opt = {}, {}
-            for name in param_names:
+            for idx, name in enumerate(param_names):
                 if name in fixed or grads.get(name) is None:
                     new_params[name] = params[name]
                     new_opt[name] = opt_state[name]
                 else:
                     w, s = opt_update(params[name], grads[name],
-                                      opt_state[name])
+                                      opt_state[name], lrs[idx], wds[idx],
+                                      jax.random.fold_in(rng, (1 << 20) +
+                                                         idx))
                     new_params[name] = w
                     new_opt[name] = s
             return new_params, new_opt, new_aux, outs
@@ -253,9 +225,22 @@ class DataParallelTrainer:
         if rng is None:
             from .. import random as _random
             rng = _random.next_key()
+        lrs, wds = self._host_hyper()
         self.params, self.opt_state, self.aux, outs = self._train_step(
-            self.params, self.opt_state, self.aux, batch, rng)
+            self.params, self.opt_state, self.aux, batch, lrs, wds, rng)
         return outs
+
+    def _host_hyper(self):
+        """Per-step (lr, wd) vectors over param_names positions, computed
+        from the host optimizer (schedulers/multipliers/update counts) —
+        dynamic jit args, so lr changes don't retrace."""
+        lr_list, wd_list = self._ingraph.host_hyper(self._live_idx)
+        lrs = np.zeros(len(self.param_names), np.float32)
+        wds = np.zeros(len(self.param_names), np.float32)
+        for i, lr, wd in zip(self._live_idx, lr_list, wd_list):
+            lrs[i] = lr
+            wds[i] = wd
+        return jnp.asarray(lrs), jnp.asarray(wds)
 
     def predict(self, data, rng=None):
         batch = dict(data) if isinstance(data, dict) else \
@@ -285,3 +270,20 @@ class DataParallelTrainer:
                 self.aux[n] = jax.device_put(
                     v._data if isinstance(v, NDArray) else jnp.asarray(v),
                     self._replicated)
+
+    # -- optimizer-state interop (Updater.states layout) ----------------
+    def get_updater_states(self):
+        """Optimizer state as the host ``Updater.states`` dict
+        {param_index: state-in-create_state-layout}; interoperates with
+        ``.states`` checkpoints and the host update path."""
+        return {i: self._ingraph.state_to_host(self.opt_state[name])
+                for i, name in enumerate(self.param_names)
+                if name not in self._fixed}
+
+    def set_updater_states(self, states):
+        for i, name in enumerate(self.param_names):
+            if i in states and name not in self._fixed:
+                self.opt_state[name] = tuple(
+                    jax.device_put(jnp.asarray(s._data if isinstance(
+                        s, NDArray) else s), self._sharding_for(name))
+                    for s in self._ingraph.state_from_host(states[i]))

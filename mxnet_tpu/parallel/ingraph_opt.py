@@ -1,0 +1,365 @@
+"""In-graph optimizer updates: the python Optimizer zoo as pure XLA.
+
+The reference implements the hot update kernels natively
+(``src/operator/tensor/optimizer_op.cc:18-156``) and python optimizers call
+them per-parameter from the host.  The TPU fast path instead compiles the
+update INTO the training program, so the whole model's parameter update runs
+fused after the gradient all-reduce (``update_on_kvstore`` ≡ "optimizer
+inside the compiled step", SURVEY.md §5).
+
+Each entry mirrors the host math of the corresponding ``Optimizer`` class
+exactly (parity-tested in ``tests/test_fused_module.py``): static
+hyperparameters (momentum, betas, rescale_grad, clip_gradient) are baked
+into the compiled program, while per-step values — lr and wd, which carry
+schedulers and per-parameter multipliers — are host-computed scalars fed as
+dynamic arguments, so an lr change never retraces.
+
+State layout note: the in-graph state for a parameter is always a *tuple*
+of jax arrays; ``state_to_host``/``state_from_host`` convert to/from the
+exact structure the host optimizer's ``create_state`` produces, so
+``.states`` checkpoints interoperate between the fused and host paths.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["InGraphOptimizer", "supports_ingraph"]
+
+
+def _static_clip(g, clip):
+    if clip is not None and clip > 0:
+        return jnp.clip(g, -clip, clip)
+    return g
+
+
+def _nd(x):
+    """Export a (possibly mesh-sharded) jax array as a plain host-backed
+    NDArray, so host updaters / pickles never see committed mesh arrays."""
+    if isinstance(x, NDArray):
+        x = x._data
+    import numpy as np
+    return NDArray(jnp.asarray(np.asarray(jax.device_get(x))))
+
+
+def _jx(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# per-optimizer entries: each builder takes the host Optimizer instance and
+# returns (init_state, update, state_to_host, state_from_host)
+#   init_state(w)                      -> tuple of jax arrays
+#   update(w, g, state, lr, wd, rng)   -> (new_w, new_state)
+# ---------------------------------------------------------------------------
+def _sgd(o):
+    from ..ops.registry import get_op
+    mom = getattr(o, "momentum", 0.0)
+    base = dict(rescale_grad=o.rescale_grad,
+                clip_gradient=o.clip_gradient if o.clip_gradient else -1.0,
+                momentum=mom)
+    if mom > 0:
+        op = get_op("sgd_mom_update")
+
+        def init(w):
+            return (jnp.zeros_like(w),)
+
+        def update(w, g, s, lr, wd, rng):
+            w2, m2 = op.fcompute(dict(base, lr=lr, wd=wd), w, g, s[0])
+            return w2, (m2,)
+
+        def to_host(s):
+            return _nd(s[0])
+
+        def from_host(v):
+            return (_jx(v),)
+    else:
+        op = get_op("sgd_update")
+
+        def init(w):
+            return ()
+
+        def update(w, g, s, lr, wd, rng):
+            return op.fcompute(dict(base, lr=lr, wd=wd), w, g), ()
+
+        def to_host(s):
+            return None
+
+        def from_host(v):
+            return ()
+    return init, update, to_host, from_host
+
+
+def _nag(o):
+    mom = o.momentum
+    rs, clip = o.rescale_grad, o.clip_gradient
+
+    def init(w):
+        return (jnp.zeros_like(w),) if mom > 0 else ()
+
+    def update(w, g, s, lr, wd, rng):
+        g = _static_clip(g * rs, clip)
+        if s:
+            m = s[0] * mom
+            g = g + wd * w
+            m = m + g
+            g = g + mom * m
+            return w - lr * g, (m,)
+        return w - lr * (g + wd * w), ()
+
+    def to_host(s):
+        return _nd(s[0]) if s else None
+
+    def from_host(v):
+        return (_jx(v),) if v is not None else ()
+    return init, update, to_host, from_host
+
+
+def _sgld(o):
+    rs, clip = o.rescale_grad, o.clip_gradient
+
+    def init(w):
+        return ()
+
+    def update(w, g, s, lr, wd, rng):
+        g = _static_clip(g * rs, clip)
+        noise = jax.random.normal(rng, w.shape, w.dtype) * jnp.sqrt(lr)
+        return w - lr / 2 * (g + wd * w) + noise, ()
+
+    return init, update, (lambda s: None), (lambda v: ())
+
+
+def _dcasgd(o):
+    mom, lamda = o.momentum, o.lamda
+    rs, clip = o.rescale_grad, o.clip_gradient
+    has_mom = mom != 0.0
+
+    def init(w):
+        if has_mom:
+            return (jnp.zeros_like(w), jnp.array(w))
+        return (jnp.array(w),)
+
+    def update(w, g, s, lr, wd, rng):
+        g = _static_clip(g * rs, clip)
+        prev = s[-1]
+        delta = -lr * (g + wd * w + lamda * g * g * (w - prev))
+        if has_mom:
+            m = s[0] * mom + delta
+        else:
+            m = delta
+        w2 = w + m
+        return w2, ((m, w) if has_mom else (w,))
+
+    def to_host(s):
+        if has_mom:
+            return (_nd(s[0]), _nd(s[1]))
+        return (None, _nd(s[0]))
+
+    def from_host(v):
+        m, prev = v
+        if has_mom:
+            return (_jx(m), _jx(prev))
+        return (_jx(prev),)
+    return init, update, to_host, from_host
+
+
+def _adam(o):
+    from ..ops.registry import get_op
+    op = get_op("adam_update")
+    base = dict(rescale_grad=o.rescale_grad,
+                clip_gradient=o.clip_gradient if o.clip_gradient else -1.0,
+                beta1=o.beta1, beta2=o.beta2, epsilon=o.epsilon)
+
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, s, lr, wd, rng):
+        # bias correction is folded into lr on the host (host_lr below),
+        # exactly as Adam.update does before calling the fused op
+        w2, m2, v2 = op.fcompute(dict(base, lr=lr, wd=wd), w, g, *s)
+        return w2, (m2, v2)
+
+    def to_host(s):
+        return (_nd(s[0]), _nd(s[1]))
+
+    def from_host(v):
+        return (_jx(v[0]), _jx(v[1]))
+    return init, update, to_host, from_host
+
+
+def _adam_host_lr(o, index, lr):
+    import math
+    t = o._index_update_count[index]
+    return lr * math.sqrt(1. - o.beta2 ** t) / (1. - o.beta1 ** t)
+
+
+def _adagrad(o):
+    rs, clip, eps = o.rescale_grad, o.clip_gradient, o.float_stable_eps
+
+    def init(w):
+        return (jnp.zeros_like(w),)
+
+    def update(w, g, s, lr, wd, rng):
+        g = _static_clip(g * rs, clip)
+        h = s[0] + g * g
+        return w - lr * (g / (h + eps) ** 0.5 + wd * w), (h,)
+
+    return init, update, (lambda s: _nd(s[0])), (lambda v: (_jx(v),))
+
+
+def _rmsprop(o):
+    from ..ops.registry import get_op
+    base = dict(rescale_grad=o.rescale_grad,
+                clip_gradient=o.clip_gradient if o.clip_gradient else -1.0,
+                gamma1=o.gamma1, epsilon=o.epsilon,
+                clip_weights=o.clip_weights if o.clip_weights else -1.0)
+    if o.centered:
+        op = get_op("rmspropalex_update")
+        base["gamma2"] = o.gamma2
+
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, s, lr, wd, rng):
+            w2, n2, g2, d2 = op.fcompute(dict(base, lr=lr, wd=wd), w, g, *s)
+            return w2, (n2, g2, d2)
+    else:
+        op = get_op("rmsprop_update")
+
+        def init(w):
+            return (jnp.zeros_like(w),)
+
+        def update(w, g, s, lr, wd, rng):
+            w2, n2 = op.fcompute(dict(base, lr=lr, wd=wd), w, g, s[0])
+            return w2, (n2,)
+
+    def to_host(s):
+        return tuple(_nd(x) for x in s)
+
+    def from_host(v):
+        return tuple(_jx(x) for x in v)
+    return init, update, to_host, from_host
+
+
+def _adadelta(o):
+    rho, eps = o.rho, o.epsilon
+    rs, clip = o.rescale_grad, o.clip_gradient
+
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, s, lr, wd, rng):
+        g = _static_clip(g * rs, clip)
+        acc_g, acc_d = s
+        acc_g = rho * acc_g + (1. - rho) * g * g
+        cur = ((acc_d + eps) ** 0.5 / (acc_g + eps) ** 0.5) * g
+        acc_d = rho * acc_d + (1. - rho) * cur * cur
+        return w - cur - wd * w, (acc_g, acc_d)
+
+    def to_host(s):
+        return (_nd(s[0]), _nd(s[1]))
+
+    def from_host(v):
+        return (_jx(v[0]), _jx(v[1]))
+    return init, update, to_host, from_host
+
+
+def _ftrl(o):
+    lamda1, beta = o.lamda1, o.beta
+    rs, clip = o.rescale_grad, o.clip_gradient
+
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, s, lr, wd, rng):
+        g = _static_clip(g * rs, clip)
+        z, n = s
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+        n = n + g * g
+        z = z + g - sigma * w
+        w2 = (jnp.sign(z) * lamda1 - z) / ((beta + jnp.sqrt(n)) / lr + wd)
+        w2 = w2 * (jnp.abs(z) > lamda1)
+        return w2.astype(w.dtype), (z, n)
+
+    def to_host(s):
+        return (_nd(s[0]), _nd(s[1]))
+
+    def from_host(v):
+        return (_jx(v[0]), _jx(v[1]))
+    return init, update, to_host, from_host
+
+
+def _test(o):
+    rs = o.rescale_grad
+
+    def init(w):
+        return (jnp.zeros_like(w),)
+
+    def update(w, g, s, lr, wd, rng):
+        w2 = w + g * rs
+        return w2, (w2,)
+
+    return init, update, (lambda s: _nd(s[0])), (lambda v: (_jx(v),))
+
+
+# class name (lowercased) -> (builder, host_lr_transform or None)
+_ENTRIES = {
+    "sgd": (_sgd, None),
+    "ccsgd": (_sgd, None),
+    "nag": (_nag, None),
+    "sgld": (_sgld, None),
+    "dcasgd": (_dcasgd, None),
+    "adam": (_adam, _adam_host_lr),
+    "adagrad": (_adagrad, None),
+    "rmsprop": (_rmsprop, None),
+    "adadelta": (_adadelta, None),
+    "ftrl": (_ftrl, None),
+    "test": (_test, None),
+}
+
+
+def supports_ingraph(optimizer):
+    """True if this Optimizer instance has an exact in-graph equivalent."""
+    return type(optimizer).__name__.lower() in _ENTRIES
+
+
+class InGraphOptimizer:
+    """Compiled-update adapter around a host ``Optimizer`` instance.
+
+    The host instance stays authoritative for bookkeeping (update counts,
+    schedulers, lr/wd multipliers); ``host_hyper`` advances it one step and
+    returns the per-parameter (lr, wd) scalars the compiled update consumes.
+    """
+
+    def __init__(self, optimizer):
+        key = type(optimizer).__name__.lower()
+        if key not in _ENTRIES:
+            raise MXNetError(
+                "no in-graph update for optimizer %r (have %s)"
+                % (type(optimizer).__name__, sorted(_ENTRIES)))
+        self.optimizer = optimizer
+        builder, self._host_lr = _ENTRIES[key]
+        (self.init_state, self.update,
+         self.state_to_host, self.state_from_host) = builder(optimizer)
+
+    def host_hyper(self, indices):
+        """Advance update counts and compute (lrs, wds) float32 lists for
+        ``indices`` — mirrors Updater: _update_count then _get_lr/_get_wd
+        (+ Adam's bias-correction fold)."""
+        o = self.optimizer
+        lrs, wds = [], []
+        for i in indices:
+            o._update_count(i)
+        for i in indices:
+            lr = o._get_lr(i)
+            if self._host_lr is not None:
+                lr = self._host_lr(o, i, lr)
+            lrs.append(lr)
+            wds.append(o._get_wd(i))
+        return lrs, wds

@@ -1,0 +1,396 @@
+"""Module fused fast-path tests: selection, parity vs executor-group path,
+de-fuse fallback, checkpoint interop.
+
+Reference parity target: the fused path must be numerically identical to the
+classic kvstore/updater loop (model.py:88-118 semantics) — same updates per
+step for every optimizer with an in-graph equivalent.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.io.io import NDArrayIter
+from mxnet_tpu.module import Module
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _convnet():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                              pad=(1, 1), name="conv1")
+    bn = mx.sym.BatchNorm(conv, name="bn1")
+    act = mx.sym.Activation(bn, act_type="relu")
+    pool = mx.sym.Pooling(act, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max")
+    flat = mx.sym.Flatten(pool)
+    fc = mx.sym.FullyConnected(flat, num_hidden=4, name="fc1")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _init_args(sym, data_shape, label_shape, seed=7):
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = sym.infer_shape(data=data_shape,
+                                   softmax_label=label_shape)
+    args = {}
+    inputs = ("data", "softmax_label")
+    for name, shape in zip(sym.list_arguments(), shapes):
+        if name not in inputs:
+            args[name] = nd.array(
+                rng.uniform(-0.1, 0.1, shape).astype("float32"))
+    return args
+
+
+def _run(sym, contexts, optimizer, opt_params, fused, steps=4,
+         data_shape=(8, 12), label_shape=(8,), n_classes=4, seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (steps * data_shape[0],) +
+                    data_shape[1:]).astype("float32")
+    y = rng.randint(0, n_classes, (steps * label_shape[0],)
+                    ).astype("float32")
+    it = NDArrayIter(x, y, batch_size=data_shape[0])
+    mod = Module(sym, context=contexts)
+    if not fused:
+        mod._fused_disabled = True
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(arg_params=_init_args(sym, data_shape, label_shape),
+                    aux_params={}, allow_missing=False)
+    mod.init_optimizer(kvstore="local", optimizer=optimizer,
+                       optimizer_params=opt_params)
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+    if fused:
+        assert (mod._fused is not None), "fused path was not selected"
+    else:
+        assert mod._fused is None
+    return mod.get_params()
+
+
+OPTIMIZERS = [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3}),
+    ("sgd", {"learning_rate": 0.05}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.05}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+    ("adadelta", {}),
+    ("ftrl", {"learning_rate": 0.1}),
+    ("dcasgd", {"learning_rate": 0.05, "momentum": 0.5}),
+]
+
+
+@pytest.mark.parametrize("opt_name,opt_params", OPTIMIZERS,
+                         ids=lambda p: str(p))
+def test_fused_parity_single_device(opt_name, opt_params):
+    sym = _mlp()
+    args_f, _ = _run(sym, [mx.cpu(0)], opt_name, opt_params, fused=True)
+    args_c, _ = _run(sym, [mx.cpu(0)], opt_name, opt_params, fused=False)
+    for name in args_c:
+        np.testing.assert_allclose(
+            args_f[name].asnumpy(), args_c[name].asnumpy(),
+            rtol=2e-5, atol=2e-6, err_msg="%s/%s" % (opt_name, name))
+
+
+def test_fused_parity_multi_device():
+    sym = _mlp()
+    ctxs = [mx.cpu(i) for i in range(4)]
+    args_f, _ = _run(sym, ctxs, "sgd",
+                     {"learning_rate": 0.1, "momentum": 0.9}, fused=True)
+    args_c, _ = _run(sym, ctxs, "sgd",
+                     {"learning_rate": 0.1, "momentum": 0.9}, fused=False)
+    for name in args_c:
+        np.testing.assert_allclose(
+            args_f[name].asnumpy(), args_c[name].asnumpy(),
+            rtol=2e-5, atol=2e-6, err_msg=name)
+
+
+def test_fused_parity_batchnorm_aux():
+    sym = _convnet()
+    kw = dict(data_shape=(8, 3, 8, 8))
+    args_f, aux_f = _run(sym, [mx.cpu(0)], "sgd",
+                         {"learning_rate": 0.1}, fused=True, **kw)
+    args_c, aux_c = _run(sym, [mx.cpu(0)], "sgd",
+                         {"learning_rate": 0.1}, fused=False, **kw)
+    for name in args_c:
+        np.testing.assert_allclose(
+            args_f[name].asnumpy(), args_c[name].asnumpy(),
+            rtol=3e-5, atol=3e-6, err_msg=name)
+    for name in aux_c:
+        np.testing.assert_allclose(
+            aux_f[name].asnumpy(), aux_c[name].asnumpy(),
+            rtol=3e-5, atol=3e-6, err_msg=name)
+
+
+def test_fused_fit_and_score():
+    sym = _mlp()
+    rng = np.random.RandomState(0)
+    # learnable task: class = argmax of 4 fixed random projections
+    w = rng.randn(12, 4)
+    x = rng.uniform(-1, 1, (256, 12)).astype("float32")
+    y = np.argmax(x @ w, axis=1).astype("float32")
+    it = NDArrayIter(x, y, batch_size=32, shuffle=True)
+    val = NDArrayIter(x, y, batch_size=32)
+    mod = Module(sym, context=[mx.cpu(0)])
+    mod.fit(it, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            num_epoch=8)
+    assert mod._fused is not None, "fit did not use the fused path"
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.8, "fused fit failed to learn (acc=%.3f)" % acc
+
+
+def test_fused_defuse_continues_training():
+    sym = _mlp()
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (32, 12)).astype("float32")
+    y = rng.randint(0, 4, (32,)).astype("float32")
+    it = NDArrayIter(x, y, batch_size=8)
+    mod = Module(sym, context=[mx.cpu(0)])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    assert mod._fused is not None
+    batches = list(it)
+    mod.forward_backward(batches[0])
+    mod.update()
+    # explicit split-API use must fall back to executor-group semantics
+    mod.forward(batches[1], is_train=True)
+    assert mod._fused is None and mod._fused_disabled
+    mod.backward()
+    mod.update()
+    args, _ = mod.get_params()
+    for v in args.values():
+        assert np.isfinite(v.asnumpy()).all()
+
+
+def test_fused_optimizer_state_checkpoint(tmp_path):
+    sym = _mlp()
+    rng = np.random.RandomState(2)
+    x = rng.uniform(-1, 1, (32, 12)).astype("float32")
+    y = rng.randint(0, 4, (32,)).astype("float32")
+    it = NDArrayIter(x, y, batch_size=8)
+
+    def make(fused):
+        mod = Module(sym, context=[mx.cpu(0)])
+        if not fused:
+            mod._fused_disabled = True
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(arg_params=_init_args(sym, (8, 12), (8,)),
+                        aux_params={})
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        return mod
+
+    mod = make(fused=True)
+    it.reset()
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+
+    # a fresh fused module loads the fused-written states
+    mod2 = make(fused=True)
+    mod2.load_optimizer_states(fname)
+    st = mod2._fused.get_updater_states()
+    st_ref = mod._fused.get_updater_states()
+    for k in st_ref:
+        np.testing.assert_allclose(st[k].asnumpy(), st_ref[k].asnumpy(),
+                                   rtol=1e-6)
+
+    # the classic host-updater path loads the same file (interop)
+    mod3 = make(fused=False)
+    mod3.load_optimizer_states(fname)
+    assert set(mod3._updater.states) == set(st_ref)
+
+
+def test_fused_state_checkpoint_multi_device_interop(tmp_path):
+    """Optimizer-state files use the update_on_kvstore layout (plain
+    param-index keys) so fused and classic kvstore paths interoperate
+    at any ctx count."""
+    sym = _mlp()
+    ctxs = [mx.cpu(i) for i in range(2)]
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (32, 12)).astype("float32")
+    y = rng.randint(0, 4, (32,)).astype("float32")
+    it = NDArrayIter(x, y, batch_size=8)
+
+    def make(fused):
+        mod = Module(sym, context=ctxs)
+        if not fused:
+            mod._fused_disabled = True
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(arg_params=_init_args(sym, (8, 12), (8,)),
+                        aux_params={})
+        mod.init_optimizer(kvstore="local", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        return mod
+
+    mod = make(fused=True)
+    it.reset()
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+    fname = str(tmp_path / "opt2.states")
+    mod.save_optimizer_states(fname)
+
+    # classic 2-device path (update_on_kvstore: states live in the
+    # kvstore updater, keyed by plain param index) loads without mis-keying
+    mod_c = make(fused=False)
+    assert mod_c._update_on_kvstore
+    mod_c.load_optimizer_states(fname)
+    st_ref = mod.get_params()[0]
+    names = mod._exec_group.param_names
+    for i, name in enumerate(names):
+        s = mod_c._kvstore._updater.states[i]
+        assert s.shape == st_ref[name].shape, name
+
+    # classic-written file loads back into a fused module
+    it.reset()
+    for batch in it:
+        mod_c.forward_backward(batch)
+        mod_c.update()
+    fname2 = str(tmp_path / "opt2c.states")
+    mod_c.save_optimizer_states(fname2)
+    mod_f = make(fused=True)
+    mod_f.load_optimizer_states(fname2)
+    for i, name in enumerate(names):
+        got = mod_f._fused.get_updater_states()[i]
+        want = mod_c._kvstore._updater.states[i]
+        np.testing.assert_allclose(got.asnumpy(), want.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_fused_defuse_preserves_update_counts():
+    """Adam bias correction must not restart after a multi-device
+    de-fuse (update counts carried over to host-updater indexing)."""
+    sym = _mlp()
+    ctxs = [mx.cpu(i) for i in range(2)]
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-1, 1, (32, 12)).astype("float32")
+    y = rng.randint(0, 4, (32,)).astype("float32")
+    it = NDArrayIter(x, y, batch_size=8)
+    mod = Module(sym, context=ctxs)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    batches = list(it)
+    for b in batches[:3]:
+        mod.forward_backward(b)
+        mod.update()
+    mod.forward(batches[3], is_train=True)   # triggers de-fuse
+    assert mod._fused is None
+    counts = mod._optimizer._index_update_count
+    assert counts and all(c == 3 for c in counts.values()), counts
+    mod.backward()
+    mod.update()
+    assert mod._optimizer._index_update_count[0] == 4
+
+
+def test_fused_eval_forward_keeps_pending_batch():
+    """forward_backward -> forward(is_train=False) -> update() must
+    still apply the pending update (reference-path semantics)."""
+    sym = _mlp()
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-1, 1, (16, 12)).astype("float32")
+    y = rng.randint(0, 4, (16,)).astype("float32")
+    it = NDArrayIter(x, y, batch_size=8)
+    mod = Module(sym, context=[mx.cpu(0)])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(arg_params=_init_args(sym, (8, 12), (8,)),
+                    aux_params={})
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    b0, b1 = list(it)
+    before = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    mod.forward_backward(b0)
+    mod.forward(b1, is_train=False)
+    mod.update()
+    after = mod.get_params()[0]
+    changed = any(not np.allclose(before[k], after[k].asnumpy())
+                  for k in before)
+    assert changed, "update after eval forward did not apply"
+
+
+def test_fused_monitor_with_ctx_group_stages():
+    """Monitor on a ctx_group staged executor gathers to one device
+    instead of crashing on mixed committed devices."""
+    with mx.AttrScope(ctx_group="s1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    with mx.AttrScope(ctx_group="s2"):
+        out = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(fc1, name="fc2", num_hidden=4),
+            name="softmax")
+    ex = out.simple_bind(mx.cpu(0),
+                         group2ctx={"s1": mx.cpu(1), "s2": mx.cpu(2)},
+                         data=(4, 6), softmax_label=(4,))
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    rs = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rs.uniform(-0.1, 0.1, arr.shape)
+    ex.forward(is_train=False)
+    assert any("fc1" in s for s in seen)
+
+
+def test_fused_reshape_to_indivisible_batch_falls_back():
+    """reshape to a batch size not divisible across contexts must fall
+    back to executor-group semantics, not crash or strand the module."""
+    sym = _mlp()
+    ctxs = [mx.cpu(i) for i in range(4)]
+    rng = np.random.RandomState(8)
+    x = rng.uniform(-1, 1, (16, 12)).astype("float32")
+    y = rng.randint(0, 4, (16,)).astype("float32")
+    it = NDArrayIter(x, y, batch_size=8)
+    mod = Module(sym, context=ctxs)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    assert mod._fused is not None
+    b0 = list(it)[0]
+    mod.forward_backward(b0)
+    mod.update()
+    mod.reshape([("data", (6, 12))], [("softmax_label", (6,))])
+    assert mod._fused is None  # fell back
+    from mxnet_tpu.io.io import DataBatch
+    nb = DataBatch(data=[nd.array(x[:6])], label=[nd.array(y[:6])])
+    mod.forward_backward(nb)
+    mod.update()
+    args, _ = mod.get_params()
+    for v in args.values():
+        assert np.isfinite(v.asnumpy()).all()
+
+
+def test_fused_respects_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("MXNET_MODULE_FUSED", "0")
+    sym = _mlp()
+    rng = np.random.RandomState(4)
+    x = rng.uniform(-1, 1, (16, 12)).astype("float32")
+    y = rng.randint(0, 4, (16,)).astype("float32")
+    it = NDArrayIter(x, y, batch_size=8)
+    mod = Module(sym, context=[mx.cpu(0)])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+    assert mod._fused is None
