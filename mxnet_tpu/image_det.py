@@ -1,0 +1,295 @@
+"""Detection image augmenters: bbox-aware crop/pad/mirror/resize.
+
+Reference: ``src/io/image_det_aug_default.cc`` (DefaultImageDetAugmenter) —
+random crop sampling under scale/aspect-ratio/overlap/coverage constraints
+with emit modes, random expansion padding, mirror, and resize, all updating
+the normalized object boxes alongside the pixels.
+
+Label layout (reference ``ImageDetLabel``, image_det_aug_default.cc:235):
+``[header_width, object_width, (extra headers...), (id, xmin, ymin, xmax,
+ymax, extra...) * num_objects]`` with coordinates normalized to [0, 1].
+
+Augmenters operate on ``(img_hwc_float32, label_2d)`` pairs where
+``label_2d`` has shape (num_objects, object_width).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["DetLabel", "DetHorizontalFlipAug", "DetRandomCropAug",
+           "DetRandomPadAug", "DetResizeAug", "DetColorNormalizeAug",
+           "CreateDetAugmenter"]
+
+
+class DetLabel:
+    """Parsed detection label (header + object boxes)."""
+
+    __slots__ = ("header", "objects", "object_width")
+
+    def __init__(self, raw):
+        raw = np.asarray(raw, dtype=np.float32).reshape(-1)
+        if raw.size < 7:
+            raise MXNetError("detection label needs >= 7 values "
+                             "(2 header + one 5-wide object), got %d"
+                             % raw.size)
+        header_width = int(raw[0])
+        object_width = int(raw[1])
+        if header_width < 2 or object_width < 5:
+            raise MXNetError("bad detection label header (%d, %d)"
+                             % (header_width, object_width))
+        if (raw.size - header_width) % object_width != 0:
+            raise MXNetError("detection label size %d does not align with "
+                             "header %d + objects of width %d"
+                             % (raw.size, header_width, object_width))
+        self.header = raw[:header_width].copy()
+        self.object_width = object_width
+        self.objects = raw[header_width:].reshape(-1, object_width).copy()
+
+    def flatten(self):
+        return np.concatenate([self.header, self.objects.reshape(-1)])
+
+    def copy(self):
+        out = DetLabel.__new__(DetLabel)
+        out.header = self.header.copy()
+        out.objects = self.objects.copy()
+        out.object_width = self.object_width
+        return out
+
+
+def _box_iou(a, boxes):
+    """IOU of box ``a`` (4,) vs ``boxes`` (N,4), xmin/ymin/xmax/ymax."""
+    ix = np.maximum(0.0, np.minimum(a[2], boxes[:, 2]) -
+                    np.maximum(a[0], boxes[:, 0]))
+    iy = np.maximum(0.0, np.minimum(a[3], boxes[:, 3]) -
+                    np.maximum(a[1], boxes[:, 1]))
+    inter = ix * iy
+    area_a = max(0.0, (a[2] - a[0]) * (a[3] - a[1]))
+    area_b = np.maximum(0.0, (boxes[:, 2] - boxes[:, 0]) *
+                        (boxes[:, 3] - boxes[:, 1]))
+    union = area_a + area_b - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def _coverage(inner, outer):
+    """Fraction of ``inner`` boxes' area covered by box ``outer``."""
+    ix = np.maximum(0.0, np.minimum(outer[2], inner[:, 2]) -
+                    np.maximum(outer[0], inner[:, 0]))
+    iy = np.maximum(0.0, np.minimum(outer[3], inner[:, 3]) -
+                    np.maximum(outer[1], inner[:, 1]))
+    area = np.maximum(0.0, (inner[:, 2] - inner[:, 0]) *
+                      (inner[:, 3] - inner[:, 1]))
+    return np.where(area > 0, ix * iy / np.maximum(area, 1e-12), 0.0)
+
+
+def _crop_boxes(label, crop, emit_mode, emit_thresh):
+    """Transform boxes into crop coordinates; drop boxes per emit mode
+    (reference crop_emit_mode 'center'/'overlap')."""
+    objs = label.objects
+    if objs.shape[0] == 0:
+        return objs
+    boxes = objs[:, 1:5]
+    cx0, cy0, cx1, cy1 = crop
+    cw, ch = cx1 - cx0, cy1 - cy0
+    if emit_mode == "center":
+        centers_x = (boxes[:, 0] + boxes[:, 2]) / 2
+        centers_y = (boxes[:, 1] + boxes[:, 3]) / 2
+        keep = ((centers_x >= cx0) & (centers_x <= cx1) &
+                (centers_y >= cy0) & (centers_y <= cy1))
+    else:  # overlap
+        cov = _coverage(boxes, np.asarray(crop, np.float32))
+        keep = cov > emit_thresh
+    objs = objs[keep].copy()
+    if objs.shape[0] == 0:
+        return objs
+    b = objs[:, 1:5]
+    b[:, 0] = np.clip((b[:, 0] - cx0) / cw, 0.0, 1.0)
+    b[:, 1] = np.clip((b[:, 1] - cy0) / ch, 0.0, 1.0)
+    b[:, 2] = np.clip((b[:, 2] - cx0) / cw, 0.0, 1.0)
+    b[:, 3] = np.clip((b[:, 3] - cy0) / ch, 0.0, 1.0)
+    objs[:, 1:5] = b
+    return objs
+
+
+def DetHorizontalFlipAug(p):
+    """Mirror image and boxes with probability p (rand_mirror_prob)."""
+    def aug(img, label):
+        if np.random.random() < p:
+            img = img[:, ::-1, :]
+            objs = label.objects
+            if objs.shape[0]:
+                x0 = 1.0 - objs[:, 3]
+                x1 = 1.0 - objs[:, 1]
+                objs[:, 1], objs[:, 3] = x0, x1
+        return img, label
+    return aug
+
+
+def DetRandomCropAug(min_scales=(0.3,), max_scales=(1.0,),
+                     min_aspect_ratios=(0.5,), max_aspect_ratios=(2.0,),
+                     min_overlaps=(0.0,), max_overlaps=(1.0,),
+                     min_sample_coverages=(0.0,), max_sample_coverages=(1.0,),
+                     min_object_coverages=(0.0,), max_object_coverages=(1.0,),
+                     num_crop_sampler=1, crop_emit_mode="center",
+                     emit_overlap_thresh=0.3, max_crop_trials=(25,), p=1.0):
+    """Constrained random crop (reference RandomCropGenerator): each
+    sampler draws crops until one satisfies its IOU/coverage constraints
+    against the ground-truth boxes; one passing sampler is applied."""
+    n = num_crop_sampler
+
+    def _tup(t):
+        t = tuple(t) if hasattr(t, "__len__") else (t,)
+        return t * n if len(t) == 1 else t
+    min_scales, max_scales = _tup(min_scales), _tup(max_scales)
+    min_ars, max_ars = _tup(min_aspect_ratios), _tup(max_aspect_ratios)
+    min_ovp, max_ovp = _tup(min_overlaps), _tup(max_overlaps)
+    min_scov, max_scov = (_tup(min_sample_coverages),
+                          _tup(max_sample_coverages))
+    min_ocov, max_ocov = (_tup(min_object_coverages),
+                          _tup(max_object_coverages))
+    trials = _tup(max_crop_trials)
+
+    def _sample_one(i, boxes):
+        for _ in range(trials[i]):
+            scale = np.random.uniform(min_scales[i], max_scales[i])
+            ar = np.random.uniform(min_ars[i], max_ars[i])
+            w = min(1.0, scale * np.sqrt(ar))
+            h = min(1.0, scale / np.sqrt(ar))
+            x0 = np.random.uniform(0, 1 - w)
+            y0 = np.random.uniform(0, 1 - h)
+            crop = np.array([x0, y0, x0 + w, y0 + h], np.float32)
+            if boxes.shape[0] == 0:
+                return crop
+            iou = _box_iou(crop, boxes)
+            if iou.max() < min_ovp[i] or iou.max() > max_ovp[i]:
+                continue
+            scov = _coverage(boxes[iou.argmax()][None, :], crop)[0]
+            if scov < min_scov[i] or scov > max_scov[i]:
+                continue
+            ocov = _coverage(boxes, crop)
+            vis = ocov[ocov > 0]
+            if vis.size and (vis.min() < min_ocov[i] or
+                             vis.max() > max_ocov[i]):
+                continue
+            return crop
+        return None
+
+    def aug(img, label):
+        if np.random.random() >= p:
+            return img, label
+        boxes = label.objects[:, 1:5] if label.objects.shape[0] else \
+            np.zeros((0, 4), np.float32)
+        samplers = list(range(n))
+        np.random.shuffle(samplers)
+        for i in samplers:
+            crop = _sample_one(i, boxes)
+            if crop is None:
+                continue
+            new_objs = _crop_boxes(label, crop, crop_emit_mode,
+                                   emit_overlap_thresh)
+            if label.objects.shape[0] and new_objs.shape[0] == 0:
+                continue   # crop ejected every object; try next sampler
+            h, w = img.shape[:2]
+            x0, y0 = int(crop[0] * w), int(crop[1] * h)
+            x1, y1 = max(x0 + 1, int(crop[2] * w)), \
+                max(y0 + 1, int(crop[3] * h))
+            img = img[y0:y1, x0:x1, :]
+            label.objects = new_objs
+            break
+        return img, label
+    return aug
+
+
+def DetRandomPadAug(max_pad_scale=2.0, fill_value=127, p=1.0):
+    """Expansion padding (reference rand_pad): place the image on a larger
+    fill-valued canvas; boxes shrink into canvas coordinates."""
+    def aug(img, label):
+        if np.random.random() >= p or max_pad_scale <= 1.0:
+            return img, label
+        h, w = img.shape[:2]
+        scale = np.random.uniform(1.0, max_pad_scale)
+        nh, nw = int(h * scale), int(w * scale)
+        y0 = np.random.randint(0, nh - h + 1)
+        x0 = np.random.randint(0, nw - w + 1)
+        canvas = np.full((nh, nw, img.shape[2]), fill_value,
+                         dtype=img.dtype)
+        canvas[y0:y0 + h, x0:x0 + w, :] = img
+        objs = label.objects
+        if objs.shape[0]:
+            objs[:, 1] = (objs[:, 1] * w + x0) / nw
+            objs[:, 3] = (objs[:, 3] * w + x0) / nw
+            objs[:, 2] = (objs[:, 2] * h + y0) / nh
+            objs[:, 4] = (objs[:, 4] * h + y0) / nh
+        return canvas, label
+    return aug
+
+
+def DetResizeAug(data_shape, interp=2):
+    """Force-resize to (h, w); normalized boxes are resize-invariant.
+
+    Pure PIL/numpy — augmenters run on decode pool threads, where jax
+    dispatch must never appear (concurrent tracing deadlocks)."""
+    from .io.image_util import _require_pil
+    from .image import _pil_filter
+    _, h, w = data_shape
+
+    def aug(img, label):
+        _require_pil()
+        from PIL import Image
+        if img.dtype != np.uint8:
+            img = np.clip(img, 0, 255).astype(np.uint8)
+        arr = np.asarray(Image.fromarray(img).resize(
+            (w, h), _pil_filter(interp)), dtype=np.float32)
+        return arr, label
+    return aug
+
+
+def DetColorNormalizeAug(mean, std=None):
+    def aug(img, label):
+        img = img.astype(np.float32) - np.asarray(mean, np.float32)
+        if std is not None:
+            img = img / np.asarray(std, np.float32)
+        return img, label
+    return aug
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop_prob=0,
+                       min_crop_scales=(0.0,), max_crop_scales=(1.0,),
+                       min_crop_aspect_ratios=(1.0,),
+                       max_crop_aspect_ratios=(1.0,),
+                       min_crop_overlaps=(0.0,), max_crop_overlaps=(1.0,),
+                       min_crop_sample_coverages=(0.0,),
+                       max_crop_sample_coverages=(1.0,),
+                       min_crop_object_coverages=(0.0,),
+                       max_crop_object_coverages=(1.0,),
+                       num_crop_sampler=1, crop_emit_mode="center",
+                       emit_overlap_thresh=0.3, max_crop_trials=(25,),
+                       rand_pad_prob=0, max_pad_scale=1.0,
+                       rand_mirror_prob=0, fill_value=127, inter_method=1,
+                       mean=None, std=None):
+    """Build the default detection augmenter list (the python analog of
+    DefaultImageDetAugmenter's apply order: pad → crop → mirror → resize →
+    normalize)."""
+    auglist = []
+    if rand_pad_prob > 0 and max_pad_scale > 1.0:
+        auglist.append(DetRandomPadAug(max_pad_scale, fill_value,
+                                       rand_pad_prob))
+    if rand_crop_prob > 0:
+        auglist.append(DetRandomCropAug(
+            min_crop_scales, max_crop_scales, min_crop_aspect_ratios,
+            max_crop_aspect_ratios, min_crop_overlaps, max_crop_overlaps,
+            min_crop_sample_coverages, max_crop_sample_coverages,
+            min_crop_object_coverages, max_crop_object_coverages,
+            num_crop_sampler, crop_emit_mode, emit_overlap_thresh,
+            max_crop_trials, rand_crop_prob))
+    if rand_mirror_prob > 0:
+        auglist.append(DetHorizontalFlipAug(rand_mirror_prob))
+    auglist.append(DetResizeAug(data_shape, inter_method))
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = np.array([58.395, 57.12, 57.375])
+        auglist.append(DetColorNormalizeAug(mean, std))
+    return auglist

@@ -6,8 +6,9 @@ BatchLoader/PrefetcherIter decorators) + ``python/mxnet/io.py``
 """
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
                  MNISTIter, PrefetchingIter, ResizeIter, ImageRecordIter)
+from .detection import ImageDetRecordIter
 from . import recordio
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "MNISTIter", "PrefetchingIter", "ResizeIter", "ImageRecordIter",
-           "recordio"]
+           "ImageDetRecordIter", "recordio"]

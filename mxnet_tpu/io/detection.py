@@ -1,0 +1,173 @@
+"""Detection RecordIO iterator: images + variable-count bbox labels.
+
+Reference: ``src/io/iter_image_det_recordio.cc`` (ImageDetRecordIter) —
+RecordIO records whose header label is the detection layout
+``[header_width, object_width, extra..., (id,x1,y1,x2,y2,...)*N]``,
+decoded + bbox-aware-augmented in worker threads, batched with the label
+tensor padded to a fixed object count with -1 rows (what MultiBoxTarget
+consumes).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..image_det import CreateDetAugmenter, DetLabel
+from .io import DataBatch, DataDesc, DataIter
+from .pipeline import ThreadedBatchPipeline
+
+__all__ = ["ImageDetRecordIter"]
+
+
+class ImageDetRecordIter(DataIter):
+    """RecordIO detection iterator with bbox-aware augmentation.
+
+    ``label_pad_width`` fixes the flattened label length per image
+    (header + object_width * max_objects); with the default 0 the padded
+    object count is ``max_objects`` (derived) or 16.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, label_pad_width=0,
+                 label_pad_value=-1.0, max_objects=16,
+                 preprocess_threads=4, prefetch_buffer=4,
+                 aug_list=None, data_name="data", label_name="label",
+                 mean_pixels=None, std_pixels=None, **aug_kwargs):
+        super().__init__(batch_size)
+        from . import recordio
+        if len(data_shape) != 3:
+            raise MXNetError("data_shape must be (c, h, w)")
+        self.data_shape = tuple(data_shape)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.label_pad_value = float(label_pad_value)
+        self._recordio = recordio
+        self._path = path_imgrec
+        if shuffle and not path_imgidx:
+            raise MXNetError("shuffle requires path_imgidx "
+                             "(random access needs the index)")
+        self._shuffle = shuffle
+        if path_imgidx:
+            self._rec = recordio.MXIndexedRecordIO(path_imgidx,
+                                                   path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._rec = recordio.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+        self._order = None
+        self._pos = 0
+
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(
+                self.data_shape, mean=mean_pixels, std=std_pixels,
+                **aug_kwargs)
+        self.auglist = aug_list
+
+        if label_pad_width:
+            object_width = self._peek_object_width()
+            n = (label_pad_width - 2) // object_width
+            if n <= 0:
+                raise MXNetError("label_pad_width %d too small"
+                                 % label_pad_width)
+            self.max_objects = n
+            self._object_width = object_width
+        else:
+            self.max_objects = max_objects
+            self._object_width = self._peek_object_width()
+
+        self._pipeline = ThreadedBatchPipeline(
+            self._read_raw, self._decode_one, self._assemble,
+            self._rewind, batch_size,
+            preprocess_threads=preprocess_threads,
+            prefetch=prefetch_buffer)
+
+    # -- raw record source (producer thread) ---------------------------
+    def _peek_object_width(self):
+        s = self._rec.read() if self._keys is None else \
+            self._rec.read_idx(self._keys[0])
+        self._rec.reset() if self._keys is None else None
+        if s is None:
+            raise MXNetError("empty record file %s" % self._path)
+        header, _ = self._recordio.unpack(s)
+        return DetLabel(header.label).object_width
+
+    def _read_raw(self):
+        if self._keys is not None:
+            if self._order is None:
+                self._order = list(self._keys)
+                if self._shuffle:
+                    np.random.shuffle(self._order)
+            if self._pos >= len(self._order):
+                return None
+            s = self._rec.read_idx(self._order[self._pos])
+            self._pos += 1
+            return s
+        return self._rec.read()
+
+    def _rewind(self):
+        self._pos = 0
+        if self._keys is not None:
+            if self._shuffle:
+                np.random.shuffle(self._order)
+        else:
+            self._rec.reset()
+
+    # -- per-record decode + augment (pool threads) --------------------
+    def _decode_one(self, raw):
+        from .image_util import decode_image
+        header, img_bytes = self._recordio.unpack(raw)
+        label = DetLabel(header.label)
+        img = decode_image(img_bytes)  # uint8 until resize casts
+        for aug in self.auglist:
+            img, label = aug(img, label)
+        chw = np.transpose(img, (2, 0, 1))
+        objs = label.objects[:self.max_objects]
+        padded = np.full((self.max_objects, self._object_width),
+                         self.label_pad_value, np.float32)
+        padded[:objs.shape[0]] = objs
+        return chw, padded
+
+    def _assemble(self, samples, pad):
+        # numpy only — jax conversion happens on the consumer thread
+        data = np.stack([s[0] for s in samples])
+        label = np.stack([s[1] for s in samples])
+        return data, label, pad
+
+    # -- DataIter interface --------------------------------------------
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.max_objects,
+                          self._object_width))]
+
+    def reset(self):
+        self._pipeline.reset()
+
+    def next(self):
+        data, label, pad = self._pipeline.next_batch()
+        self._batch = DataBatch([nd.array(data)], [nd.array(label)],
+                                pad=pad, provide_data=self.provide_data,
+                                provide_label=self.provide_label)
+        return self._batch
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._batch.data
+
+    def getlabel(self):
+        return self._batch.label
+
+    def getpad(self):
+        return self._batch.pad

@@ -414,23 +414,24 @@ class _NativeRecordStream:
 class ImageRecordIter(DataIter):
     """RecordIO image iterator (reference iter_image_recordio_2.cc).
 
-    Full decode/augment parity needs the native pipeline (planned in
-    ``src/``, SURVEY.md §7.8); this python implementation reads the packed
-    record stream, decodes with PIL if available, and prefetches.
+    Throughput path: the native C++ prefetcher overlaps raw record reads
+    with decode, and ``preprocess_threads`` PIL-decode/augment workers run
+    behind a double-buffered batch queue (the dmlc::ThreadedIter + OMP
+    parser-pool analog, iter_image_recordio_2.cc:495-557).
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
-                 rand_crop=False, rand_mirror=False, prefetch_buffer=16,
-                 **kwargs):
+                 rand_crop=False, rand_mirror=False, prefetch_buffer=4,
+                 preprocess_threads=4, **kwargs):
         super().__init__(batch_size)
         from . import recordio
         from .image_util import decode_record_image
+        from .pipeline import ThreadedBatchPipeline
+        self._recordio = recordio
         self._decode = decode_record_image
         if recordio._use_native():
-            # native reader thread + bounded queue (dmlc::ThreadedIter
-            # analog) overlaps record IO with decode/augment
-            self.record = _NativeRecordStream(path_imgrec, prefetch_buffer)
+            self.record = _NativeRecordStream(path_imgrec, 16)
         else:
             self.record = recordio.MXRecordIO(path_imgrec, "r")
         self.data_shape = tuple(data_shape)
@@ -441,6 +442,27 @@ class ImageRecordIter(DataIter):
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self._batch = None
+        self._pipeline = ThreadedBatchPipeline(
+            self.record.read, self._decode_one, self._assemble,
+            self.record.reset, batch_size,
+            preprocess_threads=preprocess_threads,
+            prefetch=prefetch_buffer)
+
+    def _decode_one(self, s):
+        header, img_bytes = self._recordio.unpack(s)
+        img = self._decode(img_bytes, self.data_shape,
+                           rand_crop=self.rand_crop,
+                           rand_mirror=self.rand_mirror)
+        img = (img - self.mean) * self.scale
+        lbl = header.label
+        if self.label_width == 1:
+            lbl = float(np.asarray(lbl).reshape(-1)[0])
+        return img, lbl
+
+    def _assemble(self, samples, pad):
+        # numpy only — jax conversion happens on the consumer thread
+        return (np.stack([s[0] for s in samples]),
+                np.asarray([s[1] for s in samples]), pad)
 
     @property
     def provide_data(self):
@@ -453,31 +475,16 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape)]
 
     def reset(self):
-        self.record.reset()
+        self._pipeline.reset()
 
     def iter_next(self):
-        from . import recordio
-        datas, labels = [], []
-        for _ in range(self.batch_size):
-            s = self.record.read()
-            if s is None:
-                if not datas:
-                    return False
-                while len(datas) < self.batch_size:  # pad with wrap
-                    datas.append(datas[-1])
-                    labels.append(labels[-1])
-                break
-            header, img_bytes = recordio.unpack(s)
-            img = self._decode(img_bytes, self.data_shape,
-                               rand_crop=self.rand_crop,
-                               rand_mirror=self.rand_mirror)
-            img = (img - self.mean) * self.scale
-            datas.append(img)
-            lbl = header.label
-            labels.append(lbl if self.label_width > 1 else float(
-                np.asarray(lbl).reshape(-1)[0]))
-        self._batch = DataBatch([nd.array(np.stack(datas))],
-                                [nd.array(np.asarray(labels))], pad=0)
+        try:
+            data, label, pad = self._pipeline.next_batch()
+        except StopIteration:
+            return False
+        self._batch = DataBatch([nd.array(data)], [nd.array(label)],
+                                pad=pad, provide_data=self.provide_data,
+                                provide_label=self.provide_label)
         return True
 
     def next(self):
@@ -492,4 +499,4 @@ class ImageRecordIter(DataIter):
         return self._batch.label
 
     def getpad(self):
-        return 0
+        return self._batch.pad if self._batch else 0

@@ -1,0 +1,136 @@
+"""Threaded decode/augment pipeline with double-buffered batches.
+
+Reference: ``src/io/iter_image_recordio_2.cc:495-557`` — recordio chunks are
+decoded + augmented by an OMP thread pool behind a ``dmlc::ThreadedIter``
+double buffer, so the training loop never waits on JPEG decode.  Python
+analog: a producer thread reads raw records (the native C++ prefetcher
+already overlaps disk IO), fans decode work out to a thread pool with a
+bounded in-flight window (order-preserving), assembles batches, and parks
+them in a bounded queue the iterator pops from.  PIL's JPEG decode releases
+the GIL, so pool threads genuinely overlap.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+from ..base import MXNetError
+
+__all__ = ["ThreadedBatchPipeline"]
+
+_EOF = object()
+
+
+class ThreadedBatchPipeline:
+    """Producer/consumer batch pipeline.
+
+    Parameters
+    ----------
+    read_fn : () -> raw | None
+        Sequential raw-record source; None signals end of epoch.
+    decode_fn : raw -> sample
+        CPU-bound per-record work (decode + augment); runs in pool threads.
+    assemble_fn : (samples, pad) -> batch
+        Builds the final batch object on the producer thread.
+    reset_fn : () -> None
+        Rewinds the raw source for the next epoch.
+    """
+
+    def __init__(self, read_fn, decode_fn, assemble_fn, reset_fn,
+                 batch_size, preprocess_threads=4, prefetch=4,
+                 pad_last=True):
+        self._read = read_fn
+        self._decode = decode_fn
+        self._assemble = assemble_fn
+        self._reset_src = reset_fn
+        self.batch_size = batch_size
+        self._threads = max(1, int(preprocess_threads))
+        self._prefetch = max(1, int(prefetch))
+        self._pad_last = pad_last
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._threads,
+            thread_name_prefix="mxt-decode")
+        self._queue = None
+        self._producer = None
+        self._stop = threading.Event()
+        self._start()
+
+    # -- producer -------------------------------------------------------
+    def _start(self):
+        self._stop.clear()
+        self._queue = queue.Queue(maxsize=self._prefetch)
+        self._producer = threading.Thread(target=self._produce,
+                                          daemon=True)
+        self._producer.start()
+
+    def _produce(self):
+        q = self._queue
+        try:
+            futures = deque()
+            window = self._threads * 2
+            samples = []
+            eof = False
+            while not self._stop.is_set():
+                while not eof and len(futures) < window:
+                    raw = self._read()
+                    if raw is None:
+                        eof = True
+                        break
+                    futures.append(self._pool.submit(self._decode, raw))
+                if futures:
+                    samples.append(futures.popleft().result())
+                    if len(samples) == self.batch_size:
+                        q.put(self._assemble(samples, 0))
+                        samples = []
+                    continue
+                # end of stream: flush the partial batch (padded by
+                # repeating the last sample, pad count reported)
+                if samples and self._pad_last:
+                    pad = self.batch_size - len(samples)
+                    samples = samples + [samples[-1]] * pad
+                    q.put(self._assemble(samples, pad))
+                q.put(_EOF)
+                return
+        except BaseException as e:  # surface worker errors to the consumer
+            q.put(e)
+
+    # -- consumer -------------------------------------------------------
+    def next_batch(self):
+        """Next assembled batch; raises StopIteration at epoch end."""
+        item = self._queue.get()
+        if item is _EOF:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise MXNetError("data pipeline worker failed: %r" % (item,)) \
+                from item
+        return item
+
+    def reset(self):
+        """Stop in-flight work, rewind the source, restart the producer."""
+        self._stop.set()
+        # drain so a blocked producer can observe the stop flag
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._producer.join(timeout=30)
+        self._reset_src()
+        self._start()
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._pool.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

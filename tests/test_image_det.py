@@ -1,0 +1,194 @@
+"""Detection IO tests: bbox-aware augmenters, ImageDetRecordIter, and the
+threaded decode pipeline (reference iter_image_det_recordio.cc +
+image_det_aug_default.cc + iter_image_recordio_2.cc test coverage)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.image_det import (CreateDetAugmenter, DetHorizontalFlipAug,
+                                 DetLabel, DetRandomCropAug,
+                                 DetRandomPadAug)
+from mxnet_tpu.io import ImageDetRecordIter
+from mxnet_tpu.io import recordio
+
+
+def _det_label(boxes, extra_header=()):
+    """[header_width, object_width, extra..., (id,x1,y1,x2,y2)*N]"""
+    header = [2 + len(extra_header), 5] + list(extra_header)
+    flat = []
+    for b in boxes:
+        flat.extend(b)
+    return np.array(header + flat, dtype=np.float32)
+
+
+def _make_rec(tmp_path, n=24, size=64, with_idx=True):
+    """Synthetic detection .rec: colored rectangles on noise."""
+    rec_path = str(tmp_path / "det.rec")
+    idx_path = str(tmp_path / "det.idx")
+    rs = np.random.RandomState(0)
+    if with_idx:
+        w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    else:
+        w = recordio.MXRecordIO(rec_path, "w")
+    for i in range(n):
+        img = rs.randint(0, 80, (size, size, 3)).astype(np.uint8)
+        x0, y0 = rs.randint(4, size // 2, 2)
+        bw, bh = rs.randint(8, size // 2, 2)
+        x1, y1 = min(x0 + bw, size - 1), min(y0 + bh, size - 1)
+        cls = rs.randint(0, 3)
+        img[y0:y1, x0:x1] = [(255, 0, 0), (0, 255, 0),
+                             (0, 0, 255)][cls]
+        label = _det_label([[cls, x0 / size, y0 / size,
+                             x1 / size, y1 / size]])
+        header = recordio.IRHeader(0, label, i, 0)
+        buf = recordio.pack_img(header, img, quality=95)
+        if with_idx:
+            w.write_idx(i, buf)
+        else:
+            w.write(buf)
+    w.close()
+    return rec_path, idx_path
+
+
+def test_det_label_parse_roundtrip():
+    lbl = DetLabel(_det_label([[1, .1, .2, .5, .6], [0, .3, .3, .9, .8]],
+                              extra_header=(7.0,)))
+    assert lbl.object_width == 5
+    assert lbl.objects.shape == (2, 5)
+    assert lbl.header[2] == 7.0
+    np.testing.assert_allclose(lbl.objects[0], [1, .1, .2, .5, .6])
+    flat = lbl.flatten()
+    assert flat[0] == 3 and flat[1] == 5
+
+
+def test_det_flip_updates_boxes():
+    np.random.seed(0)
+    aug = DetHorizontalFlipAug(p=1.0)
+    img = np.zeros((10, 20, 3), np.float32)
+    img[:, :10, 0] = 1.0  # left half red
+    lbl = DetLabel(_det_label([[0, 0.0, 0.0, 0.5, 1.0]]))
+    img2, lbl2 = aug(img, lbl)
+    np.testing.assert_allclose(lbl2.objects[0, 1:5], [0.5, 0.0, 1.0, 1.0])
+    assert img2[0, -1, 0] == 1.0  # red moved to the right
+
+def test_det_pad_shrinks_boxes():
+    np.random.seed(1)
+    aug = DetRandomPadAug(max_pad_scale=2.0, fill_value=0, p=1.0)
+    img = np.ones((20, 20, 3), np.float32) * 255
+    lbl = DetLabel(_det_label([[0, 0.0, 0.0, 1.0, 1.0]]))
+    img2, lbl2 = aug(img, lbl)
+    h, w = img2.shape[:2]
+    assert h >= 20 and w >= 20
+    b = lbl2.objects[0, 1:5]
+    # box must frame exactly the original image inside the canvas
+    assert (b[2] - b[0]) * w == pytest.approx(20, abs=1e-3)
+    assert (b[3] - b[1]) * h == pytest.approx(20, abs=1e-3)
+
+
+def test_det_crop_constraints_and_box_update():
+    np.random.seed(2)
+    aug = DetRandomCropAug(min_scales=(0.5,), max_scales=(0.9,),
+                           min_overlaps=(0.1,), p=1.0)
+    img = np.arange(40 * 40 * 3, dtype=np.float32).reshape(40, 40, 3)
+    lbl = DetLabel(_det_label([[1, 0.25, 0.25, 0.75, 0.75]]))
+    for _ in range(10):
+        im2, lb2 = aug(img.copy(), lbl.copy())
+        assert im2.shape[0] <= 40 and im2.shape[1] <= 40
+        if lb2.objects.shape[0]:
+            b = lb2.objects[:, 1:5]
+            assert (b >= 0).all() and (b <= 1).all()
+            assert (b[:, 2] >= b[:, 0]).all()
+            assert (b[:, 3] >= b[:, 1]).all()
+
+
+def test_det_record_iter_shapes_and_padding(tmp_path):
+    rec, idx = _make_rec(tmp_path, n=10)
+    it = ImageDetRecordIter(path_imgrec=rec, path_imgidx=idx,
+                            data_shape=(3, 32, 32), batch_size=4,
+                            max_objects=8, preprocess_threads=2)
+    assert it.provide_label[0].shape == (4, 8, 5)
+    batches = list(it)
+    assert len(batches) == 3            # 10 records -> 4+4+2(pad 2)
+    assert batches[-1].pad == 2
+    b0 = batches[0]
+    assert b0.data[0].shape == (4, 3, 32, 32)
+    lab = b0.label[0].asnumpy()
+    assert lab.shape == (4, 8, 5)
+    # first row is a real object, padded rows are -1
+    assert (lab[:, 0, 0] >= 0).all()
+    assert (lab[:, 1:, :] == -1).all()
+    coords = lab[:, 0, 1:5]
+    assert (coords >= 0).all() and (coords <= 1).all()
+    # second epoch after reset yields the same count
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_det_record_iter_augmented_epoch(tmp_path):
+    rec, idx = _make_rec(tmp_path, n=8)
+    it = ImageDetRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 32, 32),
+        batch_size=4, max_objects=4, preprocess_threads=3,
+        rand_mirror_prob=0.5, rand_crop_prob=0.5,
+        min_crop_scales=(0.6,), max_crop_scales=(1.0,),
+        min_crop_aspect_ratios=(0.8,), max_crop_aspect_ratios=(1.25,),
+        rand_pad_prob=0.5, max_pad_scale=1.5,
+        mean_pixels=[123.68, 116.78, 103.94])
+    for batch in it:
+        lab = batch.label[0].asnumpy()
+        real = lab[lab[:, :, 0] >= 0]
+        if real.size:
+            assert (real[:, 1:5] >= 0).all()
+            assert (real[:, 1:5] <= 1).all()
+
+
+def test_det_record_iter_label_pad_width(tmp_path):
+    rec, idx = _make_rec(tmp_path, n=6)
+    it = ImageDetRecordIter(path_imgrec=rec, path_imgidx=idx,
+                            data_shape=(3, 32, 32), batch_size=2,
+                            label_pad_width=2 + 5 * 10)
+    assert it.provide_label[0].shape == (2, 10, 5)
+
+
+def test_det_record_iter_shuffle_order(tmp_path):
+    rec, idx = _make_rec(tmp_path, n=16)
+    it = ImageDetRecordIter(path_imgrec=rec, path_imgidx=idx,
+                            data_shape=(3, 32, 32), batch_size=4,
+                            shuffle=True, preprocess_threads=2)
+    np.random.seed(3)
+    e1 = np.concatenate([b.label[0].asnumpy()[:, 0, 1]
+                         for b in it])
+    it.reset()
+    e2 = np.concatenate([b.label[0].asnumpy()[:, 0, 1]
+                         for b in it])
+    assert e1.shape == e2.shape
+    assert sorted(e1.tolist()) == pytest.approx(sorted(e2.tolist()))
+    assert not np.allclose(e1, e2)  # reshuffled between epochs
+
+
+def test_image_record_iter_threaded_parity(tmp_path):
+    """Threaded classification pipeline: same samples as single-thread,
+    pad reported on the final partial batch."""
+    rec_path = str(tmp_path / "cls.rec")
+    rs = np.random.RandomState(1)
+    w = recordio.MXRecordIO(rec_path, "w")
+    for i in range(10):
+        img = rs.randint(0, 255, (40, 40, 3)).astype(np.uint8)
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img))
+    w.close()
+
+    def collect(threads):
+        it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                                   data_shape=(3, 32, 32), batch_size=4,
+                                   preprocess_threads=threads)
+        labels, pads = [], []
+        for b in it:
+            labels.append(b.label[0].asnumpy())
+            pads.append(b.pad)
+        return np.concatenate(labels), pads
+
+    l1, p1 = collect(1)
+    l4, p4 = collect(4)
+    np.testing.assert_allclose(l1, l4)
+    assert p1 == p4 == [0, 0, 2]
