@@ -1,8 +1,19 @@
 """Train SSD-VGG16 (reference example/ssd/train.py).
 
-With --synthetic (default when no .rec is given) trains on generated
-colored-rectangle scenes so the full detection pipeline (anchors, target
-assignment, multi-task loss) runs without datasets."""
+Two data modes:
+* ``--rec PATH.rec [--rec-idx PATH.idx]`` — train from RecordIO detection
+  records through ``ImageDetRecordIter`` (the reference's
+  ``iter_image_det_recordio.cc`` path: threaded decode + bbox-aware
+  augmentation).
+* default (no ``--rec``) — synthetic colored-rectangle scenes so the full
+  detection pipeline (anchors, target assignment, multi-task loss) runs
+  without datasets.
+
+``--make-rec DIR`` generates a tiny synthetic detection dataset (JPEG
+images + .lst) and packs it with ``tools/im2rec.py --pack-label`` into
+``DIR/ssd_synth.rec``/``.idx``, then exits — a self-contained way to
+exercise the real-record path end-to-end.
+"""
 from __future__ import annotations
 
 import argparse
@@ -59,6 +70,67 @@ class SyntheticDetIter(mx.io.DataIter):
                                label=[mx.nd.array(label)], pad=0)
 
 
+def make_synthetic_rec(out_dir, num_images=16, num_classes=3, size=96,
+                       seed=0):
+    """Generate a tiny detection dataset and pack it via tools/im2rec.py.
+
+    Writes JPEGs + a detection-layout .lst (``idx  header_width
+    object_width  (cls x0 y0 x1 y1)*  path``), then runs im2rec with
+    ``--pack-label`` — the same tooling flow the reference documents for
+    building SSD training records.  Returns (rec_path, idx_path)."""
+    from mxnet_tpu.io.image_util import encode_image
+    sys.path.insert(0, os.path.join(CURR, "..", "..", "tools"))
+    import im2rec
+
+    os.makedirs(out_dir, exist_ok=True)
+    img_dir = os.path.join(out_dir, "images")
+    os.makedirs(img_dir, exist_ok=True)
+    rs = np.random.RandomState(seed)
+    colors = [(255, 40, 40), (40, 255, 40), (40, 40, 255)]
+    lines = []
+    for i in range(num_images):
+        img = rs.randint(0, 80, (size, size, 3)).astype(np.uint8)
+        labels = []
+        for _ in range(rs.randint(1, 3)):
+            cls = rs.randint(0, num_classes)
+            x0, y0 = rs.randint(4, size // 2, 2)
+            bw, bh = rs.randint(size // 4, size // 2, 2)
+            x1, y1 = min(x0 + bw, size - 2), min(y0 + bh, size - 2)
+            img[y0:y1, x0:x1] = colors[cls % len(colors)]
+            labels.extend([cls, x0 / size, y0 / size, x1 / size, y1 / size])
+        name = "img_%03d.jpg" % i
+        with open(os.path.join(img_dir, name), "wb") as f:
+            f.write(encode_image(img, quality=95))
+        # det layout: header_width=2, object_width=5, then flat boxes
+        lab = [2, 5] + labels
+        lines.append("%d\t%s\t%s" % (i, "\t".join("%g" % v for v in lab),
+                                     os.path.join("images", name)))
+    lst_path = os.path.join(out_dir, "ssd_synth.lst")
+    with open(lst_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    im2rec.main([lst_path[:-4], out_dir, "--pack-label", "1",
+                 "--shuffle", "0"])
+    return (os.path.join(out_dir, "ssd_synth.rec"),
+            os.path.join(out_dir, "ssd_synth.idx"))
+
+
+def get_rec_iter(args):
+    """ImageDetRecordIter over the given records (reference
+    example/ssd/train.py builds the same from --train-path)."""
+    shape = (3, args.data_shape, args.data_shape)
+    return mx.io.ImageDetRecordIter(
+        path_imgrec=args.rec,
+        path_imgidx=args.rec_idx or None,
+        data_shape=shape,
+        batch_size=args.batch_size,
+        shuffle=bool(args.rec_idx),
+        max_objects=args.max_objects,
+        mean_pixels=(123.68, 116.779, 103.939),
+        std_pixels=(58.393, 57.12, 57.375),
+        rand_mirror_prob=0.5,
+        preprocess_threads=args.preprocess_threads)
+
+
 def main():
     parser = argparse.ArgumentParser(description="Train an SSD detector")
     parser.add_argument("--num-classes", type=int, default=20)
@@ -66,7 +138,16 @@ def main():
     parser.add_argument("--data-shape", type=int, default=300)
     parser.add_argument("--num-epochs", type=int, default=1)
     parser.add_argument("--num-batches", type=int, default=8,
-                        help="synthetic batches per epoch")
+                        help="synthetic batches per epoch (no --rec)")
+    parser.add_argument("--rec", type=str, default=None,
+                        help="train from this RecordIO detection file")
+    parser.add_argument("--rec-idx", type=str, default=None,
+                        help=".idx for --rec (enables shuffling)")
+    parser.add_argument("--max-objects", type=int, default=16,
+                        help="label rows per image (padded with -1)")
+    parser.add_argument("--preprocess-threads", type=int, default=4)
+    parser.add_argument("--make-rec", type=str, default=None,
+                        help="generate a synthetic .rec into DIR and exit")
     parser.add_argument("--lr", type=float, default=0.002)
     parser.add_argument("--wd", type=float, default=5e-4)
     parser.add_argument("--mom", type=float, default=0.9)
@@ -74,10 +155,19 @@ def main():
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
+    if args.make_rec:
+        rec, idx = make_synthetic_rec(args.make_rec,
+                                      num_classes=args.num_classes)
+        logging.info("wrote %s and %s", rec, idx)
+        return
+
     net = mx.models.ssd_train(num_classes=args.num_classes)
     shape = (3, args.data_shape, args.data_shape)
-    train = SyntheticDetIter(args.num_classes, args.batch_size, shape,
-                             args.num_batches)
+    if args.rec:
+        train = get_rec_iter(args)
+    else:
+        train = SyntheticDetIter(args.num_classes, args.batch_size, shape,
+                                 args.num_batches)
 
     mod = mx.Module(net, data_names=("data",), label_names=("label",),
                     context=mx.current_context(),

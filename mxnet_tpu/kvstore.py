@@ -155,12 +155,17 @@ class KVStoreDist(KVStore):
         self._size = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._shapes = {}
         self._closed = False
+        # a recovered worker skips startup barriers: the surviving group is
+        # already past them (ps::Postoffice::is_recovery skip-barrier,
+        # kvstore_dist.h:39,77,178)
+        self._is_recovery = self._client.is_recovery
         # rank0 flips servers to bulk-sync unless async
         # (reference kvstore.cc:34-42)
         if "async" not in kv_type:
-            if self._rank == 0:
+            if self._rank == 0 and not self._is_recovery:
                 self._client.send_command("sync_mode", b"")
-            self._client.barrier()
+            if not self._is_recovery:
+                self._client.barrier()
         import atexit
         atexit.register(self.close)
 
@@ -169,10 +174,13 @@ class KVStoreDist(KVStore):
         for k, v in zip(keys, values):
             vv = v[0] if isinstance(v, (list, tuple)) else v
             self._shapes[k] = vv.shape
-            if self._rank == 0:
-                # rank0 pushes initial weights (kvstore_dist.h:62-80)
+            if self._rank == 0 and not self._is_recovery:
+                # rank0 pushes initial weights (kvstore_dist.h:62-80); a
+                # recovered rank0 must NOT re-init — the servers hold the
+                # surviving group's trained state
                 self._client.init(k, self._flat(vv))
-        self._client.barrier()
+        if not self._is_recovery:
+            self._client.barrier()
 
     def _flat(self, v):
         import numpy as np
@@ -207,15 +215,18 @@ class KVStoreDist(KVStore):
         """Ship the pickled optimizer to the servers (command 0) — the
         update then runs server-side (python/mxnet/kvstore.py:226-249)."""
         body = pickle.dumps(optimizer)
-        if self._rank == 0:
+        if self._rank == 0 and not self._is_recovery:
             self._client.send_command(0, body)
-        self._client.barrier()
+        if not self._is_recovery:
+            self._client.barrier()
 
     def barrier(self):
         self._client.barrier()
 
     def get_num_dead_node(self, node_id=0, timeout=60):
-        return self._client.get_num_dead_node()
+        """Actual dead-node count from scheduler heartbeat ages
+        (reference kvstore_dist.h:159-168)."""
+        return self._client.get_num_dead_node(node_id, timeout)
 
     def close(self):
         if not self._closed:

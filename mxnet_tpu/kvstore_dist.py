@@ -70,10 +70,48 @@ def _connect(addr, retries=600, delay=0.1):
     raise MXNetError("cannot connect to %s: %s" % (addr, last))
 
 
+def _start_heartbeat(role, rank, stop_event=None):
+    """Send liveness beats to the scheduler on a dedicated connection
+    (barriers block the main scheduler connection for minutes; heartbeats
+    must keep flowing — ps-lite likewise runs them on the van's own
+    thread).  Interval: MXNET_KVSTORE_HEARTBEAT_INTERVAL seconds."""
+    interval = float(_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "1.0"))
+
+    def beat():
+        try:
+            conn = _connect(_root_addr(), retries=50)
+        except MXNetError:
+            return
+        try:
+            while stop_event is None or not stop_event.is_set():
+                conn.send(("heartbeat", role, rank))
+                time.sleep(interval)
+        except (EOFError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    t = threading.Thread(target=beat, daemon=True)
+    t.start()
+    return t
+
+
 # ---------------------------------------------------------------------------
 # Scheduler (ps-lite Postoffice root: membership + barriers)
 # ---------------------------------------------------------------------------
 class Scheduler:
+    """Membership + barriers + liveness (ps::Postoffice role).
+
+    Liveness: every node sends periodic heartbeats on a dedicated
+    connection; ``num_dead`` counts registered, not-cleanly-finalized
+    nodes whose last heartbeat is older than the caller's timeout
+    (reference ps-lite heartbeats behind ``get_num_dead_node``,
+    kvstore_dist.h:159-168).  A node registering with a recovery rank
+    reuses its slot (``ps::Postoffice::is_recovery`` re-join)."""
+
     def __init__(self):
         self.num_workers = int(_env("DMLC_NUM_WORKER", "1"))
         self.num_servers = int(_env("DMLC_NUM_SERVER", "1"))
@@ -84,9 +122,33 @@ class Scheduler:
         self.next_worker = 0
         self.barrier_count = 0
         self.barrier_gen = 0
+        self.last_seen = {}      # (role, rank) -> last heartbeat time
+        self.finalized = set()   # nodes that deregistered cleanly
+
+    def _mark(self, role, rank):
+        self.last_seen[(role, rank)] = time.time()
+        self.finalized.discard((role, rank))
+
+    def _count_dead(self, mask, timeout):
+        """Dead nodes in the ps-lite group mask (2=servers, 4=workers;
+        0 means all groups)."""
+        if mask == 0:
+            mask = 7
+        now = time.time()
+        cnt = 0
+        with self.lock:
+            for (role, rank), ts in self.last_seen.items():
+                if (role, rank) in self.finalized:
+                    continue
+                bit = 2 if role == "server" else 4
+                if (mask & bit) and now - ts > timeout:
+                    cnt += 1
+        return cnt
 
     def run(self):
-        """Serve until every worker has deregistered."""
+        """Serve until every expected node deregistered cleanly (crashed
+        nodes are covered by their recovery replacements; the launcher
+        reaps a scheduler outliving its workers)."""
         done = threading.Event()
         expected = self.num_workers + self.num_servers
 
@@ -103,16 +165,27 @@ class Scheduler:
                             rank = self.next_server
                             self.next_server += 1
                             self.server_addrs[rank] = msg[1]
+                            self._mark("server", rank)
                             self.lock.notify_all()
                         conn.send(("assigned", rank))
                     elif kind == "register_worker":
+                        recover_rank = msg[1] if len(msg) > 1 else None
                         with self.lock:
-                            rank = self.next_worker
-                            self.next_worker += 1
+                            if recover_rank is not None:
+                                rank = recover_rank
+                            else:
+                                rank = self.next_worker
+                                self.next_worker += 1
+                            self._mark("worker", rank)
                             while any(a is None for a in self.server_addrs):
                                 self.lock.wait()
                         conn.send(("assigned", rank,
                                    list(self.server_addrs)))
+                    elif kind == "heartbeat":
+                        _, role, rank = msg
+                        with self.lock:
+                            self.last_seen[(role, rank)] = time.time()
+                        # fire-and-forget: no reply
                     elif kind == "barrier":
                         with self.lock:
                             gen = self.barrier_gen
@@ -126,18 +199,24 @@ class Scheduler:
                                     self.lock.wait()
                         conn.send(("barrier_done",))
                     elif kind == "num_dead":
-                        conn.send(("num_dead", 0))
+                        mask = msg[1] if len(msg) > 1 else 0
+                        timeout = msg[2] if len(msg) > 2 else 60
+                        conn.send(("num_dead",
+                                   self._count_dead(mask, timeout)))
                     elif kind == "finalize":
+                        if len(msg) > 1:
+                            with self.lock:
+                                self.finalized.add((msg[1], msg[2]))
                         conn.send(("bye",))
+                        with self.lock:
+                            handle.finalizes += 1
+                            if handle.finalizes >= expected:
+                                done.set()
                         return
             finally:
                 conn.close()
-                with self.lock:
-                    handle.exits += 1
-                    if handle.exits >= expected:
-                        done.set()
 
-        handle.exits = 0
+        handle.finalizes = 0
         accept_thread = threading.Thread(target=self._accept,
                                          args=(handle, done),
                                          daemon=True)
@@ -212,6 +291,7 @@ class Server:
         sched = _connect(_root_addr())
         sched.send(("register_server", self.listener.address))
         _, self.rank = sched.recv()
+        _start_heartbeat("server", self.rank, self.stop_event)
 
         conns = []
         accept_t = threading.Thread(target=self._accept, args=(conns,),
@@ -219,7 +299,7 @@ class Server:
         accept_t.start()
         self.stop_event.wait()
         self.listener.close()
-        sched.send(("finalize",))
+        sched.send(("finalize", "server", self.rank))
         try:
             sched.recv()
         except (EOFError, OSError):
@@ -330,7 +410,14 @@ class WorkerClient:
     def __init__(self):
         self.sched = _connect(_root_addr())
         self.sched_lock = threading.Lock()
-        self.sched.send(("register_worker",))
+        # a restarted worker re-joins under its old rank
+        # (ps::Postoffice::is_recovery; kvstore_dist.h:39,77,178)
+        recover = _env("DMLC_PS_RECOVERY_RANK")
+        self.is_recovery = recover is not None
+        if self.is_recovery:
+            self.sched.send(("register_worker", int(recover)))
+        else:
+            self.sched.send(("register_worker",))
         msg = self.sched.recv()
         self.rank = msg[1]
         self.server_addrs = msg[2]
@@ -338,6 +425,8 @@ class WorkerClient:
         self.server_locks = [threading.Lock() for _ in self.servers]
         self.bigarray_bound = int(_env("MXNET_KVSTORE_BIGARRAY_BOUND",
                                        str(_BIGARRAY_DEFAULT)))
+        self._hb_stop = threading.Event()
+        _start_heartbeat("worker", self.rank, self._hb_stop)
 
     @property
     def num_servers(self):
@@ -440,13 +529,17 @@ class WorkerClient:
                                  "likely died)" % timeout)
             self.sched.recv()
 
-    def get_num_dead_node(self):
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Count of dead nodes in the ps-lite group mask ``node_id``
+        (2=servers, 4=workers, 0=all), judged by heartbeat age >
+        ``timeout`` seconds (reference kvstore_dist.h:159-168)."""
         with self.sched_lock:
-            self.sched.send(("num_dead",))
+            self.sched.send(("num_dead", node_id, timeout))
             return self.sched.recv()[1]
 
     def finalize(self, is_root):
         """rank0 stops the servers (reference kStopServer, kvstore_dist.h:47-59)."""
+        self._hb_stop.set()
         if is_root:
             for sid in range(self.num_servers):
                 try:
@@ -455,7 +548,7 @@ class WorkerClient:
                     pass
         with self.sched_lock:
             try:
-                self.sched.send(("finalize",))
+                self.sched.send(("finalize", "worker", self.rank))
                 self.sched.recv()
             except (EOFError, OSError):
                 pass

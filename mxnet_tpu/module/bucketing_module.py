@@ -116,10 +116,11 @@ class BucketingModule(BaseModule):
                         work_load_list=self._work_load_list,
                         fixed_param_names=self._fixed_param_names,
                         state_names=self._state_names)
-        # bucket modules share executor memory across shape variants; the
-        # fused single-program path doesn't apply (params must live in the
-        # shared executor pool)
-        module._fused_disabled = True
+        # the default bucket may take the fused fast path; further buckets
+        # adopt its trainer state (one shared parameter/optimizer pool,
+        # per-bucket compiled steps — the jit-cache analog of the
+        # reference's shared executor memory, bucketing_module.py:302-330)
+        module._on_defuse = self._handle_defuse
         module.bind(data_shapes, label_shapes, for_training,
                     inputs_need_grad, force_rebind=False,
                     shared_module=None, grad_req=grad_req)
@@ -142,15 +143,27 @@ class BucketingModule(BaseModule):
                             work_load_list=self._work_load_list,
                             fixed_param_names=self._fixed_param_names,
                             state_names=self._state_names)
+            module._on_defuse = self._handle_defuse
+            default = self._buckets[self._default_bucket_key]
             module.bind(data_shapes, label_shapes, self._curr_module.
                         for_training, self._curr_module.inputs_need_grad,
-                        force_rebind=False,
-                        shared_module=self._buckets[
-                            self._default_bucket_key])
+                        force_rebind=False, shared_module=default)
+            # fused adoption happens in the common block below
             self._buckets[bucket_key] = module
         # Buckets share parameter NDArray *handles* (see executor_group
         # shared_group plumbing), so no weight copying is needed on switch.
-        self._curr_module = self._buckets[bucket_key]
+        module = self._buckets[bucket_key]
+        default = self._buckets[self._default_bucket_key]
+        if (default._fused is not None and module._fused is None and
+                not module.optimizer_initialized and module is not default):
+            # bucket was created before the optimizer fused; join the pool
+            # (or, if its shapes can't share the trainer, resync the
+            # executor-group params so the fallback path isn't stale)
+            if not module._adopt_fused_from(default):
+                default._sync_params_from_devices()
+                module._exec_group.set_params(default._arg_params,
+                                              default._aux_params)
+        self._curr_module = module
         self._curr_bucket_key = bucket_key
         if self._monitor is not None:
             self._curr_module.install_monitor(self._monitor)
@@ -197,17 +210,43 @@ class BucketingModule(BaseModule):
             self.optimizer_initialized
         self._params_dirty = True
         if not self._curr_module.optimizer_initialized:
-            # lazily share optimizer state with the new bucket
-            self._curr_module._optimizer = \
-                self._buckets[self._default_bucket_key]._optimizer
-            self._curr_module._updater = \
-                self._buckets[self._default_bucket_key]._updater
-            self._curr_module._kvstore = \
-                self._buckets[self._default_bucket_key]._kvstore
-            self._curr_module._update_on_kvstore = \
-                self._buckets[self._default_bucket_key]._update_on_kvstore
-            self._curr_module.optimizer_initialized = True
+            default = self._buckets[self._default_bucket_key]
+            if default._fused is not None and \
+                    self._curr_module._adopt_fused_from(default):
+                pass  # bucket joined the fused pool
+            else:
+                if default._fused is not None:
+                    # this bucket can't share the fused trainer: the whole
+                    # group must leave the fused path (shared state would
+                    # otherwise diverge) — _defuse builds default's host
+                    # updater and _handle_defuse propagates it
+                    default._defuse("bucket %r cannot share the fused "
+                                    "trainer" % (self._curr_bucket_key,))
+                # lazily share host optimizer state with the new bucket
+                self._curr_module._optimizer = default._optimizer
+                self._curr_module._updater = default._updater
+                self._curr_module._kvstore = default._kvstore
+                self._curr_module._update_on_kvstore = \
+                    default._update_on_kvstore
+                self._curr_module.optimizer_initialized = True
         self._curr_module.update()
+
+    def _handle_defuse(self, source):
+        """One bucket left the fused pool (monitor, explicit backward, …):
+        every bucket must leave with it — the shared trainer state has been
+        synced to the host params by ``source``'s defuse, and all buckets
+        now share ``source``'s host-updater wiring."""
+        for mod in self._buckets.values():
+            if mod is source or mod._fused is None:
+                continue
+            mod._fused = None
+            mod._fused_disabled = True
+            mod._fused_stash = None
+            mod._optimizer = source._optimizer
+            mod._updater = source._updater
+            mod._kvstore = source._kvstore
+            mod._update_on_kvstore = source._update_on_kvstore
+            mod.optimizer_initialized = source.optimizer_initialized
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -95,6 +95,8 @@ class Module(BaseModule):
         self._fused_disabled = False
         self._fused_batch = None
         self._fused_outputs = None
+        self._fused_stash = None     # trainer kept across transient defuse
+        self._on_defuse = None       # BucketingModule coordination hook
         self._monitor = None
         self._grad_req = "write"
         self._kvstore_arg = None
@@ -475,10 +477,13 @@ class Module(BaseModule):
             return None
         return optimizer
 
-    def _build_fused(self, optimizer):
+    def _build_fused(self, optimizer, share_from=None):
         """Build the DataParallelTrainer over a mesh of this module's
         contexts, seeded with current params; None if construction fails
-        (falls back to executor-group semantics)."""
+        (falls back to executor-group semantics).  ``share_from`` makes the
+        new trainer a shape variant over another trainer's state (bucketing:
+        reference bucketing_module.py:302-330 shares executor memory the
+        same way)."""
         import numpy as np
         from jax.sharding import Mesh
         from ..parallel.dp import DataParallelTrainer
@@ -497,21 +502,51 @@ class Module(BaseModule):
                 self._symbol, data_shapes, label_shapes or None, mesh=mesh,
                 optimizer=optimizer,
                 compute_dtype=self._compute_dtype,
-                fixed_params=tuple(self._fixed_param_names))
+                fixed_params=tuple(self._fixed_param_names),
+                share_state_with=share_from)
         except Exception as e:
             self.logger.warning("fused fast path unavailable (%s); "
                                 "using executor group", e)
             return None
-        trainer.set_params(self._arg_params, self._aux_params)
+        if share_from is None:
+            trainer.set_params(self._arg_params, self._aux_params)
         return trainer
 
-    def _defuse(self, reason):
+    def _adopt_fused_from(self, other):
+        """Run this module's fused step over ``other``'s trainer state
+        (bucketing: per-bucket compiled steps, one shared parameter/
+        optimizer pool).  Returns True on success."""
+        if other._fused is None:
+            return False
+        trainer = self._build_fused(other._optimizer,
+                                    share_from=other._fused)
+        if trainer is None:
+            return False
+        self._fused = trainer
+        self._optimizer = other._optimizer
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._updater = None
+        self._kvstore_arg = other._kvstore_arg
+        self.optimizer_initialized = True
+        return True
+
+    def _defuse(self, reason, transient=False):
         """Leave the fused fast path: sync params + optimizer state over to
         the executor-group / host-updater path (full reference semantics)
-        and continue training there."""
+        and continue training there.
+
+        ``transient`` causes (an explicit forward/backward pair, a one-off
+        eval) keep the compiled trainer stashed so ``forward_backward`` can
+        re-fuse without recompiling; permanent causes (monitor install)
+        disable the fast path for good."""
         trainer = self._fused
         self._fused = None
         self._fused_disabled = True
+        # re-fuse only outside bucketing coordination (buckets defuse as a
+        # group; re-fusing one would desync the shared state)
+        self._fused_stash = trainer if (transient and
+                                        self._on_defuse is None) else None
         self.logger.info("leaving fused fast path (%s)", reason)
         self._sync_from_trainer(trainer)
         self._exec_group.set_params(self._arg_params, self._aux_params)
@@ -547,6 +582,47 @@ class Module(BaseModule):
                 # allocates next to its weight
                 self._updater.states[i * num_device + k] = \
                     _place_state(_clone_state(state), self._context[k])
+        if self._on_defuse is not None:
+            self._on_defuse(self)
+
+    def _maybe_refuse(self):
+        """Return to the fused fast path after a transient defuse: the
+        stashed trainer (jit cache intact) is re-seeded with the current
+        host params and optimizer state, and the host optimizer's
+        index layout is restored to the fused (update_on_kvstore-like)
+        convention."""
+        trainer = self._fused_stash
+        if (trainer is None or self._monitor is not None or
+                not self.optimizer_initialized):
+            return False
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        num_device = len(self._context)
+        # invert the _defuse remap: host layout index*num_device+k -> index
+        self._optimizer.idx2name = dict(
+            enumerate(self._exec_group.param_names))
+        counts = self._optimizer._index_update_count
+        self._optimizer._index_update_count = {
+            i: counts.get(i * num_device, 0)
+            for i in range(len(self._exec_group.param_names))
+            if i * num_device in counts}
+        states = {}
+        if self._updater is not None:
+            for i in range(len(self._exec_group.param_names)):
+                s = self._updater.states.get(i * num_device)
+                if s is not None:
+                    states[i] = s
+        trainer.set_params(self._arg_params, self._aux_params)
+        if states:
+            trainer.set_updater_states(states)
+        self._fused = trainer
+        self._fused_stash = None
+        self._fused_disabled = False
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._updater = None
+        self.logger.info("re-entering fused fast path")
+        return True
 
     def _sync_from_trainer(self, trainer):
         args, aux = trainer.get_params()
@@ -586,7 +662,8 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self._fused is not None:
             if is_train or (is_train is None and self.for_training):
-                self._defuse("explicit forward(is_train=True)")
+                self._defuse("explicit forward(is_train=True)",
+                             transient=True)
             else:
                 batch = self._fused_pack_batch(data_batch,
                                                fill_missing_labels=True)
@@ -600,11 +677,13 @@ class Module(BaseModule):
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
         if self._fused is not None:
-            self._defuse("explicit backward()")
+            self._defuse("explicit backward()", transient=True)
         self._exec_group.backward(out_grads=out_grads)
 
     def forward_backward(self, data_batch):
         assert self.binded and self.params_initialized
+        if self._fused is None and self._fused_stash is not None:
+            self._maybe_refuse()
         if self._fused is not None:
             self._fused_batch = self._fused_pack_batch(data_batch)
             self._fused_outputs = None

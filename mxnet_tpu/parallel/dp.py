@@ -29,6 +29,18 @@ __all__ = ["DataParallelTrainer"]
 from .ingraph_opt import InGraphOptimizer
 
 
+class _TrainerState:
+    """Shared mutable holder for (params, opt_state, aux) jax pytrees.
+
+    Bucketing shares ONE state across many shape-specialized compiled
+    steps (the reference shares executor memory pools across buckets,
+    bucketing_module.py:302-330; here the shared resource is the
+    parameter/optimizer arrays while each bucket keeps its own jit cache
+    entry)."""
+
+    __slots__ = ("params", "opt_state", "aux")
+
+
 class DataParallelTrainer:
     """Compiled data-parallel training over a mesh.
 
@@ -41,7 +53,7 @@ class DataParallelTrainer:
     def __init__(self, symbol, data_shapes, label_shapes=None, mesh=None,
                  optimizer="sgd", optimizer_params=None, initializer=None,
                  batch_axis="dp", dtype="float32", compute_dtype=None,
-                 fixed_params=()):
+                 fixed_params=(), share_state_with=None):
         """``compute_dtype='bfloat16'`` enables mixed precision: parameters
         and optimizer state stay fp32 (master weights), the traced forward/
         backward runs in bf16 on the MXU, and gradients emerge fp32 through
@@ -91,8 +103,48 @@ class DataParallelTrainer:
         self._replicated = NamedSharding(self.mesh, P())
         self._batched = NamedSharding(self.mesh, P(batch_axis))
 
-        self._init_params(initializer or Uniform(0.01))
+        if share_state_with is not None:
+            # bucketing: this trainer is a shape variant compiled over the
+            # SAME parameter/optimizer/aux arrays as the primary trainer
+            other = share_state_with
+            if (set(self.param_names) != set(other.param_names) or
+                    set(self.aux_names) != set(other.aux_names)):
+                raise MXNetError(
+                    "share_state_with requires identical param/aux sets")
+            for n in self.param_names:
+                if self._arg_shapes[n] != other._arg_shapes[n]:
+                    raise MXNetError("param %s shape mismatch across "
+                                     "shared trainers" % n)
+            self._st = other._st
+        else:
+            self._st = _TrainerState()
+            self._init_params(initializer or Uniform(0.01))
         self._compile()
+
+    # shared-state accessors: all bucket trainers observe each other's steps
+    @property
+    def params(self):
+        return self._st.params
+
+    @params.setter
+    def params(self, v):
+        self._st.params = v
+
+    @property
+    def opt_state(self):
+        return self._st.opt_state
+
+    @opt_state.setter
+    def opt_state(self, v):
+        self._st.opt_state = v
+
+    @property
+    def aux(self):
+        return self._st.aux
+
+    @aux.setter
+    def aux(self, v):
+        self._st.aux = v
 
     # ------------------------------------------------------------------
     def _sharding_for(self, name):

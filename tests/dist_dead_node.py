@@ -1,0 +1,86 @@
+"""Worker script for the dead-node-detection / recovery test.
+
+Scenario (reference ps-lite heartbeats + is_recovery semantics,
+src/kvstore/kvstore_dist.h:159-168 and :39,77,178):
+
+* rank 1 SIGKILLs itself mid-training (no clean finalize);
+* rank 0 keeps training (dist_async — pushes don't wait on peers),
+  observes ``get_num_dead_node`` rise to 1 via heartbeat timeout;
+* the test harness then launches a replacement with
+  ``DMLC_PS_RECOVERY_RANK=1``: it re-joins under the old rank, skipping
+  the startup barriers the surviving group is already past, and pushes a
+  distinctive value rank 0 waits for — training continued through a
+  worker death.
+"""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402  (server roles block+exit inside)
+
+SHAPE = (4,)
+DEAD_TIMEOUT = 1.5
+
+
+def main():
+    kv = mx.create_kvstore("dist_async")
+    rank = kv.rank
+    print("RANK", rank, flush=True)
+    recovery = bool(os.environ.get("DMLC_PS_RECOVERY_RANK"))
+
+    kv.init(3, mx.nd.zeros(SHAPE))
+
+    if recovery:
+        # replacement worker: skip startup barriers, announce with a
+        # distinctive push, then leave cleanly
+        for _ in range(3):
+            kv.push(3, mx.nd.ones(SHAPE) * 1000.0)
+            time.sleep(0.1)
+        kv.close()
+        return
+
+    if rank == 1:
+        for _ in range(3):
+            kv.push(3, mx.nd.ones(SHAPE))
+            time.sleep(0.1)
+        os.kill(os.getpid(), signal.SIGKILL)  # crash: no finalize
+
+    # rank 0: keep training; detect the death, then the recovery
+    deadline = time.time() + 60
+    dead = 0
+    while time.time() < deadline:
+        kv.push(3, mx.nd.ones(SHAPE))
+        dead = kv.get_num_dead_node(4, timeout=DEAD_TIMEOUT)
+        if dead >= 1:
+            break
+        time.sleep(0.3)
+    assert dead >= 1, "dead worker was not detected"
+    print("DETECTED_DEAD", dead, flush=True)
+
+    out = mx.nd.zeros(SHAPE)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        kv.push(3, mx.nd.ones(SHAPE))
+        kv.pull(3, out)
+        if out.asnumpy()[0] >= 1000.0:
+            break
+        time.sleep(0.3)
+    assert out.asnumpy()[0] >= 1000.0, \
+        "recovered worker's pushes never arrived"
+    # replacement re-joined under the old rank: nothing is dead anymore
+    assert kv.get_num_dead_node(4, timeout=DEAD_TIMEOUT) == 0
+    print("RECOVERY_OK", flush=True)
+    kv.close()
+
+
+if __name__ == "__main__":
+    main()

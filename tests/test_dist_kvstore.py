@@ -26,3 +26,66 @@ def test_dist_sync_kvstore_local_processes():
                  os.path.join(REPO, "tests", "dist_sync_kvstore.py")],
         env=env)
     assert rc == 0
+
+
+def test_dead_node_detection_and_recovery():
+    """SIGKILL a worker mid-training: the survivor observes
+    get_num_dead_node()==1 via heartbeat timeout, a DMLC_PS_RECOVERY_RANK
+    replacement re-joins under the old rank (skipping startup barriers),
+    and training continues (reference kvstore_dist.h:159-168, :39,77,178)."""
+    import socket
+    import subprocess
+
+    script = os.path.join(REPO, "tests", "dist_dead_node.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base = dict(os.environ)
+    base.update({
+        "JAX_PLATFORMS": "cpu",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": "1",
+        "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.2",
+        "MXNET_KVSTORE_BARRIER_TIMEOUT": "60",
+    })
+
+    def spawn(role, extra=None, **kw):
+        e = dict(base)
+        e["DMLC_ROLE"] = role
+        if extra:
+            e.update(extra)
+        return subprocess.Popen([sys.executable, script], env=e, **kw)
+
+    procs = [spawn("scheduler"), spawn("server")]
+    w0 = spawn("worker", stdout=subprocess.PIPE, text=True, bufsize=1)
+    procs += [w0]
+
+    def wait_line(proc, token, what):
+        for line in proc.stdout:
+            if token in line:
+                return line
+        raise AssertionError("never saw %s" % what)
+
+    # rank assignment follows registration order: only start the suicide
+    # worker once w0 holds rank 0
+    assert "RANK 0" in wait_line(w0, "RANK", "rank line")
+    w1 = spawn("worker")
+    try:
+        assert w1.wait(timeout=120) == -9, "worker 1 should have SIGKILLed"
+        wait_line(w0, "DETECTED_DEAD", "dead-worker detection")
+        # now launch the replacement under the old rank
+        wr = spawn("worker", extra={"DMLC_PS_RECOVERY_RANK": "1"})
+        procs.append(wr)
+        assert wr.wait(timeout=120) == 0
+        rest = w0.stdout.read()
+        assert w0.wait(timeout=120) == 0, rest
+        assert "RECOVERY_OK" in rest
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
