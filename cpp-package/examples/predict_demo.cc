@@ -1,0 +1,80 @@
+// predict_demo.cc — C++ consumer of the mxt_predict C ABI.
+//
+// Reference role: cpp-package/example + amalgamation's C predict demo —
+// proves inference runs outside the Python package through plain C
+// calls.  Usage:
+//
+//   ./predict_demo model.mxtpkg <loader_dir> <n_input_floats>
+//
+// Feeds ramp data into the first input, prints the first output's shape
+// and leading values, exits 0 on success.
+
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "../include/mxt_predict.h"
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    std::fprintf(stderr,
+                 "usage: %s <model.mxtpkg> <loader_dir> <n_input_floats>\n",
+                 argv[0]);
+    return 2;
+  }
+  const char *artifact = argv[1];
+  const char *loader_dir = argv[2];
+  size_t n = static_cast<size_t>(std::atoll(argv[3]));
+
+  MXTPredHandle h = nullptr;
+  if (MXTPredCreate(artifact, loader_dir, &h) != 0) {
+    std::fprintf(stderr, "create failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  int n_in = 0;
+  const char *in_name = nullptr;
+  if (MXTPredNumInputs(h, &n_in) != 0 || n_in < 1 ||
+      MXTPredGetInputName(h, 0, &in_name) != 0) {
+    std::fprintf(stderr, "input query failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  std::printf("inputs: %d, first: %s\n", n_in, in_name);
+
+  std::vector<float> data(n);
+  for (size_t i = 0; i < n; ++i) {
+    data[i] = static_cast<float>(i % 17) / 17.0f - 0.5f;
+  }
+  if (MXTPredSetInput(h, in_name, data.data(), data.size()) != 0) {
+    std::fprintf(stderr, "set_input failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  if (MXTPredForward(h) != 0) {
+    std::fprintf(stderr, "forward failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  int n_out = 0, ndim = 0;
+  if (MXTPredNumOutputs(h, &n_out) != 0 || n_out < 1 ||
+      MXTPredGetOutputShape(h, 0, nullptr, &ndim) != 0) {
+    std::fprintf(stderr, "output query failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  std::vector<int64_t> shape(ndim);
+  MXTPredGetOutputShape(h, 0, shape.data(), &ndim);
+  size_t total = 1;
+  std::printf("output 0 shape: [");
+  for (int i = 0; i < ndim; ++i) {
+    std::printf(i ? ", %lld" : "%lld", static_cast<long long>(shape[i]));
+    total *= static_cast<size_t>(shape[i]);
+  }
+  std::printf("]\n");
+  std::vector<float> out(total);
+  if (MXTPredGetOutput(h, 0, out.data(), out.size()) != 0) {
+    std::fprintf(stderr, "get_output failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  std::printf("output 0 first values:");
+  for (size_t i = 0; i < total && i < 4; ++i) std::printf(" %g", out[i]);
+  std::printf("\nPREDICT_DEMO_OK\n");
+  MXTPredFree(h);
+  return 0;
+}

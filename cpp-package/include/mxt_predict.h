@@ -1,0 +1,69 @@
+/*
+ * mxt_predict.h — minimal C prediction ABI for mxnet_tpu deploy
+ * artifacts (.mxtpkg).
+ *
+ * Role model: include/mxnet/c_predict_api.h in the reference (MXPredCreate /
+ * MXPredSetInput / MXPredForward / MXPredGetOutput / MXPredFree) — the
+ * self-contained inference ABI that amalgamation and the non-Python
+ * bindings consume.  Here the artifact already contains the compiled
+ * StableHLO graph + weights; this ABI hosts a Python interpreter running
+ * the single-file loader (amalgamation/mxnet_predict.py) behind plain C
+ * functions, so any C/C++/FFI consumer can run inference without writing
+ * a line of Python.
+ *
+ * All functions return 0 on success, -1 on failure (see
+ * MXTPredGetLastError).  Not thread-safe across handles by design —
+ * serialize calls per handle (the reference ABI has the same contract).
+ */
+#ifndef MXT_PREDICT_H_
+#define MXT_PREDICT_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *MXTPredHandle;
+
+/* Last error message of the calling thread (static buffer). */
+const char *MXTPredGetLastError(void);
+
+/* Create a predictor from an .mxtpkg artifact on disk.
+ * python_module_dir: directory holding mxnet_predict.py (the standalone
+ * loader); pass NULL if it is already importable. */
+int MXTPredCreate(const char *artifact_path, const char *python_module_dir,
+                  MXTPredHandle *out);
+
+/* Number of inputs / name of input i (borrowed pointer, valid until the
+ * handle is freed). */
+int MXTPredNumInputs(MXTPredHandle h, int *out);
+int MXTPredGetInputName(MXTPredHandle h, int index, const char **out);
+
+/* Set input `name` from a dense float32 buffer of `size` elements
+ * (shape/dtype conversion happens inside; size must match the
+ * artifact's declared input shape). */
+int MXTPredSetInput(MXTPredHandle h, const char *name, const float *data,
+                    size_t size);
+
+/* Run the forward pass on the current inputs. */
+int MXTPredForward(MXTPredHandle h);
+
+/* Output arity / shape / data of output `index` after Forward.
+ * MXTPredGetOutputShape: writes ndim to *ndim and up to *ndim dims into
+ * shape (pass shape=NULL to query ndim only).
+ * MXTPredGetOutput: copies `size` float32 elements into out. */
+int MXTPredNumOutputs(MXTPredHandle h, int *out);
+int MXTPredGetOutputShape(MXTPredHandle h, int index, int64_t *shape,
+                          int *ndim);
+int MXTPredGetOutput(MXTPredHandle h, int index, float *out, size_t size);
+
+/* Release the predictor. */
+int MXTPredFree(MXTPredHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXT_PREDICT_H_ */

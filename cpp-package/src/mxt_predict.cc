@@ -1,0 +1,365 @@
+// mxt_predict.cc — C prediction ABI over .mxtpkg deploy artifacts.
+//
+// Reference role: src/c_api/c_predict_api.cc — the minimal, dependency-
+// light inference ABI behind include/mxnet/c_predict_api.h.  The TPU
+// stack's compute engine is XLA reached through JAX, so this library
+// hosts an embedded CPython interpreter running the single-file loader
+// (amalgamation/mxnet_predict.py, numpy+jax only) and marshals plain C
+// buffers in and out.  No mxnet_tpu package is needed at runtime — only
+// the artifact, python, numpy and jax.
+//
+// Build (see cpp-package/Makefile):
+//   g++ -std=c++17 -O2 -fPIC -shared $(python3-config --includes) \
+//       -o libmxt_predict.so src/mxt_predict.cc \
+//       $(python3-config --ldflags --embed)
+
+#include "../include/mxt_predict.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local char g_err[2048];
+
+void set_err(const char *what) {
+  std::snprintf(g_err, sizeof(g_err), "%s", what);
+}
+
+// Capture the pending Python exception into g_err.
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  set_err(msg.c_str());
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Pred {
+  PyObject *predictor = nullptr;           // mxnet_predict.Predictor
+  std::vector<std::string> input_names;
+  std::vector<PyObject *> outputs;         // numpy float32 C-contiguous
+  std::vector<std::vector<int64_t>> out_shapes;
+
+  ~Pred() {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    for (PyObject *o : outputs) Py_XDECREF(o);
+    Py_XDECREF(predictor);
+    PyGILState_Release(gil);
+  }
+};
+
+bool ensure_python() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  // release the GIL acquired by initialization so PyGILState_Ensure
+  // nests correctly from any caller thread
+  PyEval_SaveThread();
+  return Py_IsInitialized() != 0;
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject *call_method(PyObject *obj, const char *name, PyObject *args) {
+  PyObject *fn = PyObject_GetAttrString(obj, name);
+  if (fn == nullptr) return nullptr;
+  PyObject *r = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTPredGetLastError(void) { return g_err; }
+
+int MXTPredCreate(const char *artifact_path, const char *python_module_dir,
+                  MXTPredHandle *out) {
+  if (artifact_path == nullptr || out == nullptr) {
+    set_err("null argument");
+    return -1;
+  }
+  if (!ensure_python()) {
+    set_err("could not initialize python");
+    return -1;
+  }
+  Gil gil;
+  if (python_module_dir != nullptr) {
+    PyObject *sys_path = PySys_GetObject("path");  // borrowed
+    PyObject *dir = PyUnicode_FromString(python_module_dir);
+    if (sys_path == nullptr || dir == nullptr ||
+        PyList_Insert(sys_path, 0, dir) != 0) {
+      Py_XDECREF(dir);
+      set_err_from_python();
+      return -1;
+    }
+    Py_DECREF(dir);
+  }
+  PyObject *mod = PyImport_ImportModule("mxnet_predict");
+  if (mod == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (cls == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(s)", artifact_path);
+  PyObject *pred = PyObject_CallObject(cls, args);
+  Py_DECREF(args);
+  Py_DECREF(cls);
+  if (pred == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  Pred *p = new Pred;
+  p->predictor = pred;
+  PyObject *names = PyObject_GetAttrString(pred, "input_names");
+  if (names == nullptr || !PyList_Check(names)) {
+    Py_XDECREF(names);
+    delete p;
+    set_err("input_names not a list");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    p->input_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+  }
+  Py_DECREF(names);
+  *out = p;
+  return 0;
+}
+
+int MXTPredNumInputs(MXTPredHandle h, int *out) {
+  if (h == nullptr || out == nullptr) {
+    set_err("null argument");
+    return -1;
+  }
+  *out = static_cast<int>(static_cast<Pred *>(h)->input_names.size());
+  return 0;
+}
+
+int MXTPredGetInputName(MXTPredHandle h, int index, const char **out) {
+  Pred *p = static_cast<Pred *>(h);
+  if (p == nullptr || out == nullptr || index < 0 ||
+      index >= static_cast<int>(p->input_names.size())) {
+    set_err("bad input index");
+    return -1;
+  }
+  *out = p->input_names[index].c_str();
+  return 0;
+}
+
+int MXTPredSetInput(MXTPredHandle h, const char *name, const float *data,
+                    size_t size) {
+  Pred *p = static_cast<Pred *>(h);
+  if (p == nullptr || name == nullptr || data == nullptr) {
+    set_err("null argument");
+    return -1;
+  }
+  Gil gil;
+  // hand the buffer over as a list -> np.array inside set_input; shapes
+  // are reshaped from the artifact's declared input shape
+  PyObject *meta = PyObject_GetAttrString(p->predictor, "meta");
+  if (meta == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *shapes = PyDict_GetItemString(meta, "input_shapes");  // borrowed
+  PyObject *shape = shapes != nullptr
+                        ? PyDict_GetItemString(shapes, name)  // borrowed
+                        : nullptr;
+  if (shape == nullptr) {
+    Py_DECREF(meta);
+    set_err("unknown input name");
+    return -1;
+  }
+  int64_t want = 1;
+  for (Py_ssize_t i = 0; i < PyList_Size(shape); ++i) {
+    want *= PyLong_AsLongLong(PyList_GetItem(shape, i));
+  }
+  if (static_cast<int64_t>(size) != want) {
+    Py_DECREF(meta);
+    std::snprintf(g_err, sizeof(g_err),
+                  "input %s: got %zu elements, artifact expects %lld", name,
+                  size, static_cast<long long>(want));
+    return -1;
+  }
+  // zero-copy wrap of the caller's buffer: memoryview -> np.frombuffer
+  // -> reshape (set_input copies once into its own contiguous array, so
+  // the view never outlives this call)
+  PyObject *view = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      static_cast<Py_ssize_t>(size * sizeof(float)), PyBUF_READ);
+  PyObject *np = view != nullptr ? PyImport_ImportModule("numpy") : nullptr;
+  PyObject *arr = nullptr;
+  if (np != nullptr) {
+    PyObject *frombuffer = PyObject_GetAttrString(np, "frombuffer");
+    if (frombuffer != nullptr) {
+      PyObject *a1 = PyObject_CallFunction(frombuffer, "Os", view,
+                                           "float32");
+      Py_DECREF(frombuffer);
+      if (a1 != nullptr) {
+        PyObject *reshape = PyObject_GetAttrString(a1, "reshape");
+        if (reshape != nullptr) {
+          arr = PyObject_CallFunctionObjArgs(reshape, shape, nullptr);
+          Py_DECREF(reshape);
+        }
+        Py_DECREF(a1);
+      }
+    }
+    Py_DECREF(np);
+  }
+  Py_XDECREF(view);
+  Py_DECREF(meta);
+  if (arr == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(sO)", name, arr);
+  PyObject *r = call_method(p->predictor, "set_input", args);
+  Py_DECREF(args);
+  Py_DECREF(arr);
+  if (r == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredForward(MXTPredHandle h) {
+  Pred *p = static_cast<Pred *>(h);
+  if (p == nullptr) {
+    set_err("null handle");
+    return -1;
+  }
+  Gil gil;
+  PyObject *outs = call_method(p->predictor, "forward", nullptr);
+  if (outs == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  for (PyObject *o : p->outputs) Py_XDECREF(o);
+  p->outputs.clear();
+  p->out_shapes.clear();
+  if (!PyList_Check(outs)) {
+    Py_DECREF(outs);
+    set_err("forward did not return a list");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(outs); ++i) {
+    PyObject *o = PyList_GetItem(outs, i);  // borrowed
+    // normalize: float32 + C order + keep a flat memory view via tolist-
+    // free path (astype returns a fresh contiguous array)
+    PyObject *astype = PyObject_GetAttrString(o, "astype");
+    if (astype == nullptr) {
+      Py_DECREF(outs);
+      set_err_from_python();
+      return -1;
+    }
+    PyObject *of = PyObject_CallFunction(astype, "s", "float32");
+    Py_DECREF(astype);
+    if (of == nullptr) {
+      Py_DECREF(outs);
+      set_err_from_python();
+      return -1;
+    }
+    PyObject *shape = PyObject_GetAttrString(of, "shape");
+    std::vector<int64_t> dims;
+    if (shape != nullptr && PyTuple_Check(shape)) {
+      for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d) {
+        dims.push_back(PyLong_AsLongLong(PyTuple_GetItem(shape, d)));
+      }
+    }
+    Py_XDECREF(shape);
+    p->outputs.push_back(of);
+    p->out_shapes.push_back(std::move(dims));
+  }
+  Py_DECREF(outs);
+  return 0;
+}
+
+int MXTPredNumOutputs(MXTPredHandle h, int *out) {
+  Pred *p = static_cast<Pred *>(h);
+  if (p == nullptr || out == nullptr) {
+    set_err("null argument");
+    return -1;
+  }
+  *out = static_cast<int>(p->outputs.size());
+  return 0;
+}
+
+int MXTPredGetOutputShape(MXTPredHandle h, int index, int64_t *shape,
+                          int *ndim) {
+  Pred *p = static_cast<Pred *>(h);
+  if (p == nullptr || ndim == nullptr || index < 0 ||
+      index >= static_cast<int>(p->outputs.size())) {
+    set_err("bad output index (call Forward first)");
+    return -1;
+  }
+  const std::vector<int64_t> &dims = p->out_shapes[index];
+  *ndim = static_cast<int>(dims.size());
+  if (shape != nullptr) {
+    for (size_t i = 0; i < dims.size(); ++i) shape[i] = dims[i];
+  }
+  return 0;
+}
+
+int MXTPredGetOutput(MXTPredHandle h, int index, float *out, size_t size) {
+  Pred *p = static_cast<Pred *>(h);
+  if (p == nullptr || out == nullptr || index < 0 ||
+      index >= static_cast<int>(p->outputs.size())) {
+    set_err("bad output index (call Forward first)");
+    return -1;
+  }
+  Gil gil;
+  PyObject *o = p->outputs[index];
+  Py_buffer view;
+  if (PyObject_GetBuffer(o, &view, PyBUF_CONTIG_RO | PyBUF_FORMAT) != 0) {
+    set_err_from_python();
+    return -1;
+  }
+  size_t n = static_cast<size_t>(view.len) / sizeof(float);
+  if (n != size) {
+    PyBuffer_Release(&view);
+    std::snprintf(g_err, sizeof(g_err),
+                  "output %d has %zu elements, caller buffer %zu", index, n,
+                  size);
+    return -1;
+  }
+  std::memcpy(out, view.buf, view.len);
+  PyBuffer_Release(&view);
+  return 0;
+}
+
+int MXTPredFree(MXTPredHandle h) {
+  delete static_cast<Pred *>(h);
+  return 0;
+}
+
+}  // extern "C"

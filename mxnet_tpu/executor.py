@@ -56,18 +56,19 @@ def shape_overrides(symbol, known_shapes):
 
 
 class _Segment:
-    """One contiguous same-device run of ops (ctx_group staged execution —
-    the unit that replaces the reference's per-device engine streams)."""
+    """One single-device cluster of ops (ctx_group staged execution — the
+    unit that replaces the reference's per-device engine streams)."""
 
     __slots__ = ("device", "nodes", "in_keys", "out_keys", "aux_idx",
-                 "jit_fwd", "jit_bwd")
+                 "aux_src", "jit_fwd", "jit_bwd")
 
-    def __init__(self, device, nodes, in_keys, out_keys, aux_idx):
+    def __init__(self, device, nodes, in_keys, out_keys, aux_idx, aux_src):
         self.device = device
         self.nodes = nodes          # [(global_topo_idx, node)]
         self.in_keys = in_keys      # value keys consumed from outside
         self.out_keys = out_keys    # value keys visible outside
         self.aux_idx = aux_idx      # aux array indices updated here
+        self.aux_src = aux_src      # aux idx -> max topo idx updating it
         self.jit_fwd = None
         self.jit_bwd = None
 
@@ -192,10 +193,14 @@ class Executor:
         Reference: ``AssignContext`` runs nnvm PlaceDevice keyed on the
         ``__ctx_group__`` attr and splices ``_CrossDeviceCopy`` at cut
         edges (graph_executor.cc:242-331, src/operator/cross_device_copy.cc).
-        Here each maximal same-device run of ops becomes one jit-compiled
-        program pinned to its device; cut edges become explicit async
-        ``jax.device_put`` transfers, and the per-segment dispatch pipeline
-        plays the role of the reference's async engine overlap."""
+        Here nodes are clustered into per-device segments (count bounded by
+        the device alternation structure, NOT by topo interleavings — see
+        the worklist sweep below), each jit-compiled and pinned to its
+        device; cut edges become explicit ``jax.device_put`` transfers, and
+        the per-segment dispatch pipeline plays the role of the reference's
+        async engine overlap.  Segment execution order is a valid
+        topological order of the clustered DAG, but not necessarily the
+        global node topo order."""
         if not self._group2ctx:
             return None
         try:
@@ -227,24 +232,54 @@ class Executor:
             if node.is_variable and id(node) not in var_dev:
                 var_dev[id(node)] = default_dev
 
-        # maximal contiguous same-device runs (topo order)
+        # Cluster nodes by device with a dependency-respecting worklist
+        # sweep (not maximal contiguous topo runs: an unrolled MP-LSTM
+        # interleaves groups per timestep, which would degenerate to
+        # O(layers x timesteps) separately-compiled segments).  Each round
+        # picks the device of the earliest-topo ready op and absorbs every
+        # op of that device that becomes ready as the round proceeds — for
+        # an acyclic group-dependency structure this yields one segment per
+        # group (+ leading/trailing default-device segments), the same
+        # count the reference gets from per-device engine streams
+        # (graph_executor.cc:242-331).  O(nodes + edges) via per-node
+        # unsatisfied-predecessor counts and per-device ready heaps.
+        import heapq
+        op_nodes = [(idx, node) for idx, node in enumerate(self._nodes)
+                    if not node.is_variable]
+        pred_count = {}
+        consumers = {}
+        for idx, node in op_nodes:
+            preds = {id(n) for n, _ in node.inputs if not n.is_variable}
+            pred_count[id(node)] = len(preds)
+            for p in preds:
+                consumers.setdefault(p, []).append((idx, node))
+        ready = {}  # device -> heap of (topo_idx, node)
+        for idx, node in op_nodes:
+            if pred_count[id(node)] == 0:
+                heapq.heappush(ready.setdefault(node_dev[id(node)], []),
+                               (idx, id(node), node))
         segments = []
-        cur_dev = None
-        for idx, node in enumerate(self._nodes):
-            if node.is_variable:
-                continue
-            d = node_dev[id(node)]
-            if cur_dev is None or d != cur_dev:
-                segments.append({"device": d, "nodes": []})
-                cur_dev = d
-            segments[-1]["nodes"].append((idx, node))
+        n_left = len(op_nodes)
+        while n_left:
+            # device of the earliest-topo ready node opens the round
+            d = min((h[0][0], dev) for dev, h in ready.items() if h)[1]
+            taken = []
+            heap = ready[d]
+            while heap:
+                idx, _, node = heapq.heappop(heap)
+                taken.append((idx, node))
+                for cidx, cons in consumers.get(id(node), ()):
+                    pred_count[id(cons)] -= 1
+                    if pred_count[id(cons)] == 0:
+                        cdev = node_dev[id(cons)]
+                        heapq.heappush(
+                            ready.setdefault(cdev, []),
+                            (cidx, id(cons), cons))
+            segments.append({"device": d, "nodes": taken})
+            n_left -= len(taken)
 
         # consumers of each value key, for out_keys
         consumed_by = {}   # key -> set of segment indices (or "head")
-        seg_of_node = {}
-        for si, seg in enumerate(segments):
-            for _, node in seg["nodes"]:
-                seg_of_node[id(node)] = si
         for si, seg in enumerate(segments):
             for _, node in seg["nodes"]:
                 for n, oi in node.inputs:
@@ -267,7 +302,8 @@ class Executor:
                         in_keys.append(key)
             out_keys = []
             aux_idx = []
-            for _, node in seg["nodes"]:
+            aux_src = {}
+            for idx, node in seg["nodes"]:
                 n_out = len(node.op.outputs(node.attrs))
                 for oi in range(n_out):
                     key = (id(node), oi)
@@ -279,8 +315,9 @@ class Executor:
                     ai = self._var_map[id(an)][1]
                     if ai not in aux_idx:
                         aux_idx.append(ai)
+                    aux_src[ai] = max(aux_src.get(ai, -1), idx)
             plan.append(_Segment(seg["device"], seg["nodes"], in_keys,
-                                 out_keys, aux_idx))
+                                 out_keys, aux_idx, aux_src))
         self._var_dev = var_dev
         for seg in plan:
             self._compile_segment(seg)
@@ -293,12 +330,19 @@ class Executor:
         def seg_trace(ins, rng, is_train):
             vals = dict(zip(seg.in_keys, ins))
             aux_upd = {}
+            aux_rank = {}
             for idx, node in seg.nodes:
                 outs, upd = eval_node(node, idx, vals, is_train, rng)
                 for oi, o in enumerate(outs):
                     vals[(id(node), oi)] = o
                 for (an, _), u in zip(node.aux_inputs(), upd):
-                    aux_upd[var_map[id(an)][1]] = u
+                    ai = var_map[id(an)][1]
+                    # cluster order may differ from topo order; the
+                    # topo-LAST updater of a shared aux must win, matching
+                    # the single-program trace
+                    if idx >= aux_rank.get(ai, -1):
+                        aux_rank[ai] = idx
+                        aux_upd[ai] = u
             return (tuple(vals[k] for k in seg.out_keys),
                     tuple(aux_upd.get(ai) for ai in seg.aux_idx))
 
@@ -342,6 +386,7 @@ class Executor:
                 env[(id(node), 0)] = (arg_vals[i] if kind == "arg"
                                      else aux_vals[i])
         new_aux = list(aux_vals)
+        aux_rank = {}
         saved = []
         for seg in self._stage_plan:
             ins = tuple(jax.device_put(env[k], seg.device)
@@ -351,7 +396,10 @@ class Executor:
             for k, v in zip(seg.out_keys, outs):
                 env[k] = v
             for ai, v in zip(seg.aux_idx, auxu):
-                if v is not None:
+                # segment order is not topo order: keep the update from the
+                # topo-latest op touching this aux (single-program parity)
+                if v is not None and seg.aux_src[ai] >= aux_rank.get(ai, -1):
+                    aux_rank[ai] = seg.aux_src[ai]
                     new_aux[ai] = v
         outputs = tuple(env[k] for k in self._head)
         return outputs, tuple(new_aux), saved, env
@@ -387,7 +435,14 @@ class Executor:
             if node.is_variable:
                 kind, i = self._var_map[id(node)]
                 if kind == "arg" and (id(node), 0) in cot:
-                    arg_grads[i] = cot[(id(node), 0)]
+                    g = cot[(id(node), 0)]
+                    if i in arg_grads:
+                        # several var NODES can collapse onto one arg slot
+                        # (same-name weight sharing): their cotangents sum
+                        arg_grads[i] = arg_grads[i] + jax.device_put(
+                            g, next(iter(arg_grads[i].devices())))
+                    else:
+                        arg_grads[i] = g
         return arg_grads
 
     def _compile(self):

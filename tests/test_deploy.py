@@ -1,0 +1,105 @@
+"""Deployment periphery tests: .mxtpkg export (amalgamation analog), the
+standalone numpy+jax loader, and the C ABI + C++ demo consumer
+(reference amalgamation/ + include/mxnet/c_predict_api.h +
+cpp-package/)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_checkpoint(tmp_path):
+    """Train-free tiny convnet checkpoint with deterministic params."""
+    rs = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                              pad=(1, 1), name="conv1")
+    act = mx.sym.Activation(conv, act_type="relu")
+    flat = mx.sym.Flatten(act)
+    fc = mx.sym.FullyConnected(flat, num_hidden=3, name="fc1")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    shapes, _, _ = net.infer_shape(data=(2, 3, 8, 8), softmax_label=(2,))
+    args = {}
+    for name, shape in zip(net.list_arguments(), shapes):
+        if name not in ("data", "softmax_label"):
+            args[name] = nd.array(rs.uniform(-0.2, 0.2, shape)
+                                  .astype("float32"))
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 1, net, args, {})
+    x = rs.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    pred = mx.Predictor.from_checkpoint(prefix, 1,
+                                        {"data": (2, 3, 8, 8)})
+    ref = pred.forward(data=x)[0].asnumpy()
+    return prefix, x, ref
+
+
+def test_export_and_load_model(tmp_path):
+    prefix, x, ref = _make_checkpoint(tmp_path)
+    from mxnet_tpu.deploy import export_checkpoint, load_model
+    pkg = str(tmp_path / "model.mxtpkg")
+    export_checkpoint(prefix, 1, {"data": (2, 3, 8, 8)}, pkg)
+    m = load_model(pkg)
+    assert m.input_names == ["data"]
+    out = m.forward(data=x)[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_standalone_loader_is_self_contained(tmp_path):
+    """amalgamation/mxnet_predict.py must run the artifact WITHOUT
+    mxnet_tpu importable (the single-file deploy contract)."""
+    prefix, x, ref = _make_checkpoint(tmp_path)
+    from mxnet_tpu.deploy import export_checkpoint
+    pkg = str(tmp_path / "model.mxtpkg")
+    export_checkpoint(prefix, 1, {"data": (2, 3, 8, 8)}, pkg)
+    np.save(str(tmp_path / "x.npy"), x)
+    np.save(str(tmp_path / "ref.npy"), ref)
+    code = (
+        "import sys, json, numpy as np\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "sys.modules['mxnet_tpu'] = None  # poison: loader must not use it\n"
+        "sys.path.insert(0, %r)\n"
+        "from mxnet_predict import Predictor\n"
+        "p = Predictor(%r)\n"
+        "x = np.load(%r); ref = np.load(%r)\n"
+        "out = p.forward(data=x)[0]\n"
+        "np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)\n"
+        "print('STANDALONE_OK')\n"
+        % (os.path.join(REPO, "amalgamation"), pkg,
+           str(tmp_path / "x.npy"), str(tmp_path / "ref.npy")))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)  # run outside the repo tree
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, cwd=str(tmp_path), timeout=240)
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    assert "STANDALONE_OK" in p.stdout
+
+
+def test_c_abi_demo_runs_inference(tmp_path):
+    """Build libmxt_predict.so + predict_demo with g++ and run inference
+    from C++ — a non-Python consumer of the framework's deploy path."""
+    prefix, x, ref = _make_checkpoint(tmp_path)
+    from mxnet_tpu.deploy import export_checkpoint
+    pkg = str(tmp_path / "model.mxtpkg")
+    export_checkpoint(prefix, 1, {"data": (2, 3, 8, 8)}, pkg)
+
+    build = subprocess.run(["make", "-C",
+                            os.path.join(REPO, "cpp-package")],
+                           capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip("cpp toolchain unavailable: %s"
+                    % build.stderr[-400:])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    demo = subprocess.run(
+        [os.path.join(REPO, "cpp-package", "predict_demo"), pkg,
+         os.path.join(REPO, "amalgamation"), str(2 * 3 * 8 * 8)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert demo.returncode == 0, (demo.stdout[-800:], demo.stderr[-800:])
+    assert "PREDICT_DEMO_OK" in demo.stdout
+    assert "output 0 shape: [2, 3]" in demo.stdout
