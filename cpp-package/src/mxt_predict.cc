@@ -323,9 +323,14 @@ int MXTPredGetOutputShape(MXTPredHandle h, int index, int64_t *shape,
     return -1;
   }
   const std::vector<int64_t> &dims = p->out_shapes[index];
+  // honor the caller's declared capacity in *ndim (header contract:
+  // "up to *ndim dims"), then report the true rank
+  int cap = *ndim;
   *ndim = static_cast<int>(dims.size());
   if (shape != nullptr) {
-    for (size_t i = 0; i < dims.size(); ++i) shape[i] = dims[i];
+    int n = static_cast<int>(dims.size());
+    if (cap > 0 && cap < n) n = cap;
+    for (int i = 0; i < n; ++i) shape[i] = dims[i];
   }
   return 0;
 }

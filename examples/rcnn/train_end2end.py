@@ -1,0 +1,385 @@
+"""Faster-RCNN-style detector on synthetic scenes (reference
+example/rcnn/train_end2end.py, trimmed to the toy scale of the other
+examples).
+
+Composition exercised end-to-end:
+
+* an **AnchorTarget** python ``CustomOp`` (the reference rcnn package
+  implements anchor assignment as a python layer too) producing RPN
+  class labels (+1/0/-1-ignore) and bbox regression targets;
+* RPN trained with ``SoftmaxOutput(use_ignore)`` + ``smooth_l1``;
+* a Fast-RCNN head trained on gt-jittered + random rois through
+  **ROIPooling** (``src/operator/roi_pooling.cc`` analog);
+* at test time the trained RPN feeds the **Proposal** op
+  (``src/operator/contrib/proposal.cc`` analog: anchor decode + NMS) and
+  the head classifies the proposals — detection recall on the synthetic
+  gt measures the whole pipeline.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+FEAT_STRIDE = 8
+SCALES = (2.0, 4.0, 8.0)
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+
+
+def make_anchors(H, W):
+    """Anchor grid in pixels, matching the Proposal op's base-anchor
+    formula (mxnet_tpu/ops/contrib.py _proposal_fc)."""
+    base = []
+    bs = FEAT_STRIDE
+    for r in RATIOS:
+        size = bs * bs / r
+        ws = np.round(np.sqrt(size))
+        hh = np.round(ws * r)
+        for s in SCALES:
+            w2, h2 = ws * s / 2.0, hh * s / 2.0
+            cx = cy = (bs - 1) / 2.0
+            base.append([cx - w2 + 0.5, cy - h2 + 0.5,
+                         cx + w2 - 0.5, cy + h2 - 0.5])
+    base = np.asarray(base, np.float32)
+    sx, sy = np.meshgrid(np.arange(W) * FEAT_STRIDE,
+                         np.arange(H) * FEAT_STRIDE)
+    shifts = np.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    return (base[None] + shifts).reshape(-1, 4)  # (H*W*A, 4)
+
+
+def iou_matrix(a, b):
+    """(N,4) x (M,4) pixel-coord IoU."""
+    ax1, ay1, ax2, ay2 = a[:, 0, None], a[:, 1, None], a[:, 2, None], \
+        a[:, 3, None]
+    bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], b[None, :, 2], \
+        b[None, :, 3]
+    iw = np.maximum(np.minimum(ax2, bx2) - np.maximum(ax1, bx1) + 1, 0)
+    ih = np.maximum(np.minimum(ay2, by2) - np.maximum(ay1, by1) + 1, 0)
+    inter = iw * ih
+    area_a = (ax2 - ax1 + 1) * (ay2 - ay1 + 1)
+    area_b = (bx2 - bx1 + 1) * (by2 - by1 + 1)
+    return inter / np.maximum(area_a + area_b - inter, 1e-6)
+
+
+class AnchorTarget(mx.operator.CustomOp):
+    """RPN targets: label 1/0/-1(ignore) + bbox deltas for positives
+    (reference rcnn/rcnn/io/rpn.py assign_anchor, run as a python layer)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        score = in_data[0].asnumpy()          # (N, 2A, H, W) for shape
+        gt = in_data[1].asnumpy()             # (N, M, 5) [-1 padded]
+        N, _, H, W = score.shape
+        anchors = make_anchors(H, W)          # (K, 4), K = H*W*A
+        K = anchors.shape[0]
+        labels = np.full((N, K), -1.0, np.float32)
+        targets = np.zeros((N, K, 4), np.float32)
+        weights = np.zeros((N, K, 4), np.float32)
+        for n in range(N):
+            boxes = gt[n][gt[n, :, 0] >= 0]
+            if len(boxes) == 0:
+                labels[n] = 0
+                continue
+            ious = iou_matrix(anchors, boxes[:, 1:5])   # (K, M)
+            best_gt = ious.argmax(axis=1)
+            best_iou = ious.max(axis=1)
+            labels[n][best_iou < 0.3] = 0
+            labels[n][best_iou >= 0.5] = 1
+            labels[n][ious.argmax(axis=0)] = 1          # best anchor per gt
+            pos = labels[n] == 1
+            m = boxes[best_gt][pos]
+            aw = anchors[pos, 2] - anchors[pos, 0] + 1
+            ah = anchors[pos, 3] - anchors[pos, 1] + 1
+            acx = anchors[pos, 0] + 0.5 * (aw - 1)
+            acy = anchors[pos, 1] + 0.5 * (ah - 1)
+            gw = m[:, 3] - m[:, 1] + 1
+            gh = m[:, 4] - m[:, 2] + 1
+            gcx = m[:, 1] + 0.5 * (gw - 1)
+            gcy = m[:, 2] + 0.5 * (gh - 1)
+            targets[n][pos] = np.stack(
+                [(gcx - acx) / aw, (gcy - acy) / ah,
+                 np.log(gw / aw), np.log(gh / ah)], axis=-1)
+            weights[n][pos] = 1.0
+        # layouts: label (N, A*H*W) matching the (N,2,A*H*W)-reshaped
+        # score; targets/weights (N, 4A, H, W) matching rpn_bbox_pred
+        lab = labels.reshape(N, H, W, A).transpose(0, 3, 1, 2) \
+            .reshape(N, -1)
+        tgt = targets.reshape(N, H, W, A * 4).transpose(0, 3, 1, 2)
+        wgt = weights.reshape(N, H, W, A * 4).transpose(0, 3, 1, 2)
+        self.assign(out_data[0], req[0], lab)
+        self.assign(out_data[1], req[1], tgt)
+        self.assign(out_data[2], req[2], wgt)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i in range(len(in_grad)):
+            self.assign(in_grad[i], req[i],
+                        np.zeros(in_grad[i].shape, np.float32))
+
+
+@mx.operator.register("rcnn_anchor_target")
+class AnchorTargetProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["cls_score", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        n, twoA, h, w = in_shape[0]
+        a = twoA // 2
+        return in_shape, [(n, a * h * w), (n, 4 * a, h, w),
+                          (n, 4 * a, h, w)], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return AnchorTarget()
+
+
+def backbone(data):
+    x = data
+    for i, f in enumerate((16, 32, 64)):
+        x = mx.sym.Convolution(x, kernel=(3, 3), stride=(2, 2),
+                               pad=(1, 1), num_filter=f,
+                               name="conv%d" % i)
+        x = mx.sym.Activation(x, act_type="relu")
+    return x  # stride 8
+
+
+def rpn_heads(feat):
+    rpn = mx.sym.Activation(
+        mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                           num_filter=64, name="rpn_conv"),
+        act_type="relu")
+    score = mx.sym.Convolution(rpn, kernel=(1, 1), num_filter=2 * A,
+                               name="rpn_cls_score")
+    bbox = mx.sym.Convolution(rpn, kernel=(1, 1), num_filter=4 * A,
+                              name="rpn_bbox_pred")
+    return score, bbox
+
+
+def roi_head(feat, rois, num_classes):
+    pooled = mx.sym.ROIPooling(data=feat, rois=rois, pooled_size=(6, 6),
+                               spatial_scale=1.0 / FEAT_STRIDE,
+                               name="roi_pool")
+    flat = mx.sym.Flatten(pooled)
+    fc = mx.sym.Activation(
+        mx.sym.FullyConnected(flat, num_hidden=128, name="fc6"),
+        act_type="relu")
+    return mx.sym.FullyConnected(fc, num_hidden=num_classes + 1,
+                                 name="cls_score")
+
+
+def train_symbol(num_classes):
+    data = mx.sym.Variable("data")
+    gt_boxes = mx.sym.Variable("gt_boxes")
+    rois = mx.sym.Variable("rois")            # (R, 5) from the iterator
+    roi_label = mx.sym.Variable("roi_label")  # (R,)
+    feat = backbone(data)
+    score, bbox = rpn_heads(feat)
+
+    tgt = mx.sym.Custom(cls_score=score, gt_boxes=gt_boxes,
+                        op_type="rcnn_anchor_target", name="anchor_tgt")
+    rpn_label, bbox_target, bbox_weight = tgt[0], tgt[1], tgt[2]
+    score_2 = mx.sym.Reshape(score, shape=(0, 2, -1),
+                             name="rpn_score_reshape")
+    rpn_cls = mx.sym.SoftmaxOutput(score_2, label=rpn_label,
+                                   multi_output=True, use_ignore=True,
+                                   ignore_label=-1, normalization="valid",
+                                   name="rpn_cls_prob")
+    rpn_reg = mx.sym.MakeLoss(
+        mx.sym.sum(mx.sym.smooth_l1(
+            (bbox - mx.sym.BlockGrad(bbox_target)) *
+            mx.sym.BlockGrad(bbox_weight), scalar=3.0)) / 256.0,
+        name="rpn_reg_loss")
+
+    cls_score = roi_head(feat, rois, num_classes)
+    head_cls = mx.sym.SoftmaxOutput(cls_score, label=roi_label,
+                                    name="head_cls_prob")
+    return mx.sym.Group([rpn_cls, rpn_reg, head_cls])
+
+
+def test_symbol(num_classes, rpn_post=16):
+    """Deploy composition: trained RPN -> Proposal -> ROIPooling -> head."""
+    data = mx.sym.Variable("data")
+    im_info = mx.sym.Variable("im_info")
+    feat = backbone(data)
+    score, bbox = rpn_heads(feat)
+    # two-class softmax prob from score pairs: p_fg = sigmoid(fg - bg)
+    bg = mx.sym.slice_axis(score, axis=1, begin=0, end=A)
+    fg = mx.sym.slice_axis(score, axis=1, begin=A, end=2 * A)
+    p_fg = mx.sym.Activation(fg - bg, act_type="sigmoid")
+    cls_prob = mx.sym.Concat(1.0 - p_fg, p_fg, dim=1,
+                             name="rpn_cls_prob")
+    rois = mx.sym.Proposal(cls_prob=cls_prob, bbox_pred=bbox,
+                           im_info=im_info, feature_stride=FEAT_STRIDE,
+                           scales=SCALES, ratios=RATIOS,
+                           rpn_pre_nms_top_n=256,
+                           rpn_post_nms_top_n=rpn_post,
+                           threshold=0.5, rpn_min_size=4, name="proposal")
+    cls_score = roi_head(feat, rois, num_classes)
+    prob = mx.sym.softmax(cls_score, axis=-1, name="head_prob")
+    return mx.sym.Group([mx.sym.BlockGrad(rois),
+                         mx.sym.BlockGrad(prob)])
+
+
+class SceneIter(mx.io.DataIter):
+    """Colored-rectangle scenes with pixel-coord gt + training rois
+    (gt-jittered positives and random negatives — the Fast-RCNN external
+    proposal protocol)."""
+
+    def __init__(self, count, batch_size, size=96, num_classes=3,
+                 rois_per_image=8, seed=0):
+        super().__init__(batch_size)
+        self.rs = np.random.RandomState(seed)
+        self.count, self.size = count, size
+        self.num_classes = num_classes
+        self.rpi = rois_per_image
+        self.cur = 0
+        self.provide_data = [
+            mx.io.DataDesc("data", (batch_size, 3, size, size)),
+            mx.io.DataDesc("rois", (batch_size * rois_per_image, 5))]
+        self.provide_label = [
+            mx.io.DataDesc("gt_boxes", (batch_size, 2, 5)),
+            mx.io.DataDesc("roi_label", (batch_size * rois_per_image,))]
+
+    def reset(self):
+        self.cur = 0
+
+    def make_scene(self):
+        s = self.size
+        img = self.rs.uniform(-0.3, 0.3, (3, s, s)).astype(np.float32)
+        gt = np.full((2, 5), -1.0, np.float32)
+        for j in range(self.rs.randint(1, 3)):
+            cls = self.rs.randint(0, self.num_classes)
+            w, h = self.rs.randint(s // 6, s // 2, 2)
+            x1 = self.rs.randint(0, s - w - 1)
+            y1 = self.rs.randint(0, s - h - 1)
+            img[cls, y1:y1 + h, x1:x1 + w] += 1.0
+            gt[j] = [cls, x1, y1, x1 + w - 1, y1 + h - 1]
+        return img, gt
+
+    def next(self):
+        if self.cur >= self.count:
+            raise StopIteration
+        self.cur += 1
+        b, s, rpi = self.batch_size, self.size, self.rpi
+        data = np.zeros((b, 3, s, s), np.float32)
+        gts = np.zeros((b, 2, 5), np.float32)
+        rois = np.zeros((b * rpi, 5), np.float32)
+        rlab = np.zeros((b * rpi,), np.float32)
+        for n in range(b):
+            data[n], gts[n] = self.make_scene()
+            boxes = gts[n][gts[n, :, 0] >= 0]
+            for r in range(rpi):
+                i = n * rpi + r
+                rois[i, 0] = n
+                if r < rpi // 2:   # jittered positive
+                    g = boxes[self.rs.randint(len(boxes))]
+                    w, h = g[3] - g[1] + 1, g[4] - g[2] + 1
+                    jit = self.rs.uniform(-0.15, 0.15, 4) * [w, h, w, h]
+                    rois[i, 1:] = np.clip(g[1:5] + jit, 0, s - 1)
+                    rlab[i] = g[0] + 1  # classes 1..C, 0 = background
+                else:              # random box; label by IoU
+                    w, h = self.rs.randint(s // 6, s // 2, 2)
+                    x1 = self.rs.randint(0, s - w - 1)
+                    y1 = self.rs.randint(0, s - h - 1)
+                    box = np.array([x1, y1, x1 + w - 1, y1 + h - 1],
+                                   np.float32)
+                    rois[i, 1:] = box
+                    ious = iou_matrix(box[None], boxes[:, 1:5])[0]
+                    rlab[i] = boxes[ious.argmax(), 0] + 1 \
+                        if ious.max() > 0.5 else 0
+        return mx.io.DataBatch(
+            data=[mx.nd.array(data), mx.nd.array(rois)],
+            label=[mx.nd.array(gts), mx.nd.array(rlab)], pad=0)
+
+
+def evaluate(mod_params, num_classes, batches=4, batch_size=8, size=96,
+             rpn_post=16, seed=123):
+    """Detection recall of the Proposal->ROIPooling->head composition."""
+    net = test_symbol(num_classes, rpn_post)
+    ex = net.simple_bind(mx.current_context(), grad_req="null",
+                         data=(batch_size, 3, size, size),
+                         im_info=(batch_size, 3))
+    ex.copy_params_from(mod_params, allow_extra_params=True)
+    it = SceneIter(batches, batch_size, size, num_classes, seed=seed)
+    hit = tot = 0
+    for batch in it:
+        data = batch.data[0]
+        gts = batch.label[0].asnumpy()
+        im_info = np.tile([size, size, 1.0],
+                          (batch_size, 1)).astype(np.float32)
+        ex.forward(data=data, im_info=mx.nd.array(im_info))
+        rois = ex.outputs[0].asnumpy()        # (N*post, 5)
+        prob = ex.outputs[1].asnumpy()        # (N*post, C+1)
+        cls = prob.argmax(axis=1)
+        for n in range(batch_size):
+            sel = rois[:, 0] == n
+            rb, rc = rois[sel][:, 1:], cls[sel]
+            for g in gts[n][gts[n, :, 0] >= 0]:
+                tot += 1
+                ious = iou_matrix(rb, g[None, 1:5])[:, 0]
+                ok = (ious > 0.5) & (rc == g[0] + 1)
+                hit += bool(ok.any())
+    return hit / max(tot, 1)
+
+
+class HeadAccuracy(mx.metric.EvalMetric):
+    """Classification accuracy of the ROI head over its training rois
+    (outputs: [rpn_cls_prob, rpn_reg_loss, head_cls_prob])."""
+
+    def __init__(self):
+        super().__init__("head_acc")
+
+    def update(self, labels, preds):
+        roi_label = labels[1].asnumpy()
+        pred = preds[2].asnumpy().argmax(axis=1)
+        self.sum_metric += float((pred == roi_label).sum())
+        self.num_inst += roi_label.size
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="toy Faster-RCNN end-to-end")
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--image-size", type=int, default=96)
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    parser.add_argument("--batches-per-epoch", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=0.002)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = train_symbol(args.num_classes)
+    train = SceneIter(args.batches_per_epoch, args.batch_size,
+                      args.image_size, args.num_classes)
+    mod = mx.Module(net, data_names=("data", "rois"),
+                    label_names=("gt_boxes", "roi_label"),
+                    context=mx.current_context())
+    mod.fit(train, num_epoch=args.num_epochs,
+            eval_metric=HeadAccuracy(),
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.0),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       8))
+    arg_params, aux_params = mod.get_params()
+    params = {k: v for k, v in arg_params.items()}
+    recall = evaluate(params, args.num_classes,
+                      batch_size=args.batch_size, size=args.image_size)
+    logging.info("detection recall %.3f", recall)
+
+
+if __name__ == "__main__":
+    main()

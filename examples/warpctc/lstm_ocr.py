@@ -1,0 +1,163 @@
+"""LSTM + CTC "OCR" (reference example/warpctc/: lstm_ocr.py trains an
+LSTM with warp-ctc on captcha digit strips; toy_ctc.py is the synthetic
+variant).  The TPU build's CTCLoss is the in-graph lax.scan forward
+algorithm (``mxnet_tpu/ops/contrib.py`` _contrib_CTCLoss, reference
+``src/operator/contrib/ctc_loss.cc``), so the whole model — unrolled
+LSTM, per-step classifier, CTC — compiles into one XLA program.
+
+Synthetic task (reference toy_ctc.py protocol): a 4-digit string is
+rendered as an 80-step sequence of noisy one-hot columns (each digit
+held for 20 steps); the network must output the digit string with no
+per-step alignment supervision.  Greedy CTC decoding (collapse repeats,
+drop blanks) measures sequence accuracy.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+BLANK = 0  # CTCLoss convention: labels are 1..C-1, 0 is blank/pad
+
+
+def gen_sample(rs, seq_len, num_label, feat_dim, noise):
+    digits = rs.randint(0, feat_dim, (num_label,))
+    hold = seq_len // num_label
+    feats = np.zeros((seq_len, feat_dim), np.float32)
+    for i, d in enumerate(digits):
+        feats[i * hold:(i + 1) * hold, d] = 1.0
+    feats += rs.uniform(-noise, noise, feats.shape)
+    return feats, digits + 1  # labels are 1-based (0 = blank)
+
+
+class OCRIter(mx.io.DataIter):
+    def __init__(self, count, batch_size, seq_len=80, num_label=4,
+                 feat_dim=10, noise=0.3, seed=0):
+        super().__init__(batch_size)
+        self.rs = np.random.RandomState(seed)
+        self.count, self.seq_len = count, seq_len
+        self.num_label, self.feat_dim, self.noise = num_label, feat_dim, \
+            noise
+        self.cur = 0
+        self.provide_data = [mx.io.DataDesc(
+            "data", (batch_size, seq_len, feat_dim))]
+        self.provide_label = [mx.io.DataDesc(
+            "label", (batch_size, num_label))]
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= self.count:
+            raise StopIteration
+        self.cur += 1
+        data = np.zeros((self.batch_size, self.seq_len, self.feat_dim),
+                        np.float32)
+        label = np.zeros((self.batch_size, self.num_label), np.float32)
+        for i in range(self.batch_size):
+            data[i], label[i] = gen_sample(self.rs, self.seq_len,
+                                           self.num_label, self.feat_dim,
+                                           self.noise)
+        return mx.io.DataBatch(data=[mx.nd.array(data)],
+                               label=[mx.nd.array(label)], pad=0)
+
+
+def ocr_symbol(seq_len, num_hidden, num_classes):
+    """Unrolled LSTM -> per-step FC -> CTCLoss; outputs
+    (MakeLoss(ctc), BlockGrad(per-step log-softmax input)) so the fit
+    loop can both train and decode (reference lstm_ocr.py builds the
+    same pair as separate train/infer symbols)."""
+    data = mx.sym.Variable("data")          # (N, T, F)
+    label = mx.sym.Variable("label")        # (N, L), 1-based, 0 pad
+    cell = mx.rnn.LSTMCell(num_hidden=num_hidden, prefix="lstm_")
+    outputs, _ = cell.unroll(seq_len, inputs=data, layout="NTC",
+                             merge_outputs=True)     # (N, T, H)
+    flat = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = mx.sym.FullyConnected(flat, num_hidden=num_classes,
+                                 name="cls")          # (N*T, C)
+    # CTCLoss wants (T, N, C)
+    tnc = mx.sym.transpose(mx.sym.Reshape(
+        pred, shape=(-1, seq_len, num_classes)), axes=(1, 0, 2))
+    ctc = mx.sym.CTCLoss(data=tnc, label=label, name="ctc")
+    return mx.sym.Group([mx.sym.MakeLoss(ctc),
+                         mx.sym.BlockGrad(tnc, name="pred")])
+
+
+def greedy_decode(tnc_scores):
+    """(T, N, C) scores -> list of label sequences (collapse repeats,
+    drop blanks) — standard CTC best-path decoding."""
+    best = np.argmax(tnc_scores, axis=-1)   # (T, N)
+    out = []
+    for n in range(best.shape[1]):
+        seq, prev = [], -1
+        for t in best[:, n]:
+            if t != prev and t != BLANK:
+                seq.append(int(t))
+            prev = t
+        out.append(seq)
+    return out
+
+
+class SeqAccuracy(mx.metric.EvalMetric):
+    """Exact-sequence-match rate via greedy CTC decode."""
+
+    def __init__(self):
+        super().__init__("seq_acc")
+
+    def update(self, labels, preds):
+        tnc = preds[1].asnumpy()
+        decoded = greedy_decode(tnc)
+        lab = labels[0].asnumpy()
+        for seq, row in zip(decoded, lab):
+            truth = [int(v) for v in row if v > 0]
+            self.sum_metric += float(seq == truth)
+            self.num_inst += 1
+
+
+def main():
+    parser = argparse.ArgumentParser(description="LSTM+CTC toy OCR")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--seq-len", type=int, default=80)
+    parser.add_argument("--num-label", type=int, default=4)
+    parser.add_argument("--feat-dim", type=int, default=10)
+    parser.add_argument("--num-epochs", type=int, default=20)
+    parser.add_argument("--batches-per-epoch", type=int, default=30)
+    parser.add_argument("--noise", type=float, default=0.2)
+    parser.add_argument("--optimizer", type=str, default="adam")
+    parser.add_argument("--lr", type=float, default=0.005)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    num_classes = args.feat_dim + 1  # digits 1..10 + blank 0
+    net = ocr_symbol(args.seq_len, args.num_hidden, num_classes)
+    train = OCRIter(args.batches_per_epoch, args.batch_size,
+                    args.seq_len, args.num_label, args.feat_dim,
+                    noise=args.noise)
+    val = OCRIter(4, args.batch_size, args.seq_len, args.num_label,
+                  args.feat_dim, noise=args.noise, seed=99)
+
+    mod = mx.Module(net, data_names=("data",), label_names=("label",),
+                    context=mx.current_context())
+    mod.fit(train, eval_data=val, eval_metric=SeqAccuracy(),
+            num_epoch=args.num_epochs,
+            optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.34),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       10))
+    score = mod.score(val, SeqAccuracy())
+    logging.info("final seq accuracy %.3f", score[0][1])
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,7 @@ def export_model(symbol, arg_params, aux_params, input_shapes, path,
     input_dtypes = dict(input_dtypes or {})
 
     const_args = {}
+    zero_filled = []
     for name, shape in zip(arg_names, arg_shapes):
         if name in input_shapes:
             continue
@@ -66,10 +67,21 @@ def export_model(symbol, arg_params, aux_params, input_shapes, path,
             const_args[name] = jnp.asarray(
                 v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
         elif shape is not None:
+            # legitimate only for loss-head inputs (labels) that inference
+            # never reads — a real missing weight would silently export a
+            # garbage-predicting artifact, so it is reported loudly
             const_args[name] = jnp.zeros(tuple(shape), jnp.float32)
+            zero_filled.append(name)
         else:
             raise MXNetError("argument %r is neither an input nor in "
                              "arg_params and its shape is unknown" % name)
+    if zero_filled:
+        import logging
+        logging.warning(
+            "export_model: arguments %s are not in arg_params and were "
+            "baked as ZEROS — expected only for unused loss inputs "
+            "(labels); if any is a weight, the artifact will predict "
+            "garbage", zero_filled)
     const_aux = []
     for name, shape in zip(aux_names, aux_shapes):
         if name in (aux_params or {}):

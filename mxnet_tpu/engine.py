@@ -88,6 +88,11 @@ class Engine:
                     from . import native
                     if native.available():
                         self._host = native.NativeEngine()
+                        if self._host is not None:
+                            # queued host tasks (async checkpoint writes)
+                            # must land before interpreter teardown
+                            import atexit
+                            atexit.register(self._host.wait_all)
         return self._host
 
     # -- sync points --------------------------------------------------------

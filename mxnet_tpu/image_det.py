@@ -20,7 +20,7 @@ from .base import MXNetError
 
 __all__ = ["DetLabel", "DetHorizontalFlipAug", "DetRandomCropAug",
            "DetRandomPadAug", "DetResizeAug", "DetColorNormalizeAug",
-           "CreateDetAugmenter"]
+           "DetColorJitterAug", "CreateDetAugmenter"]
 
 
 class DetLabel:
@@ -83,23 +83,30 @@ def _coverage(inner, outer):
     return np.where(area > 0, ix * iy / np.maximum(area, 1e-12), 0.0)
 
 
-def _crop_boxes(label, crop, emit_mode, emit_thresh):
+def _crop_boxes(label, crop, emit_mode, emit_thresh, min_eject_coverage=0.0):
     """Transform boxes into crop coordinates; drop boxes per emit mode
-    (reference crop_emit_mode 'center'/'overlap')."""
+    (reference crop_emit_mode 'center'/'overlap').  ``min_eject_coverage``
+    additionally ejects boxes whose visible fraction inside the crop falls
+    below the threshold (parameter from the reference lineage's later
+    ImageDetRecordIter revisions; 0 disables)."""
     objs = label.objects
     if objs.shape[0] == 0:
         return objs
     boxes = objs[:, 1:5]
     cx0, cy0, cx1, cy1 = crop
     cw, ch = cx1 - cx0, cy1 - cy0
+    cov = None
+    if emit_mode != "center" or min_eject_coverage > 0:
+        cov = _coverage(boxes, np.asarray(crop, np.float32))
     if emit_mode == "center":
         centers_x = (boxes[:, 0] + boxes[:, 2]) / 2
         centers_y = (boxes[:, 1] + boxes[:, 3]) / 2
         keep = ((centers_x >= cx0) & (centers_x <= cx1) &
                 (centers_y >= cy0) & (centers_y <= cy1))
     else:  # overlap
-        cov = _coverage(boxes, np.asarray(crop, np.float32))
         keep = cov > emit_thresh
+    if min_eject_coverage > 0:
+        keep = keep & (cov >= min_eject_coverage)
     objs = objs[keep].copy()
     if objs.shape[0] == 0:
         return objs
@@ -132,7 +139,8 @@ def DetRandomCropAug(min_scales=(0.3,), max_scales=(1.0,),
                      min_sample_coverages=(0.0,), max_sample_coverages=(1.0,),
                      min_object_coverages=(0.0,), max_object_coverages=(1.0,),
                      num_crop_sampler=1, crop_emit_mode="center",
-                     emit_overlap_thresh=0.3, max_crop_trials=(25,), p=1.0):
+                     emit_overlap_thresh=0.3, max_crop_trials=(25,), p=1.0,
+                     min_eject_coverage=0.0):
     """Constrained random crop (reference RandomCropGenerator): each
     sampler draws crops until one satisfies its IOU/coverage constraints
     against the ground-truth boxes; one passing sampler is applied."""
@@ -187,7 +195,8 @@ def DetRandomCropAug(min_scales=(0.3,), max_scales=(1.0,),
             if crop is None:
                 continue
             new_objs = _crop_boxes(label, crop, crop_emit_mode,
-                                   emit_overlap_thresh)
+                                   emit_overlap_thresh,
+                                   min_eject_coverage)
             if label.objects.shape[0] and new_objs.shape[0] == 0:
                 continue   # crop ejected every object; try next sampler
             h, w = img.shape[:2]
@@ -225,13 +234,118 @@ def DetRandomPadAug(max_pad_scale=2.0, fill_value=127, p=1.0):
     return aug
 
 
-def DetResizeAug(data_shape, interp=2):
-    """Force-resize to (h, w); normalized boxes are resize-invariant.
+def DetColorJitterAug(max_random_hue=0, random_hue_prob=0.0,
+                      max_random_saturation=0, random_saturation_prob=0.0,
+                      max_random_illumination=0,
+                      random_illumination_prob=0.0,
+                      max_random_contrast=0.0, random_contrast_prob=0.0):
+    """Detection HSL jitter (reference image_det_aug_default.cc random
+    hue/saturation/illumination/contrast: each channel independently
+    perturbed with its own probability; hue/saturation work in HLS space
+    like the cv2 path, illumination is an additive lightness shift,
+    contrast scales around the mean).  Boxes are untouched."""
+    import colorsys  # noqa: F401  (documentation: HLS convention)
+
+    def _rgb_to_hls(img):
+        # vectorized RGB->HLS on [0,1] floats (cv2.COLOR_BGR2HLS analog)
+        r, g, b = img[..., 0], img[..., 1], img[..., 2]
+        maxc = np.max(img, axis=-1)
+        minc = np.min(img, axis=-1)
+        l = (maxc + minc) / 2.0
+        delta = maxc - minc
+        s = np.where(delta == 0, 0.0,
+                     np.where(l <= 0.5, delta / np.maximum(maxc + minc,
+                                                           1e-12),
+                              delta / np.maximum(2.0 - maxc - minc,
+                                                 1e-12)))
+        dsafe = np.maximum(delta, 1e-12)
+        rc = (maxc - r) / dsafe
+        gc = (maxc - g) / dsafe
+        bc = (maxc - b) / dsafe
+        h = np.where(maxc == r, bc - gc,
+                     np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+        h = np.where(delta == 0, 0.0, (h / 6.0) % 1.0)
+        return h, l, s
+
+    def _hls_to_rgb(h, l, s):
+        m2 = np.where(l <= 0.5, l * (1.0 + s), l + s - l * s)
+        m1 = 2.0 * l - m2
+
+        def channel(hue):
+            hue = hue % 1.0
+            out = np.where(hue < 1 / 6, m1 + (m2 - m1) * hue * 6.0,
+                           np.where(hue < 0.5, m2,
+                                    np.where(hue < 2 / 3,
+                                             m1 + (m2 - m1) *
+                                             (2 / 3 - hue) * 6.0, m1)))
+            return out
+        return np.stack([channel(h + 1 / 3), channel(h),
+                         channel(h - 1 / 3)], axis=-1)
+
+    def aug(img, label):
+        hue = max_random_hue if (max_random_hue > 0 and
+                                 np.random.random() <
+                                 random_hue_prob) else 0
+        sat = max_random_saturation if (max_random_saturation > 0 and
+                                        np.random.random() <
+                                        random_saturation_prob) else 0
+        illum = max_random_illumination if (
+            max_random_illumination > 0 and
+            np.random.random() < random_illumination_prob) else 0
+        contrast = max_random_contrast if (
+            max_random_contrast > 0 and
+            np.random.random() < random_contrast_prob) else 0
+        if not (hue or sat or illum or contrast):
+            return img, label
+        arr = np.clip(np.asarray(img, np.float32), 0, 255) / 255.0
+        if hue or sat or illum:
+            h, l, s = _rgb_to_hls(arr)
+            if hue:
+                # reference: hue in degrees over the cv2 0..180 half-circle
+                h = h + np.random.uniform(-hue, hue) / 180.0
+            if sat:
+                s = np.clip(s * (1.0 + np.random.uniform(-sat, sat) /
+                                 100.0), 0.0, 1.0)
+            if illum:
+                l = np.clip(l + np.random.uniform(-illum, illum) / 255.0,
+                            0.0, 1.0)
+            arr = _hls_to_rgb(h, np.clip(l, 0, 1), np.clip(s, 0, 1))
+        if contrast:
+            c = 1.0 + np.random.uniform(-contrast, contrast)
+            arr = (arr - arr.mean()) * c + arr.mean()
+        return np.clip(arr * 255.0, 0, 255).astype(np.float32), label
+    return aug
+
+
+def _det_inter_filter(inter_method, old_size, new_size):
+    """PIL filter for the reference's inter_method conventions: 0-4 fixed
+    methods, 9 = auto by scaling direction (area when shrinking, bicubic
+    when enlarging — reference GetInterMethod), 10 = random per image."""
+    from .image import _pil_filter
+    if inter_method == 10:
+        return _pil_filter(np.random.randint(0, 5))
+    if inter_method == 9:
+        return _pil_filter(4 if new_size < old_size else 2)
+    return _pil_filter(inter_method)
+
+
+def DetResizeAug(data_shape, interp=2, resize_mode="force", fill_value=127):
+    """Resize to (h, w) under the reference's resize_mode semantics
+    (image_det_aug_default.cc:616-648):
+
+    * ``force`` — stretch to data_shape regardless of aspect ratio
+      (normalized boxes are invariant);
+    * ``shrink`` — keep aspect ratio, only shrink when larger;
+    * ``fit`` — keep aspect ratio, fit inside data_shape.
+
+    XLA batching needs static shapes, so shrink/fit letterbox the result
+    onto a fill-valued data_shape canvas (top-left anchored, the batch
+    padding the reference's iterator applies) and boxes are rescaled to
+    canvas coordinates.
 
     Pure PIL/numpy — augmenters run on decode pool threads, where jax
     dispatch must never appear (concurrent tracing deadlocks)."""
     from .io.image_util import _require_pil
-    from .image import _pil_filter
     _, h, w = data_shape
 
     def aug(img, label):
@@ -239,9 +353,29 @@ def DetResizeAug(data_shape, interp=2):
         from PIL import Image
         if img.dtype != np.uint8:
             img = np.clip(img, 0, 255).astype(np.uint8)
-        arr = np.asarray(Image.fromarray(img).resize(
-            (w, h), _pil_filter(interp)), dtype=np.float32)
-        return arr, label
+        ih, iw = img.shape[:2]
+        if resize_mode == "force":
+            filt = _det_inter_filter(interp, max(ih, iw), max(h, w))
+            arr = np.asarray(Image.fromarray(img).resize((w, h), filt),
+                             dtype=np.float32)
+            return arr, label
+        ratio = min(h / ih, w / iw)
+        if resize_mode == "shrink":
+            ratio = min(ratio, 1.0)
+        nh, nw = max(1, int(ih * ratio)), max(1, int(iw * ratio))
+        filt = _det_inter_filter(interp, max(ih, iw), max(nh, nw))
+        small = np.asarray(Image.fromarray(img).resize((nw, nh), filt),
+                           dtype=np.float32)
+        canvas = np.full((h, w, img.shape[2]), float(fill_value),
+                         np.float32)
+        canvas[:nh, :nw, :] = small
+        objs = label.objects
+        if objs.shape[0]:
+            objs[:, 1] *= nw / w
+            objs[:, 3] *= nw / w
+            objs[:, 2] *= nh / h
+            objs[:, 4] *= nh / h
+        return canvas, label
     return aug
 
 
@@ -265,13 +399,41 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop_prob=0,
                        max_crop_object_coverages=(1.0,),
                        num_crop_sampler=1, crop_emit_mode="center",
                        emit_overlap_thresh=0.3, max_crop_trials=(25,),
+                       min_eject_coverage=0.0,
                        rand_pad_prob=0, max_pad_scale=1.0,
+                       max_random_hue=0, random_hue_prob=0.0,
+                       max_random_saturation=0,
+                       random_saturation_prob=0.0,
+                       max_random_illumination=0,
+                       random_illumination_prob=0.0,
+                       max_random_contrast=0.0, random_contrast_prob=0.0,
                        rand_mirror_prob=0, fill_value=127, inter_method=1,
-                       mean=None, std=None):
-    """Build the default detection augmenter list (the python analog of
-    DefaultImageDetAugmenter's apply order: pad → crop → mirror → resize →
-    normalize)."""
+                       resize_mode="force", mean=None, std=None):
+    """Build the default detection augmenter list.
+
+    Parameter surface mirrors the reference's
+    ``DefaultImageDetAugmentParam`` (src/io/image_det_aug_default.cc:
+    96-170): resize/resize_mode(force|shrink|fit), the multi-sampler crop
+    spec (scales, aspect ratios, overlaps, sample/object coverages,
+    trials, emit mode + threshold), expansion padding, HSL jitter
+    (hue/saturation/illumination/contrast max + prob), mirror,
+    fill_value, inter_method (0-4 fixed, 9 auto, 10 random).
+    ``min_eject_coverage`` is from the lineage's later revisions;
+    ``mean``/``std`` fold the iterator's normalize stage in.  Apply order
+    follows the reference: HSL jitter → mirror → pad → crop → resize."""
     auglist = []
+    if resize > 0:
+        # pre-resize shortest side (reference resize field)
+        auglist.append(_DetResizeShortAug(resize, inter_method))
+    if (random_hue_prob > 0 or random_saturation_prob > 0 or
+            random_illumination_prob > 0 or random_contrast_prob > 0):
+        auglist.append(DetColorJitterAug(
+            max_random_hue, random_hue_prob, max_random_saturation,
+            random_saturation_prob, max_random_illumination,
+            random_illumination_prob, max_random_contrast,
+            random_contrast_prob))
+    if rand_mirror_prob > 0:
+        auglist.append(DetHorizontalFlipAug(rand_mirror_prob))
     if rand_pad_prob > 0 and max_pad_scale > 1.0:
         auglist.append(DetRandomPadAug(max_pad_scale, fill_value,
                                        rand_pad_prob))
@@ -282,10 +444,9 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop_prob=0,
             min_crop_sample_coverages, max_crop_sample_coverages,
             min_crop_object_coverages, max_crop_object_coverages,
             num_crop_sampler, crop_emit_mode, emit_overlap_thresh,
-            max_crop_trials, rand_crop_prob))
-    if rand_mirror_prob > 0:
-        auglist.append(DetHorizontalFlipAug(rand_mirror_prob))
-    auglist.append(DetResizeAug(data_shape, inter_method))
+            max_crop_trials, rand_crop_prob, min_eject_coverage))
+    auglist.append(DetResizeAug(data_shape, inter_method, resize_mode,
+                                fill_value))
     if mean is not None or std is not None:
         if mean is True:
             mean = np.array([123.68, 116.28, 103.53])
@@ -293,3 +454,26 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop_prob=0,
             std = np.array([58.395, 57.12, 57.375])
         auglist.append(DetColorNormalizeAug(mean, std))
     return auglist
+
+
+def _DetResizeShortAug(size, interp):
+    """Resize the shortest side to ``size`` keeping aspect ratio
+    (reference ``resize`` field); boxes are normalized, so untouched."""
+    from .io.image_util import _require_pil
+
+    def aug(img, label):
+        _require_pil()
+        from PIL import Image
+        if img.dtype != np.uint8:
+            img = np.clip(img, 0, 255).astype(np.uint8)
+        ih, iw = img.shape[:2]
+        short = min(ih, iw)
+        if short == size:
+            return img.astype(np.float32), label
+        ratio = size / short
+        nh, nw = max(1, int(ih * ratio)), max(1, int(iw * ratio))
+        filt = _det_inter_filter(interp, short, size)
+        arr = np.asarray(Image.fromarray(img).resize((nw, nh), filt),
+                         dtype=np.float32)
+        return arr, label
+    return aug

@@ -116,6 +116,17 @@ def available():
     return lib() is not None
 
 
+def storage_stats():
+    """(used_bytes, pooled_bytes) of the native host storage pool
+    (reference Storage::Get() pool counters; the RecordIO prefetcher's
+    record buffers ride this pool)."""
+    l = lib()
+    if l is None:
+        return (0, 0)
+    return (int(l.mxt_storage_used_bytes()),
+            int(l.mxt_storage_pooled_bytes()))
+
+
 # ---------------------------------------------------------------------------
 # Engine facade
 # ---------------------------------------------------------------------------

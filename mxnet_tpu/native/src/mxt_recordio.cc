@@ -16,10 +16,60 @@
 #include <thread>
 #include <vector>
 
+// host staging allocations ride the pooled storage manager
+// (mxt_storage.cc — the reference routes pipeline buffers through its
+// pooled storage layer the same way, pooled_storage_manager.h)
+extern "C" void *mxt_storage_alloc(uint64_t size);
+extern "C" void mxt_storage_free(void *p, uint64_t size);
+
 namespace {
 
 constexpr uint32_t kMagic = 0xced7230a;
 constexpr uint32_t kLengthMask = (1u << 29) - 1;
+
+// Record payloads live in pooled buffers; capacities are bucketed to 4KB
+// multiples so variable-size records (JPEGs) still hit the exact-size
+// free pool.
+struct PooledBuf {
+  char *p = nullptr;
+  uint64_t cap = 0;
+  size_t len = 0;
+
+  PooledBuf() = default;
+  PooledBuf(const char *data, size_t n) {
+    cap = ((n | 1) + 4095) / 4096 * 4096;
+    p = static_cast<char *>(mxt_storage_alloc(cap));
+    len = n;
+    if (n) std::memcpy(p, data, n);
+  }
+  PooledBuf(PooledBuf &&o) noexcept : p(o.p), cap(o.cap), len(o.len) {
+    o.p = nullptr;
+    o.cap = 0;
+    o.len = 0;
+  }
+  PooledBuf &operator=(PooledBuf &&o) noexcept {
+    if (this != &o) {
+      Release();
+      p = o.p;
+      cap = o.cap;
+      len = o.len;
+      o.p = nullptr;
+      o.cap = 0;
+      o.len = 0;
+    }
+    return *this;
+  }
+  PooledBuf(const PooledBuf &) = delete;
+  PooledBuf &operator=(const PooledBuf &) = delete;
+  ~PooledBuf() { Release(); }
+
+  void Release() {
+    if (p) mxt_storage_free(p, cap);
+    p = nullptr;
+    cap = 0;
+    len = 0;
+  }
+};
 
 struct Reader {
   FILE *f = nullptr;
@@ -72,12 +122,12 @@ struct Writer {
 struct Prefetcher {
   Reader reader;
   size_t capacity;
-  std::deque<std::string> queue;
+  std::deque<PooledBuf> queue;
   std::mutex mu;
   std::condition_variable cv_produce, cv_consume;
   bool eof = false, stop = false;
   std::thread producer;
-  std::string current;  // last record handed to the consumer
+  PooledBuf current;  // last record handed to the consumer
 
   int64_t err = -1;  // status reported at end of stream (-1 eof, -2 corrupt)
 
@@ -112,7 +162,7 @@ struct Prefetcher {
       }
       cv_produce.wait(lk, [this] { return stop || queue.size() < capacity; });
       if (stop) return;
-      queue.emplace_back(data, static_cast<size_t>(n));
+      queue.emplace_back(PooledBuf(data, static_cast<size_t>(n)));
       cv_consume.notify_one();
     }
   }
@@ -125,8 +175,8 @@ struct Prefetcher {
     current = std::move(queue.front());
     queue.pop_front();
     cv_produce.notify_one();
-    *data = current.data();
-    return static_cast<int64_t>(current.size());
+    *data = current.p;
+    return static_cast<int64_t>(current.len);
   }
 };
 

@@ -25,7 +25,7 @@ import numpy as np
 
 from . import engine as _engine
 from . import random as _random
-from .base import MXNetError, _uid
+from .base import MXNetError, _uid, get_env
 from .context import Context, cpu, current_context
 from .ops.registry import get_op, list_ops
 
@@ -405,24 +405,102 @@ def waitall():
 # Save / load (reference: NDArray::Save/Load, ndarray.h:178-184; format here is
 # an npz container carrying the same {list|dict of named arrays} semantics)
 # ---------------------------------------------------------------------------
+# pending async writes: canonical path -> host-engine var; readers of a
+# path wait on its var (reference-style dependency tracking — every file
+# is an engine "variable", writes are mutating ops, reads wait on them)
+_file_vars = {}
+_file_vars_lock = None
+_async_write_error = []
+
+
+def _canon_path(path):
+    import os
+    return os.path.abspath(path)
+
+
+_FILE_VARS_CAP = 256
+
+
+def _async_save(path, write_fn):
+    """Route a checkpoint write through the C++ host engine so training
+    never blocks on disk (reference: save ops are Engine::PushAsync tasks
+    on the IO thread, serialized per destination).  Falls back to a
+    synchronous write when the native runtime is unavailable or
+    NaiveEngine mode is on."""
+    global _file_vars_lock
+    from . import engine as _engine
+    if _async_write_error:
+        raise MXNetError("previous async save failed: %s"
+                         % _async_write_error.pop(0))
+    eng = None
+    if not _engine.is_naive() and \
+            get_env("MXNET_ASYNC_CHECKPOINT") != "0":
+        eng = _engine.get().host
+    if eng is None:
+        write_fn()
+        return
+    import threading
+    if _file_vars_lock is None:
+        _file_vars_lock = threading.Lock()
+    path = _canon_path(path)
+    with _file_vars_lock:
+        if len(_file_vars) >= _FILE_VARS_CAP:
+            # epoch-stamped checkpoints create one var per file; bound the
+            # native var table by retiring settled entries
+            for old_path in [p for p in _file_vars if p != path]:
+                old_var = _file_vars.pop(old_path)
+                eng.wait_for_var(old_var)
+                eng.delete_var(old_var)
+        var = _file_vars.get(path)
+        if var is None:
+            var = _file_vars[path] = eng.new_var()
+
+    def task():
+        try:
+            write_fn()
+        except Exception as exc:  # surfaced on the next save/load/waitall
+            _async_write_error.append("%s: %s" % (path, exc))
+
+    eng.push(task, mutable_vars=(var,))
+
+
+def _wait_pending_write(fname):
+    """Block until any queued write to ``fname`` (or its .npz twin) has
+    landed, then surface errors."""
+    from . import engine as _engine
+    eng = _engine.get()._host
+    if eng is not None:
+        for path in (_canon_path(fname), _canon_path(fname + ".npz")):
+            var = _file_vars.get(path)
+            if var is not None:
+                eng.wait_for_var(var)
+    if _async_write_error:
+        raise MXNetError("async save failed: %s"
+                         % _async_write_error.pop(0))
+
+
 def save(fname, data):
     # np.savez always appends .npz to names lacking it; canonical on-disk
     # name is therefore fname + '.npz' and load() resolves the same way.
+    # Values are snapshotted (asnumpy) before returning; the file write
+    # itself runs on the host engine (see _async_save).
     if isinstance(data, NDArray):
         data = [data]
+    path = _npz_save_name(fname)
     if isinstance(data, dict):
-        np.savez(_npz_save_name(fname),
-                 __mx_format__=np.array("dict"),
-                 **{k: v.asnumpy() for k, v in data.items()})
+        arrays = {k: v.asnumpy() for k, v in data.items()}
+        fmt = "dict"
     elif isinstance(data, (list, tuple)):
-        np.savez(_npz_save_name(fname),
-                 __mx_format__=np.array("list"),
-                 **{"arr_%d" % i: v.asnumpy() for i, v in enumerate(data)})
+        arrays = {"arr_%d" % i: v.asnumpy() for i, v in enumerate(data)}
+        fmt = "list"
     else:
         raise MXNetError("save requires NDArray, list or dict")
+    _async_save(path, lambda: np.savez(
+        path, __mx_format__=np.array(fmt), **arrays))
 
 
 def load(fname):
+    _wait_pending_write(fname)
     with np.load(_npz_load_name(fname)) as zf:
         fmt = str(zf["__mx_format__"])
         if fmt == "dict":

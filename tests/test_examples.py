@@ -73,3 +73,30 @@ def test_ssd_train_from_records(tmp_path):
              timeout=480)
     out = p.stderr + p.stdout
     assert "done" in out
+
+
+def test_warpctc_lstm_ocr():
+    """LSTM+CTC toy OCR must actually learn: exact-sequence accuracy via
+    greedy CTC decode well above chance (reference example/warpctc/
+    toy_ctc.py protocol)."""
+    import re
+    p = _run("examples/warpctc/lstm_ocr.py",
+             "--seq-len", "20", "--num-hidden", "64",
+             "--num-epochs", "14", "--batches-per-epoch", "30",
+             timeout=480)
+    out = p.stderr + p.stdout
+    accs = re.findall(r"final seq accuracy ([0-9.]+)", out)
+    assert accs, out[-800:]
+    assert float(accs[-1]) > 0.8, out[-800:]
+
+
+def test_rcnn_end2end():
+    """Toy Faster-RCNN: AnchorTarget CustomOp + RPN training, then the
+    Proposal -> ROIPooling -> head composition must localize+classify
+    most synthetic gt boxes (reference example/rcnn/train_end2end.py)."""
+    import re
+    p = _run("examples/rcnn/train_end2end.py", timeout=480)
+    out = p.stderr + p.stdout
+    rec = re.findall(r"detection recall ([0-9.]+)", out)
+    assert rec, out[-800:]
+    assert float(rec[-1]) > 0.6, out[-800:]

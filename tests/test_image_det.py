@@ -192,3 +192,106 @@ def test_image_record_iter_threaded_parity(tmp_path):
     l4, p4 = collect(4)
     np.testing.assert_allclose(l1, l4)
     assert p1 == p4 == [0, 0, 2]
+
+
+def test_det_color_jitter_changes_pixels_not_boxes():
+    from mxnet_tpu.image_det import DetColorJitterAug
+    np.random.seed(3)
+    aug = DetColorJitterAug(max_random_hue=18, random_hue_prob=1.0,
+                            max_random_saturation=32,
+                            random_saturation_prob=1.0,
+                            max_random_illumination=32,
+                            random_illumination_prob=1.0,
+                            max_random_contrast=0.3,
+                            random_contrast_prob=1.0)
+    rs = np.random.RandomState(0)
+    img = rs.randint(30, 220, (16, 16, 3)).astype(np.float32)
+    lbl = DetLabel(_det_label([[1, .1, .2, .6, .7]]))
+    before = lbl.objects.copy()
+    img2, lbl2 = aug(img.copy(), lbl)
+    assert img2.shape == img.shape
+    assert not np.allclose(img2, img), "jitter left the image unchanged"
+    assert img2.min() >= 0 and img2.max() <= 255
+    np.testing.assert_array_equal(lbl2.objects, before)
+
+
+def test_det_color_jitter_grey_hue_invariance():
+    """Hue rotation of a grey image is a no-op (HLS sanity)."""
+    from mxnet_tpu.image_det import DetColorJitterAug
+    np.random.seed(4)
+    aug = DetColorJitterAug(max_random_hue=90, random_hue_prob=1.0)
+    img = np.full((8, 8, 3), 128.0, np.float32)
+    lbl = DetLabel(_det_label([[0, .1, .1, .5, .5]]))
+    img2, _ = aug(img.copy(), lbl)
+    np.testing.assert_allclose(img2, img, atol=1.5)
+
+
+def test_det_resize_fit_letterboxes_boxes():
+    from mxnet_tpu.image_det import DetResizeAug
+    # 100x50 (h x w) source into 64x64 fit: ratio=0.64 -> 64x32 content
+    aug = DetResizeAug((3, 64, 64), resize_mode="fit", fill_value=7)
+    img = np.full((100, 50, 3), 200, np.uint8)
+    lbl = DetLabel(_det_label([[2, 0.0, 0.0, 1.0, 1.0]]))
+    img2, lbl2 = aug(img, lbl)
+    assert img2.shape == (64, 64, 3)
+    assert np.all(img2[:, 32:, :] == 7.0)     # letterbox fill
+    assert np.all(img2[:, :31, :] == 200.0)   # content
+    np.testing.assert_allclose(lbl2.objects[0, 1:5],
+                               [0.0, 0.0, 0.5, 1.0], atol=0.02)
+
+
+def test_det_resize_shrink_keeps_small_images():
+    from mxnet_tpu.image_det import DetResizeAug
+    aug = DetResizeAug((3, 64, 64), resize_mode="shrink", fill_value=0)
+    img = np.full((32, 32, 3), 100, np.uint8)
+    lbl = DetLabel(_det_label([[0, 0.0, 0.0, 1.0, 1.0]]))
+    img2, lbl2 = aug(img, lbl)
+    assert img2.shape == (64, 64, 3)
+    assert np.all(img2[:32, :32, :] == 100.0)  # unscaled content
+    assert np.all(img2[32:, :, :] == 0.0)
+    np.testing.assert_allclose(lbl2.objects[0, 1:5],
+                               [0.0, 0.0, 0.5, 0.5], atol=0.02)
+
+
+def test_det_crop_min_eject_coverage():
+    from mxnet_tpu.image_det import _crop_boxes
+    lbl = DetLabel(_det_label([[0, 0.0, 0.0, 0.2, 0.2],
+                               [1, 0.4, 0.4, 0.6, 0.6]]))
+    crop = (0.45, 0.45, 1.0, 1.0)
+    # center mode alone keeps box 2 (center 0.5 in crop)
+    kept = _crop_boxes(lbl.copy(), crop, "center", 0.3)
+    assert kept.shape[0] == 1
+    # its visible coverage is ~(0.15/0.2)^2 = 0.56; eject at 0.9 drops it
+    kept2 = _crop_boxes(lbl.copy(), crop, "center", 0.3,
+                        min_eject_coverage=0.9)
+    assert kept2.shape[0] == 0
+
+
+def test_create_det_augmenter_full_surface():
+    """The full reference parameter surface builds and runs (including
+    inter_method=10 random choice and the resize pre-stage)."""
+    from mxnet_tpu.image_det import CreateDetAugmenter
+    np.random.seed(5)
+    augs = CreateDetAugmenter(
+        (3, 32, 32), resize=48, rand_crop_prob=1.0,
+        min_crop_scales=(0.5, 0.7), max_crop_scales=(1.0, 1.0),
+        min_crop_aspect_ratios=(0.8,), max_crop_aspect_ratios=(1.2,),
+        num_crop_sampler=2, crop_emit_mode="overlap",
+        emit_overlap_thresh=0.2, max_crop_trials=(10, 10),
+        min_eject_coverage=0.1, rand_pad_prob=0.5, max_pad_scale=1.5,
+        max_random_hue=18, random_hue_prob=0.5,
+        max_random_saturation=32, random_saturation_prob=0.5,
+        max_random_illumination=32, random_illumination_prob=0.5,
+        max_random_contrast=0.3, random_contrast_prob=0.5,
+        rand_mirror_prob=0.5, inter_method=10, resize_mode="force",
+        mean=True, std=True)
+    rs = np.random.RandomState(1)
+    for _ in range(8):
+        img = rs.randint(0, 255, (40, 56, 3)).astype(np.float32)
+        lbl = DetLabel(_det_label([[1, .2, .2, .7, .8]]))
+        for a in augs:
+            img, lbl = a(img, lbl)
+        assert img.shape == (32, 32, 3)
+        if lbl.objects.shape[0]:
+            b = lbl.objects[:, 1:5]
+            assert (b >= -1e-5).all() and (b <= 1 + 1e-5).all()
