@@ -1,0 +1,82 @@
+"""Stacked autoencoder (reference example/autoencoder/: MLP autoencoder
+pretraining on MNIST).  Synthetic-digit variant: reconstructs the same
+separable blob digits the mnist example trains on, so it runs without
+datasets; reconstruction MSE is the report.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def synthetic_digits(n, rs, side=16):
+    """Blobby class-conditional images (separable; see train_mnist)."""
+    ys = rs.randint(0, 10, n)
+    xs = np.zeros((n, side * side), np.float32)
+    grid = np.stack(np.meshgrid(np.arange(side), np.arange(side)),
+                    -1).reshape(-1, 2)
+    for i, y in enumerate(ys):
+        cx = 3 + (y % 5) * 2.2
+        cy = 3 + (y // 5) * 7.0
+        d = ((grid[:, 0] - cx) ** 2 + (grid[:, 1] - cy) ** 2) / 6.0
+        xs[i] = np.exp(-d) + rs.uniform(0, 0.15, side * side)
+    return xs, ys
+
+
+def sae_symbol(dims):
+    """Encoder dims[0]->...->dims[-1] and mirrored decoder, MSE loss
+    (reference autoencoder model.py)."""
+    x = mx.sym.Variable("data")
+    h = x
+    for i, d in enumerate(dims[1:]):
+        h = mx.sym.Activation(
+            mx.sym.FullyConnected(h, num_hidden=d, name="enc%d" % i),
+            act_type="sigmoid")
+    for i, d in enumerate(reversed(dims[:-1])):
+        h = mx.sym.FullyConnected(h, num_hidden=d, name="dec%d" % i)
+        if i < len(dims) - 2:
+            h = mx.sym.Activation(h, act_type="sigmoid")
+    return mx.sym.LinearRegressionOutput(h, label=mx.sym.Variable(
+        "recon_label"), name="recon")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="stacked autoencoder")
+    parser.add_argument("--num-examples", type=int, default=2048)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=12)
+    parser.add_argument("--dims", type=str, default="256,64,16")
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(0)
+    X, _ = synthetic_digits(args.num_examples, rs)
+    dims = [int(d) for d in args.dims.split(",")]
+    assert dims[0] == X.shape[1], "first dim must match input size"
+    it = mx.io.NDArrayIter(X, X, batch_size=args.batch_size, shuffle=True,
+                           label_name="recon_label")
+    mod = mx.Module(sae_symbol(dims), data_names=("data",),
+                    label_names=("recon_label",),
+                    context=mx.current_context())
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="mse",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       20))
+    mse = mod.score(it, "mse")[0][1]
+    logging.info("final reconstruction mse %.5f", mse)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""CNN text classification (reference example/cnn_text_classification/:
+Kim-2014 CNN — embedding, parallel conv widths over time, max-over-time
+pooling, dropout, FC).  Synthetic task: classify token sequences by
+which "signal" n-gram they contain, so the example runs without the MR
+dataset.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_data(rs, n, seq_len, vocab, num_classes):
+    """Each class k is marked by the bigram (2k+1, 2k+2) planted at a
+    random position in otherwise-random token noise."""
+    X = rs.randint(num_classes * 2 + 1, vocab, (n, seq_len))
+    y = rs.randint(0, num_classes, n)
+    pos = rs.randint(0, seq_len - 1, n)
+    for i in range(n):
+        X[i, pos[i]] = 2 * y[i] + 1
+        X[i, pos[i] + 1] = 2 * y[i] + 2
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def text_cnn(seq_len, vocab, embed, filter_sizes, num_filter,
+             num_classes, dropout):
+    data = mx.sym.Variable("data")            # (N, T) token ids
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                           name="embed")      # (N, T, E)
+    x = mx.sym.Reshape(emb, shape=(0, 1, seq_len, embed))
+    pooled = []
+    for fs in filter_sizes:
+        c = mx.sym.Convolution(x, kernel=(fs, embed),
+                               num_filter=num_filter,
+                               name="conv%d" % fs)   # (N, F, T-fs+1, 1)
+        a = mx.sym.Activation(c, act_type="relu")
+        p = mx.sym.Pooling(a, kernel=(seq_len - fs + 1, 1),
+                           pool_type="max")          # (N, F, 1, 1)
+        pooled.append(p)
+    h = mx.sym.Flatten(mx.sym.Concat(*pooled, dim=1))
+    if dropout > 0:
+        h = mx.sym.Dropout(h, p=dropout)
+    fc = mx.sym.FullyConnected(h, num_hidden=num_classes, name="cls")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="CNN text classifier")
+    parser.add_argument("--num-examples", type=int, default=2048)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--seq-len", type=int, default=24)
+    parser.add_argument("--vocab", type=int, default=200)
+    parser.add_argument("--embed", type=int, default=32)
+    parser.add_argument("--num-classes", type=int, default=4)
+    parser.add_argument("--num-filter", type=int, default=32)
+    parser.add_argument("--filter-sizes", type=str, default="2,3,4")
+    parser.add_argument("--dropout", type=float, default=0.25)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    parser.add_argument("--lr", type=float, default=0.005)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(0)
+    X, y = make_data(rs, args.num_examples, args.seq_len, args.vocab,
+                     args.num_classes)
+    Xv, yv = make_data(np.random.RandomState(9), 512, args.seq_len,
+                       args.vocab, args.num_classes)
+    train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size)
+    net = text_cnn(args.seq_len, args.vocab, args.embed,
+                   [int(f) for f in args.filter_sizes.split(",")],
+                   args.num_filter, args.num_classes, args.dropout)
+    mod = mx.Module(net, context=mx.current_context())
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       20))
+    acc = mod.score(val, "acc")[0][1]
+    logging.info("validation accuracy %.3f", acc)
+
+
+if __name__ == "__main__":
+    main()

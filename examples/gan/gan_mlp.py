@@ -1,0 +1,129 @@
+"""MLP GAN on a synthetic 2-D Gaussian mixture (reference example/gan/:
+gan_mnist.py trains G and D as two Modules, wiring the discriminator's
+input gradient back into the generator via ``inputs_need_grad=True`` —
+the same two-module protocol here, at toy scale so it runs anywhere).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def real_batch(rs, n):
+    """8-mode ring mixture in 2-D."""
+    modes = rs.randint(0, 8, n)
+    theta = modes * (2 * np.pi / 8)
+    mu = np.stack([np.cos(theta), np.sin(theta)], -1)
+    return (mu + rs.randn(n, 2) * 0.1).astype(np.float32)
+
+
+def generator_symbol(zdim, hidden):
+    z = mx.sym.Variable("noise")
+    h = mx.sym.Activation(mx.sym.FullyConnected(z, num_hidden=hidden,
+                                                name="g_fc1"),
+                          act_type="relu")
+    h = mx.sym.Activation(mx.sym.FullyConnected(h, num_hidden=hidden,
+                                                name="g_fc2"),
+                          act_type="relu")
+    return mx.sym.FullyConnected(h, num_hidden=2, name="g_out")
+
+
+def discriminator_symbol(hidden):
+    x = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(x, num_hidden=hidden,
+                                                name="d_fc1"),
+                          act_type="relu")
+    h = mx.sym.Activation(mx.sym.FullyConnected(h, num_hidden=hidden,
+                                                name="d_fc2"),
+                          act_type="relu")
+    d = mx.sym.FullyConnected(h, num_hidden=2, name="d_out")
+    return mx.sym.SoftmaxOutput(d, name="dloss")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="toy MLP GAN")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--zdim", type=int, default=8)
+    parser.add_argument("--hidden", type=int, default=64)
+    parser.add_argument("--iters", type=int, default=800)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(0)
+    B = args.batch_size
+
+    gen = mx.Module(generator_symbol(args.zdim, args.hidden),
+                    data_names=("noise",), label_names=(),
+                    context=mx.current_context())
+    gen.bind(data_shapes=[("noise", (B, args.zdim))], label_shapes=None,
+             inputs_need_grad=False)
+    gen.init_params(initializer=mx.initializer.Xavier())
+    gen.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    dis = mx.Module(discriminator_symbol(args.hidden),
+                    data_names=("data",), label_names=("dloss_label",),
+                    context=mx.current_context())
+    # inputs_need_grad: the generator trains on d(input) gradients
+    dis.bind(data_shapes=[("data", (B, 2))],
+             label_shapes=[("dloss_label", (B,))], inputs_need_grad=True)
+    dis.init_params(initializer=mx.initializer.Xavier())
+    dis.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    ones = mx.nd.ones((B,))
+    zeros = mx.nd.zeros((B,))
+    for it in range(args.iters):
+        z = mx.nd.array(rs.randn(B, args.zdim).astype(np.float32))
+        gen.forward(mx.io.DataBatch(data=[z], label=[]), is_train=True)
+        fake = gen.get_outputs()[0]
+        real = mx.nd.array(real_batch(rs, B))
+
+        # -- discriminator step: real->1, fake->0 ----------------------
+        dis.forward(mx.io.DataBatch(data=[real], label=[ones]),
+                    is_train=True)
+        dis.backward()
+        grads_real = [[g.copy() for g in gl] for gl in
+                      dis._exec_group.grad_arrays]
+        dis.forward(mx.io.DataBatch(data=[fake], label=[zeros]),
+                    is_train=True)
+        dis.backward()
+        for gl, rl in zip(dis._exec_group.grad_arrays, grads_real):
+            for g, r in zip(gl, rl):
+                g += r
+        dis.update()
+
+        # -- generator step: make D call fakes real --------------------
+        dis.forward(mx.io.DataBatch(data=[fake], label=[ones]),
+                    is_train=True)
+        dis.backward()
+        dgrad = dis.get_input_grads()[0]
+        gen.backward([dgrad])
+        gen.update()
+
+        if (it + 1) % 100 == 0:
+            p = dis.get_outputs()[0].asnumpy()[:, 1].mean()
+            logging.info("iter %d  D(fake->real prob) %.3f", it + 1, p)
+
+    # report: mean distance of fakes to the nearest mixture mode
+    z = mx.nd.array(rs.randn(512, args.zdim).astype(np.float32))
+    gen.reshape([("noise", (512, args.zdim))])
+    gen.forward(mx.io.DataBatch(data=[z], label=[]), is_train=False)
+    fake = gen.get_outputs()[0].asnumpy()
+    theta = np.arange(8) * (2 * np.pi / 8)
+    modes = np.stack([np.cos(theta), np.sin(theta)], -1)
+    d = np.linalg.norm(fake[:, None, :] - modes[None], axis=-1).min(1)
+    logging.info("mean distance to nearest mode %.3f", d.mean())
+
+
+if __name__ == "__main__":
+    main()

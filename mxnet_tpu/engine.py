@@ -104,6 +104,10 @@ class Engine:
         # Drain host-engine tasks first (they may feed device work).
         if self._host is not None:
             self._host.wait_all()
+            # a drained queue may have recorded a failed checkpoint
+            # write; waitall is the contract point to surface it
+            from . import ndarray as _nd
+            _nd.check_async_write_errors()
         # Drain all outstanding async work on every device.
         for d in jax.devices():
             try:

@@ -304,15 +304,16 @@ def DetColorJitterAug(max_random_hue=0, random_hue_prob=0.0,
                 # reference: hue in degrees over the cv2 0..180 half-circle
                 h = h + np.random.uniform(-hue, hue) / 180.0
             if sat:
-                s = np.clip(s * (1.0 + np.random.uniform(-sat, sat) /
-                                 100.0), 0.0, 1.0)
+                # reference: additive on the 0..255 S channel
+                s = np.clip(s + np.random.uniform(-sat, sat) / 255.0,
+                            0.0, 1.0)
             if illum:
                 l = np.clip(l + np.random.uniform(-illum, illum) / 255.0,
                             0.0, 1.0)
             arr = _hls_to_rgb(h, np.clip(l, 0, 1), np.clip(s, 0, 1))
         if contrast:
-            c = 1.0 + np.random.uniform(-contrast, contrast)
-            arr = (arr - arr.mean()) * c + arr.mean()
+            # reference: pure gain, convertTo(res, -1, 1 + c, 0)
+            arr = arr * (1.0 + np.random.uniform(-contrast, contrast))
         return np.clip(arr * 255.0, 0, 255).astype(np.float32), label
     return aug
 

@@ -37,7 +37,8 @@ struct PooledBuf {
 
   PooledBuf() = default;
   PooledBuf(const char *data, size_t n) {
-    cap = ((n | 1) + 4095) / 4096 * 4096;
+    uint64_t need = n ? n : 1;  // zero-length records still own a block
+    cap = (need + 4095) / 4096 * 4096;
     p = static_cast<char *>(mxt_storage_alloc(cap));
     len = n;
     if (n) std::memcpy(p, data, n);

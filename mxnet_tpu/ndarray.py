@@ -408,9 +408,20 @@ def waitall():
 # pending async writes: canonical path -> host-engine var; readers of a
 # path wait on its var (reference-style dependency tracking — every file
 # is an engine "variable", writes are mutating ops, reads wait on them)
+import threading as _threading
+
 _file_vars = {}
-_file_vars_lock = None
+_file_vars_lock = _threading.Lock()
 _async_write_error = []
+
+
+def check_async_write_errors():
+    """Raise the first recorded async-save failure (called by load,
+    save, and engine.waitall so a failed checkpoint write cannot pass
+    silently)."""
+    if _async_write_error:
+        raise MXNetError("async save failed: %s"
+                         % _async_write_error.pop(0))
 
 
 def _canon_path(path):
@@ -427,11 +438,8 @@ def _async_save(path, write_fn):
     on the IO thread, serialized per destination).  Falls back to a
     synchronous write when the native runtime is unavailable or
     NaiveEngine mode is on."""
-    global _file_vars_lock
     from . import engine as _engine
-    if _async_write_error:
-        raise MXNetError("previous async save failed: %s"
-                         % _async_write_error.pop(0))
+    check_async_write_errors()
     eng = None
     if not _engine.is_naive() and \
             get_env("MXNET_ASYNC_CHECKPOINT") != "0":
@@ -439,10 +447,17 @@ def _async_save(path, write_fn):
     if eng is None:
         write_fn()
         return
-    import threading
-    if _file_vars_lock is None:
-        _file_vars_lock = threading.Lock()
     path = _canon_path(path)
+
+    def task():
+        try:
+            write_fn()
+        except Exception as exc:  # surfaced on the next save/load/waitall
+            _async_write_error.append("%s: %s" % (path, exc))
+
+    # the lock covers lookup, eviction (wait+delete), and push, so a
+    # concurrent reader can never observe a deleted var (readers take the
+    # same lock through their wait — see _wait_pending_write)
     with _file_vars_lock:
         if len(_file_vars) >= _FILE_VARS_CAP:
             # epoch-stamped checkpoints create one var per file; bound the
@@ -454,14 +469,7 @@ def _async_save(path, write_fn):
         var = _file_vars.get(path)
         if var is None:
             var = _file_vars[path] = eng.new_var()
-
-    def task():
-        try:
-            write_fn()
-        except Exception as exc:  # surfaced on the next save/load/waitall
-            _async_write_error.append("%s: %s" % (path, exc))
-
-    eng.push(task, mutable_vars=(var,))
+        eng.push(task, mutable_vars=(var,))
 
 
 def _wait_pending_write(fname):
@@ -470,13 +478,12 @@ def _wait_pending_write(fname):
     from . import engine as _engine
     eng = _engine.get()._host
     if eng is not None:
-        for path in (_canon_path(fname), _canon_path(fname + ".npz")):
-            var = _file_vars.get(path)
-            if var is not None:
-                eng.wait_for_var(var)
-    if _async_write_error:
-        raise MXNetError("async save failed: %s"
-                         % _async_write_error.pop(0))
+        with _file_vars_lock:
+            for path in (_canon_path(fname), _canon_path(fname + ".npz")):
+                var = _file_vars.get(path)
+                if var is not None:
+                    eng.wait_for_var(var)
+    check_async_write_errors()
 
 
 def save(fname, data):

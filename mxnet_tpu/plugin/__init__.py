@@ -1,0 +1,16 @@
+"""Optional bridges to other frameworks (reference ``plugin/``: torch,
+caffe, warpctc, opencv op plugins compiled in via make flags).
+
+Here the available interop target is PyTorch (baked into this image):
+
+* :mod:`mxnet_tpu.plugin.torch_bridge` — ``TorchModule`` wraps any
+  ``torch.nn.Module`` as a symbol/CustomOp (the reference's TorchModuleOp,
+  plugin/torch/torch_module.cc, which embeds lua-torch modules the same
+  way); ``TorchCriterion`` wraps a torch loss.
+
+The caffe plugin's *converter* role (tools/caffe_converter) is filled by
+``tools/torch_converter.py`` — imports pretrained torch models into
+framework checkpoints.  Warp-ctc's role is native: CTCLoss is an in-graph
+op (ops/contrib.py).
+"""
+from . import torch_bridge  # noqa: F401

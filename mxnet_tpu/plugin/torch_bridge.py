@@ -1,0 +1,163 @@
+"""PyTorch op/criterion bridge.
+
+Reference: ``plugin/torch/torch_module.cc`` (TorchModuleOp — run a
+lua-torch ``nn.Module`` as an MXNet operator, parameters owned by MXNet
+and copied in each call) and ``torch_criterion.cc`` (TorchCriterionOp).
+Same shape here with modern PyTorch: the torch module runs on host
+inside a CustomOp; forward/backward go through torch autograd; the
+bridged op composes with native symbols in one graph (the host hop is a
+jax pure-callback boundary, so the XLA program splits around it — use
+for long-tail ops, not hot-path layers).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import operator as op_mod
+
+__all__ = ["TorchModule", "TorchCriterion", "torch_module_symbol"]
+
+
+def _require_torch():
+    try:
+        import torch
+        return torch
+    except ImportError as exc:  # pragma: no cover
+        raise MXNetError("the torch plugin requires pytorch") from exc
+
+
+class _TorchOp(op_mod.CustomOp):
+    """Runs one ``torch.nn.Module``; gradients via torch autograd."""
+
+    def __init__(self, module):
+        torch = _require_torch()
+        self._torch = torch
+        self._m = module
+        self._last = None  # (inputs, output) tensors of the last forward
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        torch = self._torch
+        x = torch.from_numpy(np.array(in_data[0].asnumpy()))
+        if is_train:
+            x.requires_grad_(True)
+            y = self._m(x)
+            self._last = (x, y)
+        else:
+            with torch.no_grad():
+                y = self._m(x)
+        self.assign(out_data[0], req[0], y.detach().numpy())
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        torch = self._torch
+        if self._last is None:
+            raise MXNetError("torch op backward before forward")
+        x, y = self._last
+        for p in self._m.parameters():
+            if p.grad is not None:
+                p.grad = None
+        g = torch.from_numpy(np.array(out_grad[0].asnumpy()))
+        y.backward(g)
+        self.assign(in_grad[0], req[0], x.grad.numpy())
+        # torch-owned parameter grads accumulate on the module itself;
+        # the host optimizer step for them belongs to the caller
+        # (reference TorchModuleOp keeps params on the torch side too)
+
+
+class _TorchOpProp(op_mod.CustomOpProp):
+    def __init__(self, module, out_shape_fn=None):
+        super().__init__(need_top_grad=True)
+        self._module = module
+        self._out_shape_fn = out_shape_fn
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        if self._out_shape_fn is not None:
+            return in_shape, [tuple(self._out_shape_fn(in_shape[0]))], []
+        torch = _require_torch()
+        with torch.no_grad():
+            y = self._module(torch.zeros(*in_shape[0]))
+        return in_shape, [tuple(y.shape)], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _TorchOp(self._module)
+
+
+_REGISTRY = {}
+
+
+def torch_module_symbol(module, data, name="torch", out_shape_fn=None):
+    """Wrap ``module`` (torch.nn.Module) as a Symbol applied to ``data``.
+
+    >>> net = torch_module_symbol(torch.nn.Tanh(), mx.sym.Variable("data"))
+    """
+    from .. import symbol as sym_mod
+    key = "torch_bridge_%d" % id(module)
+    if key not in _REGISTRY:
+        prop = _TorchOpProp(module, out_shape_fn)
+
+        @op_mod.register(key)
+        class _P(op_mod.CustomOpProp):  # noqa: N801
+            def __new__(cls):
+                return prop
+        _REGISTRY[key] = prop
+    return sym_mod.Custom(data=data, op_type=key, name=name)
+
+
+class TorchModule:
+    """Imperative wrapper: NDArray in, NDArray out, ``backward`` returns
+    the input gradient (reference TorchModuleOp verbs)."""
+
+    def __init__(self, module):
+        _require_torch()
+        self._m = module
+        self._op = _TorchOp(module)
+
+    def __call__(self, x, is_train=False):
+        from .. import ndarray as nd
+        out_shape = self._infer(x.shape)
+        out = nd.zeros(out_shape)
+        self._op.forward(is_train, ["write"], [x], [out], [])
+        return out
+
+    def _infer(self, in_shape):
+        torch = _require_torch()
+        with torch.no_grad():
+            return tuple(self._m(torch.zeros(*in_shape)).shape)
+
+    def backward(self, x, out_grad):
+        from .. import ndarray as nd
+        gin = nd.zeros(x.shape)
+        self._op.backward(["write"], [out_grad], [x], [None], [gin], [])
+        return gin
+
+
+class TorchCriterion:
+    """Torch loss as a criterion: ``(pred, label) -> scalar loss`` with
+    ``backward`` producing d(loss)/d(pred) (reference TorchCriterionOp)."""
+
+    def __init__(self, criterion):
+        self._torch = _require_torch()
+        self._c = criterion
+        self._last = None
+
+    def __call__(self, pred, label):
+        torch = self._torch
+        p = torch.from_numpy(np.array(pred.asnumpy())).requires_grad_(True)
+        t = torch.from_numpy(np.array(label.asnumpy()))
+        loss = self._c(p, t)
+        self._last = (p, loss)
+        return float(loss.detach())
+
+    def backward(self):
+        from .. import ndarray as nd
+        if self._last is None:
+            raise MXNetError("criterion backward before forward")
+        p, loss = self._last
+        loss.backward()
+        return nd.array(p.grad.numpy())

@@ -100,3 +100,41 @@ def test_rcnn_end2end():
     rec = re.findall(r"detection recall ([0-9.]+)", out)
     assert rec, out[-800:]
     assert float(rec[-1]) > 0.6, out[-800:]
+
+
+def test_autoencoder():
+    import re
+    p = _run("examples/autoencoder/mnist_sae.py",
+             "--num-examples", "512", "--num-epochs", "8")
+    m = re.findall(r"final reconstruction mse ([0-9.]+)",
+                   p.stderr + p.stdout)
+    assert m and float(m[-1]) < 0.05, (p.stderr + p.stdout)[-500:]
+
+
+def test_cnn_text_classification():
+    import re
+    p = _run("examples/cnn_text_classification/text_cnn.py",
+             "--num-examples", "1024", "--num-epochs", "4")
+    m = re.findall(r"validation accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
+
+
+def test_bi_lstm_sort():
+    import re
+    p = _run("examples/bi-lstm-sort/sort_lstm.py",
+             "--num-examples", "2048", "--num-epochs", "8", timeout=480)
+    m = re.findall(r"final sorted-token accuracy ([0-9.]+)",
+                   p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.7, (p.stderr + p.stdout)[-500:]
+
+
+def test_gan_mlp():
+    """Adversarial dynamics through the two-module inputs_need_grad
+    protocol: fakes move toward the data manifold (a full GAN
+    convergence bar would be flaky; this asserts real progress from the
+    ~1.0 random-init distance)."""
+    import re
+    p = _run("examples/gan/gan_mlp.py", "--iters", "600", timeout=480)
+    out = p.stderr + p.stdout
+    m = re.findall(r"mean distance to nearest mode ([0-9.]+)", out)
+    assert m and float(m[-1]) < 0.9, out[-500:]

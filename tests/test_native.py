@@ -206,6 +206,10 @@ def test_prefetcher_buffers_ride_storage_pool(tmp_path):
     for i in range(64):
         w.write(bytes([i % 251]) * (500 + 37 * i))
     w.close()
+    # empty the free pool so every buffer the stream needs is a fresh
+    # alloc (pool hits keep used+pooled constant and would make the
+    # growth assertion order-dependent)
+    native.lib().mxt_storage_release_all()
     used0, pooled0 = native.storage_stats()
     pf = native.NativePrefetcher(path, capacity=8)
     seen = sum(1 for _ in pf)

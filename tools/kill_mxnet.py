@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training processes (reference
+tools/kill-mxnet.py: ssh's to each host and pkills the training
+program).  Single-host analog: find processes carrying a ``DMLC_ROLE``
+environment (scheduler/server/worker spawned by tools/launch.py) and
+terminate them — escalating to SIGKILL for survivors.
+
+    python tools/kill_mxnet.py [--signal 9] [--dry-run]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def find_ps_processes():
+    """[(pid, role, cmdline)] of live processes with DMLC_ROLE set."""
+    out = []
+    me = os.getpid()
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit() or int(pid_s) == me:
+            continue
+        try:
+            with open("/proc/%s/environ" % pid_s, "rb") as f:
+                env = f.read().split(b"\0")
+            role = None
+            for kv in env:
+                if kv.startswith(b"DMLC_ROLE="):
+                    role = kv.split(b"=", 1)[1].decode()
+                    break
+            if role is None:
+                continue
+            with open("/proc/%s/cmdline" % pid_s, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode().strip()
+            out.append((int(pid_s), role, cmd))
+        except (OSError, PermissionError):
+            continue
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="kill local DMLC_ROLE (PS) processes")
+    parser.add_argument("--signal", type=int, default=signal.SIGTERM)
+    parser.add_argument("--dry-run", action="store_true")
+    parser.add_argument("--grace", type=float, default=3.0,
+                        help="seconds before escalating to SIGKILL")
+    args = parser.parse_args()
+
+    procs = find_ps_processes()
+    if not procs:
+        print("no DMLC_ROLE processes found")
+        return 0
+    for pid, role, cmd in procs:
+        print("%s%d (%s): %s" % ("would kill " if args.dry_run else
+                                 "killing ", pid, role, cmd[:100]))
+        if not args.dry_run:
+            try:
+                os.kill(pid, args.signal)
+            except OSError as exc:
+                print("  failed: %s" % exc)
+    if args.dry_run:
+        return 0
+    time.sleep(args.grace)
+    for pid, role, _ in procs:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            continue  # gone
+        print("escalating SIGKILL to %d (%s)" % (pid, role))
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

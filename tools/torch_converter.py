@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Convert PyTorch models into framework checkpoints.
+
+Role model: ``tools/caffe_converter`` in the reference
+(``convert_symbol.py`` maps a fixed caffe layer vocabulary to symbols,
+``convert_model.py`` maps the weights; Caffe was the era's pretrained-
+model interchange).  Today's interchange living in this image is
+PyTorch, so this converter walks a ``torch.nn`` module graph over the
+analogous layer vocabulary and emits ``prefix-symbol.json`` +
+``prefix-0000.params`` loadable by ``Module.load`` / ``Predictor``.
+
+Supported modules (the caffe_converter vocabulary equivalents):
+``Sequential``, ``Conv2d``, ``BatchNorm2d``, ``Linear``, ``ReLU``,
+``Sigmoid``, ``Tanh``, ``MaxPool2d``, ``AvgPool2d``,
+``AdaptiveAvgPool2d(1)``, ``Flatten``, ``Dropout``, ``Softmax``.
+
+    python tools/torch_converter.py --demo out_prefix   # convert a demo net
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import ndarray as nd  # noqa: E402
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def convert(module, data_shape, prefix=None, epoch=0):
+    """Convert ``module`` (torch.nn) to (symbol, arg_params, aux_params);
+    writes a ``prefix-symbol.json`` + ``prefix-%04d.params`` checkpoint
+    when ``prefix`` is given (reference convert_model.py output layout)."""
+    import torch.nn as tnn
+
+    arg_params = {}
+    aux_params = {}
+    counter = [0]
+
+    def walk(m, x):
+        i = counter[0]
+        counter[0] += 1
+        name = "%s_%d" % (type(m).__name__.lower(), i)
+        if isinstance(m, tnn.Sequential):
+            counter[0] -= 1  # containers don't consume a layer index
+            for child in m:
+                x = walk(child, x)
+            return x
+        if isinstance(m, tnn.Conv2d):
+            if m.groups != 1 or m.dilation != (1, 1):
+                raise ValueError("unsupported Conv2d config in %s" % name)
+            arg_params[name + "_weight"] = nd.array(
+                m.weight.detach().numpy())
+            no_bias = m.bias is None
+            if not no_bias:
+                arg_params[name + "_bias"] = nd.array(
+                    m.bias.detach().numpy())
+            return mx.sym.Convolution(
+                x, kernel=_pair(m.kernel_size), stride=_pair(m.stride),
+                pad=_pair(m.padding), num_filter=m.out_channels,
+                no_bias=no_bias, name=name)
+        if isinstance(m, tnn.BatchNorm2d):
+            arg_params[name + "_gamma"] = nd.array(
+                m.weight.detach().numpy())
+            arg_params[name + "_beta"] = nd.array(m.bias.detach().numpy())
+            aux_params[name + "_moving_mean"] = nd.array(
+                m.running_mean.detach().numpy())
+            aux_params[name + "_moving_var"] = nd.array(
+                m.running_var.detach().numpy())
+            return mx.sym.BatchNorm(x, eps=m.eps, momentum=m.momentum or
+                                    0.9, fix_gamma=False, name=name)
+        if isinstance(m, tnn.Linear):
+            arg_params[name + "_weight"] = nd.array(
+                m.weight.detach().numpy())
+            no_bias = m.bias is None
+            if not no_bias:
+                arg_params[name + "_bias"] = nd.array(
+                    m.bias.detach().numpy())
+            return mx.sym.FullyConnected(x, num_hidden=m.out_features,
+                                         no_bias=no_bias, name=name)
+        if isinstance(m, tnn.ReLU):
+            return mx.sym.Activation(x, act_type="relu", name=name)
+        if isinstance(m, tnn.Sigmoid):
+            return mx.sym.Activation(x, act_type="sigmoid", name=name)
+        if isinstance(m, tnn.Tanh):
+            return mx.sym.Activation(x, act_type="tanh", name=name)
+        if isinstance(m, tnn.MaxPool2d):
+            return mx.sym.Pooling(
+                x, kernel=_pair(m.kernel_size),
+                stride=_pair(m.stride or m.kernel_size),
+                pad=_pair(m.padding), pool_type="max", name=name)
+        if isinstance(m, tnn.AvgPool2d):
+            return mx.sym.Pooling(
+                x, kernel=_pair(m.kernel_size),
+                stride=_pair(m.stride or m.kernel_size),
+                pad=_pair(m.padding), pool_type="avg", name=name)
+        if isinstance(m, tnn.AdaptiveAvgPool2d):
+            if _pair(m.output_size) != (1, 1):
+                raise ValueError("only AdaptiveAvgPool2d(1) supported")
+            return mx.sym.Pooling(x, global_pool=True, kernel=(1, 1),
+                                  pool_type="avg", name=name)
+        if isinstance(m, tnn.Flatten):
+            return mx.sym.Flatten(x, name=name)
+        if isinstance(m, tnn.Dropout):
+            return mx.sym.Dropout(x, p=m.p, name=name)
+        if isinstance(m, tnn.Softmax):
+            return mx.sym.softmax(x, axis=m.dim if m.dim is not None
+                                  else -1, name=name)
+        raise ValueError("unsupported torch module %s (%s)"
+                         % (type(m).__name__, name))
+
+    data = mx.sym.Variable("data")
+    sym = walk(module, data)
+    if prefix is not None:
+        mx.model.save_checkpoint(prefix, epoch, sym, arg_params,
+                                 aux_params)
+    return sym, arg_params, aux_params
+
+
+def demo_net():
+    import torch.nn as tnn
+    return tnn.Sequential(
+        tnn.Conv2d(3, 8, 3, padding=1), tnn.BatchNorm2d(8), tnn.ReLU(),
+        tnn.MaxPool2d(2), tnn.Conv2d(8, 16, 3, padding=1), tnn.ReLU(),
+        tnn.AdaptiveAvgPool2d(1), tnn.Flatten(), tnn.Linear(16, 10))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="convert a torch model to a framework checkpoint")
+    parser.add_argument("prefix", help="output checkpoint prefix")
+    parser.add_argument("--demo", action="store_true",
+                        help="convert a built-in demo convnet")
+    parser.add_argument("--state-dict", type=str,
+                        help="load this state_dict into the demo net "
+                             "before converting")
+    parser.add_argument("--data-shape", type=str, default="1,3,32,32")
+    args = parser.parse_args()
+    import torch
+    net = demo_net()
+    if args.state_dict:
+        net.load_state_dict(torch.load(args.state_dict))
+    net.eval()
+    shape = tuple(int(x) for x in args.data_shape.split(","))
+    sym, _, _ = convert(net, shape, prefix=args.prefix)
+    print("wrote %s-symbol.json / %s-0000.params (outputs: %s)"
+          % (args.prefix, args.prefix, sym.list_outputs()))
+
+
+if __name__ == "__main__":
+    main()
