@@ -337,28 +337,51 @@ def bench_comm(chip):
 
 
 def _init_backend(max_tries=3):
-    """Initialize the JAX backend with retry/backoff (BENCH_r02 rc=1 was a
-    backend-init flake; a retry must not void the round)."""
+    """Initialize the JAX backend with retry/backoff AND a watchdog.
+
+    BENCH_r02 showed two failure modes: a fast 'Unavailable' RuntimeError
+    (retried here) and an indefinite HANG inside backend init when the
+    TPU tunnel is down (the judge's re-run sat >13 minutes).  The probe
+    therefore runs on a daemon thread with a deadline
+    (BENCH_INIT_TIMEOUT seconds, default 300) so a dead tunnel degrades
+    to a structured error artifact instead of a silent wedge."""
     # honor JAX_PLATFORMS before the first backend touch: the axon TPU
     # plugin re-prepends itself to jax_platforms at import, overriding
     # JAX_PLATFORMS=cpu and then hanging CPU-only runs in tunnel init
     # (mxnet_tpu/__init__.py applies the same fix)
+    import threading
+
     import jax
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    deadline = float(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
     last = None
     for attempt in range(max_tries):
-        try:
-            devs = jax.devices()
-            return devs
-        except Exception as e:  # backend init failures are RuntimeErrors
-            last = e
-            if attempt == max_tries - 1:
-                break
-            wait = 20 * (attempt + 1)
-            print("# backend init failed (attempt %d/%d): %s; retry in %ds"
-                  % (attempt + 1, max_tries, e, wait), flush=True)
-            time.sleep(wait)
+        result = {}
+
+        def probe():
+            try:
+                result["devs"] = jax.devices()
+            except Exception as e:  # noqa: BLE001 — reported below
+                result["err"] = e
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(deadline)
+        if "devs" in result:
+            return result["devs"]
+        if t.is_alive():
+            last = RuntimeError(
+                "backend init still hung after %.0fs (TPU tunnel down?)"
+                % deadline)
+        else:
+            last = result.get("err")
+        if attempt == max_tries - 1:
+            break
+        wait = 20 * (attempt + 1)
+        print("# backend init failed (attempt %d/%d): %s; retry in %ds"
+              % (attempt + 1, max_tries, last, wait), flush=True)
+        time.sleep(wait)
     raise last
 
 

@@ -46,6 +46,7 @@ class _TorchOp(op_mod.CustomOp):
         else:
             with torch.no_grad():
                 y = self._m(x)
+            self._last = None  # an eval forward invalidates the stash
         self.assign(out_data[0], req[0], y.detach().numpy())
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
@@ -97,7 +98,11 @@ def torch_module_symbol(module, data, name="torch", out_shape_fn=None):
     >>> net = torch_module_symbol(torch.nn.Tanh(), mx.sym.Variable("data"))
     """
     from .. import symbol as sym_mod
-    key = "torch_bridge_%d" % id(module)
+    # key includes the shape fn: re-wrapping the same module with a
+    # different out_shape_fn must not reuse the old prop.  Entries pin
+    # the module for process lifetime — same as the operator registry
+    # that would hold the prop class anyway.
+    key = "torch_bridge_%d_%d" % (id(module), id(out_shape_fn))
     if key not in _REGISTRY:
         prop = _TorchOpProp(module, out_shape_fn)
 
@@ -110,31 +115,30 @@ def torch_module_symbol(module, data, name="torch", out_shape_fn=None):
 
 
 class TorchModule:
-    """Imperative wrapper: NDArray in, NDArray out, ``backward`` returns
-    the input gradient (reference TorchModuleOp verbs)."""
+    """Imperative wrapper: NDArray in, NDArray out, ``backward`` computes
+    the input gradient for the GIVEN input (reference TorchModuleOp
+    verbs; stateless, so interleaved train/eval calls cannot cross
+    wires)."""
 
     def __init__(self, module):
-        _require_torch()
+        self._torch = _require_torch()
         self._m = module
-        self._op = _TorchOp(module)
 
     def __call__(self, x, is_train=False):
         from .. import ndarray as nd
-        out_shape = self._infer(x.shape)
-        out = nd.zeros(out_shape)
-        self._op.forward(is_train, ["write"], [x], [out], [])
-        return out
-
-    def _infer(self, in_shape):
-        torch = _require_torch()
+        torch = self._torch
+        t = torch.from_numpy(np.array(x.asnumpy()))
         with torch.no_grad():
-            return tuple(self._m(torch.zeros(*in_shape)).shape)
+            y = self._m(t)
+        return nd.array(y.detach().numpy())
 
     def backward(self, x, out_grad):
         from .. import ndarray as nd
-        gin = nd.zeros(x.shape)
-        self._op.backward(["write"], [out_grad], [x], [None], [gin], [])
-        return gin
+        torch = self._torch
+        t = torch.from_numpy(np.array(x.asnumpy())).requires_grad_(True)
+        y = self._m(t)
+        y.backward(torch.from_numpy(np.array(out_grad.asnumpy())))
+        return nd.array(t.grad.numpy())
 
 
 class TorchCriterion:

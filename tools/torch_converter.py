@@ -55,8 +55,12 @@ def convert(module, data_shape, prefix=None, epoch=0):
                 x = walk(child, x)
             return x
         if isinstance(m, tnn.Conv2d):
-            if m.groups != 1 or m.dilation != (1, 1):
-                raise ValueError("unsupported Conv2d config in %s" % name)
+            if (m.groups != 1 or m.dilation != (1, 1) or
+                    not isinstance(m.padding, (tuple, list, int)) or
+                    m.padding_mode != "zeros"):
+                raise ValueError("unsupported Conv2d config in %s "
+                                 "(groups/dilation/padding='same'/"
+                                 "padding_mode)" % name)
             arg_params[name + "_weight"] = nd.array(
                 m.weight.detach().numpy())
             no_bias = m.bias is None
@@ -68,6 +72,9 @@ def convert(module, data_shape, prefix=None, epoch=0):
                 pad=_pair(m.padding), num_filter=m.out_channels,
                 no_bias=no_bias, name=name)
         if isinstance(m, tnn.BatchNorm2d):
+            if m.momentum is None:
+                raise ValueError("BatchNorm2d(momentum=None) (cumulative "
+                                 "averaging) unsupported in %s" % name)
             arg_params[name + "_gamma"] = nd.array(
                 m.weight.detach().numpy())
             arg_params[name + "_beta"] = nd.array(m.bias.detach().numpy())
@@ -75,8 +82,12 @@ def convert(module, data_shape, prefix=None, epoch=0):
                 m.running_mean.detach().numpy())
             aux_params[name + "_moving_var"] = nd.array(
                 m.running_var.detach().numpy())
-            return mx.sym.BatchNorm(x, eps=m.eps, momentum=m.momentum or
-                                    0.9, fix_gamma=False, name=name)
+            # convention flip: torch updates running stats with weight
+            # `momentum` on the BATCH; this framework (like the reference)
+            # keeps weight `momentum` on the MOVING stats
+            return mx.sym.BatchNorm(x, eps=m.eps,
+                                    momentum=1.0 - m.momentum,
+                                    fix_gamma=False, name=name)
         if isinstance(m, tnn.Linear):
             arg_params[name + "_weight"] = nd.array(
                 m.weight.detach().numpy())
@@ -93,11 +104,17 @@ def convert(module, data_shape, prefix=None, epoch=0):
         if isinstance(m, tnn.Tanh):
             return mx.sym.Activation(x, act_type="tanh", name=name)
         if isinstance(m, tnn.MaxPool2d):
+            if m.ceil_mode or m.dilation not in (1, (1, 1)):
+                raise ValueError("unsupported MaxPool2d config in %s "
+                                 "(ceil_mode/dilation)" % name)
             return mx.sym.Pooling(
                 x, kernel=_pair(m.kernel_size),
                 stride=_pair(m.stride or m.kernel_size),
                 pad=_pair(m.padding), pool_type="max", name=name)
         if isinstance(m, tnn.AvgPool2d):
+            if m.ceil_mode:
+                raise ValueError("unsupported AvgPool2d ceil_mode in %s"
+                                 % name)
             return mx.sym.Pooling(
                 x, kernel=_pair(m.kernel_size),
                 stride=_pair(m.stride or m.kernel_size),
@@ -119,6 +136,9 @@ def convert(module, data_shape, prefix=None, epoch=0):
 
     data = mx.sym.Variable("data")
     sym = walk(module, data)
+    # shape-check the converted graph against the declared input now so
+    # unsupported configs fail at convert time, not first use
+    sym.infer_shape(data=tuple(data_shape))
     if prefix is not None:
         mx.model.save_checkpoint(prefix, epoch, sym, arg_params,
                                  aux_params)
@@ -145,6 +165,10 @@ def main():
     parser.add_argument("--data-shape", type=str, default="1,3,32,32")
     args = parser.parse_args()
     import torch
+    if not args.demo and not args.state_dict:
+        parser.error("specify --demo (built-in net, optionally with "
+                     "--state-dict weights); arbitrary models convert "
+                     "through the library API torch_converter.convert()")
     net = demo_net()
     if args.state_dict:
         net.load_state_dict(torch.load(args.state_dict))
