@@ -1,0 +1,85 @@
+"""Fine-tune a pretrained checkpoint on a new task (reference
+example/image-classification/fine-tune.py): cut the network at the layer
+before the old classifier via ``get_internals``, attach a fresh FC for
+the new class count, seed every surviving weight from the checkpoint,
+and train with a small learning rate.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, CURR)
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from common import data as common_data  # noqa: E402
+from common import fit as common_fit  # noqa: E402
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten0"):
+    """(new_net, surviving_args): graph cut + fresh classifier
+    (reference fine-tune.py get_fine_tune_model)."""
+    all_layers = symbol.get_internals()
+    net = all_layers[layer_name + "_output"]
+    net = mx.sym.FullyConnected(data=net, num_hidden=num_classes,
+                                name="fc_finetune")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if not k.startswith("fc")}
+    return net, new_args
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="fine-tune from a checkpoint",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    common_fit.add_fit_args(parser)
+    common_data.add_data_args(parser)
+    common_data.add_data_aug_args(parser)
+    parser.add_argument("--pretrained-model", type=str, required=True,
+                        help="checkpoint prefix to start from")
+    parser.add_argument("--pretrained-epoch", type=int, default=0)
+    parser.add_argument("--layer-before-fullc", type=str,
+                        default="flatten0")
+    # small lr, light regularization (reference defaults)
+    parser.set_defaults(num_epochs=4, lr=0.01, lr_step_epochs="2",
+                        wd=0.0, mom=0.0, batch_size=32,
+                        image_shape="3,28,28", num_classes=10,
+                        num_examples=2048, kv_store="local")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.pretrained_epoch)
+    net, new_args = get_fine_tune_model(sym, arg_params,
+                                        args.num_classes,
+                                        args.layer_before_fullc)
+
+    kv = mx.create_kvstore(args.kv_store)
+    train, val = common_data.get_rec_iter(args, kv)
+    devs = mx.cpu() if args.gpus is None or args.gpus == "" else [
+        mx.tpu(int(i)) for i in args.gpus.split(",")]
+    model = mx.Module(context=devs, symbol=net)
+    model.fit(train,
+              eval_data=val,
+              num_epoch=args.num_epochs,
+              eval_metric="accuracy",
+              kvstore=kv,
+              optimizer=args.optimizer,
+              optimizer_params={"learning_rate": args.lr,
+                                "momentum": args.mom, "wd": args.wd},
+              initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                                factor_type="in",
+                                                magnitude=2),
+              arg_params=new_args,
+              aux_params=aux_params,
+              allow_missing=True,
+              batch_end_callback=mx.callback.Speedometer(
+                  args.batch_size, args.disp_batches))
+    score = model.score(train, "acc")
+    logging.info("finetuned train accuracy %.4f", score[0][1])

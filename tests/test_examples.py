@@ -138,3 +138,22 @@ def test_gan_mlp():
     out = p.stderr + p.stdout
     m = re.findall(r"mean distance to nearest mode ([0-9.]+)", out)
     assert m and float(m[-1]) < 0.9, out[-500:]
+
+
+def test_fine_tune_transfers_backbone(tmp_path):
+    """fine-tune.py cuts at the named layer, transfers backbone weights
+    from the checkpoint, and trains a new head (reference
+    example/image-classification/fine-tune.py)."""
+    prefix = str(tmp_path / "base")
+    _run("examples/image-classification/train_mnist.py",
+         "--network", "lenet", "--num-examples", "256",
+         "--num-epochs", "1", "--batch-size", "32",
+         "--data-dir", "/nonexistent", "--model-prefix", prefix)
+    p = _run("examples/image-classification/fine-tune.py",
+             "--pretrained-model", prefix, "--pretrained-epoch", "1",
+             "--layer-before-fullc", "flatten0",
+             "--num-classes", "5", "--num-examples", "256",
+             "--num-epochs", "1", "--image-shape", "1,28,28",
+             "--benchmark", "1", timeout=300)
+    out = p.stderr + p.stdout
+    assert "finetuned train accuracy" in out
