@@ -89,8 +89,13 @@ def add_fit_args(parser):
     return train
 
 
-def fit(args, network, data_loader, **kwargs):
-    """Train `network` on the loader (reference fit.py fit())."""
+def fit(args, network, data_loader, arg_params=None, aux_params=None,
+        **kwargs):
+    """Train `network` on the loader (reference fit.py fit()).
+
+    ``arg_params``/``aux_params`` seed the parameters when no
+    ``--load-epoch`` checkpoint overrides them (the fine-tune entry
+    point passes the surgically transferred backbone this way)."""
     kv = mx.create_kvstore(args.kv_store)
     head = "%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s"
     logging.basicConfig(level=logging.INFO, format=head)
@@ -109,9 +114,11 @@ def fit(args, network, data_loader, **kwargs):
                 tic = time.time()
         return
 
-    sym, arg_params, aux_params = _load_model(args, kv.rank)
+    sym, ck_args, ck_auxs = _load_model(args, kv.rank)
     if sym is not None:
         network = sym
+    if ck_args is not None:
+        arg_params, aux_params = ck_args, ck_auxs
 
     devs = mx.cpu() if args.gpus is None or args.gpus == "" else [
         mx.tpu(int(i)) for i in args.gpus.split(",")]

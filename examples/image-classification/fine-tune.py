@@ -2,12 +2,12 @@
 example/image-classification/fine-tune.py): cut the network at the layer
 before the old classifier via ``get_internals``, attach a fresh FC for
 the new class count, seed every surviving weight from the checkpoint,
-and train with a small learning rate.
+and train through the shared ``common.fit`` driver (so checkpointing,
+lr scheduling, dtype and kvstore flags all apply).
 """
 from __future__ import annotations
 
 import argparse
-import logging
 import os
 import sys
 
@@ -22,15 +22,20 @@ from common import fit as common_fit  # noqa: E402
 
 def get_fine_tune_model(symbol, arg_params, num_classes,
                         layer_name="flatten0"):
-    """(new_net, surviving_args): graph cut + fresh classifier
-    (reference fine-tune.py get_fine_tune_model)."""
+    """(new_net, surviving_args): graph cut + fresh classifier.
+
+    The new head gets a name no checkpoint uses (``fc_finetune``), so the
+    surviving parameter set is exactly the checkpoint params that are
+    still arguments of the cut graph — no name-pattern filtering (the
+    reference's ``'fc' not in k`` heuristic silently drops backbone FC
+    layers on vgg/alexnet-style nets)."""
     all_layers = symbol.get_internals()
     net = all_layers[layer_name + "_output"]
     net = mx.sym.FullyConnected(data=net, num_hidden=num_classes,
                                 name="fc_finetune")
     net = mx.sym.SoftmaxOutput(data=net, name="softmax")
-    new_args = {k: v for k, v in arg_params.items()
-                if not k.startswith("fc")}
+    keep = set(net.list_arguments())
+    new_args = {k: v for k, v in arg_params.items() if k in keep}
     return net, new_args
 
 
@@ -53,33 +58,11 @@ if __name__ == "__main__":
                         num_examples=2048, kv_store="local")
     args = parser.parse_args()
 
-    logging.basicConfig(level=logging.INFO)
     sym, arg_params, aux_params = mx.model.load_checkpoint(
         args.pretrained_model, args.pretrained_epoch)
     net, new_args = get_fine_tune_model(sym, arg_params,
                                         args.num_classes,
                                         args.layer_before_fullc)
 
-    kv = mx.create_kvstore(args.kv_store)
-    train, val = common_data.get_rec_iter(args, kv)
-    devs = mx.cpu() if args.gpus is None or args.gpus == "" else [
-        mx.tpu(int(i)) for i in args.gpus.split(",")]
-    model = mx.Module(context=devs, symbol=net)
-    model.fit(train,
-              eval_data=val,
-              num_epoch=args.num_epochs,
-              eval_metric="accuracy",
-              kvstore=kv,
-              optimizer=args.optimizer,
-              optimizer_params={"learning_rate": args.lr,
-                                "momentum": args.mom, "wd": args.wd},
-              initializer=mx.initializer.Xavier(rnd_type="gaussian",
-                                                factor_type="in",
-                                                magnitude=2),
-              arg_params=new_args,
-              aux_params=aux_params,
-              allow_missing=True,
-              batch_end_callback=mx.callback.Speedometer(
-                  args.batch_size, args.disp_batches))
-    score = model.score(train, "acc")
-    logging.info("finetuned train accuracy %.4f", score[0][1])
+    common_fit.fit(args, net, common_data.get_rec_iter,
+                   arg_params=new_args, aux_params=aux_params)

@@ -121,10 +121,21 @@ class Accuracy(EvalMetric):
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
-            pred_label = _to_np(pred_label)
             label = _to_np(label)
-            if pred_label.shape != label.shape:
-                pred_label = _np.argmax(pred_label, axis=self.axis)
+            if hasattr(pred_label, "_data") and \
+                    tuple(pred_label.shape) != tuple(label.shape):
+                # reduce on DEVICE before the host sync: transferring the
+                # (batch,) argmax instead of (batch, num_classes) logits
+                # keeps the per-batch metric sync off the TPU PCIe/tunnel
+                # hot path (the reference's update_metric pays a full
+                # output copy; we don't have to)
+                import jax.numpy as jnp
+                pred_label = _np.asarray(
+                    jnp.argmax(pred_label._data, axis=self.axis))
+            else:
+                pred_label = _to_np(pred_label)
+                if pred_label.shape != label.shape:
+                    pred_label = _np.argmax(pred_label, axis=self.axis)
             pred_label = pred_label.astype("int32").flatten()
             label = label.astype("int32").flatten()
             check_label_shapes(label, pred_label)

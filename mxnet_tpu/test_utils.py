@@ -290,7 +290,9 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
                 arr[:] = aux_params[name]
         exe.forward(is_train=(grad_req != "null"))
         if grad_req != "null":
-            exe.backward([nd.ones(o.shape, ctx=ctx)
+            # head grads must match the executor's output dtype (a bf16
+            # run needs bf16 cotangents)
+            exe.backward([nd.ones(o.shape, ctx=ctx, dtype=str(o.dtype))
                           for o in exe.outputs])
         output_points.append(exe)
 

@@ -1,0 +1,69 @@
+"""Cross-context / cross-dtype consistency runs (reference
+tests/python/gpu/test_operator_gpu.py: the whole CPU suite re-runs on the
+accelerator plus ``check_consistency`` cpu-vs-gpu pairs — here the pairs
+are virtual devices of the 8-CPU mesh and fp32-vs-bf16 type_dicts, the
+same harness the TPU run uses for chip-vs-host checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _conv_bn_net():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="c1")
+    b = mx.sym.BatchNorm(c, name="b1")
+    a = mx.sym.Activation(b, act_type="relu")
+    p = mx.sym.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = mx.sym.FullyConnected(mx.sym.Flatten(p), num_hidden=4, name="f1")
+    return mx.sym.SoftmaxOutput(f, name="softmax")
+
+
+def test_conv_net_consistent_across_devices():
+    """Same symbol, same inputs, two device contexts — identical numbers
+    (the reference's cpu-vs-gpu pairing on fake device ids)."""
+    sym = _conv_bn_net()
+    shapes = {"data": (4, 3, 8, 8), "softmax_label": (4,)}
+    check_consistency(sym, [dict(ctx=mx.cpu(0), **shapes),
+                            dict(ctx=mx.cpu(1), **shapes)])
+
+
+@pytest.mark.parametrize("op_builder", [
+    lambda d: mx.sym.sum(mx.sym.dot(d, mx.sym.transpose(d))),
+    lambda d: mx.sym.sum(mx.sym.Activation(d, act_type="tanh")),
+    lambda d: mx.sym.sum(mx.sym.softmax(d, axis=-1)),
+    lambda d: mx.sym.sum(mx.sym.BatchNorm(
+        mx.sym.Reshape(d, shape=(2, 2, 2, 2)), name="bn")),
+], ids=["dot", "tanh", "softmax", "batchnorm"])
+def test_ops_consistent_fp32_vs_bf16(op_builder):
+    """fp32 vs bf16 type_dict within bf16-scaled tolerance — what the
+    compute_dtype='bfloat16' fast path relies on."""
+    data = mx.sym.Variable("data")
+    sym = op_builder(data)
+    shapes = {"data": (4, 4)}
+    tol = {np.dtype(np.float32): 1e-3}
+    try:
+        import jax.numpy as jnp
+        tol[np.dtype(jnp.bfloat16)] = 6e-2
+    except TypeError:
+        pass
+    check_consistency(
+        sym,
+        [dict(ctx=mx.cpu(0), type_dict={"data": "float32"}, **shapes),
+         dict(ctx=mx.cpu(0), type_dict={"data": "bfloat16"}, **shapes)],
+        tol=tol)
+
+
+def test_consistency_catches_divergence():
+    """The harness itself must fail when runs genuinely differ."""
+    data = mx.sym.Variable("data")
+    sym = mx.sym.sum(mx.sym.Dropout(data, p=0.5))  # rng-dependent träin
+    with pytest.raises(AssertionError):
+        # dropout in train mode draws different masks per executor; the
+        # harness must flag the mismatch rather than average it away
+        import mxnet_tpu.random as rnd
+        rnd.seed(0)
+        check_consistency(sym, [dict(ctx=mx.cpu(0), data=(64, 64)),
+                                dict(ctx=mx.cpu(1), data=(64, 64))])
