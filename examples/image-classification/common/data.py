@@ -61,6 +61,13 @@ def add_data_aug_args(parser):
     aug.add_argument("--random-mirror", type=int, default=1)
     aug.add_argument("--max-random-aspect-ratio", type=float, default=0)
     aug.add_argument("--max-random-rotate-angle", type=int, default=0)
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0)
+    aug.add_argument("--min-random-scale", type=float, default=1.0)
+    aug.add_argument("--max-random-scale", type=float, default=1.0)
+    aug.add_argument("--max-random-h", type=int, default=0)
+    aug.add_argument("--max-random-s", type=int, default=0)
+    aug.add_argument("--max-random-l", type=int, default=0)
+    aug.add_argument("--pad-size", type=int, default=0)
     return aug
 
 
@@ -79,6 +86,13 @@ def get_rec_iter(args, kv=None):
         path_imgrec=args.data_train, data_shape=image_shape,
         batch_size=args.batch_size, shuffle=True,
         rand_crop=args.random_crop, rand_mirror=args.random_mirror,
+        max_rotate_angle=args.max_random_rotate_angle,
+        max_shear_ratio=args.max_random_shear_ratio,
+        max_aspect_ratio=args.max_random_aspect_ratio,
+        min_random_scale=args.min_random_scale,
+        max_random_scale=args.max_random_scale,
+        random_h=args.max_random_h, random_s=args.max_random_s,
+        random_l=args.max_random_l, pad=args.pad_size,
         num_parts=nworker, part_index=rank)
     if not args.data_val:
         return train, None

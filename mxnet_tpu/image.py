@@ -29,7 +29,8 @@ __all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
            "random_size_crop", "ResizeAug", "RandomCropAug",
            "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
            "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
-           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
+           "rgb_to_hls", "hls_to_rgb", "hsl_jitter", "HLSJitterAug"]
 
 
 def _pil_filter(interp):
@@ -241,6 +242,72 @@ def ColorNormalizeAug(mean, std):
     return aug
 
 
+def rgb_to_hls(arr):
+    """Vectorized RGB->HLS on [0,1] float arrays (cv2 BGR2HLS analog;
+    shared by the classification and detection HSL jitters)."""
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = np.max(arr, axis=-1)
+    minc = np.min(arr, axis=-1)
+    l = (maxc + minc) / 2.0
+    delta = maxc - minc
+    s = np.where(delta == 0, 0.0,
+                 np.where(l <= 0.5,
+                          delta / np.maximum(maxc + minc, 1e-12),
+                          delta / np.maximum(2.0 - maxc - minc, 1e-12)))
+    dsafe = np.maximum(delta, 1e-12)
+    rc = (maxc - r) / dsafe
+    gc = (maxc - g) / dsafe
+    bc = (maxc - b) / dsafe
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(delta == 0, 0.0, (h / 6.0) % 1.0)
+    return h, l, s
+
+
+def hls_to_rgb(h, l, s):
+    """Inverse of :func:`rgb_to_hls`."""
+    m2 = np.where(l <= 0.5, l * (1.0 + s), l + s - l * s)
+    m1 = 2.0 * l - m2
+
+    def channel(hue):
+        hue = hue % 1.0
+        return np.where(hue < 1 / 6, m1 + (m2 - m1) * hue * 6.0,
+                        np.where(hue < 0.5, m2,
+                                 np.where(hue < 2 / 3,
+                                          m1 + (m2 - m1) *
+                                          (2 / 3 - hue) * 6.0, m1)))
+    return np.stack([channel(h + 1 / 3), channel(h),
+                     channel(h - 1 / 3)], axis=-1)
+
+
+def hsl_jitter(src, random_h=0, random_s=0, random_l=0):
+    """Random HSL shift on a 0..255 HWC float image (reference
+    image_aug_default.cc random_h/random_s/random_l: additive uniform
+    deltas on the cv2 HLS channels — H in degrees of the 0..180
+    half-circle, S and L on the 0..255 scale)."""
+    if not (random_h or random_s or random_l):
+        return src
+    arr = np.clip(np.asarray(src, np.float32), 0, 255) / 255.0
+    h, l, s = rgb_to_hls(arr)
+    if random_h:
+        h = h + np.random.uniform(-random_h, random_h) / 180.0
+    if random_s:
+        s = np.clip(s + np.random.uniform(-random_s, random_s) / 255.0,
+                    0.0, 1.0)
+    if random_l:
+        l = np.clip(l + np.random.uniform(-random_l, random_l) / 255.0,
+                    0.0, 1.0)
+    out = hls_to_rgb(h, np.clip(l, 0, 1), np.clip(s, 0, 1))
+    return np.clip(out * 255.0, 0, 255).astype(np.float32)
+
+
+def HLSJitterAug(random_h, random_s, random_l):
+    """Augmenter-list wrapper over :func:`hsl_jitter`."""
+    def aug(src):
+        return [hsl_jitter(src, random_h, random_s, random_l)]
+    return aug
+
+
 def HorizontalFlipAug(p):
     def aug(src):
         if random.random() < p:
@@ -257,7 +324,8 @@ def CastAug():
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+                    contrast=0, saturation=0, pca_noise=0, random_h=0,
+                    random_s=0, random_l=0, inter_method=2):
     """Build the standard augmenter list (reference image.py:289)."""
     auglist = []
     if resize > 0:
@@ -280,6 +348,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
 
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if random_h or random_s or random_l:
+        # HLS-space jitter, the record-augmenter's random_h/s/l surface
+        # (image_aug_default.cc) on the python ImageIter path
+        auglist.append(HLSJitterAug(random_h, random_s, random_l))
     if pca_noise > 0:
         eigval = np.array([55.46, 4.794, 1.148])
         eigvec = np.array([[-0.5675, 0.7192, 0.4009],

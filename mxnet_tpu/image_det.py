@@ -243,44 +243,9 @@ def DetColorJitterAug(max_random_hue=0, random_hue_prob=0.0,
     hue/saturation/illumination/contrast: each channel independently
     perturbed with its own probability; hue/saturation work in HLS space
     like the cv2 path, illumination is an additive lightness shift,
-    contrast scales around the mean).  Boxes are untouched."""
-    import colorsys  # noqa: F401  (documentation: HLS convention)
-
-    def _rgb_to_hls(img):
-        # vectorized RGB->HLS on [0,1] floats (cv2.COLOR_BGR2HLS analog)
-        r, g, b = img[..., 0], img[..., 1], img[..., 2]
-        maxc = np.max(img, axis=-1)
-        minc = np.min(img, axis=-1)
-        l = (maxc + minc) / 2.0
-        delta = maxc - minc
-        s = np.where(delta == 0, 0.0,
-                     np.where(l <= 0.5, delta / np.maximum(maxc + minc,
-                                                           1e-12),
-                              delta / np.maximum(2.0 - maxc - minc,
-                                                 1e-12)))
-        dsafe = np.maximum(delta, 1e-12)
-        rc = (maxc - r) / dsafe
-        gc = (maxc - g) / dsafe
-        bc = (maxc - b) / dsafe
-        h = np.where(maxc == r, bc - gc,
-                     np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
-        h = np.where(delta == 0, 0.0, (h / 6.0) % 1.0)
-        return h, l, s
-
-    def _hls_to_rgb(h, l, s):
-        m2 = np.where(l <= 0.5, l * (1.0 + s), l + s - l * s)
-        m1 = 2.0 * l - m2
-
-        def channel(hue):
-            hue = hue % 1.0
-            out = np.where(hue < 1 / 6, m1 + (m2 - m1) * hue * 6.0,
-                           np.where(hue < 0.5, m2,
-                                    np.where(hue < 2 / 3,
-                                             m1 + (m2 - m1) *
-                                             (2 / 3 - hue) * 6.0, m1)))
-            return out
-        return np.stack([channel(h + 1 / 3), channel(h),
-                         channel(h - 1 / 3)], axis=-1)
+    contrast is a pure gain).  Boxes are untouched."""
+    from .image import hls_to_rgb as _hls_to_rgb
+    from .image import rgb_to_hls as _rgb_to_hls
 
     def aug(img, label):
         hue = max_random_hue if (max_random_hue > 0 and

@@ -44,26 +44,69 @@ def encode_image(arr, quality=95, fmt=".jpg"):
 
 
 def decode_record_image(img_bytes, data_shape, rand_crop=False,
-                        rand_mirror=False):
-    """Decode + resize/crop to CHW float32 (subset of the reference's
-    default augmenter: resize-shortest, center/random crop, mirror)."""
+                        rand_mirror=False, max_rotate_angle=0,
+                        max_shear_ratio=0.0, min_random_scale=1.0,
+                        max_random_scale=1.0, max_aspect_ratio=0.0,
+                        random_h=0, random_s=0, random_l=0, pad=0,
+                        fill_value=255):
+    """Decode + augment to CHW float32 — the reference record-iterator
+    training augmenter surface (``src/io/image_aug_default.cc``):
+    rotation (``max_rotate_angle``), shear (``max_shear_ratio``), random
+    scale/aspect applied to the crop window, center/random crop, mirror,
+    HSL jitter (``random_h/s/l``), and border ``pad`` with
+    ``fill_value``."""
     _require_pil()
     c, h, w = data_shape
     img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
+
+    if pad > 0:
+        # border padding happens on the SOURCE image (reference pad
+        # param), before geometry; output stays data_shape
+        from PIL import ImageOps
+        img = ImageOps.expand(img, border=pad, fill=(fill_value,) * 3)
+
+    if max_rotate_angle > 0 or max_shear_ratio > 0:
+        angle = np.random.uniform(-max_rotate_angle, max_rotate_angle)
+        shear = np.random.uniform(-max_shear_ratio, max_shear_ratio)
+        fv = (fill_value,) * 3
+        if angle:
+            img = img.rotate(angle, resample=Image.BILINEAR,
+                             fillcolor=fv)
+        if shear:
+            # x' = x + shear*y affine (reference shear matrix)
+            img = img.transform(img.size, Image.AFFINE,
+                                (1.0, shear, 0.0, 0.0, 1.0, 0.0),
+                                resample=Image.BILINEAR, fillcolor=fv)
+
+    # crop-window size: target scaled by random scale and aspect jitter
+    scale_jitter = np.random.uniform(min_random_scale, max_random_scale)
+    ar = 1.0 + (np.random.uniform(-max_aspect_ratio, max_aspect_ratio)
+                if max_aspect_ratio > 0 else 0.0)
+    ch_, cw_ = h / scale_jitter, (w / scale_jitter) * ar
+
     iw, ih = img.size
-    # resize shortest side to target then crop
-    scale = max(h / ih, w / iw)
-    if scale != 1.0:
-        img = img.resize((max(int(iw * scale + 0.5), w),
-                          max(int(ih * scale + 0.5), h)))
+    scale = max(ch_ / ih, cw_ / iw)
+    if scale > 1.0:
+        # upscale only when the source is smaller than the crop window;
+        # larger sources are cropped at original scale (the reference
+        # crops data_shape directly — downscaling here would nullify
+        # `pad` translation jitter, e.g. the CIFAR pad-4 recipe)
+        img = img.resize((max(int(iw * scale + 0.5), int(cw_)),
+                          max(int(ih * scale + 0.5), int(ch_))))
     iw, ih = img.size
+    cw_i, ch_i = min(int(cw_), iw), min(int(ch_), ih)
     if rand_crop:
-        x0 = np.random.randint(0, iw - w + 1)
-        y0 = np.random.randint(0, ih - h + 1)
+        x0 = np.random.randint(0, iw - cw_i + 1)
+        y0 = np.random.randint(0, ih - ch_i + 1)
     else:
-        x0, y0 = (iw - w) // 2, (ih - h) // 2
-    img = img.crop((x0, y0, x0 + w, y0 + h))
+        x0, y0 = (iw - cw_i) // 2, (ih - ch_i) // 2
+    img = img.crop((x0, y0, x0 + cw_i, y0 + ch_i))
+    if img.size != (w, h):
+        img = img.resize((w, h), Image.BILINEAR)
     arr = np.asarray(img, dtype=np.float32)
     if rand_mirror and np.random.rand() < 0.5:
         arr = arr[:, ::-1]
+    if random_h or random_s or random_l:
+        from ..image import hsl_jitter
+        arr = hsl_jitter(arr, random_h, random_s, random_l)
     return arr.transpose(2, 0, 1)  # HWC -> CHW

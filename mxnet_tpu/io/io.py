@@ -423,7 +423,10 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
                  rand_crop=False, rand_mirror=False, prefetch_buffer=4,
-                 preprocess_threads=4, **kwargs):
+                 preprocess_threads=4, max_rotate_angle=0,
+                 max_shear_ratio=0.0, min_random_scale=1.0,
+                 max_random_scale=1.0, max_aspect_ratio=0.0, random_h=0,
+                 random_s=0, random_l=0, pad=0, fill_value=255, **kwargs):
         super().__init__(batch_size)
         from . import recordio
         from .image_util import decode_record_image
@@ -441,6 +444,15 @@ class ImageRecordIter(DataIter):
         self.scale = scale
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
+        # reference image_aug_default.cc training-augmenter surface
+        self._aug_kwargs = dict(
+            max_rotate_angle=max_rotate_angle,
+            max_shear_ratio=max_shear_ratio,
+            min_random_scale=min_random_scale,
+            max_random_scale=max_random_scale,
+            max_aspect_ratio=max_aspect_ratio, random_h=random_h,
+            random_s=random_s, random_l=random_l, pad=pad,
+            fill_value=fill_value)
         self._batch = None
         self._pipeline = ThreadedBatchPipeline(
             self.record.read, self._decode_one, self._assemble,
@@ -452,7 +464,8 @@ class ImageRecordIter(DataIter):
         header, img_bytes = self._recordio.unpack(s)
         img = self._decode(img_bytes, self.data_shape,
                            rand_crop=self.rand_crop,
-                           rand_mirror=self.rand_mirror)
+                           rand_mirror=self.rand_mirror,
+                           **self._aug_kwargs)
         img = (img - self.mean) * self.scale
         lbl = header.label
         if self.label_width == 1:

@@ -139,3 +139,49 @@ def test_prefetching_iter():
     pit = mx.io.PrefetchingIter(it)
     labs = np.concatenate([b.label[0].asnumpy() for b in pit])
     assert sorted(labs.astype(int).tolist()) == list(range(16))
+
+
+def test_image_record_iter_training_augs(tmp_path):
+    """The record-iterator training augmenter surface (reference
+    image_aug_default.cc): rotate/shear/scale/HSL/pad run in the decode
+    pool and keep the declared data_shape."""
+    from mxnet_tpu.io import recordio
+    from mxnet_tpu.io.image_util import encode_image
+    rec_path = str(tmp_path / "aug.rec")
+    w = recordio.MXRecordIO(rec_path, "w")
+    rs = np.random.RandomState(0)
+    for i in range(16):
+        img = rs.randint(0, 255, (40, 48, 3)).astype(np.uint8)
+        head = recordio.IRHeader(0, float(i % 4), i, 0)
+        w.write(recordio.pack(head, encode_image(img)))
+    w.close()
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, data_shape=(3, 32, 32), batch_size=8,
+        rand_crop=True, rand_mirror=True, max_rotate_angle=15,
+        max_shear_ratio=0.1, min_random_scale=0.8, max_random_scale=1.0,
+        max_aspect_ratio=0.15, random_h=18, random_s=24, random_l=24,
+        pad=4, fill_value=127, preprocess_threads=2)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (8, 3, 32, 32)
+        arr = batch.data[0].asnumpy()
+        assert np.isfinite(arr).all() and arr.max() <= 255.0
+        n += batch.data[0].shape[0] - (batch.pad or 0)
+    assert n == 16
+
+
+def test_hsl_jitter_identity_and_range():
+    from mxnet_tpu.image import hsl_jitter, rgb_to_hls, hls_to_rgb
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 255, (8, 8, 3)).astype(np.float32)
+    # zero jitter is the identity
+    np.testing.assert_array_equal(hsl_jitter(img), img)
+    # HLS roundtrip is faithful
+    h, l, s = rgb_to_hls(img / 255.0)
+    back = hls_to_rgb(h, l, s) * 255.0
+    np.testing.assert_allclose(back, img, atol=0.6)
+    # jitter stays in range and changes pixels
+    np.random.seed(1)
+    out = hsl_jitter(img, random_h=30, random_s=40, random_l=40)
+    assert out.min() >= 0 and out.max() <= 255
+    assert not np.allclose(out, img)
