@@ -1,0 +1,95 @@
+"""Train with a numpy-implemented operator (reference example/numpy-ops/
+custom_softmax.py: a CustomOp softmax loss written in numpy drives a real
+training loop — the escape hatch for host-side math inside a graph).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    """Softmax + cross-entropy gradient, entirely in numpy (reference
+    custom_softmax.py forward/backward)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        lbl = in_data[1].asnumpy().astype(np.int32)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(lbl.shape[0]), lbl] -= 1.0
+        self.assign(in_grad[0], req[0], y)  # Module rescale_grad handles 1/batch
+        self.assign(in_grad[1], req[1],
+                    np.zeros(in_grad[1].shape, np.float32))
+
+
+@mx.operator.register("numpy_softmax_example")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return NumpySoftmax()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="numpy CustomOp training")
+    parser.add_argument("--num-examples", type=int, default=2048)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(args.num_examples, 16).astype(np.float32)
+    y = (X[:, :8].sum(1) > X[:, 8:].sum(1)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size, shuffle=True,
+                           label_name="label")
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    fc = mx.sym.FullyConnected(
+        mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=32,
+                                                name="fc1"),
+                          act_type="relu"),
+        num_hidden=2, name="fc2")
+    net = mx.sym.Custom(data=fc, label=label,
+                        op_type="numpy_softmax_example", name="softmax")
+
+    mod = mx.Module(net, data_names=("data",), label_names=("label",),
+                    context=mx.current_context())
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="accuracy",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       20))
+    acc = mod.score(it, "acc")[0][1]
+    logging.info("numpy-op training accuracy %.3f", acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -185,3 +185,19 @@ def test_fine_tune_transfers_backbone(tmp_path):
     # old classifier weights are NOT carried into the new graph
     assert "fullyconnected1_weight" not in new_args
     assert "fc_finetune_weight" in net.list_arguments()
+
+
+def test_multi_task():
+    import re
+    p = _run("examples/multi-task/multitask_mlp.py",
+             "--num-examples", "1024", "--num-epochs", "5")
+    m = re.findall(r"mean task accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.85, (p.stderr + p.stdout)[-500:]
+
+
+def test_numpy_ops_custom_softmax():
+    import re
+    p = _run("examples/numpy-ops/custom_softmax.py", "--num-epochs", "6")
+    m = re.findall(r"numpy-op training accuracy ([0-9.]+)",
+                   p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
