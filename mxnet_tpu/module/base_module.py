@@ -161,6 +161,7 @@ class BaseModule:
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
+                self.prepare(data_batch)
                 self.forward_backward(data_batch)
                 self.update()
                 self.update_metric(eval_metric, data_batch.label)
@@ -240,6 +241,23 @@ class BaseModule:
 
     def install_monitor(self, mon):
         raise NotImplementedError()
+
+    def get_states(self, merge_multi_context=True):
+        """Values of the module's state arrays (reference
+        base_module.py:674); modules without states return []."""
+        assert self.binded and self.params_initialized
+        return []
+
+    def set_states(self, states=None, value=None):
+        """Set state arrays (reference base_module.py:698)."""
+        assert self.binded and self.params_initialized
+        assert states is None and value is None, \
+            "this module has no states"
+
+    def prepare(self, data_batch):
+        """Per-batch preparation hook, called by the fit loop before
+        ``forward_backward`` (reference base_module.py:719; a no-op for
+        dense modules — BucketingModule binds the batch's bucket here)."""
 
 
 class _BatchEndParam:

@@ -257,6 +257,20 @@ class BucketingModule(BaseModule):
             self.inputs_need_grad
         return self._curr_module.get_input_grads(merge_multi_context)
 
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_states(merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        self._curr_module.set_states(states, value)
+
+    def prepare(self, data_batch):
+        """Bind the batch's bucket before forward (reference
+        bucketing_module.py:361)."""
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
         self._curr_module.update_metric(eval_metric, labels)

@@ -75,6 +75,7 @@ class DataParallelExecutorGroup:
         self.logger = logger
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
+        self.state_names = list(state_names or [])
         self.execs = []
         self.data_shapes = None
         self.label_shapes = None
@@ -226,12 +227,18 @@ class DataParallelExecutorGroup:
             ex.forward_backward()
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _merge_multi_context(groups):
+        """Per-name lists of per-executor arrays -> batch-concatenated
+        arrays (the kvstore-free merge every getter shares)."""
+        return [nd.concatenate(parts, axis=0) if len(parts) > 1
+                else parts[0] for parts in groups]
+
     def get_outputs(self, merge_multi_context=True):
         outputs = [[ex.outputs[i] for ex in self.execs]
                    for i in range(len(self.execs[0].outputs))]
         if merge_multi_context:
-            return [nd.concatenate(parts, axis=0) if len(parts) > 1
-                    else parts[0] for parts in outputs]
+            return self._merge_multi_context(outputs)
         return outputs
 
     def get_input_grads(self, merge_multi_context=True):
@@ -239,9 +246,35 @@ class DataParallelExecutorGroup:
         grads = [[e.grad_dict[name] for e in self.execs]
                  for name in self.data_names]
         if merge_multi_context:
-            return [nd.concatenate(parts, axis=0) if len(parts) > 1
-                    else parts[0] for parts in grads]
+            return self._merge_multi_context(grads)
         return grads
+
+    def get_states(self, merge_multi_context=True):
+        """Current values of the state arrays (reference
+        executor_group.py:417 — states are batch-sliced inputs the caller
+        carries across batches, e.g. stateful-RNN hidden state)."""
+        states = [[e.arg_dict[name] for e in self.execs]
+                  for name in self.state_names]
+        if merge_multi_context:
+            return self._merge_multi_context(states)
+        return states
+
+    def set_states(self, states=None, value=None):
+        """Set state arrays from merged values or a scalar fill
+        (reference executor_group.py:438)."""
+        if states is not None:
+            assert value is None, "only one of states/value"
+            for name, merged in zip(self.state_names, states):
+                for i, ex in enumerate(self.execs):
+                    islice = self.slices[i]
+                    src = merged[i] if isinstance(merged, (list, tuple)) \
+                        else merged.slice(islice.start, islice.stop)
+                    ex.arg_dict[name][:] = src
+        else:
+            assert value is not None, "one of states/value required"
+            for name in self.state_names:
+                for ex in self.execs:
+                    ex.arg_dict[name][:] = value
 
     def update_metric(self, eval_metric, labels):
         for i, ex in enumerate(self.execs):

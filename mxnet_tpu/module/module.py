@@ -724,6 +724,19 @@ class Module(BaseModule):
             self.inputs_need_grad
         return self._exec_group.get_input_grads(merge_multi_context)
 
+    def get_states(self, merge_multi_context=True):
+        """Values of the ``state_names`` arrays (reference
+        module.py:618); stateful setups never take the fused path, so
+        the executor group always holds them."""
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_states(merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        """Set the ``state_names`` arrays from merged values or a scalar
+        (reference module.py:641)."""
+        assert self.binded and self.params_initialized
+        self._exec_group.set_states(states, value)
+
     def update_metric(self, eval_metric, labels):
         if self._fused is not None:
             eval_metric.update(labels, self._fused_get_outputs())

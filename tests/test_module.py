@@ -225,3 +225,33 @@ def test_feedforward_api():
     assert preds.shape == (128, 3)
     acc = (preds.argmax(axis=1) == y).mean()
     assert acc > 0.85, acc
+
+
+def test_module_states_api():
+    """state_names arrays are readable/settable through
+    get_states/set_states (reference module.py:618-662): a stateful
+    accumulator carries its hidden state across batches."""
+    data = mx.sym.Variable("data")
+    state = mx.sym.Variable("state")
+    out = mx.sym.BlockGrad(data * 0.5 + state, name="out")
+    mod = mx.module.Module(out, data_names=("data",), label_names=(),
+                           state_names=("state",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 3))], label_shapes=None,
+             for_training=True)
+    mod.init_params()
+    mod.set_states(value=0.0)
+    x = np.ones((4, 3), dtype=np.float32)
+    from mxnet_tpu.io.io import DataBatch
+    mod.forward(DataBatch(data=[mx.nd.array(x)], label=[]))
+    out0 = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out0, 0.5 * x)
+    # carry the output back in as the next state (stateful-RNN pattern)
+    mod.set_states(states=[mod.get_outputs()[0]])
+    st = mod.get_states()[0].asnumpy()
+    np.testing.assert_allclose(st, 0.5 * x)
+    mod.forward(DataBatch(data=[mx.nd.array(x)], label=[]))
+    out1 = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out1, x)
+    # scalar fill
+    mod.set_states(value=2.0)
+    np.testing.assert_allclose(mod.get_states()[0].asnumpy(), 2.0)
