@@ -610,3 +610,116 @@ def _init_ndarray_module():
     for name in list_ops():
         if not hasattr(mod, name):
             setattr(mod, name, _make_op_func(name))
+
+
+# -- module-level math conveniences (reference ndarray.py add/subtract/
+#    multiply/divide/power/maximum/minimum/equal/... functions with
+#    array-or-scalar dispatch; comparisons return 0/1 float arrays) ---------
+def _as_nd(x):
+    return x if isinstance(x, NDArray) else array(np.asarray(x))
+
+
+def add(lhs, rhs):
+    return _as_nd(lhs) + rhs
+
+
+def subtract(lhs, rhs):
+    return _as_nd(lhs) - rhs
+
+
+def multiply(lhs, rhs):
+    return _as_nd(lhs) * rhs
+
+
+def divide(lhs, rhs):
+    return _as_nd(lhs) / rhs
+
+
+true_divide = divide
+
+
+def power(lhs, rhs):
+    return _as_nd(lhs) ** rhs
+
+
+def _minmax(op, scalar_op, lhs, rhs):
+    # route through imperative_invoke so autograd records the op like
+    # every other math entry point
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return imperative_invoke(op, [lhs, rhs], {})
+    if isinstance(lhs, NDArray):
+        return imperative_invoke(scalar_op, [lhs],
+                                 {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):  # commutative
+        return imperative_invoke(scalar_op, [rhs],
+                                 {"scalar": float(lhs)})
+    raise MXNetError("at least one argument must be an NDArray")
+
+
+def maximum(lhs, rhs):
+    """Elementwise maximum with scalar broadcast (reference
+    ndarray.maximum)."""
+    return _minmax("_maximum", "_maximum_scalar", lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    return _minmax("_minimum", "_minimum_scalar", lhs, rhs)
+
+
+def _compare(fn, lhs, rhs):
+    l = lhs._data if isinstance(lhs, NDArray) else lhs
+    r = rhs._data if isinstance(rhs, NDArray) else rhs
+    return NDArray(fn(l, r).astype(jnp.float32))
+
+
+def equal(lhs, rhs):
+    """1.0 where equal else 0.0 (reference ndarray.equal)."""
+    return _compare(jnp.equal, lhs, rhs)
+
+
+def not_equal(lhs, rhs):
+    return _compare(jnp.not_equal, lhs, rhs)
+
+
+def greater(lhs, rhs):
+    return _compare(jnp.greater, lhs, rhs)
+
+
+def greater_equal(lhs, rhs):
+    return _compare(jnp.greater_equal, lhs, rhs)
+
+
+def lesser(lhs, rhs):
+    return _compare(jnp.less, lhs, rhs)
+
+
+def lesser_equal(lhs, rhs):
+    return _compare(jnp.less_equal, lhs, rhs)
+
+
+def moveaxis(tensor, source, destination):
+    """Move an axis to a new position (reference ndarray.moveaxis)."""
+    return NDArray(jnp.moveaxis(tensor._data, source, destination))
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,
+             channels=3, mean=None):
+    """Decode an image byte string to an NDArray, optionally clipped and
+    mean-subtracted (reference ndarray.imdecode, backed by
+    image_io.cc)."""
+    if index != 0:
+        raise MXNetError("imdecode index != 0 is not supported")
+    from .image import imdecode as _imdecode
+    arr = _imdecode(str_img, flag=1 if channels == 3 else 0)
+    x0, y0, x1, y1 = clip_rect
+    if x1 > 0 and y1 > 0:
+        arr = arr[y0:y1, x0:x1]
+    arr = np.asarray(arr, dtype=np.float32)
+    if mean is not None:
+        arr = arr - (mean.asnumpy() if isinstance(mean, NDArray)
+                     else np.asarray(mean, np.float32))
+    res = array(arr)
+    if out is not None:
+        out[:] = res
+        return out
+    return res

@@ -234,6 +234,7 @@ _scalar("_rpower_scalar", lambda x, s: jnp.power(s, x))
 _scalar("_maximum_scalar", jnp.maximum)
 _scalar("_minimum_scalar", jnp.minimum)
 _scalar("_mod_scalar", jnp.mod)
+_scalar("_hypot_scalar", jnp.hypot)
 _scalar("_rmod_scalar", lambda x, s: jnp.mod(s, x))
 _scalar("_equal_scalar", lambda x, s: jnp.equal(x, s).astype(x.dtype))
 _scalar("_not_equal_scalar", lambda x, s: jnp.not_equal(x, s).astype(x.dtype))

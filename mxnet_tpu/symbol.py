@@ -686,3 +686,52 @@ def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
     return _invoke("_arange", [], {"start": start, "stop": stop,
                                    "step": step, "repeat": repeat,
                                    "dtype": dtype}, name=name)
+
+
+# -- module-level math conveniences (reference symbol.py maximum/minimum/
+#    pow/hypot: symbol-vs-symbol uses the elementwise op, symbol-vs-scalar
+#    the *_scalar variant) ---------------------------------------------------
+def _binary_convenience(op, scalar_op, rscalar_ok, lhs, rhs):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _invoke(op, [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return _invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, Symbol):
+        if not rscalar_ok:
+            raise MXNetError("commutative scalar form only")
+        return _invoke(scalar_op, [rhs], {"scalar": float(lhs)})
+    raise MXNetError("at least one argument must be a Symbol")
+
+
+def maximum(lhs, rhs):
+    """Elementwise maximum (reference symbol.maximum)."""
+    return _binary_convenience("_maximum", "_maximum_scalar", True,
+                               lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    """Elementwise minimum (reference symbol.minimum)."""
+    return _binary_convenience("_minimum", "_minimum_scalar", True,
+                               lhs, rhs)
+
+
+def hypot(lhs, rhs):
+    """sqrt(lhs^2 + rhs^2) (reference symbol.hypot)."""
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _invoke("_hypot", [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return _invoke("_hypot_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, Symbol):
+        return _invoke("_hypot_scalar", [rhs], {"scalar": float(lhs)})
+    raise MXNetError("at least one argument must be a Symbol")
+
+
+def pow(lhs, rhs):  # noqa: A001 — reference API name
+    """Elementwise power (reference symbol.pow)."""
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _invoke("_power", [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return _invoke("_power_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, Symbol):
+        return _invoke("_rpower_scalar", [rhs], {"scalar": float(lhs)})
+    raise MXNetError("at least one argument must be a Symbol")

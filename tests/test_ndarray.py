@@ -139,3 +139,52 @@ def test_sampling_ops():
     np.testing.assert_allclose(u.asnumpy(), u2.asnumpy())
     n = nd.normal(loc=0, scale=1, shape=(500,))
     assert abs(float(n.asnumpy().mean())) < 0.3
+
+
+def test_module_level_math_conveniences():
+    """Reference ndarray.py module functions: add/subtract/multiply/
+    divide/power/negative with scalar dispatch, elementwise
+    maximum/minimum, 0/1-float comparisons, moveaxis."""
+    a = nd.array(np.array([1., 5., 3.], np.float32))
+    b = nd.array(np.array([4., 2., 3.], np.float32))
+    np.testing.assert_allclose(nd.add(a, b).asnumpy(), [5, 7, 6])
+    np.testing.assert_allclose(nd.subtract(a, 1).asnumpy(), [0, 4, 2])
+    np.testing.assert_allclose(nd.multiply(2, a).asnumpy(), [2, 10, 6])
+    np.testing.assert_allclose(nd.divide(a, b).asnumpy(),
+                               [0.25, 2.5, 1.0])
+    np.testing.assert_allclose(nd.true_divide(a, 2).asnumpy(),
+                               [0.5, 2.5, 1.5])
+    np.testing.assert_allclose(nd.negative(a).asnumpy(), [-1, -5, -3])
+    np.testing.assert_allclose(nd.power(a, 2).asnumpy(), [1, 25, 9])
+    np.testing.assert_allclose(nd.maximum(a, b).asnumpy(), [4, 5, 3])
+    np.testing.assert_allclose(nd.minimum(a, 3).asnumpy(), [1, 3, 3])
+    eq = nd.equal(a, b)
+    assert eq.dtype == np.float32
+    np.testing.assert_allclose(eq.asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose(nd.not_equal(a, b).asnumpy(), [1, 1, 0])
+    np.testing.assert_allclose(nd.greater(a, b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose(nd.greater_equal(a, b).asnumpy(),
+                               [0, 1, 1])
+    np.testing.assert_allclose(nd.lesser(a, b).asnumpy(), [1, 0, 0])
+    np.testing.assert_allclose(nd.lesser_equal(a, b).asnumpy(),
+                               [1, 0, 1])
+    assert nd.moveaxis(nd.zeros((2, 3, 4)), 0, 2).shape == (3, 4, 2)
+
+
+def test_symbol_math_conveniences():
+    import mxnet_tpu as mx
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    s = mx.sym.Group([mx.sym.maximum(x, y), mx.sym.minimum(x, 1.0),
+                      mx.sym.pow(2.0, x), mx.sym.hypot(x, 4.0),
+                      mx.sym.maximum(0.5, x)])
+    ex = s.simple_bind(mx.cpu(), x=(3,), y=(3,))
+    ex.arg_dict["x"][:] = [0., 1., 2.]
+    ex.arg_dict["y"][:] = [2., 0., 1.]
+    outs = [o.asnumpy() for o in ex.forward()]
+    xv, yv = np.array([0., 1., 2.]), np.array([2., 0., 1.])
+    np.testing.assert_allclose(outs[0], np.maximum(xv, yv))
+    np.testing.assert_allclose(outs[1], np.minimum(xv, 1.0))
+    np.testing.assert_allclose(outs[2], 2.0 ** xv)
+    np.testing.assert_allclose(outs[3], np.hypot(xv, 4.0))
+    np.testing.assert_allclose(outs[4], np.maximum(xv, 0.5))
