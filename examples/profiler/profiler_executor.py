@@ -1,0 +1,75 @@
+"""Profile a training workload to Chrome trace format (reference
+example/profiler/profiler_executor.py: MXSetProfilerConfig/State around a
+bind+forward/backward loop, then load profile.json in
+chrome://tracing).
+
+The per-op timing seam is the engine dispatch hook (mxnet_tpu/engine.py
+dispatch -> profiler.record, the reference's ExecuteOprBlock recording at
+threaded_engine.h:296-308); ``MXNET_PROFILER_JAX_LOGDIR`` additionally
+captures a full ``jax.profiler`` device trace.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(description="profile a train loop")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--hidden", type=int, default=128)
+    parser.add_argument("--out", type=str, default="profile.json")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data = mx.sym.Variable("data")
+    net = data
+    for i in range(3):
+        net = mx.sym.Activation(
+            mx.sym.FullyConnected(net, num_hidden=args.hidden,
+                                  name="fc%d" % i), act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=10, name="out"),
+        name="softmax")
+
+    ex = net.simple_bind(mx.current_context(),
+                         data=(args.batch_size, 64),
+                         softmax_label=(args.batch_size,))
+    rs = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rs.uniform(-0.1, 0.1, arr.shape)
+
+    # profile only the steady-state loop (reference sets state around the
+    # timed region, excluding bind/compile)
+    mx.profiler.profiler_set_config(mode="symbolic", filename=args.out)
+    ex.forward(is_train=True)
+    ex.backward()
+    mx.nd.waitall()
+    mx.profiler.profiler_set_state("run")
+    for _ in range(args.iters):
+        ex.forward(is_train=True)
+        ex.backward()
+    mx.nd.waitall()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+
+    with open(args.out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    logging.info("wrote %s with %d trace events (open in "
+                 "chrome://tracing)", args.out, len(events))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,9 @@ class Engine:
 
     @staticmethod
     def get():
+        inst = Engine._inst
+        if inst is not None:  # hot path: no lock once constructed
+            return inst
         with Engine._lock:
             if Engine._inst is None:
                 Engine._inst = Engine()
@@ -67,7 +70,10 @@ class Engine:
             return fn(*args, **kwargs)
         t0 = time.perf_counter_ns()
         out = fn(*args, **kwargs)
-        if self._naive:
+        if self._naive or prof is not None:
+            # profiling measures EXECUTION, not async dispatch: block like
+            # the reference's per-op recording (which requires disabling
+            # bulk-exec and likewise perturbs scheduling)
             jax.block_until_ready(out)
         if prof is not None:
             prof.record(name, t0, time.perf_counter_ns())

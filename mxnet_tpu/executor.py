@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import engine as _engine
 from . import random as _random
 from .base import MXNetError, get_env
 from .ndarray import NDArray
@@ -388,11 +389,13 @@ class Executor:
         new_aux = list(aux_vals)
         aux_rank = {}
         saved = []
-        for seg in self._stage_plan:
+        for si, seg in enumerate(self._stage_plan):
             ins = tuple(jax.device_put(env[k], seg.device)
                         for k in seg.in_keys)
             saved.append(ins)
-            outs, auxu = seg.jit_fwd(ins, rng, bool(is_train))
+            outs, auxu = _engine.get().dispatch(
+                "segment_%d_forward" % si, seg.jit_fwd, ins, rng,
+                bool(is_train))
             for k, v in zip(seg.out_keys, outs):
                 env[k] = v
             for ai, v in zip(seg.aux_idx, auxu):
@@ -414,12 +417,16 @@ class Executor:
             if node.is_variable:
                 id2arg[id(node)] = self._var_map[id(node)]
         arg_grads = {}
-        for seg, ins in zip(reversed(self._stage_plan), reversed(saved)):
+        n_seg = len(self._stage_plan)
+        for ri, (seg, ins) in enumerate(zip(reversed(self._stage_plan),
+                                            reversed(saved))):
             cots = tuple(
                 jax.device_put(cot[k] if k in cot
                                else jnp.zeros_like(env[k]), seg.device)
                 for k in seg.out_keys)
-            _, _, in_grads = seg.jit_bwd(ins, rng, cots)
+            _, _, in_grads = _engine.get().dispatch(
+                "segment_%d_backward" % (n_seg - 1 - ri), seg.jit_bwd,
+                ins, rng, cots)
             for k, g in zip(seg.in_keys, in_grads):
                 if g is None or g.dtype == jax.dtypes.float0:
                     continue
@@ -538,10 +545,14 @@ class Executor:
         elif is_train:
             # stash vjp residuals so a following backward() consumes them
             # instead of re-running the forward (VERDICT r2 weak #3)
-            outs, new_aux, vjp = self._jit_fwd_res(arg_vals, aux_vals, rng)
+            outs, new_aux, vjp = _engine.get().dispatch(
+                "executor_forward_train", self._jit_fwd_res, arg_vals,
+                aux_vals, rng)
             self._last_res = (outs, vjp)
         else:
-            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng, False)
+            outs, new_aux = _engine.get().dispatch(
+                "executor_forward", self._jit_fwd, arg_vals, aux_vals,
+                rng, False)
         for o_nd, o in zip(self.outputs, outs):
             o_nd._data = o
         if is_train:
@@ -566,8 +577,9 @@ class Executor:
             for nm, o in zip(names, outs):
                 records.append(("%s_%s" % (node.name, nm), o))
 
-        outs, new_aux = self._trace(arg_vals, aux_vals, is_train, rng,
-                                    tap=tap)
+        outs, new_aux = _engine.get().dispatch(
+            "executor_forward_monitored", self._trace, arg_vals, aux_vals,
+            is_train, rng, tap=tap)
         for nm, o in records:
             self._monitor_cb(nm, NDArray(o))
         return outs, new_aux
@@ -600,10 +612,12 @@ class Executor:
             # so activation-sized residuals free before the optimizer step
             outs, vjp = self._last_res
             self._last_res = None
-            grads = self._jit_bwd_res(vjp, outs, ograds)
+            grads = _engine.get().dispatch(
+                "executor_backward", self._jit_bwd_res, vjp, outs, ograds)
         else:
-            outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
-                                                     rng, ograds)
+            outs, new_aux, grads = _engine.get().dispatch(
+                "executor_forward_backward", self._jit_fwd_bwd, arg_vals,
+                aux_vals, rng, ograds)
             for o_nd, o in zip(self.outputs, outs):
                 o_nd._data = o
             for a_nd, a in zip(self.aux_arrays, new_aux):

@@ -278,8 +278,11 @@ class DataParallelTrainer:
             from .. import random as _random
             rng = _random.next_key()
         lrs, wds = self._host_hyper()
-        self.params, self.opt_state, self.aux, outs = self._train_step(
-            self.params, self.opt_state, self.aux, batch, lrs, wds, rng)
+        from .. import engine as _engine
+        self.params, self.opt_state, self.aux, outs = \
+            _engine.get().dispatch(
+                "fused_train_step", self._train_step, self.params,
+                self.opt_state, self.aux, batch, lrs, wds, rng)
         return outs
 
     def _host_hyper(self):

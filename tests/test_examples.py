@@ -201,3 +201,18 @@ def test_numpy_ops_custom_softmax():
     m = re.findall(r"numpy-op training accuracy ([0-9.]+)",
                    p.stderr + p.stdout)
     assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
+
+
+def test_profiler_example(tmp_path):
+    """Chrome-trace profiling around a bind+train loop (reference
+    example/profiler): events land in the dump with sane timestamps."""
+    import json
+    out = str(tmp_path / "prof.json")
+    _run("examples/profiler/profiler_executor.py", "--iters", "8",
+         "--out", out)
+    with open(out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    assert "executor_forward_train" in names, names
+    assert "executor_backward" in names, names
