@@ -33,18 +33,22 @@ from .symbol import Variable  # noqa: E402
 from . import executor  # noqa: E402
 from .attribute import AttrScope  # noqa: E402
 from .name import NameManager, Prefix  # noqa: E402
-from . import initializer  # noqa: E402
+from . import initializer
+from . import initializer as init  # mx.init shorthand (reference __init__.py:28)  # noqa: E402
 from .initializer import init_registry  # noqa: E402
 from . import optimizer  # noqa: E402
 from .optimizer import Optimizer  # noqa: E402
 from . import lr_scheduler  # noqa: E402
 from . import metric  # noqa: E402
-from . import kvstore as kvs  # noqa: E402
+from . import kvstore
+from . import kvstore as kv  # mx.kv shorthand (reference __init__.py:36)
 from .kvstore import KVStore, create as create_kvstore  # noqa: E402
 from . import kvstore_server  # noqa: E402  (role hijack runs at kvstore
 # creation, not import — see kvstore_server._init_kvstore_server_module)
-from . import io  # noqa: E402
-from . import module  # noqa: E402
+from . import io
+from .io import recordio  # noqa: E402
+from . import module
+from . import module as mod  # mx.mod shorthand (reference __init__.py:53)  # noqa: E402
 from .module import Module  # noqa: E402
 from . import model  # noqa: E402
 from .model import FeedForward  # noqa: E402
@@ -67,7 +71,5 @@ from . import executor_manager  # noqa: E402
 from . import pallas_ops  # noqa: E402
 from . import test_utils  # noqa: E402
 from . import contrib  # noqa: E402
-
-kvstore = kvs
 
 __version__ = "0.1.0"
