@@ -1,0 +1,151 @@
+"""Noise-contrastive estimation over a big output vocabulary (reference
+example/nce-loss/{nce.py,toy_nce.py}: replace the full softmax with a
+binary discrimination between the true class and k sampled noise
+classes — ``Embedding`` over candidate labels, dot with the hidden
+vector, ``LogisticRegressionOutput`` against [1, 0, ..., 0]).
+
+Toy task (reference toy_nce.py protocol): input encodes its class;
+training with NCE only (num_label-1 negatives per example) must still
+produce embeddings whose full-vocab argmax scoring is accurate.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def nce_loss(data, label, vocab_size, num_hidden):
+    """NCE head: score candidate labels against the hidden vector
+    (reference example/nce-loss/nce.py nce_loss)."""
+    label_embed = mx.sym.Embedding(label, input_dim=vocab_size,
+                                   output_dim=num_hidden,
+                                   name="label_embed")
+    label_bias = mx.sym.Embedding(label, input_dim=vocab_size,
+                                  output_dim=1, name="label_bias")
+    hidden = mx.sym.Reshape(data, shape=(-1, 1, num_hidden))
+    pred = mx.sym.broadcast_mul(hidden, label_embed)
+    pred = mx.sym.sum(pred, axis=2) + mx.sym.Reshape(label_bias,
+                                                     shape=(-1, 0))
+    return mx.sym.LogisticRegressionOutput(
+        pred, label=mx.sym.Variable("label_weight"), name="nce")
+
+
+def net_symbol(input_dim, vocab_size, num_hidden):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    hid = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=num_hidden, name="enc"),
+        act_type="tanh")
+    return nce_loss(hid, label, vocab_size, num_hidden)
+
+
+class NceAccuracy(mx.metric.EvalMetric):
+    """Candidate-slot accuracy (reference example/nce-loss/nce.py
+    NceAccuracy): does the true slot (argmax of label_weight) win."""
+
+    def __init__(self):
+        super(NceAccuracy, self).__init__("nce-accuracy")
+
+    def update(self, labels, preds):
+        weight = labels[1].asnumpy()
+        pred = preds[0].asnumpy()
+        self.sum_metric += float(
+            (pred.argmax(axis=1) == weight.argmax(axis=1)).sum())
+        self.num_inst += pred.shape[0]
+
+
+class NceIter(mx.io.DataIter):
+    """Per-batch negative sampling: label = [true, k noise draws]."""
+
+    def __init__(self, X, y, vocab_size, num_label, batch_size, rs):
+        super(NceIter, self).__init__(batch_size)
+        self.X, self.y = X, y
+        self.vocab, self.k = vocab_size, num_label
+        self.rs = rs
+        self._i = 0
+        self.provide_data = [mx.io.DataDesc("data", (batch_size,
+                                                     X.shape[1]))]
+        self.provide_label = [
+            mx.io.DataDesc("label", (batch_size, num_label)),
+            mx.io.DataDesc("label_weight", (batch_size, num_label))]
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        b = self.batch_size
+        if (self._i + 1) * b > len(self.y):
+            raise StopIteration
+        sl = slice(self._i * b, (self._i + 1) * b)
+        self._i += 1
+        true = self.y[sl]
+        neg = self.rs.randint(0, self.vocab, (b, self.k - 1))
+        # resample collisions with the true label once (cheap, good enough)
+        coll = neg == true[:, None]
+        neg[coll] = (neg[coll] + 1 + self.rs.randint(
+            0, self.vocab - 1, int(coll.sum()))) % self.vocab
+        label = np.concatenate([true[:, None], neg], axis=1)
+        weight = np.zeros((b, self.k), np.float32)
+        weight[:, 0] = 1.0
+        return mx.io.DataBatch(
+            data=[mx.nd.array(self.X[sl])],
+            label=[mx.nd.array(label.astype(np.float32)),
+                   mx.nd.array(weight)],
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="toy NCE")
+    parser.add_argument("--vocab-size", type=int, default=1000)
+    parser.add_argument("--num-examples", type=int, default=8192)
+    parser.add_argument("--num-label", type=int, default=6)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(5)
+    # input = noisy 2-hot code of the class index
+    y = rs.randint(0, args.vocab_size, args.num_examples)
+    dim = 64
+    X = rs.rand(args.num_examples, dim).astype(np.float32) * 0.1
+    X[np.arange(len(y)), y % dim] += 1.0
+    X[np.arange(len(y)), (y // dim) % dim] += 0.5
+
+    train = NceIter(X, y, args.vocab_size, args.num_label,
+                    args.batch_size, rs)
+    net = net_symbol(dim, args.vocab_size, args.num_hidden)
+    mod = mx.Module(net, context=mx.current_context(),
+                    label_names=("label", "label_weight"))
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.003},
+            initializer=mx.initializer.Xavier(),
+            eval_metric=NceAccuracy(), kvstore="local")
+
+    # full-vocab scoring with the learned label embeddings
+    arg_params, _ = mod.get_params()
+    W = arg_params["label_embed_weight"].asnumpy()
+    bias = arg_params["label_bias_weight"].asnumpy()[:, 0]
+    enc_w = arg_params["enc_weight"].asnumpy()
+    enc_b = arg_params["enc_bias"].asnumpy()
+    n_eval = 1024
+    hid = np.tanh(X[:n_eval] @ enc_w.T + enc_b)
+    scores = hid @ W.T + bias
+    acc = float((scores.argmax(axis=1) == y[:n_eval]).mean())
+    print("full-vocab nce accuracy %.4f (chance %.5f)"
+          % (acc, 1.0 / args.vocab_size))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""L2/L1-SVM digit classification (reference example/svm_mnist/
+svm_mnist.py: 512-512-10 MLP topped by ``SVMOutput`` instead of softmax,
+trained on noisy PCA'd MNIST).  Synthetic separable clusters stand in for
+the PCA'd digits so the script is self-contained; both margin objectives
+(`use_linear` L1 and the default squared-hinge L2) are runnable.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_data(rs, n, num_classes, dim):
+    """Noisy class clusters in `dim`-d space (the PCA'd-MNIST stand-in)."""
+    centers = rs.randn(num_classes, dim).astype(np.float32) * 3.0
+    y = rs.randint(0, num_classes, n)
+    X = centers[y] + rs.randn(n, dim).astype(np.float32)
+    X = (X - X.mean()) / X.std()  # the reference feeds PCA'd features;
+    # standardizing keeps hinge pre-activations O(1) so the margin is live
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def svm_mlp(num_classes, hidden, use_linear, margin, reg_coef):
+    data = mx.sym.Variable("data")
+    net = data
+    for i, h in enumerate((hidden, hidden)):
+        net = mx.sym.Activation(
+            mx.sym.FullyConnected(net, num_hidden=h, name="fc%d" % (i + 1)),
+            act_type="relu", name="relu%d" % (i + 1))
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc3")
+    return mx.sym.SVMOutput(net, name="svm", use_linear=use_linear,
+                            margin=margin, regularization_coefficient=reg_coef)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="SVM-output MLP")
+    parser.add_argument("--num-examples", type=int, default=4096)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--dim", type=int, default=70)
+    parser.add_argument("--hidden", type=int, default=128)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--use-linear", action="store_true",
+                        help="L1-SVM hinge instead of the default L2")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(7)
+    X, y = make_data(rs, args.num_examples, args.num_classes, args.dim)
+    n_train = int(0.8 * args.num_examples)
+    train = mx.io.NDArrayIter(X[:n_train], y[:n_train],
+                              batch_size=args.batch_size, shuffle=True,
+                              label_name="svm_label")
+    val = mx.io.NDArrayIter(X[n_train:], y[n_train:],
+                            batch_size=args.batch_size,
+                            label_name="svm_label")
+
+    net = svm_mlp(args.num_classes, args.hidden, args.use_linear,
+                  margin=1.0, reg_coef=1.0)
+    mod = mx.Module(net, context=mx.current_context(),
+                    label_names=("svm_label",))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-5},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="accuracy", kvstore="local")
+    score = mod.score(val, mx.metric.Accuracy())
+    acc = dict(score)["accuracy"]
+    print("final svm accuracy %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -254,6 +254,11 @@ class MAE(EvalMetric):
             pred = _to_np(pred)
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                # a 1-D prediction is a column of scalars; align it with
+                # the reshaped label so the subtraction cannot broadcast
+                # (n,1)-(n,) into an (n,n) matrix
+                pred = pred.reshape(pred.shape[0], 1)
             self.sum_metric += _np.abs(label - pred).mean()
             self.num_inst += 1
 
@@ -269,6 +274,11 @@ class MSE(EvalMetric):
             pred = _to_np(pred)
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                # a 1-D prediction is a column of scalars; align it with
+                # the reshaped label so the subtraction cannot broadcast
+                # (n,1)-(n,) into an (n,n) matrix
+                pred = pred.reshape(pred.shape[0], 1)
             self.sum_metric += ((label - pred) ** 2.0).mean()
             self.num_inst += 1
 
@@ -284,6 +294,11 @@ class RMSE(EvalMetric):
             pred = _to_np(pred)
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                # a 1-D prediction is a column of scalars; align it with
+                # the reshaped label so the subtraction cannot broadcast
+                # (n,1)-(n,) into an (n,n) matrix
+                pred = pred.reshape(pred.shape[0], 1)
             self.sum_metric += _np.sqrt(((label - pred) ** 2.0).mean())
             self.num_inst += 1
 

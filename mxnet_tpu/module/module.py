@@ -633,11 +633,26 @@ class Module(BaseModule):
         self._params_dirty = False
 
     def _fused_pack_batch(self, data_batch, fill_missing_labels=False):
+        # batch.data follows the ITERATOR's provide_data order, which is
+        # what the module was bound with — not necessarily the
+        # constructor's data_names order (NDArrayIter sorts dict inputs).
+        # Zipping constructor order against iterator order silently swaps
+        # same-shaped inputs (e.g. user/item in matrix factorization).
+        def _names(descs):
+            # descriptors may be DataDesc or classic (name, shape) tuples
+            return [d.name if hasattr(d, "name") else d[0] for d in descs]
+
+        provide = getattr(data_batch, "provide_data", None)
+        dnames = _names(provide if provide else self._data_shapes)
         batch = {}
-        for name, arr in zip(self._data_names, data_batch.data):
+        for name, arr in zip(dnames, data_batch.data):
             batch[name] = arr
         labels = getattr(data_batch, "label", None) or []
-        for name, arr in zip(self._label_names, labels):
+        provide_l = getattr(data_batch, "provide_label", None)
+        lnames = (_names(provide_l) if provide_l
+                  else _names(self._label_shapes or [])
+                  or self._label_names)
+        for name, arr in zip(lnames, labels):
             batch[name] = arr
         if fill_missing_labels:
             for name in self._label_names:

@@ -216,3 +216,55 @@ def test_profiler_example(tmp_path):
     names = {e.get("name") for e in events if isinstance(e, dict)}
     assert "executor_forward_train" in names, names
     assert "executor_backward" in names, names
+
+
+def test_svm_mnist():
+    """SVMOutput margin objectives (reference example/svm_mnist)."""
+    import re
+    p = _run("examples/svm_mnist/svm_mnist.py",
+             "--num-examples", "2048", "--num-epochs", "5")
+    m = re.findall(r"final svm accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
+    p = _run("examples/svm_mnist/svm_mnist.py", "--use-linear",
+             "--num-examples", "2048", "--num-epochs", "5")
+    m = re.findall(r"final svm accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
+
+
+def test_adversary_fgsm():
+    """FGSM through grad_req='write' on the data input (reference
+    example/adversary): adversarial accuracy collapses from clean."""
+    import re
+    p = _run("examples/adversary/fgsm_mnist.py",
+             "--num-examples", "1024", "--num-epochs", "4")
+    m = re.findall(r"clean accuracy ([0-9.]+) adversarial accuracy "
+                   r"([0-9.]+)", p.stderr + p.stdout)
+    assert m, (p.stderr + p.stdout)[-500:]
+    clean, adv = float(m[-1][0]), float(m[-1][1])
+    assert clean > 0.95, m
+    assert adv < clean - 0.1, m
+
+
+def test_recommenders_matrix_fact():
+    """Embedding-based matrix factorization (reference
+    example/recommenders/matrix_fact.py): held-out RMSE beats the
+    rating std by a wide margin."""
+    import re
+    p = _run("examples/recommenders/matrix_fact.py",
+             "--num-ratings", "20000", "--num-epochs", "10")
+    m = re.findall(r"rating std ([0-9.]+) final val rmse ([0-9.]+)",
+                   p.stderr + p.stdout)
+    assert m, (p.stderr + p.stdout)[-500:]
+    std, rmse = float(m[-1][0]), float(m[-1][1])
+    assert rmse < 0.5 * std, m
+
+
+def test_nce_loss():
+    """NCE over a 1000-word vocab (reference example/nce-loss/toy_nce.py):
+    full-vocab scoring with NCE-trained embeddings is accurate."""
+    import re
+    p = _run("examples/nce-loss/toy_nce.py",
+             "--num-examples", "8192", "--num-epochs", "10")
+    m = re.findall(r"full-vocab nce accuracy ([0-9.]+)",
+                   p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.5, (p.stderr + p.stdout)[-500:]

@@ -545,3 +545,32 @@ def test_refuse_after_transient_defuse():
         np.testing.assert_allclose(args_f[name].asnumpy(),
                                    args_h[name].asnumpy(),
                                    rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_fused_eval_respects_bound_input_order():
+    """Eval/predict on the fused path must map batch.data by the BOUND
+    (iterator) input order, not the constructor data_names order —
+    same-shaped inputs would silently swap (the matrix-factorization
+    user/item bug)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    # asymmetric in its inputs: out = 2*a - b
+    out = mx.sym.LinearRegressionOutput(2.0 * a - b, name="o")
+    mod = mx.Module(out, context=mx.cpu(), data_names=("a", "b"),
+                    label_names=("o_label",))
+    # bind in the OPPOSITE order — as an iterator with sorted/other
+    # ordering would
+    mod.bind(data_shapes=[("b", (4, 1)), ("a", (4, 1))],
+             label_shapes=[("o_label", (4, 1))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    av = np.arange(4, dtype=np.float32).reshape(4, 1)
+    bv = np.full((4, 1), 10.0, np.float32)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(bv), mx.nd.array(av)],  # bound order: b, a
+        label=[mx.nd.array(np.zeros((4, 1), np.float32))])
+    mod.forward(batch, is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, 2.0 * av - bv, rtol=1e-6)

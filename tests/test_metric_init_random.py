@@ -156,3 +156,17 @@ def test_distribution_moments():
     u = mx.nd.uniform(low=-1, high=3, shape=(100000,)).asnumpy()
     assert abs(u.mean() - 1.0) < 0.05
     assert u.min() >= -1 and u.max() <= 3
+
+
+def test_regression_metric_1d_pred_no_broadcast():
+    """(n,) preds vs (n,) labels must not broadcast into an (n,n)
+    difference matrix (1-D predictions come from e.g. sum(axis=1) into
+    LinearRegressionOutput — the matrix-factorization shape)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    lbl = mx.nd.array(np.arange(8, dtype=np.float32))
+    pred = mx.nd.array(np.arange(8, dtype=np.float32) + 1.0)
+    for name, expect in (("mse", 1.0), ("rmse", 1.0), ("mae", 1.0)):
+        m = mx.metric.create(name)
+        m.update([lbl], [pred])
+        assert abs(m.get()[1] - expect) < 1e-6, (name, m.get())
