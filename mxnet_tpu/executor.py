@@ -129,6 +129,16 @@ class Executor:
         self._last_staged = None
         self._last_res = None
 
+    def _rng_at_eval(self):
+        """Does any node draw randomness at inference (sampling ops)?"""
+        cached = getattr(self, "_rng_at_eval_cache", None)
+        if cached is None:
+            cached = self._rng_at_eval_cache = any(
+                not node.is_variable and
+                getattr(node.op, "rng_at_eval", False)
+                for node in self._nodes)
+        return cached
+
     # ------------------------------------------------------------------
     def _build_maps(self):
         symbol = self._symbol
@@ -528,7 +538,16 @@ class Executor:
             self.arg_arrays[i]._data = jax.device_put(
                 v._data if isinstance(v, NDArray) else jnp.asarray(v), dev)
         arg_vals, aux_vals = self._gather()
-        rng = _random.next_key()
+        if is_train or self._rng_at_eval():
+            rng = _random.next_key()
+        else:
+            # no op in this graph draws randomness at inference (dropout
+            # is identity): reuse one cached key instead of paying an
+            # eager host split per call — deterministic eval, no
+            # per-batch dispatch
+            rng = getattr(self, "_eval_rng", None)
+            if rng is None:
+                rng = self._eval_rng = _random.next_key()
         self._last_res = None
         if self._monitor_cb is not None:
             outs, new_aux = self._forward_monitored(arg_vals, aux_vals,

@@ -34,12 +34,65 @@ class EvalMetric:
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
+        self._dev_state = None
+        self._dev_stat_jit = None
+        self._dev_accum_jit = None
         self.reset()
 
     def update(self, labels, preds):
         raise NotImplementedError()
 
+    # -- device-side accumulation (TPU fast path) --------------------------
+    #
+    # The reference fit loop syncs every batch (update_metric's asnumpy).
+    # Over a TPU tunnel a per-batch host sync serializes the whole
+    # dispatch pipeline, so metrics that can be expressed as a pure
+    # (labels, preds) -> [stat_sum, inst_count] reduction accumulate in a
+    # single on-device f32[2]; the host fetches it only when the value is
+    # actually read (epoch end / Speedometer), keeping the training loop
+    # fetch-free.
+
+    def device_stat_fn(self):
+        """Pure jax fn ``(labels, preds) -> f32[2]`` of [sum, count], or
+        None when this metric has no device fast path."""
+        return None
+
+    def update_device(self, labels, preds):
+        """Accumulate on device without a host sync.  Returns False when
+        unsupported (caller must fall back to host ``update``)."""
+        if self.num is not None or len(labels) != len(preds):
+            return False
+        fn = self.device_stat_fn()
+        if fn is None:
+            return False
+        import jax
+        try:
+            labels = tuple(x._data if isinstance(x, NDArray) else x
+                           for x in labels)
+            preds = tuple(x._data if isinstance(x, NDArray) else x
+                          for x in preds)
+            if self._dev_stat_jit is None:
+                self._dev_stat_jit = jax.jit(fn)
+                self._dev_accum_jit = jax.jit(
+                    lambda state, ls, ps: state + fn(ls, ps))
+            if self._dev_state is None:
+                self._dev_state = self._dev_stat_jit(labels, preds)
+            else:
+                self._dev_state = self._dev_accum_jit(self._dev_state,
+                                                      labels, preds)
+        except Exception:  # odd dtypes/shapes: host update handles them
+            return False
+        return True
+
+    def _drain_device(self):
+        if self._dev_state is not None:
+            stat = _np.asarray(self._dev_state)
+            self._dev_state = None
+            self.sum_metric += float(stat[0])
+            self.num_inst += int(stat[1])
+
     def reset(self):
+        self._dev_state = None
         if self.num is None:
             self.num_inst = 0
             self.sum_metric = 0.0
@@ -49,6 +102,7 @@ class EvalMetric:
 
     def get(self):
         if self.num is None:
+            self._drain_device()
             if self.num_inst == 0:
                 return (self.name, float("nan"))
             return (self.name, self.sum_metric / self.num_inst)
@@ -92,6 +146,23 @@ class CompositeEvalMetric(EvalMetric):
         for metric in self.metrics:
             metric.update(labels, preds)
 
+    def update_device(self, labels, preds):
+        # all-or-nothing: a mixed device/host split would double-count
+        # when the caller falls back to host update for the whole set
+        if any(m.num is not None or m.device_stat_fn() is None
+               for m in self.metrics):
+            return False
+        snapshots = [m._dev_state for m in self.metrics]
+        for i, m in enumerate(self.metrics):
+            if not m.update_device(labels, preds):
+                # a member failed at trace/run time after earlier members
+                # already accumulated: roll those back so the caller's
+                # whole-composite host fallback cannot double-count
+                for mm, state in zip(self.metrics[:i + 1], snapshots):
+                    mm._dev_state = state
+                return False
+        return True
+
     def reset(self):
         try:
             for metric in self.metrics:
@@ -117,6 +188,24 @@ class Accuracy(EvalMetric):
     def __init__(self, axis=1):
         super().__init__("accuracy")
         self.axis = axis
+
+    def device_stat_fn(self):
+        axis = self.axis
+
+        def fn(labels, preds):
+            import jax.numpy as jnp
+            correct = jnp.float32(0.0)
+            count = 0
+            for label, pred in zip(labels, preds):
+                if pred.ndim != label.ndim:
+                    pred = jnp.argmax(pred, axis=axis)
+                p = pred.reshape(-1).astype(jnp.int32)
+                lbl = label.reshape(-1).astype(jnp.int32)
+                correct = correct + (p == lbl).sum().astype(jnp.float32)
+                count += p.shape[0]
+            return jnp.stack([correct,
+                              jnp.asarray(count, jnp.float32)])
+        return fn
 
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
@@ -149,6 +238,30 @@ class TopKAccuracy(EvalMetric):
         self.top_k = top_k
         assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
         self.name += "_%d" % self.top_k
+
+    def device_stat_fn(self):
+        top_k = self.top_k
+
+        def fn(labels, preds):
+            import jax
+            import jax.numpy as jnp
+            correct = jnp.float32(0.0)
+            count = 0
+            for label, pred in zip(labels, preds):
+                lbl = label.reshape(-1).astype(jnp.int32)
+                if pred.ndim == 2:
+                    k = min(pred.shape[1], top_k)
+                    _, idx = jax.lax.top_k(pred.astype(jnp.float32), k)
+                    hits = (idx.astype(jnp.int32) ==
+                            lbl[:, None]).sum()
+                else:
+                    hits = (pred.reshape(-1).astype(jnp.int32)
+                            == lbl).sum()
+                correct = correct + hits.astype(jnp.float32)
+                count += lbl.shape[0]
+            return jnp.stack([correct,
+                              jnp.asarray(count, jnp.float32)])
+        return fn
 
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
@@ -221,6 +334,31 @@ class Perplexity(EvalMetric):
         self.ignore_label = ignore_label
         self.axis = axis
 
+    def device_stat_fn(self):
+        ignore_label = self.ignore_label
+
+        def fn(labels, preds):
+            import jax.numpy as jnp
+            loss = jnp.float32(0.0)
+            num = jnp.float32(0.0)
+            for label, pred in zip(labels, preds):
+                lbl = label.reshape(-1).astype(jnp.int32)
+                probs = pred.reshape(-1, pred.shape[-1])[
+                    jnp.arange(lbl.shape[0]), lbl]
+                n = jnp.float32(lbl.shape[0])
+                if ignore_label is not None:
+                    ignore = (lbl == ignore_label).astype(probs.dtype)
+                    n = n - ignore.sum().astype(jnp.float32)
+                    probs = probs * (1 - ignore) + ignore
+                loss = loss - jnp.log(
+                    jnp.maximum(1e-10, probs)).sum().astype(jnp.float32)
+                num = num + n
+            # per-update exp, exactly the host semantics: accumulating raw
+            # loss and exp-ing at drain time would make the reported value
+            # depend on how often get() is called
+            return jnp.stack([jnp.exp(loss / num) * num, num])
+        return fn
+
     def update(self, labels, preds):
         assert len(labels) == len(preds)
         loss = 0.
@@ -243,22 +381,50 @@ class Perplexity(EvalMetric):
         self.num_inst += num
 
 
+def _as_columns(label, pred):
+    """numpy views with 1-D sides reshaped to (n, 1): a (n,1)-(n,)
+    subtraction would broadcast into an (n,n) matrix."""
+    label = _to_np(label)
+    pred = _to_np(pred)
+    if len(label.shape) == 1:
+        label = label.reshape(label.shape[0], 1)
+    if len(pred.shape) == 1:
+        pred = pred.reshape(pred.shape[0], 1)
+    return label, pred
+
+
+def _regression_device_stat(err_fn):
+    """Device stat for MAE/MSE/RMSE host semantics: per (label, pred)
+    pair, sum_metric += batch error, num_inst += 1."""
+    def fn(labels, preds):
+        import jax.numpy as jnp
+        total = jnp.float32(0.0)
+        pairs = 0
+        for label, pred in zip(labels, preds):
+            if label.ndim == 1:
+                label = label.reshape(-1, 1)
+            if pred.ndim == 1:
+                pred = pred.reshape(-1, 1)
+            total = total + err_fn(label.astype(jnp.float32),
+                                   pred.astype(jnp.float32))
+            pairs += 1
+        return jnp.stack([total, jnp.asarray(pairs, jnp.float32)])
+    return fn
+
+
 class MAE(EvalMetric):
     def __init__(self):
         super().__init__("mae")
 
+    def device_stat_fn(self):
+        import jax.numpy as jnp
+        return _regression_device_stat(
+            lambda lbl, p: jnp.abs(lbl - p).mean())
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
-            label = _to_np(label)
-            pred = _to_np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                # a 1-D prediction is a column of scalars; align it with
-                # the reshaped label so the subtraction cannot broadcast
-                # (n,1)-(n,) into an (n,n) matrix
-                pred = pred.reshape(pred.shape[0], 1)
+            label, pred = _as_columns(label, pred)
             self.sum_metric += _np.abs(label - pred).mean()
             self.num_inst += 1
 
@@ -267,18 +433,14 @@ class MSE(EvalMetric):
     def __init__(self):
         super().__init__("mse")
 
+    def device_stat_fn(self):
+        return _regression_device_stat(
+            lambda lbl, p: ((lbl - p) ** 2.0).mean())
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
-            label = _to_np(label)
-            pred = _to_np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                # a 1-D prediction is a column of scalars; align it with
-                # the reshaped label so the subtraction cannot broadcast
-                # (n,1)-(n,) into an (n,n) matrix
-                pred = pred.reshape(pred.shape[0], 1)
+            label, pred = _as_columns(label, pred)
             self.sum_metric += ((label - pred) ** 2.0).mean()
             self.num_inst += 1
 
@@ -287,18 +449,15 @@ class RMSE(EvalMetric):
     def __init__(self):
         super().__init__("rmse")
 
+    def device_stat_fn(self):
+        import jax.numpy as jnp
+        return _regression_device_stat(
+            lambda lbl, p: jnp.sqrt(((lbl - p) ** 2.0).mean()))
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
-            label = _to_np(label)
-            pred = _to_np(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                # a 1-D prediction is a column of scalars; align it with
-                # the reshaped label so the subtraction cannot broadcast
-                # (n,1)-(n,) into an (n,n) matrix
-                pred = pred.reshape(pred.shape[0], 1)
+            label, pred = _as_columns(label, pred)
             self.sum_metric += _np.sqrt(((label - pred) ** 2.0).mean())
             self.num_inst += 1
 
@@ -307,6 +466,21 @@ class CrossEntropy(EvalMetric):
     def __init__(self, eps=1e-8):
         super().__init__("cross-entropy")
         self.eps = eps
+
+    def device_stat_fn(self):
+        eps = self.eps
+
+        def fn(labels, preds):
+            import jax.numpy as jnp
+            loss = jnp.float32(0.0)
+            count = 0
+            for label, pred in zip(labels, preds):
+                lbl = label.reshape(-1).astype(jnp.int32)
+                prob = pred[jnp.arange(lbl.shape[0]), lbl]
+                loss = loss - jnp.log(prob + eps).sum().astype(jnp.float32)
+                count += lbl.shape[0]
+            return jnp.stack([loss, jnp.asarray(count, jnp.float32)])
+        return fn
 
     def update(self, labels, preds):
         check_label_shapes(labels, preds)

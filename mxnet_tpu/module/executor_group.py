@@ -52,12 +52,24 @@ def _split_input_slice(batch_size, work_load_list):
 
 def _load_general(data, targets):
     """Copy list-of-batch-arrays into per-exec target arrays
-    (reference executor_group.py:14-50)."""
+    (reference executor_group.py:14-50).
+
+    Device-resident sources are sliced and copied device-side: an
+    ``asnumpy`` here would fetch the whole batch over the TPU
+    interconnect every step and re-upload it."""
     for d_src, d_targets in zip(data, targets):
-        src = d_src.asnumpy() if hasattr(d_src, "asnumpy") else \
-            np.asarray(d_src)
+        dev_src = d_src._data if hasattr(d_src, "_data") else None
+        np_src = None
         for slice_idx, target in d_targets:
-            target[:] = src[slice_idx]
+            if dev_src is not None:
+                start = slice_idx.start or 0
+                full = start == 0 and (slice_idx.stop is None or
+                                       slice_idx.stop >= dev_src.shape[0])
+                target[:] = dev_src if full else dev_src[slice_idx]
+            else:
+                if np_src is None:
+                    np_src = np.asarray(d_src)
+                target[:] = np_src[slice_idx]
 
 
 class DataParallelExecutorGroup:

@@ -754,7 +754,13 @@ class Module(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         if self._fused is not None:
-            eval_metric.update(labels, self._fused_get_outputs())
+            outs = self._fused_get_outputs()
+            # device-side accumulation keeps the hot loop free of host
+            # syncs (per-batch fetches serialize the dispatch pipeline
+            # over a TPU tunnel); metrics without a device path fall
+            # back to the reference's host update
+            if not eval_metric.update_device(labels, outs):
+                eval_metric.update(labels, outs)
             return
         self._exec_group.update_metric(eval_metric, labels)
 

@@ -368,7 +368,7 @@ def _dropout_fstateful(attrs, inputs, aux, is_train, rng):
 
 
 register("Dropout", fstateful=_dropout_fstateful,
-         attrs={"p": Float(0.5)}, needs_rng=True,
+         attrs={"p": Float(0.5)}, needs_rng=True, rng_at_eval=False,
          doc="Inverted dropout; identity at inference "
              "(reference src/operator/dropout.cc).")
 

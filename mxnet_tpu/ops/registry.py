@@ -130,8 +130,8 @@ class OpDef:
                  arguments=("data",), outputs=("output",), aux_states=(),
                  infer_shape=None, infer_type=None,
                  infer_shape_backward=None, num_outputs=1,
-                 key_var_num_args=None, needs_rng=False, mutate=(),
-                 free_attrs=False, doc=""):
+                 key_var_num_args=None, needs_rng=False, rng_at_eval=None,
+                 mutate=(), free_attrs=False, doc=""):
         self.name = name
         self.fcompute = fcompute
         self.fstateful = fstateful
@@ -146,6 +146,11 @@ class OpDef:
         # name of the attr holding the variadic input count (Concat: num_args)
         self.key_var_num_args = key_var_num_args
         self.needs_rng = needs_rng
+        # does the op draw randomness at INFERENCE?  Dropout/RNN-dropout
+        # are identity when is_train=False, but sampling ops draw always;
+        # executors use this to decide whether an eval forward may reuse a
+        # cached key (skipping a per-call host split)
+        self.rng_at_eval = needs_rng if rng_at_eval is None else rng_at_eval
         # ((out_idx, arg_idx), ...): extra outputs written back into input
         # handles by imperative_invoke (reference FMutateInputs — optimizer
         # update ops mutate their state inputs, op_attr_types.h)

@@ -198,7 +198,7 @@ def _rnn_infer(attrs, in_shapes):
 
 register("RNN", fstateful=_rnn_fstateful, arguments=_rnn_args,
          outputs=_rnn_outputs, num_outputs=_rnn_num_outputs,
-         needs_rng=True,
+         needs_rng=True, rng_at_eval=False,
          attrs={"state_size": Int(required=True),
                 "num_layers": Int(required=True),
                 "mode": Str(required=True),

@@ -178,6 +178,11 @@ class DataParallelTrainer:
         nodes = symbol._nodes()
         aux_set = set(self.aux_names)
         head = [(id(n), oi) for n, oi in symbol._outputs]
+        # sampling ops draw at inference too: predict() must not reuse a
+        # cached key for such graphs
+        self._rng_at_eval = any(not n.is_variable and
+                                getattr(n.op, "rng_at_eval", False)
+                                for n in nodes)
         param_names = self.param_names
         data_names = self.data_names + self.label_names
         overrides = shape_overrides(symbol, self._arg_shapes)
@@ -221,6 +226,11 @@ class DataParallelTrainer:
                         else v) for k, v in tree.items()}
 
         def train_step(params, opt_state, aux, batch, lrs, wds, rng):
+            # split INSIDE the graph and carry the successor key out: the
+            # host never runs an eager split per step (23 ms over a TPU
+            # tunnel) and never re-uploads a key
+            rng, rng_next = jax.random.split(rng)
+
             def f(ps):
                 args = _cast(dict(batch))
                 args.update(_cast(ps))
@@ -245,7 +255,7 @@ class DataParallelTrainer:
                                                          idx))
                     new_params[name] = w
                     new_opt[name] = s
-            return new_params, new_opt, new_aux, outs
+            return new_params, new_opt, new_aux, outs, rng_next
 
         def predict_step(params, aux, batch, rng):
             args = _cast(dict(batch))
@@ -275,35 +285,60 @@ class DataParallelTrainer:
                 batch[self.label_names[0]] = label
         batch = self._shard_batch(batch)
         if rng is None:
-            from .. import random as _random
-            rng = _random.next_key()
+            rng = self._carry_rng()
         lrs, wds = self._host_hyper()
         from .. import engine as _engine
-        self.params, self.opt_state, self.aux, outs = \
+        self.params, self.opt_state, self.aux, outs, rng_next = \
             _engine.get().dispatch(
                 "fused_train_step", self._train_step, self.params,
                 self.opt_state, self.aux, batch, lrs, wds, rng)
+        self._rng_dev = rng_next
         return outs
+
+    def _carry_rng(self):
+        """Device-resident PRNG key threaded through the compiled step
+        (successor keys come back as a step output — no per-step host
+        split or upload)."""
+        rng = getattr(self, "_rng_dev", None)
+        if rng is None:
+            from .. import random as _random
+            rng = self._rng_dev = _random.next_key()
+        return rng
 
     def _host_hyper(self):
         """Per-step (lr, wd) vectors over param_names positions, computed
         from the host optimizer (schedulers/multipliers/update counts) —
         dynamic jit args, so lr changes don't retrace."""
         lr_list, wd_list = self._ingraph.host_hyper(self._live_idx)
+        key = (tuple(lr_list), tuple(wd_list))
+        cached = getattr(self, "_hyper_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         lrs = np.zeros(len(self.param_names), np.float32)
         wds = np.zeros(len(self.param_names), np.float32)
         for i, lr, wd in zip(self._live_idx, lr_list, wd_list):
             lrs[i] = lr
             wds[i] = wd
-        return jnp.asarray(lrs), jnp.asarray(wds)
+        dev = (jnp.asarray(lrs), jnp.asarray(wds))
+        # constant-lr steps would otherwise pay two host->device
+        # transfers per batch; schedulers that do change lr miss the
+        # cache and re-upload
+        self._hyper_cache = (key, dev)
+        return dev
 
     def predict(self, data, rng=None):
         batch = dict(data) if isinstance(data, dict) else \
             {self.data_names[0]: data}
         batch = self._shard_batch(batch)
         if rng is None:
-            from .. import random as _random
-            rng = _random.next_key()
+            if getattr(self, "_rng_at_eval", False):
+                # graph samples at inference: every call needs fresh draws
+                from .. import random as _random
+                rng = _random.next_key()
+            else:
+                # dropout-only graphs are identity at inference: reuse the
+                # carried key — deterministic eval, no per-call host split
+                rng = self._carry_rng()
         return self._predict_step(self.params, self.aux, batch, rng)
 
     def get_params(self):

@@ -170,3 +170,93 @@ def test_regression_metric_1d_pred_no_broadcast():
         m = mx.metric.create(name)
         m.update([lbl], [pred])
         assert abs(m.get()[1] - expect) < 1e-6, (name, m.get())
+
+
+def test_device_metric_accumulation_matches_host():
+    """update_device must agree with host update for every supported
+    metric, including drain-at-get semantics (fused fit loop path)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    rs = np.random.RandomState(0)
+    pred = rs.rand(16, 10).astype(np.float32)
+    pred /= pred.sum(axis=1, keepdims=True)
+    label = rs.randint(0, 10, 16).astype(np.float32)
+    reg_pred = rs.rand(16).astype(np.float32)
+    reg_label = rs.rand(16).astype(np.float32)
+    cases = [
+        (mx.metric.Accuracy(), [label], [pred]),
+        (mx.metric.TopKAccuracy(top_k=3), [label], [pred]),
+        (mx.metric.CrossEntropy(), [label], [pred]),
+        (mx.metric.Perplexity(ignore_label=None), [label], [pred]),
+        (mx.metric.Perplexity(ignore_label=0), [label], [pred]),
+        (mx.metric.MSE(), [reg_label], [reg_pred]),
+        (mx.metric.RMSE(), [reg_label], [reg_pred]),
+        (mx.metric.MAE(), [reg_label], [reg_pred]),
+    ]
+    for m, ls, ps in cases:
+        lnd = [mx.nd.array(x) for x in ls]
+        pnd = [mx.nd.array(x) for x in ps]
+        host = type(m)(**({"top_k": 3} if "top_k" in m.name else
+                          {"ignore_label": m.ignore_label}
+                          if m.name == "Perplexity" else {}))
+        host.update(lnd, pnd)
+        host.update(lnd, pnd)
+        assert m.update_device(lnd, pnd), m.name
+        assert m.update_device(lnd, pnd), m.name
+        hv, dv = host.get()[1], m.get()[1]
+        assert abs(hv - dv) < 1e-4 * max(1.0, abs(hv)), \
+            (m.name, hv, dv)
+
+
+def test_composite_device_metric_no_double_count():
+    """A composite whose member fails device-side must roll back the
+    members that succeeded, so the host fallback cannot double-count."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    class Flaky(mx.metric.EvalMetric):
+        """Works on host, raises at device trace time."""
+
+        def __init__(self):
+            super().__init__("flaky")
+
+        def update(self, labels, preds):
+            self.sum_metric += 1.0
+            self.num_inst += 1
+
+        def device_stat_fn(self):
+            def fn(labels, preds):
+                raise RuntimeError("no device path after all")
+            return fn
+
+    acc = mx.metric.Accuracy()
+    comp = mx.metric.CompositeEvalMetric([acc, Flaky()])
+    label = mx.nd.array(np.array([0, 1], np.float32))
+    pred = mx.nd.array(np.array([[0.9, 0.1], [0.1, 0.9]], np.float32))
+    ok = comp.update_device([label], [pred])
+    assert not ok
+    comp.update([label], [pred])  # host fallback (the caller's move)
+    names, vals = comp.get()
+    accuracy = dict(zip(names, vals))["accuracy"]
+    assert accuracy == 1.0, (names, vals)  # 2/2, not 4/4 or 2/4
+
+
+def test_eval_rng_semantics():
+    """Sampling graphs draw fresh randomness every eval forward; pure
+    dropout graphs reuse a cached key (identity at eval anyway)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    # sampling executor: two forwards differ
+    s = mx.sym.uniform(low=0.0, high=1.0, shape=(4,))
+    ex = s.bind(mx.cpu(), {})
+    a = ex.forward(is_train=False)[0].asnumpy().copy()
+    b = ex.forward(is_train=False)[0].asnumpy().copy()
+    assert not np.allclose(a, b)
+    # dropout-only executor: eval is identity regardless of key reuse
+    data = mx.sym.Variable("data")
+    net = mx.sym.Dropout(data, p=0.5)
+    ex2 = net.simple_bind(mx.cpu(), data=(4, 4), grad_req="null")
+    x = np.random.rand(4, 4).astype(np.float32)
+    ex2.arg_dict["data"][:] = x
+    out = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-6)
