@@ -1,0 +1,96 @@
+"""Fully-convolutional semantic segmentation (reference example/fcn-xs/:
+conv backbone -> 1x1 score conv -> Deconvolution upsampling -> Crop back
+to input size -> pixelwise SoftmaxOutput with multi_output, the FCN-xs
+skip architecture of symbol_fcnxs.py).
+
+Synthetic task: each image is a grid of colored blobs; the pixel class
+is determined by the local blob color.  A small FCN must reach high
+pixel accuracy.  Exercises Deconvolution, Crop, and multi-output
+softmax — the ops the reference family exists to compose.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_data(rs, n, size, num_classes):
+    """Blocky class maps rendered to noisy color images."""
+    cell = 4
+    grid = size // cell
+    cls = rs.randint(0, num_classes, (n, grid, grid))
+    seg = np.repeat(np.repeat(cls, cell, axis=1), cell, axis=2)
+    palette = rs.rand(num_classes, 3).astype(np.float32)
+    img = palette[seg].transpose(0, 3, 1, 2)
+    img += 0.1 * rs.randn(*img.shape).astype(np.float32)
+    return img.astype(np.float32), seg.astype(np.float32)
+
+
+def fcn_symbol(num_classes):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(mx.sym.Convolution(
+        data, kernel=(3, 3), pad=(1, 1), num_filter=16, name="conv1"),
+        act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Activation(mx.sym.Convolution(
+        net, kernel=(3, 3), pad=(1, 1), num_filter=32, name="conv2"),
+        act_type="relu")
+    score = mx.sym.Convolution(net, kernel=(1, 1), num_filter=num_classes,
+                               name="score")
+    # stride-2 learned upsampling back to input resolution, then crop to
+    # the exact input geometry (reference symbol_fcnxs.py fcn32s)
+    up = mx.sym.Deconvolution(score, kernel=(4, 4), stride=(2, 2),
+                              pad=(1, 1), num_filter=num_classes,
+                              name="upsample")
+    up = mx.sym.Crop(up, data, num_args=2, name="crop")
+    return mx.sym.SoftmaxOutput(up, multi_output=True, use_ignore=True,
+                                ignore_label=-1, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="FCN segmentation")
+    parser.add_argument("--num-examples", type=int, default=512)
+    parser.add_argument("--size", type=int, default=32)
+    parser.add_argument("--num-classes", type=int, default=4)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(9)
+    X, S = make_data(rs, args.num_examples, args.size, args.num_classes)
+    # SoftmaxOutput(multi_output) wants labels (batch, H*W)
+    labels = S.reshape(len(S), -1)
+    n_train = int(0.85 * args.num_examples)
+    train = mx.io.NDArrayIter(X[:n_train], labels[:n_train],
+                              batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(X[n_train:], labels[n_train:],
+                            batch_size=args.batch_size)
+
+    net = fcn_symbol(args.num_classes)
+    mod = mx.Module(net, context=mx.current_context())
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            initializer=mx.initializer.Xavier(magnitude=2.0),
+            eval_metric=mx.metric.Accuracy(axis=1), kvstore="local")
+
+    probs = mod.predict(val).asnumpy()            # (n, C, H, W)
+    pred = probs.argmax(axis=1).reshape(len(probs), -1)
+    truth = labels[n_train:][:len(pred)]
+    acc = float((pred == truth).mean())
+    print("pixel accuracy %.4f (chance %.3f)" % (acc,
+                                                 1.0 / args.num_classes))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Neural style transfer (reference example/neural-style/nstyle.py:
+freeze a conv feature extractor, then optimize the INPUT IMAGE by
+gradient descent on content + Gram-matrix style losses).
+
+Self-contained: a small fixed random conv pyramid stands in for VGG19
+(random projections preserve enough structure for the optimization
+dynamics); content/style images are synthetic.  Exercises grad w.r.t. a
+data input, symbolic Gram matrices via batch_dot, MakeLoss heads, and a
+hand-rolled Adam on the image (the reference optimizes the image
+outside Module too).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def feature_net(num_stages=3, filters=(8, 16, 32)):
+    """Conv pyramid; returns per-stage activations (the 'relu1_1...'
+    taps of the reference's model_vgg19.py)."""
+    data = mx.sym.Variable("data")
+    taps = []
+    net = data
+    for i in range(num_stages):
+        net = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=filters[i],
+                                 name="conv%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+        taps.append(net)
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="avg")
+    return taps
+
+
+def gram(sym):
+    """Channel Gram matrix of a (1, C, H, W) activation."""
+    flat = mx.sym.Reshape(sym, shape=(0, 0, -1))       # (1, C, HW)
+    return mx.sym.batch_dot(flat, flat, transpose_b=True)
+
+
+def style_transfer_symbol(content_weight, style_weight):
+    taps = feature_net()
+    content_tap = taps[-1]
+    losses = [mx.sym.MakeLoss(
+        mx.sym.sum(mx.sym.square(content_tap -
+                                 mx.sym.Variable("content_target"))),
+        grad_scale=content_weight, name="content_loss")]
+    for i, tap in enumerate(taps):
+        losses.append(mx.sym.MakeLoss(
+            mx.sym.sum(mx.sym.square(
+                gram(tap) - mx.sym.Variable("style_target%d" % i))),
+            grad_scale=style_weight, name="style_loss%d" % i))
+    return mx.sym.Group(losses), len(taps)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="neural style")
+    parser.add_argument("--size", type=int, default=32)
+    parser.add_argument("--iters", type=int, default=120)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--content-weight", type=float, default=1e-3)
+    parser.add_argument("--style-weight", type=float, default=1e-6)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(1)
+    s = args.size
+    content_img = np.zeros((1, 3, s, s), np.float32)
+    content_img[:, :, s // 4:3 * s // 4, s // 4:3 * s // 4] = 1.0
+    style_img = np.tile(rs.rand(1, 3, 1, s).astype(np.float32) > 0.5,
+                        (1, 1, s, 1)).astype(np.float32)
+
+    sym, n_taps = style_transfer_symbol(args.content_weight,
+                                        args.style_weight)
+    ctx = mx.current_context()
+
+    # fixed random "VGG" weights, shared with the target extractors
+    init = mx.initializer.Xavier(magnitude=1.0)
+    ex = sym.simple_bind(ctx, data=(1, 3, s, s),
+                         grad_req={"data": "write"})
+    for name, arr in ex.arg_dict.items():
+        if name.startswith("conv"):
+            init(mx.initializer.InitDesc(name), arr)
+
+    def extract_targets(img):
+        """Run the net on an image and capture content/style targets."""
+        ex.arg_dict["data"][:] = img
+        # zero targets -> outputs are sum-sq of raw taps; we want the raw
+        # taps, so rebuild them from a plain feature executor instead
+        taps = feature_net()
+        fex = mx.sym.Group(taps + [gram(t) for t in taps]).bind(
+            ctx, {n: a for n, a in ex.arg_dict.items()
+                  if n.startswith("conv") or n == "data"})
+        outs = fex.forward(is_train=False)
+        content = outs[len(taps) - 1].asnumpy()
+        grams = [o.asnumpy() for o in outs[len(taps):]]
+        return content, grams
+
+    ex.arg_dict["data"][:] = content_img
+    content_target, _ = extract_targets(content_img)
+    ex.arg_dict["data"][:] = style_img
+    _, style_targets = extract_targets(style_img)
+
+    ex.arg_dict["content_target"][:] = content_target
+    for i in range(n_taps):
+        ex.arg_dict["style_target%d" % i][:] = style_targets[i]
+
+    # optimize the image with Adam (reference uses lr-decayed SGD/Adam)
+    img = rs.uniform(-0.1, 0.1, (1, 3, s, s)).astype(np.float32)
+    m = np.zeros_like(img)
+    v = np.zeros_like(img)
+    beta1, beta2, eps = 0.9, 0.999, 1e-8
+    first_loss = last_loss = None
+    for it in range(args.iters):
+        ex.arg_dict["data"][:] = img
+        outs = ex.forward(is_train=True)
+        loss = float(sum(o.asnumpy().sum() for o in outs))
+        ex.backward()
+        g = ex.grad_dict["data"].asnumpy()
+        m = beta1 * m + (1 - beta1) * g
+        v = beta2 * v + (1 - beta2) * g * g
+        mh = m / (1 - beta1 ** (it + 1))
+        vh = v / (1 - beta2 ** (it + 1))
+        img = img - args.lr * mh / (np.sqrt(vh) + eps)
+        if first_loss is None:
+            first_loss = loss
+        last_loss = loss
+        if it % 20 == 0:
+            logging.info("iter %d loss %.6f", it, loss)
+    print("style loss first %.6f last %.6f ratio %.4f"
+          % (first_loss, last_loss, last_loss / first_loss))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Deep Q-Network on a toy chain MDP (reference
+example/reinforcement-learning/dqn/: Q-network Module, replay memory,
+target network synced by parameter copy, epsilon-greedy exploration,
+TD(0) targets).
+
+Environment: N-state chain; RIGHT moves toward the goal (reward +1 at
+the end), LEFT moves back (reward 0), episodes cap at 2N steps.  The
+optimal policy is always-RIGHT with return 1; an untrained agent
+wanders and mostly times out.  Exercises: two-module parameter copy
+(get_params/set_params), predict-forward inside a control loop, and
+fit-free manual forward/backward/update training.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class Chain:
+    def __init__(self, n):
+        self.n = n
+        self.reset()
+
+    def reset(self):
+        self.pos = 0
+        self.t = 0
+        return self.pos
+
+    def step(self, action):
+        self.t += 1
+        self.pos = min(self.pos + 1, self.n - 1) if action == 1 else \
+            max(self.pos - 1, 0)
+        done = self.pos == self.n - 1 or self.t >= 2 * self.n
+        reward = 1.0 if self.pos == self.n - 1 else 0.0
+        return self.pos, reward, done
+
+
+def one_hot(idx, n):
+    out = np.zeros((len(idx), n), np.float32)
+    out[np.arange(len(idx)), idx] = 1.0
+    return out
+
+
+def q_symbol(num_actions, hidden):
+    data = mx.sym.Variable("data")
+    # explicit names: the online and target nets are separate modules and
+    # must agree on parameter names for get_params/set_params syncing
+    net = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1"),
+        act_type="relu")
+    q = mx.sym.FullyConnected(net, num_hidden=num_actions, name="qvals")
+    return mx.sym.LinearRegressionOutput(q, name="q")
+
+
+def make_module(sym, batch, n_states, for_training):
+    mod = mx.Module(sym, context=mx.current_context(),
+                    label_names=("q_label",))
+    mod.bind(data_shapes=[("data", (batch, n_states))],
+             label_shapes=[("q_label", (batch, 2))],
+             for_training=for_training)
+    return mod
+
+
+def main():
+    parser = argparse.ArgumentParser(description="DQN chain")
+    parser.add_argument("--n-states", type=int, default=8)
+    parser.add_argument("--episodes", type=int, default=250)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--gamma", type=float, default=0.95)
+    parser.add_argument("--sync-every", type=int, default=20)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(4)
+    env = Chain(args.n_states)
+    qnet = make_module(q_symbol(2, 32), args.batch_size, args.n_states,
+                       True)
+    qnet.init_params(mx.initializer.Xavier())
+    qnet.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": 3e-3})
+    target = make_module(q_symbol(2, 32), args.batch_size, args.n_states,
+                         False)
+    arg_p, aux_p = qnet.get_params()
+    target.init_params(arg_params=arg_p, aux_params=aux_p)
+
+    replay = []
+    returns = []
+    eps = 1.0
+    zero_label = mx.nd.zeros((args.batch_size, 2))
+
+    def q_of(mod, states):
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(one_hot(states, args.n_states))],
+            label=[zero_label])
+        mod.forward(batch, is_train=False)
+        return mod.get_outputs()[0].asnumpy()
+
+    for ep in range(args.episodes):
+        s = env.reset()
+        total = 0.0
+        done = False
+        while not done:
+            if rs.rand() < eps:
+                a = rs.randint(2)
+            else:
+                a = int(q_of(qnet, np.array([s] * args.batch_size))
+                        [0].argmax())
+            s2, r, done = env.step(a)
+            total += r
+            replay.append((s, a, r, s2, done))
+            if len(replay) > 5000:
+                replay.pop(0)
+            s = s2
+
+            if len(replay) >= args.batch_size:
+                idx = rs.randint(0, len(replay), args.batch_size)
+                ss, aa, rr, ss2, dd = zip(*[replay[i] for i in idx])
+                q_cur = q_of(qnet, np.array(ss))
+                q_next = q_of(target, np.array(ss2))
+                tgt = q_cur.copy()
+                td = np.array(rr, np.float32) + args.gamma * \
+                    q_next.max(axis=1) * (1.0 - np.array(dd, np.float32))
+                tgt[np.arange(args.batch_size), list(aa)] = td
+                batch = mx.io.DataBatch(
+                    data=[mx.nd.array(one_hot(np.array(ss),
+                                              args.n_states))],
+                    label=[mx.nd.array(tgt)])
+                qnet.forward_backward(batch)
+                qnet.update()
+
+        returns.append(total)
+        eps = max(0.05, eps * 0.98)
+        if (ep + 1) % args.sync_every == 0:
+            arg_p, aux_p = qnet.get_params()
+            target.set_params(arg_p, aux_p)
+        if (ep + 1) % 50 == 0:
+            logging.info("episode %d mean return(last 50) %.3f eps %.2f",
+                         ep + 1, float(np.mean(returns[-50:])), eps)
+
+    early = float(np.mean(returns[:50]))
+    late = float(np.mean(returns[-50:]))
+    print("mean return first-50 %.3f last-50 %.3f" % (early, late))
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,10 @@ class EvalMetric:
         unsupported (caller must fall back to host ``update``)."""
         if self.num is not None or len(labels) != len(preds):
             return False
+        if getattr(self, "_dev_unsupported", False):
+            # a previous attempt failed at trace time: don't pay a failed
+            # jit trace + exception on every batch of the hot loop
+            return False
         fn = self.device_stat_fn()
         if fn is None:
             return False
@@ -81,6 +85,7 @@ class EvalMetric:
                 self._dev_state = self._dev_accum_jit(self._dev_state,
                                                       labels, preds)
         except Exception:  # odd dtypes/shapes: host update handles them
+            self._dev_unsupported = True  # sticky until reset()
             return False
         return True
 
@@ -93,6 +98,7 @@ class EvalMetric:
 
     def reset(self):
         self._dev_state = None
+        self._dev_unsupported = False
         if self.num is None:
             self.num_inst = 0
             self.sum_metric = 0.0

@@ -195,20 +195,42 @@ register_alias("Convolution", "Convolution_v1")
 
 
 def _deconv_fcompute(attrs, data, weight, bias=None):
+    """Transposed convolution as a dilated convolution: the reference's
+    Deconvolution is the gradient of Convolution w.r.t. data
+    (deconvolution-inl.h), i.e. conv(dilate_by_stride(x), flip(W)) with
+    padding (k-1-p, k-1-p+adj).  Output spatial size is exactly
+    (i-1)*s - 2p + k + adj.  Weight layout (in_ch, nf/group, k...)."""
     n = _conv_dims(attrs)
     stride = _tuple_n(attrs["stride"], n, "stride")
     pad = _tuple_n(attrs["pad"], n, "pad")
+    kernel = tuple(attrs["kernel"])
+    g = attrs["num_group"]
+    adj = _tuple_n(attrs["adj"], n, "adj") if attrs["adj"] else (0,) * n
+    if attrs["target_shape"]:
+        tgt = tuple(attrs["target_shape"])
+        adj = tuple(t - ((i - 1) * s - 2 * p + k)
+                    for t, i, s, p, k in zip(tgt, data.shape[2:], stride,
+                                             pad, kernel))
     spatial = "DHW"[-n:]
-    dn = jax.lax.conv_dimension_numbers(
-        data.shape, weight.shape,
-        ("NC" + spatial, "IO" + spatial, "NC" + spatial))
-    k = tuple(attrs["kernel"])
-    out = jax.lax.conv_transpose(
-        data, weight, strides=stride,
-        padding=[(p, p) for p in pad],
-        dimension_numbers=dn, transpose_kernel=True)
-    # conv_transpose with 'transpose_kernel' matches gradient-of-conv
-    # semantics, which is exactly the reference Deconvolution definition.
+    flip = (slice(None), slice(None)) + (slice(None, None, -1),) * n
+
+    def one(x, w):
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, w.shape,
+            ("NC" + spatial, "IO" + spatial, "NC" + spatial))
+        return jax.lax.conv_general_dilated(
+            x, w[flip], window_strides=(1,) * n,
+            padding=[(k - 1 - p, k - 1 - p + a)
+                     for k, p, a in zip(kernel, pad, adj)],
+            lhs_dilation=stride, dimension_numbers=dn)
+
+    if g == 1:
+        out = one(data, weight)
+    else:
+        xs = jnp.split(data, g, axis=1)
+        ws = jnp.split(weight, g, axis=0)
+        out = jnp.concatenate([one(x, w) for x, w in zip(xs, ws)],
+                              axis=1)
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out
@@ -227,8 +249,11 @@ def _deconv_infer(attrs, in_shapes):
     in_shapes[1] = (ds[1], nf // attrs["num_group"]) + kernel
     if not attrs["no_bias"]:
         in_shapes[2] = (nf,)
-    spatial = tuple((d - 1) * s - 2 * p + k + a for d, k, s, p, a
-                    in zip(ds[2:], kernel, stride, pad, adj))
+    if attrs["target_shape"]:
+        spatial = tuple(attrs["target_shape"])
+    else:
+        spatial = tuple((d - 1) * s - 2 * p + k + a for d, k, s, p, a
+                        in zip(ds[2:], kernel, stride, pad, adj))
     return in_shapes, [(ds[0], nf) + spatial], []
 
 

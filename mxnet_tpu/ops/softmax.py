@@ -70,9 +70,12 @@ def _softmax_output_bwd(cfg, res, g):
     grad_scale, ignore_label, multi_output, use_ignore, _, normalization = cfg
     prob, label = res
     if multi_output:
-        # data: (n, c, d1...), label: (n, d1...)
+        # data: (n, c, d1...), label: (n, prod(d1...)) or (n, d1...);
+        # keep `label` untouched — its cotangent below must match the
+        # bound input shape
         num_class = prob.shape[1]
-        onehot = jax.nn.one_hot(label.astype(jnp.int32), num_class,
+        lbl = label.reshape((label.shape[0],) + prob.shape[2:])
+        onehot = jax.nn.one_hot(lbl.astype(jnp.int32), num_class,
                                 axis=1, dtype=prob.dtype)
     else:
         num_class = prob.shape[-1]
@@ -82,7 +85,7 @@ def _softmax_output_bwd(cfg, res, g):
     grad = prob - onehot
     if use_ignore:
         if multi_output:
-            mask = (label != ignore_label).astype(prob.dtype)
+            mask = (lbl != ignore_label).astype(prob.dtype)
             grad = grad * jnp.expand_dims(mask, 1)
         else:
             mask = (label != ignore_label).astype(prob.dtype)
@@ -113,7 +116,11 @@ def _softmax_output_infer(attrs, in_shapes):
     if ds is None:
         return in_shapes, [None], []
     if attrs["multi_output"]:
-        in_shapes[1] = (ds[0],) + tuple(ds[2:])
+        # reference softmax_output-inl.h: label is (batch, prod(rest))
+        rest = 1
+        for d in ds[2:]:
+            rest *= d
+        in_shapes[1] = (ds[0], rest)
     else:
         in_shapes[1] = (ds[0],)
     return in_shapes, [ds], []

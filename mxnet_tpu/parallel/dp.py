@@ -298,11 +298,14 @@ class DataParallelTrainer:
     def _carry_rng(self):
         """Device-resident PRNG key threaded through the compiled step
         (successor keys come back as a step output — no per-step host
-        split or upload)."""
+        split or upload).  A later mx.random.seed() invalidates the
+        carried key so reseeded runs stay reproducible."""
+        from .. import random as _random
+        gen = _random.generation()
         rng = getattr(self, "_rng_dev", None)
-        if rng is None:
-            from .. import random as _random
+        if rng is None or getattr(self, "_rng_gen", None) != gen:
             rng = self._rng_dev = _random.next_key()
+            self._rng_gen = gen
         return rng
 
     def _host_hyper(self):

@@ -17,6 +17,7 @@ __all__ = ["seed", "next_key", "current_seed"]
 _lock = threading.Lock()
 _seed = [0]
 _key = [jax.random.key(0)]
+_generation = [0]
 
 
 def seed(seed_state):
@@ -24,10 +25,18 @@ def seed(seed_state):
     with _lock:
         _seed[0] = int(seed_state)
         _key[0] = jax.random.key(int(seed_state))
+        # consumers that carry device-resident successor keys (fused
+        # trainers) watch this to know their carried key is stale
+        _generation[0] += 1
 
 
 def current_seed():
     return _seed[0]
+
+
+def generation():
+    """Bumped on every seed(); lets key-carrying consumers re-sync."""
+    return _generation[0]
 
 
 def next_key():

@@ -268,3 +268,44 @@ def test_nce_loss():
     m = re.findall(r"full-vocab nce accuracy ([0-9.]+)",
                    p.stderr + p.stdout)
     assert m and float(m[-1]) > 0.5, (p.stderr + p.stdout)[-500:]
+
+
+def test_neural_style():
+    """Input-image optimization against Gram/content losses (reference
+    example/neural-style): loss must collapse by orders of magnitude."""
+    import re
+    p = _run("examples/neural-style/nstyle.py", "--iters", "80")
+    m = re.findall(r"ratio ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) < 0.01, (p.stderr + p.stdout)[-500:]
+
+
+def test_bayesian_sgld():
+    """SGLD posterior sampling (reference example/bayesian-methods):
+    MC-averaged predictive beats chance decisively."""
+    import re
+    p = _run("examples/bayesian-methods/sgld_mnist.py",
+             "--num-examples", "2048", "--num-epochs", "8",
+             "--burn-in-epochs", "4")
+    m = re.findall(r"mc-averaged acc ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.8, (p.stderr + p.stdout)[-500:]
+
+
+def test_dqn_chain():
+    """DQN with target-network parameter sync (reference
+    example/reinforcement-learning/dqn): returns improve to
+    near-optimal."""
+    import re
+    p = _run("examples/reinforcement-learning/dqn_chain.py",
+             "--episodes", "200", timeout=480)
+    m = re.findall(r"last-50 ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.7, (p.stderr + p.stdout)[-500:]
+
+
+def test_fcn_segmentation():
+    """FCN with Deconvolution+Crop+multi-output softmax (reference
+    example/fcn-xs): high pixel accuracy on blob segmentation."""
+    import re
+    p = _run("examples/fcn-xs/fcn_seg.py",
+             "--num-examples", "256", "--num-epochs", "8", timeout=480)
+    m = re.findall(r"pixel accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.85, (p.stderr + p.stdout)[-500:]

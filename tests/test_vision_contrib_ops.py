@@ -224,3 +224,50 @@ def test_count_sketch():
     for j in range(6):
         ref[:, int(h[j])] += x[:, j] * s_sign[j]
     np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_deconvolution_matches_conv_gradient():
+    """Deconvolution == d(conv)/d(data) for the conv that maps the
+    deconv's output space to its input space with the same weight
+    (reference deconvolution-inl.h defines it as exactly this), across
+    asymmetric channels, groups, and nonzero padding."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import mxnet_tpu as mx
+    cases = [((4, 4), (2, 2), (1, 1), 16, 4, 6, 1),
+             ((3, 3), (1, 1), (1, 1), 8, 4, 8, 1),
+             ((4, 4), (2, 2), (0, 0), 8, 4, 2, 2),
+             ((3, 3), (2, 2), (1, 1), 7, 6, 6, 3)]
+    for (k, s, p, i, cin, nf, g) in cases:
+        d = mx.sym.Variable("data")
+        dc = mx.sym.Deconvolution(d, kernel=k, stride=s, pad=p,
+                                  num_filter=nf, num_group=g,
+                                  no_bias=True)
+        _, osh, _ = dc.infer_shape(data=(2, cin, i, i))
+        expect_sp = (i - 1) * s[0] - 2 * p[0] + k[0]
+        assert osh[0][2] == expect_sp, (osh, expect_sp)
+        ex = dc.simple_bind(mx.cpu(), data=(2, cin, i, i),
+                            grad_req="null")
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, cin, i, i).astype(np.float32)
+        W = rs.randn(cin, nf // g, *k).astype(np.float32)
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict[dc.list_arguments()[1]][:] = W
+        out = ex.forward(is_train=False)[0].asnumpy()
+        assert out.shape == osh[0], (out.shape, osh[0])
+
+        def conv(y):
+            dn = jax.lax.conv_dimension_numbers(
+                y.shape, W.shape, ("NCHW", "OIHW", "NCHW"))
+            return jax.lax.conv_general_dilated(
+                y, jnp.asarray(W), window_strides=s,
+                padding=[(p[0], p[0]), (p[1], p[1])],
+                dimension_numbers=dn, feature_group_count=g)
+
+        _, vjp = jax.vjp(conv, jnp.zeros((2, nf) + out.shape[2:],
+                                         jnp.float32))
+        oracle = np.asarray(vjp(jnp.asarray(x))[0])
+        err = np.abs(out - oracle).max() / max(1e-6,
+                                               np.abs(oracle).max())
+        assert err < 1e-5, (k, s, p, cin, nf, g, err)
