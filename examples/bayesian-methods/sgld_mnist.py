@@ -41,6 +41,10 @@ def main():
     parser.add_argument("--burn-in-epochs", type=int, default=5)
     parser.add_argument("--lr", type=float, default=0.05)
     args = parser.parse_args()
+    if args.burn_in_epochs >= args.num_epochs:
+        parser.error("--burn-in-epochs (%d) must be < --num-epochs (%d) "
+                     "or no posterior samples are collected"
+                     % (args.burn_in_epochs, args.num_epochs))
     logging.basicConfig(level=logging.INFO)
 
     rs = np.random.RandomState(21)

@@ -1,0 +1,83 @@
+"""Custom python loss via PythonLossModule (reference example/module/
+python_loss.py: network Module chained with a PythonLossModule whose
+gradient function is written in numpy — here, the softmax-cross-entropy
+gradient — trained through a SequentialModule).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def softmax_ce_grad(scores, labels):
+    """d(CE(softmax(scores)))/d(scores) in numpy."""
+    s = scores.asnumpy()
+    lbl = labels.asnumpy().astype(np.int32)
+    e = np.exp(s - s.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    p[np.arange(len(lbl)), lbl] -= 1.0
+    return p / len(lbl)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="python loss demo")
+    parser.add_argument("--num-examples", type=int, default=4096)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(8)
+    dim, num_classes = 32, 10
+    centers = rs.randn(num_classes, dim).astype(np.float32) * 2
+    y = rs.randint(0, num_classes, args.num_examples)
+    X = (centers[y] + 0.6 * rs.randn(args.num_examples, dim)).astype(
+        np.float32)
+    y = y.astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                              shuffle=True,
+                              label_name="softmax_label")
+
+    data = mx.sym.Variable("data")
+    scores = mx.sym.FullyConnected(
+        mx.sym.Activation(
+            mx.sym.FullyConnected(data, num_hidden=64, name="fc1"),
+            act_type="relu"),
+        num_hidden=num_classes, name="fc2")
+
+    net_mod = mx.Module(scores, context=mx.current_context(),
+                        label_names=[])
+    loss_mod = mx.module.PythonLossModule(grad_func=softmax_ce_grad)
+    seq = mx.module.SequentialModule()
+    seq.add(net_mod).add(loss_mod, take_labels=True, auto_wiring=True)
+
+    seq.fit(train, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier(),
+            eval_metric=mx.metric.np(
+                lambda l, p: float((p.argmax(axis=1) == l).mean())),
+            kvstore="local")
+
+    # score by hand: the loss module's outputs are raw scores
+    train.reset()
+    correct = total = 0
+    for batch in train:
+        seq.forward(batch, is_train=False)
+        out = seq.get_outputs()[0].asnumpy()
+        lbl = batch.label[0].asnumpy()
+        correct += (out.argmax(axis=1) == lbl).sum()
+        total += len(lbl)
+    print("python-loss training accuracy %.4f" % (correct / total))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""SequentialModule chaining (reference example/module/
+sequential_module.py: a net split into two Modules chained by a
+SequentialModule, trained end-to-end — gradients flow across the module
+boundary via take_labels/auto_wiring).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(description="sequential module demo")
+    parser.add_argument("--num-examples", type=int, default=4096)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(6)
+    dim, num_classes = 32, 10
+    centers = rs.randn(num_classes, dim).astype(np.float32) * 2
+    y = rs.randint(0, num_classes, args.num_examples)
+    X = (centers[y] + 0.6 * rs.randn(args.num_examples, dim)).astype(
+        np.float32)
+    y = y.astype(np.float32)
+    n_train = int(0.8 * args.num_examples)
+    train = mx.io.NDArrayIter(X[:n_train], y[:n_train],
+                              batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(X[n_train:], y[n_train:],
+                            batch_size=args.batch_size)
+
+    # stage 1: trunk ending in an activation; stage 2: head with loss
+    data = mx.sym.Variable("data")
+    trunk = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=64, name="fc1"),
+        act_type="relu", name="trunk_out")
+    head_in = mx.sym.Variable("trunk_out_output")
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(head_in, num_hidden=num_classes,
+                              name="fc2"), name="softmax")
+
+    mod1 = mx.Module(trunk, context=mx.current_context(),
+                     label_names=[])
+    mod2 = mx.Module(head, context=mx.current_context(),
+                     data_names=("trunk_out_output",))
+    seq = mx.module.SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+
+    seq.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="acc", kvstore="local")
+    acc = dict(seq.score(val, mx.metric.Accuracy()))["accuracy"]
+    print("sequential-module acc %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

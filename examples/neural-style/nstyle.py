@@ -105,9 +105,7 @@ def main():
         grams = [o.asnumpy() for o in outs[len(taps):]]
         return content, grams
 
-    ex.arg_dict["data"][:] = content_img
     content_target, _ = extract_targets(content_img)
-    ex.arg_dict["data"][:] = style_img
     _, style_targets = extract_targets(style_img)
 
     ex.arg_dict["content_target"][:] = content_target

@@ -1,0 +1,131 @@
+"""Stochastic-depth residual training (reference
+example/stochastic-depth/{sd_mnist.py,sd_module.py}: residual blocks are
+randomly dropped during training with a per-block death rate, and scaled
+by survival probability at inference).
+
+The gate is a CustomOp: at train time it multiplies the residual branch
+by a Bernoulli(survival) draw shared across the batch; at inference it
+scales by the survival probability (the reference's expectation rule).
+Exercises CustomOp randomness + train/eval behavioral divergence inside
+one symbol.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class StochasticGate(mx.operator.CustomOp):
+    def __init__(self, survival):
+        super().__init__()
+        self.survival = float(survival)
+        self._rs = np.random.RandomState()
+        self._last_gate = 1.0
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        if is_train:
+            self._last_gate = float(self._rs.rand() < self.survival)
+        else:
+            self._last_gate = self.survival
+        self.assign(out_data[0], req[0],
+                    in_data[0].asnumpy() * self._last_gate)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    out_grad[0].asnumpy() * self._last_gate)
+
+
+@mx.operator.register("stochastic_gate")
+class StochasticGateProp(mx.operator.CustomOpProp):
+    def __init__(self, survival="0.8"):
+        super().__init__(need_top_grad=True)
+        self.survival = survival
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0]], [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return StochasticGate(self.survival)
+
+
+def res_block(net, num_filter, survival, name):
+    branch = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                                num_filter=num_filter,
+                                name="%s_conv" % name)
+    branch = mx.sym.Activation(branch, act_type="relu")
+    gated = mx.sym.Custom(branch, op_type="stochastic_gate",
+                          survival=str(survival), name="%s_gate" % name)
+    return net + gated
+
+
+def sd_net(num_classes, num_blocks, death_rate):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(mx.sym.Convolution(
+        data, kernel=(3, 3), pad=(1, 1), num_filter=16, name="stem"),
+        act_type="relu")
+    for b in range(num_blocks):
+        # linearly-decayed survival (reference sd_cifar10.py rule)
+        survival = 1.0 - death_rate * (b + 1) / num_blocks
+        net = res_block(net, 16, survival, "block%d" % b)
+    net = mx.sym.Pooling(net, global_pool=True, kernel=(1, 1),
+                         pool_type="avg")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net),
+                                num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def make_digits(rs, n, num_classes=10, side=12):
+    y = rs.randint(0, num_classes, n)
+    X = rs.rand(n, 1, side, side).astype(np.float32) * 0.2
+    cell = side // 4
+    for i, k in enumerate(y):
+        r, c = divmod(int(k), 4)
+        X[i, 0, r * cell:(r + 1) * cell, c * cell:(c + 1) * cell] += 0.8
+    return X, y.astype(np.float32)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="stochastic depth")
+    parser.add_argument("--num-examples", type=int, default=4096)
+    parser.add_argument("--num-blocks", type=int, default=4)
+    parser.add_argument("--death-rate", type=float, default=0.3)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=12)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(13)
+    X, y = make_digits(rs, args.num_examples)
+    n_train = int(0.8 * args.num_examples)
+    train = mx.io.NDArrayIter(X[:n_train], y[:n_train],
+                              batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(X[n_train:], y[n_train:],
+                            batch_size=args.batch_size)
+
+    net = sd_net(10, args.num_blocks, args.death_rate)
+    mod = mx.Module(net, context=mx.current_context())
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.initializer.Xavier(magnitude=2.0),
+            eval_metric="accuracy", kvstore="local")
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    print("stochastic-depth val accuracy %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

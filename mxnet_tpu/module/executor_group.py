@@ -125,11 +125,19 @@ class DataParallelExecutorGroup:
             islice = self.slices[i]
             n_i = islice.stop - islice.start
             shapes = {}
+            # only inputs batched along the data batch axis are sliced
+            # across devices; inputs with an unrelated leading dim (e.g.
+            # rcnn's (R,5) rois alongside (B,...) images) are replicated
+            # whole on every exec
             for d in data_shapes:
-                shapes[d.name] = (n_i,) + tuple(d.shape[1:])
+                shapes[d.name] = ((n_i,) + tuple(d.shape[1:])
+                                  if d.shape[0] == batch_size
+                                  else tuple(d.shape))
             if label_shapes:
                 for l in label_shapes:
-                    shapes[l.name] = (n_i,) + tuple(l.shape[1:])
+                    shapes[l.name] = ((n_i,) + tuple(l.shape[1:])
+                                      if l.shape[0] == batch_size
+                                      else tuple(l.shape))
             ex = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
                                          **shapes)
             if shared_group is not None and i < len(shared_group.execs):
@@ -157,12 +165,20 @@ class DataParallelExecutorGroup:
         self._make_arrays()
 
     def _make_arrays(self):
+        def _in_slices(descs, name):
+            # non-batch inputs (leading dim != batch_size) load whole
+            shape0 = {d.name: d.shape[0] for d in descs}[name]
+            if shape0 == self.batch_size:
+                return self.slices
+            return [slice(0, shape0)] * len(self.execs)
+
         self.data_arrays = [
-            [(self.slices[i], e.arg_dict[name])
+            [(_in_slices(self.data_shapes, name)[i], e.arg_dict[name])
              for i, e in enumerate(self.execs)]
             for name in self.data_names if name in self.arg_names]
         self.label_arrays = [
-            [(self.slices[i], e.arg_dict[name])
+            [(_in_slices(self.label_shapes or [], name)[i],
+              e.arg_dict[name])
              for i, e in enumerate(self.execs)]
             for name in self.label_names if name in self.arg_names]
         self.param_arrays = [

@@ -493,6 +493,18 @@ class Module(BaseModule):
             return None
         if len(set(devices)) != len(devices):
             return None
+        if any(not n.is_variable and n.op.name == "Custom"
+               for n in self._symbol._nodes()):
+            # CustomOp callbacks inside the single fused program deadlock
+            # the runtime (callback blocks materializing an input while
+            # the program holds the execution stream — observed
+            # deterministically on XLA:CPU).  The executor-group path
+            # keeps callbacks in separate smaller programs, which is also
+            # how the reference serializes custom ops (custom-inl.h
+            # worker thread).
+            self.logger.info("graph contains Custom ops; using executor "
+                             "group instead of the fused fast path")
+            return None
         data_shapes = {d.name: tuple(d.shape) for d in self._data_shapes}
         label_shapes = {d.name: tuple(d.shape)
                         for d in (self._label_shapes or [])}

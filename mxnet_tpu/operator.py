@@ -14,7 +14,11 @@ Usage (identical to the reference)::
 
     class Sigmoid(mx.operator.CustomOp):
         def forward(self, is_train, req, in_data, out_data, aux):
-            self.assign(out_data[0], req[0], 1 / (1 + mx.nd.exp(-in_data[0])))
+            # callback data is host-resident: direct arithmetic on the
+            # handles and numpy math both work; device (mx.nd.*) module
+            # functions should NOT be called inside a callback
+            self.assign(out_data[0], req[0],
+                        1 / (1 + np.exp(-in_data[0].asnumpy())))
         def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
             y = out_data[0]
             self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
@@ -66,6 +70,12 @@ class CustomOp:
 
 
 def _like(src, dst):
+    import numpy as _np
+    if isinstance(getattr(dst, "_data", None), _np.ndarray):
+        # host-backed callback array (ops/custom.py _HostArray): stay in
+        # numpy — a jnp op here would dispatch to the device from inside
+        # a pure_callback, which can deadlock the runtime
+        return _np.asarray(src, dtype=dst.dtype).reshape(dst.shape)
     import jax.numpy as jnp
     return jnp.asarray(src, dtype=dst.dtype).reshape(dst.shape)
 

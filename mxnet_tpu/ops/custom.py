@@ -18,9 +18,128 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import io_callback as _io_callback
 
 from ..base import MXNetError
 from .registry import Str, register
+
+
+class _HostArray:
+    """Host-backed NDArray stand-in handed to CustomOp callbacks.
+
+    ``pure_callback`` runs while the compiled program is executing:
+    creating device arrays or calling ``device_get`` from inside the
+    callback can deadlock the runtime (observed intermittently on the
+    CPU backend).  Callback data therefore stays numpy end-to-end; the
+    surface covers what CustomOp bodies use (``asnumpy``, ``assign``
+    via ``_data``, shape/dtype, indexing)."""
+
+    __slots__ = ("_data",)
+
+    def __init__(self, a):
+        # private writable copy: jax hands read-only views of runtime
+        # buffers into callbacks, and the old NDArray contract allowed
+        # both in-place aux mutation and mutating asnumpy() results
+        self._data = np.array(a)
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return self._data.size
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def asnumpy(self):
+        return self._data
+
+    def copy(self):
+        return _HostArray(self._data.copy())
+
+    def astype(self, dtype):
+        return _HostArray(self._data.astype(dtype))
+
+    def __array__(self, dtype=None):
+        return self._data if dtype is None else \
+            self._data.astype(dtype)
+
+    def __getitem__(self, key):
+        return self._data[key]
+
+    def __setitem__(self, key, value):
+        self._data[key] = value.asnumpy() if hasattr(value, "asnumpy") \
+            else value
+
+    def __len__(self):
+        return len(self._data)
+
+    def __repr__(self):
+        return "_HostArray(%r)" % (self._data,)
+
+    # numpy-backed arithmetic so CustomOp bodies that do math directly on
+    # the handles (the reference's NDArray style) keep working — all host
+    # ops, never a device dispatch
+    def _bin(self, other, fn):
+        o = other._data if isinstance(other, _HostArray) else other
+        return _HostArray(fn(self._data, o))
+
+    def __neg__(self):
+        return _HostArray(-self._data)
+
+    def __abs__(self):
+        return _HostArray(np.abs(self._data))
+
+    def __add__(self, o):
+        return self._bin(o, np.add)
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin(o, np.subtract)
+
+    def __rsub__(self, o):
+        return self._bin(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._bin(o, np.multiply)
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin(o, np.divide)
+
+    def __rtruediv__(self, o):
+        return self._bin(o, lambda a, b: b / a)
+
+    def __pow__(self, o):
+        return self._bin(o, np.power)
+
+    def __eq__(self, o):
+        return self._bin(o, np.equal)
+
+    def __ne__(self, o):
+        return self._bin(o, np.not_equal)
+
+    def __lt__(self, o):
+        return self._bin(o, np.less)
+
+    def __le__(self, o):
+        return self._bin(o, np.less_equal)
+
+    def __gt__(self, o):
+        return self._bin(o, np.greater)
+
+    def __ge__(self, o):
+        return self._bin(o, np.greater_equal)
+
+    def __hash__(self):
+        return id(self)
 
 
 def _prop_for(attrs):
@@ -105,12 +224,12 @@ def _custom_fstateful(attrs, inputs, aux, is_train, rng):
         for s, t in zip(in_shapes, in_types))
 
     def _wrap(arrs):
-        return [NDArray(jnp.asarray(a)) for a in arrs]
+        return [_HostArray(a) for a in arrs]
 
     def _fwd_cb(*flat):
         in_nd = _wrap(flat[:n_in])
         aux_nd = _wrap(flat[n_in:])
-        out_nd = [NDArray(jnp.zeros(s, dtype=t))
+        out_nd = [_HostArray(np.zeros(s, dtype=t))
                   for s, t in zip(out_shapes, out_types)]
         op_inst.forward(is_train=is_train, req=["write"] * n_out,
                         in_data=in_nd, out_data=out_nd, aux=aux_nd)
@@ -125,7 +244,7 @@ def _custom_fstateful(attrs, inputs, aux, is_train, rng):
         in_nd = _wrap(flat[n_out:n_out + n_in])
         out_nd = _wrap(flat[n_out + n_in:n_out + n_in + n_out])
         aux_nd = _wrap(flat[n_out + n_in + n_out:])
-        ig = [NDArray(jnp.zeros(s, dtype=t))
+        ig = [_HostArray(np.zeros(s, dtype=t))
               for s, t in zip(in_shapes, in_types)]
         op_inst.backward(req=["write"] * n_in, out_grad=og, in_data=in_nd,
                          out_data=out_nd, in_grad=ig, aux=aux_nd)
@@ -134,7 +253,13 @@ def _custom_fstateful(attrs, inputs, aux, is_train, rng):
 
     @jax.custom_vjp
     def run(ins, auxs):
-        res = jax.pure_callback(_fwd_cb, fwd_result_spec, *ins, *auxs)
+        # io_callback(ordered=True): CustomOp bodies are stateful python
+        # (the reference runs them on a serialized worker thread,
+        # custom-inl.h) and concurrent pure_callback execution has been
+        # observed to deadlock materializing callback inputs; ordering
+        # serializes host work exactly like the reference's op thread
+        res = _io_callback(_fwd_cb, fwd_result_spec, *ins, *auxs,
+                           ordered=True)
         return tuple(res)
 
     def run_fwd(ins, auxs):
@@ -146,8 +271,8 @@ def _custom_fstateful(attrs, inputs, aux, is_train, rng):
     def run_bwd(resid, cot):
         ins, outs, auxs = resid
         ograds = cot[:n_out]
-        igrads = jax.pure_callback(_bwd_cb, bwd_result_spec,
-                                   *ograds, *ins, *outs, *auxs)
+        igrads = _io_callback(_bwd_cb, bwd_result_spec,
+                              *ograds, *ins, *outs, *auxs, ordered=True)
         d_aux = tuple(jnp.zeros(s, dtype=t)
                       for s, t in zip(aux_shapes, aux_types))
         return tuple(igrads), d_aux

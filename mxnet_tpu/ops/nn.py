@@ -213,24 +213,23 @@ def _deconv_fcompute(attrs, data, weight, bias=None):
                                              pad, kernel))
     spatial = "DHW"[-n:]
     flip = (slice(None), slice(None)) + (slice(None, None, -1),) * n
-
-    def one(x, w):
-        dn = jax.lax.conv_dimension_numbers(
-            x.shape, w.shape,
-            ("NC" + spatial, "IO" + spatial, "NC" + spatial))
-        return jax.lax.conv_general_dilated(
-            x, w[flip], window_strides=(1,) * n,
-            padding=[(k - 1 - p, k - 1 - p + a)
-                     for k, p, a in zip(kernel, pad, adj)],
-            lhs_dilation=stride, dimension_numbers=dn)
-
-    if g == 1:
-        out = one(data, weight)
-    else:
-        xs = jnp.split(data, g, axis=1)
-        ws = jnp.split(weight, g, axis=0)
-        out = jnp.concatenate([one(x, w) for x, w in zip(xs, ws)],
-                              axis=1)
+    w = weight
+    if g > 1:
+        # (cin, nf/g, k...) -> (cin/g, nf, k...): feature_group_count
+        # expects the rhs input dim divided by g with per-group output
+        # blocks laid out consecutively along O
+        cin, nfg = w.shape[0], w.shape[1]
+        w = jnp.moveaxis(w.reshape((g, cin // g, nfg) + kernel), 0, 1) \
+            .reshape((cin // g, g * nfg) + kernel)
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, w.shape,
+        ("NC" + spatial, "IO" + spatial, "NC" + spatial))
+    out = jax.lax.conv_general_dilated(
+        data, w[flip], window_strides=(1,) * n,
+        padding=[(k - 1 - p, k - 1 - p + a)
+                 for k, p, a in zip(kernel, pad, adj)],
+        lhs_dilation=stride, dimension_numbers=dn,
+        feature_group_count=g)
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out

@@ -263,7 +263,14 @@ class DataParallelTrainer:
             outs, _ = trace(args, _cast(aux), rng, False)
             return outs
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # pure_callback (Custom op) + donated buffers deadlock: the
+        # callback can block forever materializing an input whose buffer
+        # was donated to the next step already in flight.  Trade the
+        # in-place param update for correctness only when callbacks exist.
+        has_callback = any(not n.is_variable and n.op.name == "Custom"
+                           for n in nodes)
+        donate = () if has_callback else (0, 1, 2)
+        self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._predict_step = jax.jit(predict_step)
 
     # ------------------------------------------------------------------

@@ -309,3 +309,37 @@ def test_fcn_segmentation():
              "--num-examples", "256", "--num-epochs", "8", timeout=480)
     m = re.findall(r"pixel accuracy ([0-9.]+)", p.stderr + p.stdout)
     assert m and float(m[-1]) > 0.85, (p.stderr + p.stdout)[-500:]
+
+
+def test_stochastic_depth():
+    """Randomly-dropped residual blocks via a stateful CustomOp
+    (reference example/stochastic-depth); also guards the
+    callbacks-in-fused-program deadlock regression."""
+    import re
+    p = _run("examples/stochastic-depth/sd_mnist.py",
+             "--num-examples", "2048", "--num-epochs", "10",
+             "--death-rate", "0.3", timeout=480)
+    m = re.findall(r"val accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.6, (p.stderr + p.stdout)[-500:]
+
+
+def test_module_api_demos():
+    """Reference example/module family: manual loop + checkpoint,
+    SequentialModule chaining, PythonLossModule numpy gradient."""
+    import re
+    p = _run("examples/module/mnist_mlp.py", "--num-epochs", "4",
+             "--num-examples", "2048")
+    m = re.findall(r"manual-loop acc ([0-9.]+) reloaded acc ([0-9.]+) "
+                   r"fit acc ([0-9.]+)", p.stderr + p.stdout)
+    assert m, (p.stderr + p.stdout)[-500:]
+    assert all(float(v) > 0.9 for v in m[-1]), m
+    assert m[-1][0] == m[-1][1], m  # checkpoint roundtrip exactness
+    p = _run("examples/module/sequential_module.py", "--num-epochs", "4",
+             "--num-examples", "2048")
+    m = re.findall(r"sequential-module acc ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
+    p = _run("examples/module/python_loss.py", "--num-epochs", "4",
+             "--num-examples", "2048")
+    m = re.findall(r"python-loss training accuracy ([0-9.]+)",
+                   p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
