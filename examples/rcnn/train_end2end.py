@@ -247,10 +247,12 @@ class SceneIter(mx.io.DataIter):
         self.cur = 0
         self.provide_data = [
             mx.io.DataDesc("data", (batch_size, 3, size, size)),
-            mx.io.DataDesc("rois", (batch_size * rois_per_image, 5))]
+            mx.io.DataDesc("rois", (batch_size * rois_per_image, 5),
+                           layout="")]  # roi-level, not batch-sliced
         self.provide_label = [
             mx.io.DataDesc("gt_boxes", (batch_size, 2, 5)),
-            mx.io.DataDesc("roi_label", (batch_size * rois_per_image,))]
+            mx.io.DataDesc("roi_label", (batch_size * rois_per_image,),
+                           layout="")]
 
     def reset(self):
         self.cur = 0

@@ -17,7 +17,6 @@ reference's engine-level compute/comm overlap).
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -29,11 +28,6 @@ from .base import MXNetError, get_env
 from .ndarray import NDArray
 
 __all__ = ["Executor"]
-
-
-def _apply_pure(node, attrs, *xs):
-    """Stateless op application (rematerialization-eligible)."""
-    return node.op.apply(attrs, xs, (), False, None)[0]
 
 
 def shape_overrides(symbol, known_shapes):
@@ -161,23 +155,20 @@ class Executor:
         (outputs, aux_updates).  ``idx`` is the node's global topo index —
         the RNG fold key, so staged and single-program execution produce
         identical randomness."""
-        remat = get_env("MXNET_BACKWARD_DO_MIRROR")
         ins = [vals[(id(n), oi)] for n, oi in node.arg_inputs()]
         aux_in = tuple(vals[(id(n), oi)] for n, oi in node.aux_inputs())
         need_rng = node.op.needs_rng or node.op.stateful
         r = jax.random.fold_in(rng, idx) if (need_rng and
                                              rng is not None) else None
         attrs = self._attr_overrides.get(id(node), node.attrs)
-        if remat and not node.op.stateful and not node.op.needs_rng:
-            outs = jax.checkpoint(
-                functools.partial(_apply_pure, node, attrs))(*ins)
-            upd = ()
-        else:
-            outs, upd = node.op.apply(attrs, ins, aux_in, is_train, r)
+        outs, upd = node.op.apply(attrs, ins, aux_in, is_train, r)
         return outs, upd
 
     def _trace(self, arg_vals, aux_vals, is_train, rng, tap=None):
         """Pure traced evaluation of the DAG."""
+        if is_train and tap is None and \
+                get_env("MXNET_BACKWARD_DO_MIRROR"):
+            return self._trace_remat(arg_vals, aux_vals, rng)
         vals = {}
         new_aux = list(aux_vals)
         for idx, node in enumerate(self._nodes):
@@ -195,6 +186,129 @@ class Executor:
                 tap(node, outs)
         outputs = tuple(vals[k] for k in self._head)
         return outputs, tuple(new_aux)
+
+    def _trace_remat(self, arg_vals, aux_vals, rng):
+        """Mirroring (memonger): evaluate the DAG in ~sqrt(N)-op segments,
+        each wrapped in ``jax.checkpoint``, so backward stores only
+        segment-boundary values and recomputes segment interiors.
+
+        The reference marks cheap nodes for recompute in backward
+        (graph_executor.cc:210-223, MXNET_BACKWARD_DO_MIRROR); on TPU the
+        equivalent memory/compute trade is sqrt-chunked rematerialization
+        — XLA frees interior activations and the backward pass replays
+        each chunk from its inputs (params are residuals either way)."""
+        import math
+        nodes = self._nodes
+        op_count = sum(1 for n in nodes if not n.is_variable)
+        seg = int(get_env("MXNET_MIRROR_SEGMENT") or 0) or \
+            max(1, int(math.ceil(math.sqrt(op_count))))
+        chunks = []
+        cur, n_ops = [], 0
+        for i, node in enumerate(nodes):
+            cur.append(i)
+            if not node.is_variable:
+                n_ops += 1
+                if n_ops >= seg:
+                    chunks.append(cur)
+                    cur, n_ops = [], 0
+        if cur:
+            chunks.append(cur)
+
+        id2idx = {id(n): i for i, n in enumerate(nodes)}
+        chunk_of = {}
+        for k, c in enumerate(chunks):
+            for i in c:
+                chunk_of[i] = k
+
+        def in_keys(node):
+            return [(id(s), oi) for s, oi in node.arg_inputs()] + \
+                   [(id(s), oi) for s, oi in node.aux_inputs()]
+
+        # keys crossing a chunk boundary (variable-produced keys are
+        # re-resolved from args/aux inside each chunk instead)
+        consumers = {}
+        for i, node in enumerate(nodes):
+            if node.is_variable:
+                continue
+            for key in in_keys(node):
+                consumers.setdefault(key, set()).add(chunk_of[i])
+        for key in self._head:
+            consumers.setdefault(key, set()).add(len(chunks))
+        chunk_out = [[] for _ in chunks]
+        chunk_in = [[] for _ in chunks]
+        for key in sorted(consumers, key=lambda k: (id2idx[k[0]], k[1])):
+            src = nodes[id2idx[key[0]]]
+            if src.is_variable:
+                continue
+            pc = chunk_of[id2idx[key[0]]]
+            later = [c for c in consumers[key] if c > pc]
+            if later:
+                chunk_out[pc].append(key)
+                for c in later:
+                    if c < len(chunks):
+                        chunk_in[c].append(key)
+
+        # aux indices each chunk's stateful nodes update, in eval order
+        chunk_aux = [[self._var_map[id(an)][1]
+                      for i in c if not nodes[i].is_variable
+                      for (an, _) in nodes[i].aux_inputs()]
+                     for c in chunks]
+
+        def make_chunk(k):
+            c = chunks[k]
+            ins_list = tuple(chunk_in[k])
+            outs_list = tuple(chunk_out[k])
+            # host-callback (Custom) effects are not legal inside
+            # jax.checkpoint's partial-eval (and replaying a stateful
+            # callback in backward would be wrong anyway): such chunks
+            # run un-checkpointed — their boundaries are stored like the
+            # plain path.  Dropout/BatchNorm are fine: the rng operand
+            # and aux-update returns make the replay bit-identical.
+            has_callback = any(not nodes[i].is_variable and
+                               nodes[i].op.name == "Custom"
+                               for i in c)
+
+            def fn(in_vals, args_t, aux_t, rng):
+                vals = dict(zip(ins_list, in_vals))
+                upds = []
+                for i in c:
+                    node = nodes[i]
+                    if node.is_variable:
+                        kind, j = self._var_map[id(node)]
+                        vals[(id(node), 0)] = (args_t[j] if kind == "arg"
+                                               else aux_t[j])
+                        continue
+                    for key in in_keys(node):
+                        if key not in vals:
+                            kind, j = self._var_map[key[0]]
+                            vals[key] = (args_t[j] if kind == "arg"
+                                         else aux_t[j])
+                    outs, upd = self._eval_node(node, i, vals, True, rng)
+                    for oi, o in enumerate(outs):
+                        vals[(id(node), oi)] = o
+                    upds.extend(upd)
+                return (tuple(vals[key] for key in outs_list),
+                        tuple(upds))
+            return fn if has_callback else jax.checkpoint(fn)
+
+        live = {}
+        new_aux = list(aux_vals)
+        for k in range(len(chunks)):
+            in_vals = tuple(live[key] for key in chunk_in[k])
+            outs, upds = make_chunk(k)(in_vals, tuple(arg_vals),
+                                       tuple(aux_vals), rng)
+            for key, v in zip(chunk_out[k], outs):
+                live[key] = v
+            for j, u in zip(chunk_aux[k], upds):
+                new_aux[j] = u
+
+        def head_val(key):
+            if key in live:
+                return live[key]
+            kind, j = self._var_map[key[0]]
+            return arg_vals[j] if kind == "arg" else aux_vals[j]
+
+        return (tuple(head_val(k) for k in self._head), tuple(new_aux))
 
     # -- ctx_group staged execution ------------------------------------
     def _build_stage_plan(self):

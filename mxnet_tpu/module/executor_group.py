@@ -50,6 +50,19 @@ def _split_input_slice(batch_size, work_load_list):
     return slices
 
 
+def _batched0(desc, batch_size):
+    """Is this input batched along axis 0 with the group batch size?
+
+    A desc whose layout carries no 'N' (e.g. layout="") is explicitly
+    non-batch; a leading dim differing from the batch size (rcnn's (R,5)
+    rois next to (B,...) images) is treated the same.  Both replicate
+    whole instead of slicing."""
+    from ..io.io import DataDesc
+    axis = DataDesc.get_batch_axis(getattr(desc, "layout", None))
+    shape = desc.shape if hasattr(desc, "shape") else desc[1]
+    return axis == 0 and len(shape) > 0 and shape[0] == batch_size
+
+
 def _load_general(data, targets):
     """Copy list-of-batch-arrays into per-exec target arrays
     (reference executor_group.py:14-50).
@@ -125,18 +138,17 @@ class DataParallelExecutorGroup:
             islice = self.slices[i]
             n_i = islice.stop - islice.start
             shapes = {}
-            # only inputs batched along the data batch axis are sliced
-            # across devices; inputs with an unrelated leading dim (e.g.
-            # rcnn's (R,5) rois alongside (B,...) images) are replicated
-            # whole on every exec
+            # only inputs batched along axis 0 with the data batch size
+            # are sliced across devices; others (rcnn's (R,5) rois, descs
+            # whose layout has no 'N') are replicated whole on every exec
             for d in data_shapes:
                 shapes[d.name] = ((n_i,) + tuple(d.shape[1:])
-                                  if d.shape[0] == batch_size
+                                  if _batched0(d, batch_size)
                                   else tuple(d.shape))
             if label_shapes:
                 for l in label_shapes:
                     shapes[l.name] = ((n_i,) + tuple(l.shape[1:])
-                                      if l.shape[0] == batch_size
+                                      if _batched0(l, batch_size)
                                       else tuple(l.shape))
             ex = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
                                          **shapes)
@@ -166,11 +178,11 @@ class DataParallelExecutorGroup:
 
     def _make_arrays(self):
         def _in_slices(descs, name):
-            # non-batch inputs (leading dim != batch_size) load whole
-            shape0 = {d.name: d.shape[0] for d in descs}[name]
-            if shape0 == self.batch_size:
+            # non-batch inputs load whole on every exec
+            desc = {d.name: d for d in descs}[name]
+            if _batched0(desc, self.batch_size):
                 return self.slices
-            return [slice(0, shape0)] * len(self.execs)
+            return [slice(0, desc.shape[0])] * len(self.execs)
 
         self.data_arrays = [
             [(_in_slices(self.data_shapes, name)[i], e.arg_dict[name])

@@ -493,8 +493,7 @@ class Module(BaseModule):
             return None
         if len(set(devices)) != len(devices):
             return None
-        if any(not n.is_variable and n.op.name == "Custom"
-               for n in self._symbol._nodes()):
+        if self._symbol.has_custom_ops():
             # CustomOp callbacks inside the single fused program deadlock
             # the runtime (callback blocks materializing an input while
             # the program holds the execution stream — observed

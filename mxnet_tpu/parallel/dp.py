@@ -267,9 +267,7 @@ class DataParallelTrainer:
         # callback can block forever materializing an input whose buffer
         # was donated to the next step already in flight.  Trade the
         # in-place param update for correctness only when callbacks exist.
-        has_callback = any(not n.is_variable and n.op.name == "Custom"
-                           for n in nodes)
-        donate = () if has_callback else (0, 1, 2)
+        donate = () if symbol.has_custom_ops() else (0, 1, 2)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._predict_step = jax.jit(predict_step)
 

@@ -103,6 +103,15 @@ class Symbol:
     def _nodes(self):
         return _topo_sort([n for n, _ in self._outputs])
 
+    def has_custom_ops(self):
+        """True when the graph contains host-callback ops (``Custom``).
+
+        Callback ops constrain execution strategy: they cannot live
+        inside donated-buffer fused programs or ``jax.checkpoint``
+        regions (module.py fuse gate, executor remat chunks)."""
+        return any(not n.is_variable and n.op.name == "Custom"
+                   for n in self._nodes())
+
     def list_arguments(self):
         args = []
         for node in self._nodes():

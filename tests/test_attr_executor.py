@@ -298,3 +298,109 @@ def test_ctx_group_segments_bounded_by_groups():
     assert n_seg <= 4, "expected <= devices+1 segments, got %d" % n_seg
     np.testing.assert_allclose(out_m, out_s, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(g_m, g_s, rtol=1e-4, atol=1e-5)
+
+
+def test_mirror_remat_parity(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR routes training through sqrt-chunked
+    jax.checkpoint segments; outputs, gradients, and aux updates must be
+    identical to the plain path (reference graph_executor.cc:210-223)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import test_utils
+
+    data = mx.sym.Variable("data")
+    net = data
+    for i in range(4):
+        net = mx.sym.Activation(
+            mx.sym.FullyConnected(net, num_hidden=16,
+                                  name="fc%d" % i), act_type="relu")
+        net = mx.sym.BatchNorm(net, name="bn%d" % i)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=4, name="head"),
+        name="softmax")
+
+    def run(mirror):
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR",
+                           "1" if mirror else "0")
+        mx.random.seed(7)
+        ex = net.simple_bind(mx.cpu(), data=(8, 12),
+                             softmax_label=(8,), grad_req="write")
+        rs = np.random.RandomState(0)
+        for name, arr in sorted(ex.arg_dict.items()):
+            if name not in ("data", "softmax_label"):
+                arr[:] = rs.randn(*arr.shape).astype(np.float32) * 0.3
+        ex.arg_dict["data"][:] = rs.randn(8, 12).astype(np.float32)
+        ex.arg_dict["softmax_label"][:] = rs.randint(0, 4, 8)
+        outs = ex.forward(is_train=True)
+        ex.backward()
+        return ([o.asnumpy().copy() for o in outs],
+                {k: v.asnumpy().copy() for k, v in ex.grad_dict.items()
+                 if v is not None},
+                {k: v.asnumpy().copy() for k, v in ex.aux_dict.items()})
+
+    outs_p, grads_p, aux_p = run(False)
+    outs_m, grads_m, aux_m = run(True)
+    for a, b in zip(outs_p, outs_m):
+        test_utils.assert_almost_equal(a, b, rtol=1e-5, atol=1e-6)
+    assert set(grads_p) == set(grads_m)
+    for k in grads_p:
+        test_utils.assert_almost_equal(grads_p[k], grads_m[k],
+                                       rtol=1e-5, atol=1e-6)
+    for k in aux_p:
+        test_utils.assert_almost_equal(aux_p[k], aux_m[k],
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_mirror_remat_with_custom_op(monkeypatch):
+    """Chunks containing host-callback (Custom) ops are exempt from
+    jax.checkpoint under mirroring — the effect is illegal in remat
+    partial-eval and a replayed stateful callback would be wrong."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    class Twice(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * 2.0)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            self.assign(in_grad[0], req[0], out_grad[0] * 2.0)
+
+    @mx.operator.register("mirror_twice_op")
+    class TwiceProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0]], [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return Twice()
+
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(
+        mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=8,
+                                                name="fc1"),
+                          act_type="relu"),
+        op_type="mirror_twice_op")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=3, name="fc2"),
+        name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,),
+                         grad_req="write")
+    rs = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = rs.randn(4, 6)
+    ex.arg_dict["fc1_weight"][:] = rs.randn(8, 6) * 0.3
+    ex.arg_dict["fc2_weight"][:] = rs.randn(3, 8) * 0.3
+    ex.arg_dict["softmax_label"][:] = [0, 1, 2, 0]
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["fc1_weight"].asnumpy()
+    assert np.abs(g).sum() > 0

@@ -343,3 +343,28 @@ def test_module_api_demos():
     m = re.findall(r"python-loss training accuracy ([0-9.]+)",
                    p.stderr + p.stdout)
     assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
+
+
+def test_memcost():
+    """Reference example/memcost: reports XLA memory analysis for the
+    fused train step, plain vs mirrored."""
+    import re
+    p = _run("examples/memcost/memcost.py", "--num-layers", "20",
+             "--batch-size", "8")
+    out = p.stderr + p.stdout
+    m = re.findall(r"mirror temp ratio ([0-9.]+)", out)
+    assert m, out[-500:]
+    assert "plain    temp" in out
+
+
+def test_rnn_time_major():
+    """Reference example/rnn-time-major: same LM trained in TNC and NTC
+    layouts converges equivalently."""
+    import re
+    p = _run("examples/rnn-time-major/rnn_cell_demo.py",
+             "--num-examples", "1024", "--num-epochs", "5", timeout=480)
+    m = re.findall(r"perplexity TNC ([0-9.]+) \(([0-9.]+)s/epoch\) "
+                   r"NTC ([0-9.]+)", p.stderr + p.stdout)
+    assert m, (p.stderr + p.stdout)[-500:]
+    tnc, _, ntc = m[-1]
+    assert float(tnc) < 2.5 and float(ntc) < 2.5, m
