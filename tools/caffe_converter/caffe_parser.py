@@ -1,0 +1,221 @@
+"""Dependency-free Caffe file parsing.
+
+Reference: ``tools/caffe_converter/caffe_parser.py`` loads nets through
+the caffe python package (or a protoc-compiled ``caffe.proto``).  This
+environment has neither, so two small parsers stand in:
+
+* ``parse_prototxt`` — the protobuf *text format* subset prototxt files
+  use (``key: value`` scalars, ``key { ... }`` messages, repeated keys).
+* ``read_caffemodel`` — the protobuf *wire format*, walking NetParameter
+  with hand-coded field numbers from the public caffe.proto schema
+  (reference tools/caffe_converter/caffe.proto): layers + their weight
+  blobs, nothing else.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+__all__ = ["parse_prototxt", "get_layers", "read_caffemodel"]
+
+
+# ---------------------------------------------------------------------------
+# text format
+# ---------------------------------------------------------------------------
+def _tokenize(text):
+    out = []
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        # split into identifiers, colons, braces, quoted strings
+        i = 0
+        while i < len(line):
+            ch = line[i]
+            if ch.isspace():
+                i += 1
+            elif ch in "{}:":
+                out.append(ch)
+                i += 1
+            elif ch == '"' or ch == "'":
+                j = line.index(ch, i + 1)
+                out.append(('str', line[i + 1:j]))
+                i = j + 1
+            else:
+                j = i
+                while j < len(line) and not line[j].isspace() and \
+                        line[j] not in "{}:":
+                    j += 1
+                out.append(line[i:j])
+                i = j
+    return out
+
+
+def _coerce(tok):
+    if isinstance(tok, tuple):
+        return tok[1]
+    low = tok.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        return tok
+
+
+class Msg(dict):
+    """Parsed message: repeated fields become lists transparently."""
+
+    def add(self, key, value):
+        if key in self:
+            cur = self[key]
+            if isinstance(cur, list) and not isinstance(cur, Msg):
+                cur.append(value)
+            else:
+                self[key] = [cur, value]
+        else:
+            self[key] = value
+
+    def as_list(self, key):
+        v = self.get(key)
+        if v is None:
+            return []
+        return v if isinstance(v, list) else [v]
+
+
+def parse_prototxt(text):
+    """Parse protobuf text format into nested ``Msg`` dicts."""
+    toks = _tokenize(text)
+    pos = [0]
+
+    def parse_msg(depth=0):
+        msg = Msg()
+        while pos[0] < len(toks):
+            tok = toks[pos[0]]
+            if tok == "}":
+                pos[0] += 1
+                return msg
+            key = tok
+            pos[0] += 1
+            nxt = toks[pos[0]]
+            if nxt == ":":
+                pos[0] += 1
+                msg.add(key, _coerce(toks[pos[0]]))
+                pos[0] += 1
+            elif nxt == "{":
+                pos[0] += 1
+                msg.add(key, parse_msg(depth + 1))
+            else:
+                raise ValueError("expected ':' or '{' after %r" % key)
+        return msg
+
+    return parse_msg()
+
+
+def get_layers(net):
+    """Layer list from a parsed net: 'layer' (new) or 'layers' (V1)."""
+    return net.as_list("layer") or net.as_list("layers")
+
+
+# ---------------------------------------------------------------------------
+# wire format (caffemodel)
+# ---------------------------------------------------------------------------
+def _read_varint(buf, i):
+    val = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf):
+    """Iterate (field_number, wire_type, value, payload) over a message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, i = _read_varint(buf, i)
+            yield field, wt, val, None
+        elif wt == 5:
+            (val,) = struct.unpack_from("<f", buf, i)
+            i += 4
+            yield field, wt, val, None
+        elif wt == 1:
+            (val,) = struct.unpack_from("<d", buf, i)
+            i += 8
+            yield field, wt, val, None
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            yield field, wt, None, bytes(buf[i:i + ln])
+            i += ln
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+
+
+def _parse_blob(buf):
+    """BlobProto: data=5 (packed/repeated float), shape=7 {dim=1},
+    legacy num/channels/height/width = 1..4."""
+    data = []
+    dims = []
+    legacy = {}
+    for field, wt, val, payload in _fields(buf):
+        if field == 5:
+            if wt == 2:
+                data.extend(
+                    struct.unpack("<%df" % (len(payload) // 4), payload))
+            else:
+                data.append(val)
+        elif field == 7 and payload is not None:
+            for f2, _, v2, _ in _fields(payload):
+                if f2 == 1:
+                    dims.append(v2)
+        elif field in (1, 2, 3, 4) and wt == 0:
+            legacy[field] = val
+    if not dims and legacy:
+        dims = [legacy.get(k, 1) for k in (1, 2, 3, 4)]
+    arr = np.asarray(data, dtype=np.float32)
+    if dims:
+        arr = arr.reshape([int(d) for d in dims])
+    return arr
+
+
+def _parse_layer(buf):
+    """LayerParameter: name=1, type=2, blobs=7 (V1: name=1, type=5
+    enum, blobs=6)."""
+    name = None
+    ltype = None
+    blobs = []
+    for field, wt, val, payload in _fields(buf):
+        if field == 1 and payload is not None:
+            name = payload.decode("utf-8", "replace")
+        elif field == 2 and payload is not None:
+            ltype = payload.decode("utf-8", "replace")
+        elif field in (6, 7) and payload is not None:
+            blobs.append(_parse_blob(payload))
+    return name, ltype, blobs
+
+
+def read_caffemodel(path):
+    """{layer_name: [np blobs]} from a binary NetParameter.
+
+    NetParameter fields: layer=100 (LayerParameter), layers=2
+    (V1LayerParameter)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out = {}
+    for field, wt, val, payload in _fields(buf):
+        if field in (100, 2) and payload is not None:
+            name, _, blobs = _parse_layer(payload)
+            if name and blobs:
+                out[name] = blobs
+    return out

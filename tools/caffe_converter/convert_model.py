@@ -1,0 +1,109 @@
+"""Caffe model -> checkpoint conversion (reference
+tools/caffe_converter/convert_model.py: pairs the converted symbol with
+the caffemodel's weight blobs, renaming/reshaping into framework
+parameter conventions, and writes a standard checkpoint).
+
+Blob mapping (same table as the reference):
+* Convolution/Deconvolution: blobs[0] -> {name}_weight (layout already
+  (out, in/g, kh, kw)), blobs[1] -> {name}_bias
+* InnerProduct: blobs[0] (out, in) -> {name}_weight, blobs[1] -> bias
+* BatchNorm: blobs [mean, var, scale_factor] -> moving_mean/var divided
+  by scale_factor; a following Scale layer's [gamma, beta] fold into
+  {bn}_gamma/{bn}_beta (fix_gamma off when a Scale exists)
+
+Usage::
+
+    python convert_model.py net.prototxt net.caffemodel out-prefix
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, CURR)
+
+from caffe_parser import get_layers, parse_prototxt, read_caffemodel  # noqa: E402
+from convert_symbol import proto_to_symbol  # noqa: E402
+
+
+def convert_model(prototxt_path, caffemodel_path, output_prefix=None):
+    """Returns (symbol, arg_params, aux_params); optionally saves a
+    checkpoint at ``output_prefix``-0000.params / -symbol.json."""
+    with open(prototxt_path) as f:
+        text = f.read()
+    sym, input_name, input_dim = proto_to_symbol(text)
+    blobs = read_caffemodel(caffemodel_path)
+    net = parse_prototxt(text)
+
+    arg_params = {}
+    aux_params = {}
+    scale_of = {}   # bn layer name -> following Scale layer name
+    layers = get_layers(net)
+    for i, lay in enumerate(layers):
+        if lay.get("type") == "Scale" and i > 0 and \
+                layers[i - 1].get("type") == "BatchNorm":
+            scale_of[layers[i - 1]["name"]] = lay["name"]
+
+    for lay in layers:
+        name = lay.get("name")
+        ltype = lay.get("type")
+        lb = blobs.get(name)
+        if not lb:
+            continue
+        if ltype in ("Convolution", "Deconvolution", "InnerProduct"):
+            w = lb[0]
+            if ltype == "Deconvolution":
+                # caffe stores deconv weight (in, out/g, kh, kw) already
+                pass
+            arg_params["%s_weight" % name] = mx.nd.array(w)
+            if len(lb) > 1:
+                arg_params["%s_bias" % name] = mx.nd.array(lb[1])
+        elif ltype == "BatchNorm":
+            mean, var = lb[0], lb[1]
+            factor = float(lb[2].reshape(-1)[0]) if len(lb) > 2 else 1.0
+            if factor not in (0.0,):
+                mean = mean / factor
+                var = var / factor
+            aux_params["%s_moving_mean" % name] = mx.nd.array(mean)
+            aux_params["%s_moving_var" % name] = mx.nd.array(var)
+            sname = scale_of.get(name)
+            if sname and sname in blobs:
+                arg_params["%s_gamma" % name] = \
+                    mx.nd.array(blobs[sname][0])
+                arg_params["%s_beta" % name] = \
+                    mx.nd.array(blobs[sname][1])
+            else:
+                shape = mean.shape
+                arg_params["%s_gamma" % name] = mx.nd.ones(shape)
+                arg_params["%s_beta" % name] = mx.nd.zeros(shape)
+
+    if output_prefix:
+        mx.model.save_checkpoint(output_prefix, 0, sym, arg_params,
+                                 aux_params)
+    return sym, arg_params, aux_params
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="convert caffe model to a checkpoint")
+    parser.add_argument("prototxt")
+    parser.add_argument("caffemodel")
+    parser.add_argument("prefix")
+    args = parser.parse_args()
+    sym, arg_params, aux_params = convert_model(
+        args.prototxt, args.caffemodel, args.prefix)
+    print("converted %d arg tensors, %d aux tensors -> %s"
+          % (len(arg_params), len(aux_params), args.prefix))
+
+
+if __name__ == "__main__":
+    main()

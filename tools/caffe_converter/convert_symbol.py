@@ -1,0 +1,184 @@
+"""Caffe prototxt -> Symbol conversion (reference
+tools/caffe_converter/convert_symbol.py: walks layers, maps each Caffe
+layer type onto the equivalent operator, threading tops/bottoms —
+including Caffe's in-place layers where top == bottom).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+from caffe_parser import Msg, get_layers, parse_prototxt  # noqa: E402
+
+__all__ = ["proto_to_symbol", "convert_symbol"]
+
+
+def _pair(param, key, default=0):
+    v = param.get(key, None)
+    if v is None:
+        h = param.get("%s_h" % key)
+        w = param.get("%s_w" % key)
+        if h is not None or w is not None:
+            return (int(h or default), int(w or default))
+        return (default, default)
+    if isinstance(v, list):
+        v = v[0]
+    return (int(v), int(v))
+
+
+def _get_input(net):
+    layers = list(get_layers(net))
+    if net.get("input") is not None:
+        name = net["input"]
+        if isinstance(name, list):
+            name = name[0]
+        if net.get("input_dim") is not None:
+            dims = [int(d) for d in net.as_list("input_dim")]
+        else:
+            shape = net["input_shape"]
+            if isinstance(shape, list):
+                shape = shape[0]
+            dims = [int(d) for d in shape.as_list("dim")]
+        return name, dims, layers
+    if layers and layers[0].get("type") == "Input":
+        lay = layers.pop(0)
+        dims = [int(d) for d in
+                lay["input_param"]["shape"].as_list("dim")]
+        return lay.as_list("top")[0], dims, layers
+    raise ValueError("cannot find input declaration in prototxt")
+
+
+def proto_to_symbol(text):
+    """(symbol, input_name, input_dim) from prototxt text.
+
+    Supported layer types mirror the reference converter's table:
+    Convolution, Deconvolution, Pooling, InnerProduct, ReLU/Sigmoid/TanH,
+    Dropout, LRN, BatchNorm(+Scale), Concat, Eltwise, Flatten,
+    Softmax/SoftmaxWithLoss; Accuracy/Silence are skipped."""
+    net = parse_prototxt(text)
+    input_name, input_dim, layers = _get_input(net)
+    blobs = {input_name: mx.sym.Variable(input_name
+                                         if input_name != "data"
+                                         else "data")}
+    pending_bn = {}
+
+    for lay in layers:
+        ltype = lay.get("type")
+        name = lay.get("name")
+        bottoms = lay.as_list("bottom")
+        tops = lay.as_list("top")
+        phase = lay.get("include", Msg()).get("phase")
+        if phase == "TEST":
+            continue
+        ins = [blobs[b] for b in bottoms if b in blobs]
+        out = None
+        if ltype in ("Accuracy", "Silence", "Data"):
+            continue
+        elif ltype == "Convolution":
+            p = lay["convolution_param"]
+            out = mx.sym.Convolution(
+                ins[0], name=name,
+                num_filter=int(p["num_output"]),
+                kernel=_pair(p, "kernel_size"),
+                stride=_pair(p, "stride", 1),
+                pad=_pair(p, "pad", 0),
+                num_group=int(p.get("group", 1)),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "Deconvolution":
+            p = lay["convolution_param"]
+            out = mx.sym.Deconvolution(
+                ins[0], name=name,
+                num_filter=int(p["num_output"]),
+                kernel=_pair(p, "kernel_size"),
+                stride=_pair(p, "stride", 1),
+                pad=_pair(p, "pad", 0),
+                num_group=int(p.get("group", 1)),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "Pooling":
+            p = lay["pooling_param"]
+            pool = {0: "max", 1: "avg", "MAX": "max",
+                    "AVE": "avg"}.get(p.get("pool", 0), "max")
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(ins[0], name=name, global_pool=True,
+                                     kernel=(1, 1), pool_type=pool)
+            else:
+                out = mx.sym.Pooling(
+                    ins[0], name=name, pool_type=pool,
+                    kernel=_pair(p, "kernel_size"),
+                    stride=_pair(p, "stride", 1),
+                    pad=_pair(p, "pad", 0),
+                    pooling_convention="full")  # Caffe ceil-mode
+        elif ltype == "InnerProduct":
+            p = lay["inner_product_param"]
+            out = mx.sym.FullyConnected(
+                mx.sym.Flatten(ins[0]), name=name,
+                num_hidden=int(p["num_output"]),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "ReLU":
+            out = mx.sym.Activation(ins[0], name=name, act_type="relu")
+        elif ltype == "Sigmoid":
+            out = mx.sym.Activation(ins[0], name=name,
+                                    act_type="sigmoid")
+        elif ltype == "TanH":
+            out = mx.sym.Activation(ins[0], name=name, act_type="tanh")
+        elif ltype == "Dropout":
+            p = lay.get("dropout_param", Msg())
+            out = mx.sym.Dropout(ins[0], name=name,
+                                 p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype == "LRN":
+            p = lay["lrn_param"]
+            out = mx.sym.LRN(ins[0], name=name,
+                             alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)),
+                             knorm=float(p.get("k", 1.0)),
+                             nsize=int(p.get("local_size", 5)))
+        elif ltype == "BatchNorm":
+            p = lay.get("batch_norm_param", Msg())
+            out = mx.sym.BatchNorm(
+                ins[0], name=name, fix_gamma=True,
+                use_global_stats=bool(p.get("use_global_stats", False)),
+                eps=float(p.get("eps", 1e-5)))
+            pending_bn[tops[0]] = name
+        elif ltype == "Scale":
+            # Caffe's BatchNorm is stats-only; the following Scale layer
+            # carries gamma/beta.  The reference folds the pair the same
+            # way — here the BatchNorm symbol already owns gamma/beta, so
+            # Scale after BatchNorm is identity in the graph (its blobs
+            # are folded by convert_model).
+            out = ins[0]
+        elif ltype == "Concat":
+            p = lay.get("concat_param", Msg())
+            out = mx.sym.Concat(*ins, name=name,
+                                dim=int(p.get("axis", 1)))
+        elif ltype == "Eltwise":
+            p = lay.get("eltwise_param", Msg())
+            op = p.get("operation", 1)
+            if op in (0, "PROD"):
+                out = ins[0] * ins[1]
+            elif op in (2, "MAX"):
+                out = mx.sym.maximum(ins[0], ins[1])
+            else:
+                out = ins[0] + ins[1]
+        elif ltype == "Flatten":
+            out = mx.sym.Flatten(ins[0], name=name)
+        elif ltype in ("Softmax",):
+            out = mx.sym.SoftmaxActivation(ins[0], name=name)
+        elif ltype == "SoftmaxWithLoss":
+            out = mx.sym.SoftmaxOutput(ins[0], name="softmax")
+        else:
+            raise ValueError("unsupported caffe layer type %r (%s)"
+                             % (ltype, name))
+        blobs[tops[0]] = out
+
+    # the net's output = the last produced blob
+    return out, input_name, input_dim
+
+
+def convert_symbol(prototxt_path):
+    with open(prototxt_path) as f:
+        return proto_to_symbol(f.read())
