@@ -286,6 +286,13 @@ class Executor:
                     outs, upd = self._eval_node(node, i, vals, True, rng)
                     for oi, o in enumerate(outs):
                         vals[(id(node), oi)] = o
+                    # chunk_aux flattens per-node aux slots in this same
+                    # order; a short update list would silently shift
+                    # every later aux write in the chunk
+                    assert len(upd) == len(node.aux_inputs()), \
+                        "%s returned %d aux updates for %d aux slots" % (
+                            node.op.name, len(upd),
+                            len(node.aux_inputs()))
                     upds.extend(upd)
                 return (tuple(vals[key] for key in outs_list),
                         tuple(upds))

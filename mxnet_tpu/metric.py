@@ -47,10 +47,11 @@ class EvalMetric:
     # The reference fit loop syncs every batch (update_metric's asnumpy).
     # Over a TPU tunnel a per-batch host sync serializes the whole
     # dispatch pipeline, so metrics that can be expressed as a pure
-    # (labels, preds) -> [stat_sum, inst_count] reduction accumulate in a
-    # single on-device f32[2]; the host fetches it only when the value is
-    # actually read (epoch end / Speedometer), keeping the training loop
-    # fetch-free.
+    # (labels, preds) -> [stat_sum, inst_count] reduction accumulate
+    # on device — the sum lane in f32, the count lane in i32 (exact up
+    # to 2^31 instances; an f32 count lane starts rounding at 2^24).
+    # The host fetches the state only when the value is actually read
+    # (epoch end / Speedometer), keeping the training loop fetch-free.
 
     def device_stat_fn(self):
         """Pure jax fn ``(labels, preds) -> f32[2]`` of [sum, count], or
@@ -76,9 +77,18 @@ class EvalMetric:
             preds = tuple(x._data if isinstance(x, NDArray) else x
                           for x in preds)
             if self._dev_stat_jit is None:
-                self._dev_stat_jit = jax.jit(fn)
-                self._dev_accum_jit = jax.jit(
-                    lambda state, ls, ps: state + fn(ls, ps))
+                import jax.numpy as jnp
+
+                def split(ls, ps):
+                    stat = fn(ls, ps)
+                    return stat[0], stat[1].astype(jnp.int32)
+
+                def accum(state, ls, ps):
+                    s, c = split(ls, ps)
+                    return state[0] + s, state[1] + c
+
+                self._dev_stat_jit = jax.jit(split)
+                self._dev_accum_jit = jax.jit(accum)
             if self._dev_state is None:
                 self._dev_state = self._dev_stat_jit(labels, preds)
             else:
@@ -91,10 +101,10 @@ class EvalMetric:
 
     def _drain_device(self):
         if self._dev_state is not None:
-            stat = _np.asarray(self._dev_state)
+            s, c = self._dev_state
             self._dev_state = None
-            self.sum_metric += float(stat[0])
-            self.num_inst += int(stat[1])
+            self.sum_metric += float(s)
+            self.num_inst += int(c)
 
     def reset(self):
         self._dev_state = None
@@ -154,8 +164,12 @@ class CompositeEvalMetric(EvalMetric):
 
     def update_device(self, labels, preds):
         # all-or-nothing: a mixed device/host split would double-count
-        # when the caller falls back to host update for the whole set
+        # when the caller falls back to host update for the whole set.
+        # A member's sticky _dev_unsupported also fails the whole set up
+        # front — otherwise every batch would re-accumulate the earlier
+        # members only to roll them back below.
         if any(m.num is not None or m.device_stat_fn() is None
+               or getattr(m, "_dev_unsupported", False)
                for m in self.metrics):
             return False
         snapshots = [m._dev_state for m in self.metrics]
@@ -512,14 +526,33 @@ class Loss(EvalMetric):
             self.num_inst += pred.size
 
 
-class Torch(Loss):
+class Torch(EvalMetric):
+    """Average of torch-criterion outputs.
+
+    Deliberately NOT wired to ``plugin.torch_bridge``: the reference's
+    ``metric.Torch`` is itself a dummy ("Dummy metric for torch
+    criterions", python/mxnet/metric.py:349-357) that just averages the
+    already-computed criterion outputs fed to it — the criterion runs as
+    an op (here, via ``plugin.torch_bridge.TorchLoss``), not inside the
+    metric.  Semantics match the reference exactly: per-output mean,
+    one instance counted per ``update`` call.
+    """
+
     def __init__(self, name="torch"):
-        super(Loss, self).__init__(name)
+        super().__init__(name)
+
+    def update(self, _, preds):
+        for pred in preds:
+            self.sum_metric += float(_to_np(pred).mean())
+        self.num_inst += 1
 
 
-class Caffe(Loss):
+class Caffe(Torch):
+    """Average of caffe-criterion outputs (same dummy contract as
+    :class:`Torch`, reference metric.py:359-362)."""
+
     def __init__(self):
-        super(Loss, self).__init__("caffe")
+        super().__init__("caffe")
 
 
 class CustomMetric(EvalMetric):

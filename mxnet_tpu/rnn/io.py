@@ -1,7 +1,17 @@
-"""RNN data iterators (reference python/mxnet/rnn/io.py BucketSentenceIter)."""
+"""Sequence-bucketing data pipeline for language modelling.
+
+Role parity with the reference's ``python/mxnet/rnn/io.py`` (same public
+contract: ``BucketSentenceIter``, ``encode_sentences``), but built on this
+repo's vectorised host pipeline idiom: bucket assignment is a single
+``searchsorted`` over the length vector, each bucket is materialised as one
+dense int32 token matrix, next-token labels are a column-roll view of that
+matrix, and shuffling is permutation-indexed instead of in-place.  Batches
+are uploaded per ``next()`` (small host->HBM copies that overlap the
+previous step's compute) rather than staged wholesale on device.
+"""
 from __future__ import annotations
 
-import random
+import logging
 
 import numpy as np
 
@@ -13,54 +23,82 @@ __all__ = ["BucketSentenceIter", "encode_sentences"]
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0):
-    """Map word sentences to integer-id sentences, building a vocab
-    (reference encode_sentences)."""
-    idx = start_label
-    if vocab is None:
+    """Map token sentences to integer-id sentences.
+
+    With ``vocab=None`` a fresh vocabulary is grown in first-seen order
+    starting at ``start_label`` (skipping ``invalid_label``, which is
+    reserved for ``invalid_key``); with a supplied vocabulary, unseen
+    tokens are an error.  Returns ``(encoded, vocab)``.
+    """
+    grow = vocab is None
+    if grow:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
-    else:
-        new_vocab = False
-    res = []
-    for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert new_vocab, "Unknown token %s" % word
-                if idx == invalid_label:
-                    idx += 1
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+
+    def assign(token):
+        if token in vocab:
+            return vocab[token]
+        if not grow:
+            raise AssertionError("Unknown token %s" % token)
+        nxt = assign.next_id
+        if nxt == invalid_label:
+            nxt += 1
+        vocab[token] = nxt
+        assign.next_id = nxt + 1
+        return nxt
+
+    assign.next_id = start_label
+    encoded = [[assign(tok) for tok in sent] for sent in sentences]
+    return encoded, vocab
+
+
+def _auto_buckets(lengths, batch_size):
+    """One bucket per sentence length that has at least a batch of data."""
+    counts = np.bincount(lengths)
+    return np.flatnonzero(counts >= batch_size).tolist()
 
 
 class BucketSentenceIter(DataIter):
-    """Bucketed iterator over variable-length sequences (reference
-    BucketSentenceIter)."""
+    """Bucketed iterator over variable-length token sequences.
+
+    Sentences are padded with ``invalid_label`` up to the smallest bucket
+    that fits them (longer ones are dropped with a logged count), and the
+    label for each position is the token at the next position — the
+    standard next-token LM target.  ``layout`` selects batch-major ``NT``
+    or time-major ``TN`` batches; ``provide_data``/``provide_label`` carry
+    the layout through :class:`DataDesc` so modules can locate the batch
+    axis.
+    """
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
-                 data_name="data", label_name="softmax_label", dtype="float32",
-                 layout="NT"):
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NT"):
         super().__init__(batch_size)
+        lengths = np.array([len(s) for s in sentences], dtype=np.int64)
+        if buckets:
+            buckets = sorted(buckets)
+        else:
+            buckets = _auto_buckets(lengths, batch_size)
         if not buckets:
-            buckets = [i for i, j in enumerate(
-                np.bincount([len(s) for s in sentences]))
-                if j >= batch_size]
-        buckets.sort()
+            raise ValueError("no buckets: pass `buckets` explicitly or "
+                             "provide >= batch_size sentences per length")
 
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sent in sentences:
-            buck = np.searchsorted(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
+        # smallest bucket that fits each sentence; == len(buckets) -> drop
+        slot = np.searchsorted(np.asarray(buckets), lengths)
+        dropped = int((slot == len(buckets)).sum())
+        if dropped:
+            logging.getLogger(__name__).warning(
+                "BucketSentenceIter: dropped %d sentences longer than "
+                "max bucket %d", dropped, buckets[-1])
+
+        # one dense padded token matrix per bucket
+        self._tokens = []
+        for b, width in enumerate(buckets):
+            rows = [np.asarray(sentences[i], dtype=np.int32)
+                    for i in np.flatnonzero(slot == b)]
+            mat = np.full((len(rows), width), invalid_label, dtype=np.int32)
+            for r, row in enumerate(rows):
+                mat[r, :row.size] = row
+            self._tokens.append(mat)
 
         self.batch_size = batch_size
         self.buckets = buckets
@@ -68,66 +106,62 @@ class BucketSentenceIter(DataIter):
         self.label_name = label_name
         self.dtype = dtype
         self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
+        self.layout = layout
         self.major_axis = layout.find("N")
+        if self.major_axis not in (0, 1):
+            raise ValueError(
+                "layout %r must contain N at position 0 (batch-major NT) "
+                "or 1 (time-major TN)" % layout)
         self.default_bucket_key = max(buckets)
 
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(
-                data_name, (batch_size, self.default_bucket_key),
-                layout=layout)]
-            self.provide_label = [DataDesc(
-                label_name, (batch_size, self.default_bucket_key),
-                layout=layout)]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(
-                data_name, (self.default_bucket_key, batch_size),
-                layout=layout)]
-            self.provide_label = [DataDesc(
-                label_name, (self.default_bucket_key, batch_size),
-                layout=layout)]
-        else:
-            raise ValueError("Invalid layout %s: Must by NT (batch major) "
-                             "or TN (time major)" % layout)
+        self.provide_data = [
+            DataDesc(data_name, self._shape_for(self.default_bucket_key),
+                     layout=layout)]
+        self.provide_label = [
+            DataDesc(label_name, self._shape_for(self.default_bucket_key),
+                     layout=layout)]
 
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in range(
-                0, len(buck) - batch_size + 1, batch_size)])
-        self.curr_idx = 0
+        # epoch plan: (bucket, row-offset) per full batch; partial batches
+        # at the tail of a bucket are dropped, matching reference behavior
+        self._plan = [(b, off)
+                      for b, mat in enumerate(self._tokens)
+                      for off in range(0, mat.shape[0] - batch_size + 1,
+                                       batch_size)]
+        self._perms = [np.arange(mat.shape[0]) for mat in self._tokens]
+        self._cursor = 0
         self.reset()
 
-    def reset(self):
-        self.curr_idx = 0
-        random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
+    def _shape_for(self, seq_len):
+        if self.major_axis == 0:
+            return (self.batch_size, seq_len)
+        return (seq_len, self.batch_size)
 
-        self.nddata = []
-        self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(nd.array(buck, dtype=self.dtype))
-            self.ndlabel.append(nd.array(label, dtype=self.dtype))
+    def reset(self):
+        self._cursor = 0
+        order = np.random.permutation(len(self._plan))
+        self._plan = [self._plan[k] for k in order]
+        self._perms = [np.random.permutation(mat.shape[0])
+                       for mat in self._tokens]
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self._cursor >= len(self._plan):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
-        self.curr_idx += 1
+        b, off = self._plan[self._cursor]
+        self._cursor += 1
 
+        rows = self._perms[b][off:off + self.batch_size]
+        toks = self._tokens[b][rows]                       # (N, T) int64
+        labs = np.roll(toks, -1, axis=1)
+        labs[:, -1] = self.invalid_label
         if self.major_axis == 1:
-            data = self.nddata[i][j:j + self.batch_size].T
-            label = self.ndlabel[i][j:j + self.batch_size].T
-        else:
-            data = self.nddata[i][j:j + self.batch_size]
-            label = self.ndlabel[i][j:j + self.batch_size]
+            toks, labs = toks.T, labs.T
 
-        return DataBatch([data], [label], pad=0,
-                         bucket_key=self.buckets[i],
-                         provide_data=[DataDesc(self.data_name, data.shape)],
-                         provide_label=[DataDesc(self.label_name,
-                                                 label.shape)])
+        data = nd.array(toks.astype(self.dtype))
+        label = nd.array(labs.astype(self.dtype))
+        key = self.buckets[b]
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=key,
+            provide_data=[DataDesc(self.data_name, data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, label.shape,
+                                    layout=self.layout)])

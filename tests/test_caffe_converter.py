@@ -1,0 +1,185 @@
+"""caffe_converter: wire-format parsing and BN+Scale folding.
+
+Builds synthetic caffemodels byte-by-byte (both NetParameter formats)
+so the dependency-free parser is exercised against the real field
+numbering of caffe.proto, including the traps: modern LayerParameter
+field 6 is ParamSpec (not a blob), V1LayerParameter field 1 is the
+legacy V0 message (not the name).
+"""
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "tools", "caffe_converter"))
+
+from caffe_parser import read_caffemodel  # noqa: E402
+from convert_model import convert_model  # noqa: E402
+from convert_symbol import proto_to_symbol  # noqa: E402
+
+
+# -- minimal protobuf wire encoder ------------------------------------------
+def _varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field, wt):
+    return _varint((field << 3) | wt)
+
+
+def _bytes_field(field, payload):
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _varint_field(field, v):
+    return _tag(field, 0) + _varint(v)
+
+
+def _blob(values):
+    arr = np.asarray(values, np.float32)
+    data = _bytes_field(5, struct.pack("<%df" % arr.size, *arr.ravel()))
+    shape = _bytes_field(7, b"".join(_varint_field(1, d)
+                                     for d in arr.shape))
+    return data + shape
+
+
+def _new_layer(name, ltype, blobs, with_param_spec=False):
+    """Modern LayerParameter: name=1, type=2, blobs=7, param=6."""
+    body = _bytes_field(1, name.encode()) + _bytes_field(2, ltype.encode())
+    if with_param_spec:
+        # ParamSpec {lr_mult=3: float} — must NOT be read as a blob
+        body += _bytes_field(6, _tag(3, 5) + struct.pack("<f", 1.0))
+    for b in blobs:
+        body += _bytes_field(7, _blob(b))
+    return _bytes_field(100, body)
+
+
+def _v1_layer(name, type_enum, blobs):
+    """V1LayerParameter: name=4, type=5 (enum), blobs=6; field 1 is the
+    legacy V0LayerParameter message."""
+    body = _bytes_field(1, _bytes_field(1, b"legacy-v0-junk"))
+    body += _bytes_field(4, name.encode())
+    body += _varint_field(5, type_enum)
+    for b in blobs:
+        body += _bytes_field(6, _blob(b))
+    return _bytes_field(2, body)
+
+
+BN_PROTOTXT = """
+name: "tiny"
+input: "data"
+input_dim: 1
+input_dim: 2
+input_dim: 4
+input_dim: 4
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 3 kernel_size: 1 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "conv1" top: "bn1"
+  batch_norm_param { use_global_stats: true } }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1"
+  scale_param { bias_term: true } }
+layer { name: "relu1" type: "ReLU" bottom: "bn1" top: "bn1" }
+"""
+
+
+def test_param_spec_not_parsed_as_blob(tmp_path):
+    w = np.arange(6, dtype=np.float32).reshape(3, 2, 1, 1)
+    bias = np.array([0.5, -0.5, 1.0], np.float32)
+    raw = _new_layer("conv1", "Convolution", [w, bias],
+                     with_param_spec=True)
+    path = tmp_path / "m.caffemodel"
+    path.write_bytes(raw)
+    blobs = read_caffemodel(str(path))
+    assert list(blobs) == ["conv1"]
+    assert len(blobs["conv1"]) == 2, "ParamSpec leaked into blobs"
+    np.testing.assert_allclose(blobs["conv1"][0], w)
+    np.testing.assert_allclose(blobs["conv1"][1], bias)
+
+
+def test_v1_layer_format(tmp_path):
+    w = np.ones((4, 3), np.float32) * 2
+    raw = _v1_layer("ip1", 14, [w])  # 14 = INNER_PRODUCT enum
+    path = tmp_path / "v1.caffemodel"
+    path.write_bytes(raw)
+    blobs = read_caffemodel(str(path))
+    assert list(blobs) == ["ip1"], "V1 name must come from field 4"
+    np.testing.assert_allclose(blobs["ip1"][0], w)
+
+
+def test_bn_scale_fix_gamma_and_folding(tmp_path):
+    sym, _, _ = proto_to_symbol(BN_PROTOTXT)
+    attrs = sym.attr_dict()
+    assert attrs["bn1"]["fix_gamma"] in ("False", "0", False), \
+        "BN followed by Scale must emit fix_gamma=False"
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(3, 2, 1, 1).astype(np.float32)
+    mean = rng.randn(3).astype(np.float32)
+    var = rng.rand(3).astype(np.float32) + 0.5
+    factor = np.array([2.0], np.float32)
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+    raw = (_new_layer("conv1", "Convolution", [w]) +
+           _new_layer("bn1", "BatchNorm", [mean, var, factor]) +
+           _new_layer("scale1", "Scale", [gamma, beta]))
+    model = tmp_path / "net.caffemodel"
+    model.write_bytes(raw)
+    proto = tmp_path / "net.prototxt"
+    proto.write_text(BN_PROTOTXT)
+
+    csym, args, auxs = convert_model(str(proto), str(model))
+    np.testing.assert_allclose(args["bn1_gamma"].asnumpy(), gamma)
+    np.testing.assert_allclose(args["bn1_beta"].asnumpy(), beta)
+    np.testing.assert_allclose(auxs["bn1_moving_mean"].asnumpy(),
+                               mean / factor[0], rtol=1e-6)
+
+    # end-to-end numeric check vs a hand computation
+    import mxnet_tpu as mx
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    ex = csym.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+    ex.copy_params_from(args, auxs)
+    out = ex.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+
+    conv = np.einsum("bchw,oc->bohw", x, w[:, :, 0, 0])
+    m, v = (mean / factor[0]), (var / factor[0])
+    norm = (conv - m[None, :, None, None]) / \
+        np.sqrt(v[None, :, None, None] + 1e-5)
+    expect = np.maximum(norm * gamma[None, :, None, None] +
+                        beta[None, :, None, None], 0)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_bare_bn_keeps_fix_gamma():
+    proto = BN_PROTOTXT.replace(
+        'layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1"\n'
+        '  scale_param { bias_term: true } }\n', "")
+    assert "Scale" not in proto
+    sym, _, _ = proto_to_symbol(proto)
+    attrs = sym.attr_dict()
+    assert attrs["bn1"]["fix_gamma"] in ("True", "1", True)
+
+
+def test_bn_scale_pairing_through_inplace_layers():
+    from caffe_parser import bn_scale_pairs, get_layers, parse_prototxt
+    proto = """
+layer { name: "bn1" type: "BatchNorm" bottom: "x" top: "bn1" }
+layer { name: "drop1" type: "Dropout" bottom: "bn1" top: "bn1" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1" }
+layer { name: "bn2" type: "BatchNorm" bottom: "bn1" top: "bn2" }
+layer { name: "conv2" type: "Convolution" bottom: "bn2" top: "c2" }
+layer { name: "scale2" type: "Scale" bottom: "c2" top: "c2" }
+"""
+    pairs = bn_scale_pairs(get_layers(parse_prototxt(proto)))
+    # in-place Dropout between BN and Scale commutes with the per-channel
+    # affine -> still paired; a Convolution breaks the blob lineage
+    assert pairs == {"bn1": "scale1"}

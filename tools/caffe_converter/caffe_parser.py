@@ -122,6 +122,37 @@ def get_layers(net):
     return net.as_list("layer") or net.as_list("layers")
 
 
+def bn_scale_pairs(layers):
+    """{BatchNorm layer name: Scale layer name} for every Scale that
+    carries a BatchNorm's gamma/beta.
+
+    Caffe's BatchNorm is stats-only; the learned per-channel affine lives
+    in a following Scale layer.  The pair is matched by blob lineage, not
+    adjacency: a Scale whose bottom blob was produced by a BatchNorm —
+    possibly through intervening in-place elementwise layers (ReLU,
+    Dropout in-place on the same blob), which commute with a per-channel
+    scale.  Both convert_symbol (fix_gamma) and convert_model (blob
+    folding) use this one rule so they can never disagree.
+    """
+    pairs = {}
+    bn_of = {}  # blob name -> BatchNorm layer that (still) owns it
+    for lay in layers:
+        ltype = lay.get("type")
+        tops = lay.as_list("top")
+        bottoms = lay.as_list("bottom")
+        if ltype == "BatchNorm" and tops:
+            bn_of[tops[0]] = lay.get("name")
+        elif ltype == "Scale" and bottoms and bottoms[0] in bn_of:
+            pairs[bn_of.pop(bottoms[0])] = lay.get("name")
+        else:
+            for t in tops:
+                # a non-in-place layer rewriting the blob breaks the
+                # lineage; in-place layers (top == bottom) preserve it
+                if t in bn_of and t not in bottoms:
+                    del bn_of[t]
+    return pairs
+
+
 # ---------------------------------------------------------------------------
 # wire format (caffemodel)
 # ---------------------------------------------------------------------------
@@ -189,18 +220,24 @@ def _parse_blob(buf):
     return arr
 
 
-def _parse_layer(buf):
-    """LayerParameter: name=1, type=2, blobs=7 (V1: name=1, type=5
-    enum, blobs=6)."""
+def _parse_layer(buf, v1=False):
+    """Modern LayerParameter (NetParameter field 100): name=1,
+    type=2 (string), blobs=7; field 6 is ParamSpec, NOT a blob.
+    V1LayerParameter (NetParameter field 2): name=4, type=5 (enum),
+    blobs=6; field 1 is the legacy V0 layer message, NOT the name."""
+    name_field = 4 if v1 else 1
+    blob_field = 6 if v1 else 7
     name = None
     ltype = None
     blobs = []
     for field, wt, val, payload in _fields(buf):
-        if field == 1 and payload is not None:
+        if field == name_field and payload is not None:
             name = payload.decode("utf-8", "replace")
-        elif field == 2 and payload is not None:
+        elif field == 2 and not v1 and payload is not None:
             ltype = payload.decode("utf-8", "replace")
-        elif field in (6, 7) and payload is not None:
+        elif field == 5 and v1 and wt == 0:
+            ltype = val  # enum; callers key on name only
+        elif field == blob_field and payload is not None:
             blobs.append(_parse_blob(payload))
     return name, ltype, blobs
 
@@ -209,13 +246,14 @@ def read_caffemodel(path):
     """{layer_name: [np blobs]} from a binary NetParameter.
 
     NetParameter fields: layer=100 (LayerParameter), layers=2
-    (V1LayerParameter)."""
+    (V1LayerParameter) — each format has different field numbers inside
+    the layer message, so the format is dispatched per entry."""
     with open(path, "rb") as f:
         buf = f.read()
     out = {}
     for field, wt, val, payload in _fields(buf):
         if field in (100, 2) and payload is not None:
-            name, _, blobs = _parse_layer(payload)
+            name, _, blobs = _parse_layer(payload, v1=(field == 2))
             if name and blobs:
                 out[name] = blobs
     return out

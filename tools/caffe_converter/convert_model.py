@@ -31,7 +31,8 @@ import mxnet_tpu as mx  # noqa: E402
 CURR = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, CURR)
 
-from caffe_parser import get_layers, parse_prototxt, read_caffemodel  # noqa: E402
+from caffe_parser import (bn_scale_pairs, get_layers,  # noqa: E402
+                          parse_prototxt, read_caffemodel)
 from convert_symbol import proto_to_symbol  # noqa: E402
 
 
@@ -46,12 +47,9 @@ def convert_model(prototxt_path, caffemodel_path, output_prefix=None):
 
     arg_params = {}
     aux_params = {}
-    scale_of = {}   # bn layer name -> following Scale layer name
     layers = get_layers(net)
-    for i, lay in enumerate(layers):
-        if lay.get("type") == "Scale" and i > 0 and \
-                layers[i - 1].get("type") == "BatchNorm":
-            scale_of[layers[i - 1]["name"]] = lay["name"]
+    # same pairing rule convert_symbol used for fix_gamma
+    scale_of = bn_scale_pairs(layers)
 
     for lay in layers:
         name = lay.get("name")

@@ -13,7 +13,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import mxnet_tpu as mx  # noqa: E402
 
-from caffe_parser import Msg, get_layers, parse_prototxt  # noqa: E402
+from caffe_parser import (Msg, bn_scale_pairs, get_layers,  # noqa: E402
+                          parse_prototxt)
 
 __all__ = ["proto_to_symbol", "convert_symbol"]
 
@@ -65,7 +66,12 @@ def proto_to_symbol(text):
     blobs = {input_name: mx.sym.Variable(input_name
                                          if input_name != "data"
                                          else "data")}
-    pending_bn = {}
+    # Caffe BatchNorm is stats-only; gamma/beta live in a paired Scale
+    # layer (shared pairing rule: caffe_parser.bn_scale_pairs).  Where one
+    # exists, convert_model folds its blobs into {bn}_gamma/{bn}_beta, so
+    # the BatchNorm op must apply gamma (fix_gamma=False); a bare
+    # BatchNorm keeps gamma pinned to 1.
+    scaled_bns = set(bn_scale_pairs(layers))
 
     for lay in layers:
         ltype = lay.get("type")
@@ -140,10 +146,9 @@ def proto_to_symbol(text):
         elif ltype == "BatchNorm":
             p = lay.get("batch_norm_param", Msg())
             out = mx.sym.BatchNorm(
-                ins[0], name=name, fix_gamma=True,
+                ins[0], name=name, fix_gamma=name not in scaled_bns,
                 use_global_stats=bool(p.get("use_global_stats", False)),
                 eps=float(p.get("eps", 1e-5)))
-            pending_bn[tops[0]] = name
         elif ltype == "Scale":
             # Caffe's BatchNorm is stats-only; the following Scale layer
             # carries gamma/beta.  The reference folds the pair the same
