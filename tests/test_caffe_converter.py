@@ -178,8 +178,12 @@ layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1" }
 layer { name: "bn2" type: "BatchNorm" bottom: "bn1" top: "bn2" }
 layer { name: "conv2" type: "Convolution" bottom: "bn2" top: "c2" }
 layer { name: "scale2" type: "Scale" bottom: "c2" top: "c2" }
+layer { name: "bn3" type: "BatchNorm" bottom: "c2" top: "bn3" }
+layer { name: "relu3" type: "ReLU" bottom: "bn3" top: "bn3" }
+layer { name: "scale3" type: "Scale" bottom: "bn3" top: "bn3" }
 """
     pairs = bn_scale_pairs(get_layers(parse_prototxt(proto)))
-    # in-place Dropout between BN and Scale commutes with the per-channel
-    # affine -> still paired; a Convolution breaks the blob lineage
+    # in-place Dropout between BN and Scale is identity at inference ->
+    # still paired; a Convolution breaks the blob lineage; an in-place
+    # ReLU also breaks it (gamma*relu(x) != relu(gamma*x+beta))
     assert pairs == {"bn1": "scale1"}

@@ -129,11 +129,14 @@ def bn_scale_pairs(layers):
     Caffe's BatchNorm is stats-only; the learned per-channel affine lives
     in a following Scale layer.  The pair is matched by blob lineage, not
     adjacency: a Scale whose bottom blob was produced by a BatchNorm —
-    possibly through intervening in-place elementwise layers (ReLU,
-    Dropout in-place on the same blob), which commute with a per-channel
-    scale.  Both convert_symbol (fix_gamma) and convert_model (blob
-    folding) use this one rule so they can never disagree.
+    possibly through intervening in-place layers that are identity at
+    inference (Dropout), which therefore commute with folding the affine
+    into the BatchNorm.  A nonlinear in-place layer (ReLU: gamma*relu(x)
+    != relu(gamma*x) once beta or sign enter) BREAKS the lineage.  Both
+    convert_symbol (fix_gamma) and convert_model (blob folding) use this
+    one rule so they can never disagree.
     """
+    inference_identity = {"Dropout"}
     pairs = {}
     bn_of = {}  # blob name -> BatchNorm layer that (still) owns it
     for lay in layers:
@@ -146,9 +149,10 @@ def bn_scale_pairs(layers):
             pairs[bn_of.pop(bottoms[0])] = lay.get("name")
         else:
             for t in tops:
-                # a non-in-place layer rewriting the blob breaks the
-                # lineage; in-place layers (top == bottom) preserve it
-                if t in bn_of and t not in bottoms:
+                # any other layer rewriting the blob breaks the lineage
+                # unless it is in-place AND identity at inference
+                if t in bn_of and not (t in bottoms and
+                                       ltype in inference_identity):
                     del bn_of[t]
     return pairs
 

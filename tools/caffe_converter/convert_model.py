@@ -75,10 +75,12 @@ def convert_model(prototxt_path, caffemodel_path, output_prefix=None):
             aux_params["%s_moving_var" % name] = mx.nd.array(var)
             sname = scale_of.get(name)
             if sname and sname in blobs:
-                arg_params["%s_gamma" % name] = \
-                    mx.nd.array(blobs[sname][0])
-                arg_params["%s_beta" % name] = \
-                    mx.nd.array(blobs[sname][1])
+                sb = blobs[sname]
+                arg_params["%s_gamma" % name] = mx.nd.array(sb[0])
+                # scale_param bias_term defaults to false: one blob
+                arg_params["%s_beta" % name] = (
+                    mx.nd.array(sb[1]) if len(sb) > 1
+                    else mx.nd.zeros(sb[0].shape))
             else:
                 shape = mean.shape
                 arg_params["%s_gamma" % name] = mx.nd.ones(shape)

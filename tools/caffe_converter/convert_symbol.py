@@ -71,7 +71,8 @@ def proto_to_symbol(text):
     # exists, convert_model folds its blobs into {bn}_gamma/{bn}_beta, so
     # the BatchNorm op must apply gamma (fix_gamma=False); a bare
     # BatchNorm keeps gamma pinned to 1.
-    scaled_bns = set(bn_scale_pairs(layers))
+    bn_pairs = bn_scale_pairs(layers)
+    scaled_bns = set(bn_pairs)
 
     for lay in layers:
         ltype = lay.get("type")
@@ -150,12 +151,20 @@ def proto_to_symbol(text):
                 use_global_stats=bool(p.get("use_global_stats", False)),
                 eps=float(p.get("eps", 1e-5)))
         elif ltype == "Scale":
-            # Caffe's BatchNorm is stats-only; the following Scale layer
-            # carries gamma/beta.  The reference folds the pair the same
-            # way — here the BatchNorm symbol already owns gamma/beta, so
-            # Scale after BatchNorm is identity in the graph (its blobs
-            # are folded by convert_model).
-            out = ins[0]
+            if name in bn_pairs.values():
+                # paired with a BatchNorm: the BatchNorm symbol already
+                # owns gamma/beta (fix_gamma=False above) and
+                # convert_model folds this layer's blobs into them, so
+                # the Scale itself is identity in the graph
+                out = ins[0]
+            else:
+                # a standalone Scale's learned gamma/beta have nowhere
+                # to fold; converting it to identity would silently drop
+                # trained weights
+                raise ValueError(
+                    "Scale layer %r is not paired with a BatchNorm "
+                    "(bn_scale_pairs); standalone Scale is not supported"
+                    % name)
         elif ltype == "Concat":
             p = lay.get("concat_param", Msg())
             out = mx.sym.Concat(*ins, name=name,
