@@ -1,0 +1,197 @@
+"""Deep Embedded Clustering (reference example/dec/dec.py: pretrain a
+stacked autoencoder, k-means the embeddings, then jointly refine encoder
+and cluster centers by KL(P||Q) on Student-t soft assignments).
+
+The reference implements the DEC loss as a host ``NumpyOp`` with
+hand-written gradients (dec.py:29-62).  TPU-first, the whole objective —
+soft assignment q_ij = (1+|z_i-mu_j|^2)^-1 (normalized), target P fed as
+a label, KL loss — is expressed in symbol ops, so forward AND backward
+(d/dz and d/dmu) stay one compiled XLA program; the cluster centers mu
+are just another trainable Variable.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+sys.path.insert(0, os.path.join(CURR, "..", "autoencoder"))
+
+import mxnet_tpu as mx  # noqa: E402
+from mnist_sae import synthetic_digits  # noqa: E402
+
+
+def cluster_acc(y_pred, y):
+    """Best-bijection clustering accuracy (reference cluster_acc,
+    dec.py:18-26)."""
+    d = max(y_pred.max(), y.max()) + 1
+    w = np.zeros((d, d), np.int64)
+    for yp, yt in zip(y_pred, y):
+        w[yp, yt] += 1
+    try:
+        from scipy.optimize import linear_sum_assignment
+        rows, cols = linear_sum_assignment(w.max() - w)
+        return w[rows, cols].sum() / y_pred.size
+    except ImportError:  # greedy fallback
+        total = 0
+        w = w.copy()
+        for _ in range(d):
+            i, j = np.unravel_index(w.argmax(), w.shape)
+            total += w[i, j]
+            w[i, :] = -1
+            w[:, j] = -1
+        return total / y_pred.size
+
+
+def kmeans(z, k, rs, iters=30):
+    centers = z[rs.choice(len(z), k, replace=False)]
+    for _ in range(iters):
+        d = ((z[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        for j in range(k):
+            pts = z[assign == j]
+            if len(pts):
+                centers[j] = pts.mean(0)
+    return centers, assign
+
+
+def encoder_symbol(dims):
+    """Encoder with a LINEAR bottleneck (DEC paper: the latent layer
+    carries euclidean cluster geometry, so it must not be squashed —
+    sigmoid latents collapse the Student-t distances and the KL
+    refinement stalls)."""
+    h = mx.sym.Variable("data")
+    for i, d in enumerate(dims[1:]):
+        h = mx.sym.FullyConnected(h, num_hidden=d, name="enc%d" % i)
+        if i < len(dims) - 2:
+            h = mx.sym.Activation(h, act_type="relu")
+    return h
+
+
+def sae_symbol(dims):
+    """Autoencoder around :func:`encoder_symbol` (mirrored relu
+    decoder, MSE reconstruction)."""
+    h = encoder_symbol(dims)
+    for i, d in enumerate(reversed(dims[:-1])):
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=d, name="dec%d" % i)
+    return mx.sym.LinearRegressionOutput(
+        h, label=mx.sym.Variable("recon_label"), name="recon")
+
+
+def dec_symbol(dims, num_centers):
+    """KL(P||Q) over in-graph Student-t soft assignments."""
+    z = encoder_symbol(dims)                       # (N, K)
+    mu = mx.sym.Variable("dec_mu",
+                         shape=(num_centers, dims[-1]))  # (C, K)
+    zd = mx.sym.expand_dims(z, axis=1)             # (N, 1, K)
+    md = mx.sym.Reshape(mu, shape=(1, num_centers, dims[-1]))
+    dist2 = mx.sym.sum(mx.sym.square(mx.sym.broadcast_sub(zd, md)),
+                       axis=2)                     # (N, C)
+    qu = 1.0 / (1.0 + dist2)                       # alpha = 1
+    q = mx.sym.broadcast_div(qu, mx.sym.sum(qu, axis=1, keepdims=True))
+    p = mx.sym.Variable("p_label")                 # target distribution
+    kl = mx.sym.sum(p * (mx.sym.log(p + 1e-10) - mx.sym.log(q + 1e-10)),
+                    axis=1)
+    # outputs: the loss (grads flow to enc+mu) and q (for refresh/eval)
+    return mx.sym.Group([mx.sym.MakeLoss(mx.sym.mean(kl)),
+                         mx.sym.BlockGrad(q)])
+
+
+def target_distribution(q):
+    """P = q^2/f, renormalized (DEC paper eq. 3)."""
+    w = q ** 2 / q.sum(0)
+    return (w.T / w.sum(1)).T
+
+
+def main():
+    parser = argparse.ArgumentParser(description="deep embedded clustering")
+    parser.add_argument("--num-examples", type=int, default=2048)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--pretrain-epochs", type=int, default=15)
+    parser.add_argument("--dec-iters", type=int, default=100)
+    parser.add_argument("--update-interval", type=int, default=25)
+    parser.add_argument("--latent-dim", type=int, default=8)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    mx.random.seed(23)
+    rs = np.random.RandomState(6)
+    X, y = synthetic_digits(args.num_examples, rs)
+    dims = [X.shape[1], 64, args.latent_dim]
+    num_centers = 10
+
+    # 1. pretrain the autoencoder (reference: AutoEncoderModel layerwise
+    #    + finetune; one joint reconstruction phase suffices here)
+    it = mx.io.NDArrayIter({"data": X}, {"recon_label": X},
+                           batch_size=args.batch_size, shuffle=True)
+    sae = mx.Module(sae_symbol(dims), context=mx.current_context(),
+                    label_names=["recon_label"])
+    sae.fit(it, num_epoch=args.pretrain_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="mse")
+    arg_p, aux_p = sae.get_params()
+
+    # 2. embed + k-means init of mu
+    enc = mx.Module(encoder_symbol(dims), context=mx.current_context(),
+                    label_names=[])
+    enc.bind(data_shapes=[("data", (args.batch_size, X.shape[1]))],
+             for_training=False)
+    enc.set_params(arg_p, aux_p, allow_missing=False)
+
+    def embed(mod):
+        zs = []
+        eit = mx.io.NDArrayIter(X, batch_size=args.batch_size)
+        for batch in eit:
+            mod.forward(batch, is_train=False)
+            zs.append(mod.get_outputs()[0].asnumpy())
+        return np.concatenate(zs)[:len(X)]
+
+    z0 = embed(enc)
+    centers, assign0 = kmeans(z0, num_centers, rs)
+    acc0 = cluster_acc(assign0, y)
+    logging.info("k-means init cluster acc %.3f", acc0)
+
+    # 3. joint refinement: full-batch steps, P refreshed periodically
+    dec = mx.Module(dec_symbol(dims, num_centers), context=mx.current_context(),
+                    data_names=["data"], label_names=["p_label"])
+    dec.bind(data_shapes=[("data", (len(X), X.shape[1]))],
+             label_shapes=[("p_label", (len(X), num_centers))],
+             for_training=True)
+    dec.set_params(dict(arg_p, dec_mu=mx.nd.array(centers)), aux_p,
+                   allow_missing=False)
+    dec.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 3e-3})
+    data_nd = mx.nd.array(X)
+    p = None
+    for i in range(args.dec_iters):
+        if i % args.update_interval == 0:
+            dec.forward(mx.io.DataBatch(
+                data=[data_nd],
+                label=[mx.nd.zeros((len(X), num_centers))]),
+                is_train=False)
+            q = dec.get_outputs()[1].asnumpy()
+            p = target_distribution(q)
+            acc = cluster_acc(q.argmax(1), y)
+            logging.info("iter %d cluster acc %.3f kl-target refresh",
+                         i, acc)
+        batch = mx.io.DataBatch(data=[data_nd], label=[mx.nd.array(p)])
+        dec.forward_backward(batch)
+        dec.update()
+
+    dec.forward(mx.io.DataBatch(
+        data=[data_nd], label=[mx.nd.zeros((len(X), num_centers))]),
+        is_train=False)
+    q = dec.get_outputs()[1].asnumpy()
+    acc = cluster_acc(q.argmax(1), y)
+    print("cluster acc: kmeans %.3f final %.3f" % (acc0, acc))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Stratified train/val image lists from per-class folders (reference
+example/kaggle-ndsb1/gen_img_list.py: walks the plankton class dirs and
+emits im2rec-format .lst files with a per-class split).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def build_lists(image_root, train_frac, rs):
+    """([(idx, label, relpath)] train, [...] val, [class names])."""
+    classes = sorted(d for d in os.listdir(image_root)
+                     if os.path.isdir(os.path.join(image_root, d)))
+    train, val = [], []
+    idx = 0
+    for label, cls in enumerate(classes):
+        files = sorted(os.listdir(os.path.join(image_root, cls)))
+        order = rs.permutation(len(files))
+        n_train = max(1, int(round(train_frac * len(files))))
+        for pos, j in enumerate(order):
+            rel = os.path.join(cls, files[j])
+            (train if pos < n_train else val).append((idx, label, rel))
+            idx += 1
+    return train, val, classes
+
+
+def write_lst(path, rows):
+    with open(path, "w") as f:
+        for idx, label, rel in rows:
+            f.write("%d\t%d\t%s\n" % (idx, label, rel))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="ndsb1 image lists")
+    parser.add_argument("--image-root", required=True)
+    parser.add_argument("--out-prefix", required=True)
+    parser.add_argument("--train-frac", type=float, default=0.8)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    rs = np.random.RandomState(args.seed)
+    train, val, classes = build_lists(args.image_root, args.train_frac, rs)
+    write_lst(args.out_prefix + "_train.lst", train)
+    write_lst(args.out_prefix + "_val.lst", val)
+    with open(args.out_prefix + "_classes.txt", "w") as f:
+        f.write("\n".join(classes) + "\n")
+    print("wrote %d train / %d val over %d classes"
+          % (len(train), len(val), len(classes)))
+    return train, val, classes
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Predict class probabilities from a trained NDSB-1 checkpoint
+(reference example/kaggle-ndsb1/predict_dsb.py: batch-scores the test
+records and dumps the probability matrix for submission formatting)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def predict(prefix, epoch, rec, img_size, batch_size=32):
+    shape = (3, img_size, img_size)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=shape, batch_size=batch_size,
+        shuffle=False, mean_r=200, mean_g=200, mean_b=200,
+        scale=1.0 / 60)
+    mod = mx.Module.load(prefix, epoch, context=mx.current_context())
+    mod.bind(data_shapes=it.provide_data, for_training=False)
+    probs, labels = [], []
+    it.reset()
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0].asnumpy()
+        keep = batch.data[0].shape[0] - batch.pad
+        probs.append(out[:keep])
+        labels.append(batch.label[0].asnumpy()[:keep])
+    return np.concatenate(probs), np.concatenate(labels)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="ndsb1 predict")
+    parser.add_argument("--model-prefix", required=True)
+    parser.add_argument("--epoch", type=int, required=True)
+    parser.add_argument("--rec", required=True)
+    parser.add_argument("--img-size", type=int, default=32)
+    parser.add_argument("--out", default="probs.npz")
+    args = parser.parse_args()
+
+    probs, labels = predict(args.model_prefix, args.epoch, args.rec,
+                            args.img_size)
+    np.savez(args.out, probs=probs, labels=labels)
+    print("wrote %s: %s" % (args.out, probs.shape))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Format an NDSB-1 submission CSV (reference
+example/kaggle-ndsb1/submission_dsb.py: header of class names, one
+probability row per image, probabilities clipped away from 0/1 and
+renormalized — the Kaggle logloss-safety trick)."""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def format_submission(probs, names, classes, out_path, clip=1e-4):
+    p = np.clip(probs, clip, 1.0 - clip)
+    p = p / p.sum(axis=1, keepdims=True)
+    with open(out_path, "w") as f:
+        f.write("image," + ",".join(classes) + "\n")
+        for name, row in zip(names, p):
+            f.write(name + "," + ",".join("%.6f" % v for v in row) + "\n")
+    return p
+
+
+def main():
+    parser = argparse.ArgumentParser(description="ndsb1 submission")
+    parser.add_argument("--probs", required=True, help="npz from predict")
+    parser.add_argument("--classes", required=True,
+                        help="classes.txt from train")
+    parser.add_argument("--out", default="submission.csv")
+    args = parser.parse_args()
+
+    data = np.load(args.probs)
+    probs = data["probs"]
+    with open(args.classes) as f:
+        classes = [ln.strip() for ln in f if ln.strip()]
+    names = ["img_%05d.jpg" % i for i in range(len(probs))]
+    p = format_submission(probs, names, classes, args.out)
+    if "labels" in data:
+        labels = data["labels"].astype(np.int64)
+        logloss = float(-np.log(p[np.arange(len(p)), labels]).mean())
+        print("wrote %s (%d rows), val logloss %.4f"
+              % (args.out, len(p), logloss))
+    else:
+        print("wrote %s (%d rows)" % (args.out, len(p)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Plankton classification net (reference
+example/kaggle-ndsb1/symbol_dsb.py: small conv net — conv/relu/pool
+stacks into two fully-connected layers — sized for low-res plankton
+crops rather than ImageNet)."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def get_symbol(num_classes):
+    data = mx.sym.Variable("data")
+    h = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                           pad=(1, 1), name="conv1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.Convolution(h, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                           name="conv2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.Flatten(h)
+    h = mx.sym.FullyConnected(h, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Dropout(h, p=0.25)
+    h = mx.sym.FullyConnected(h, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")

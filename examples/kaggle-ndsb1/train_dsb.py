@@ -1,0 +1,120 @@
+"""NDSB-1 plankton training driver (reference
+example/kaggle-ndsb1/train_dsb.py: trains symbol_dsb over .rec files
+produced from the class-folder layout by gen_img_list + im2rec).
+
+Runs the real dataset pipeline end to end: class folders -> stratified
+.lst (gen_img_list) -> im2rec .rec -> ImageRecordIter -> Module.fit ->
+checkpoint.  With no --image-root, a synthetic plankton set is drawn
+(class-dependent ellipse eccentricity/orientation — separable but not
+trivially so), since this image has no dataset egress.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+sys.path.insert(0, os.path.join(CURR, "..", "..", "tools"))
+
+import mxnet_tpu as mx  # noqa: E402
+import im2rec  # noqa: E402
+from gen_img_list import build_lists, write_lst  # noqa: E402
+from symbol_dsb import get_symbol  # noqa: E402
+
+
+def synth_plankton(root, num_classes, per_class, size, rs):
+    """Grayscale-ish organisms: one filled ellipse per image whose
+    orientation and axis ratio encode the class, plus speckle noise."""
+    from mxnet_tpu.io.image_util import encode_image
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    for c in range(num_classes):
+        d = os.path.join(root, "class_%02d" % c)
+        os.makedirs(d, exist_ok=True)
+        theta = np.pi * c / num_classes
+        ratio = 1.5 + 2.5 * (c % 4) / 3.0
+        for i in range(per_class):
+            cx, cy = rs.uniform(size * 0.35, size * 0.65, 2)
+            a = rs.uniform(size * 0.22, size * 0.3)
+            b = a / ratio
+            jt = theta + rs.uniform(-0.12, 0.12)
+            u = (xx - cx) * np.cos(jt) + (yy - cy) * np.sin(jt)
+            v = -(xx - cx) * np.sin(jt) + (yy - cy) * np.cos(jt)
+            body = ((u / a) ** 2 + (v / b) ** 2) <= 1.0
+            img = rs.uniform(180, 230, (size, size)).astype(np.float32)
+            img[body] = rs.uniform(20, 90)
+            img += rs.normal(0, 8, img.shape)
+            rgb = np.clip(img, 0, 255).astype(np.uint8)[..., None]
+            rgb = np.repeat(rgb, 3, axis=2)
+            with open(os.path.join(d, "p%04d.jpg" % i), "wb") as f:
+                f.write(encode_image(rgb, quality=92))
+
+
+def make_recs(image_root, work_dir, rs, train_frac=0.8):
+    train, val, classes = build_lists(image_root, train_frac, rs)
+    paths = {}
+    for split, rows in (("train", train), ("val", val)):
+        prefix = os.path.join(work_dir, "dsb_%s" % split)
+        write_lst(prefix + ".lst", rows)
+        im2rec.main([prefix, image_root, "--shuffle",
+                     "1" if split == "train" else "0"])
+        paths[split] = prefix + ".rec"
+    with open(os.path.join(work_dir, "classes.txt"), "w") as f:
+        f.write("\n".join(classes) + "\n")
+    return paths, classes
+
+
+def main():
+    parser = argparse.ArgumentParser(description="ndsb1 training")
+    parser.add_argument("--image-root", type=str, default=None)
+    parser.add_argument("--work-dir", type=str, default="/tmp/ndsb1")
+    parser.add_argument("--num-classes", type=int, default=8)
+    parser.add_argument("--per-class", type=int, default=48)
+    parser.add_argument("--img-size", type=int, default=32)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--model-prefix", type=str, default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    mx.random.seed(3)
+    rs = np.random.RandomState(9)
+    image_root = args.image_root
+    if not image_root:
+        image_root = os.path.join(args.work_dir, "images")
+        if not os.path.isdir(image_root):
+            synth_plankton(image_root, args.num_classes, args.per_class,
+                           args.img_size, rs)
+    os.makedirs(args.work_dir, exist_ok=True)
+    recs, classes = make_recs(image_root, args.work_dir, rs)
+
+    shape = (3, args.img_size, args.img_size)
+    train_it = mx.io.ImageRecordIter(
+        path_imgrec=recs["train"],
+        path_imgidx=recs["train"][:-4] + ".idx", data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_mirror=True,
+        mean_r=200, mean_g=200, mean_b=200, scale=1.0 / 60)
+    val_it = mx.io.ImageRecordIter(
+        path_imgrec=recs["val"], data_shape=shape,
+        batch_size=args.batch_size, shuffle=False,
+        mean_r=200, mean_g=200, mean_b=200, scale=1.0 / 60)
+
+    mod = mx.Module(get_symbol(len(classes)), context=mx.current_context())
+    mod.fit(train_it, eval_data=val_it, num_epoch=args.num_epochs,
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr, "wd": 1e-4},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="accuracy")
+    acc = mod.score(val_it, "accuracy")[0][1]
+    print("val accuracy %.3f" % acc)
+    prefix = args.model_prefix or os.path.join(args.work_dir, "dsb")
+    mod.save_checkpoint(prefix, args.num_epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,7 @@ def main():
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
+    mx.random.seed(42)  # deterministic init: run-to-run parity
     rs = np.random.RandomState(5)
     X, Y = make_task(rs, args.num_examples, args.seq_len, args.vocab)
     ppl_tnc, t_tnc = run("TNC", X, Y, args)

@@ -1,0 +1,56 @@
+"""DeepSpeech-style acoustic model (reference
+example/speech_recognition/arch_deepspeech.py: conv front-end over the
+spectrogram, stacked recurrent layers, per-frame classifier, warp-CTC
+loss — assembled from the stt_layer_* builders).
+
+Same architecture shape on the TPU stack: Convolution over the
+(1, T, F) spectrogram image (stride-2 time downsampling, the reference's
+conv-striding trick), stacked LSTMCells unrolled over the downsampled
+time axis, shared FC classifier, in-graph CTCLoss — one compiled XLA
+program end to end.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def conv_frontend(data, seq_len, feat_dim, num_filter=16):
+    """(N, T, F) -> (N, T/2, num_filter*F/2): one strided conv block
+    (reference stt_layer_conv conv(...) with stride (2, 2))."""
+    img = mx.sym.Reshape(data, shape=(-1, 1, seq_len, feat_dim))
+    h = mx.sym.Convolution(img, num_filter=num_filter, kernel=(3, 3),
+                           stride=(2, 2), pad=(1, 1), name="conv1")
+    h = mx.sym.Activation(h, act_type="relu")
+    # stride-2/pad-1/kernel-3 conv outputs ceil(n/2), not floor
+    t2, f2 = (seq_len + 1) // 2, (feat_dim + 1) // 2
+    # (N, C, T/2, F/2) -> (N, T/2, C*F/2): time stays the sequence axis
+    h = mx.sym.transpose(h, axes=(0, 2, 1, 3))
+    return mx.sym.Reshape(h, shape=(-1, t2, num_filter * f2)), t2
+
+
+def deepspeech_symbol(seq_len, feat_dim, num_hidden, num_classes,
+                      num_rnn_layers=2):
+    """Returns grouped (MakeLoss(ctc), BlockGrad(per-frame scores))."""
+    data = mx.sym.Variable("data")          # (N, T, F)
+    label = mx.sym.Variable("label")        # (N, L) 1-based, 0 pad
+    h, t_out = conv_frontend(data, seq_len, feat_dim)
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(num_rnn_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                  prefix="lstm%d_" % i))
+    outputs, _ = stack.unroll(t_out, inputs=h, layout="NTC",
+                              merge_outputs=True)    # (N, T', H)
+    flat = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = mx.sym.FullyConnected(flat, num_hidden=num_classes,
+                                 name="cls")
+    tnc = mx.sym.transpose(mx.sym.Reshape(
+        pred, shape=(-1, t_out, num_classes)), axes=(1, 0, 2))
+    ctc = mx.sym.CTCLoss(data=tnc, label=label, name="ctc")
+    return mx.sym.Group([mx.sym.MakeLoss(ctc),
+                         mx.sym.BlockGrad(tnc, name="pred")])

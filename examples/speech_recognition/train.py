@@ -1,0 +1,160 @@
+"""Speech-to-text training driver (reference
+example/speech_recognition/{main.py,train.py}: DeepSpeech acoustic model
+over spectrograms with warp-CTC, CER-style metrics via stt_metric).
+
+Synthetic utterances (no egress): each "phoneme" is a band-limited
+chirp signature in a toy mel-spectrogram, held for a variable number of
+frames with noise — so the net must learn alignment-free transcription,
+exactly the CTC learning problem.  Reports greedy-decode CER
+(edit-distance / reference-length, the stt_metric protocol).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from arch_deepspeech import deepspeech_symbol  # noqa: E402
+
+BLANK = 0
+
+
+def gen_utterance(rs, num_phonemes, seq_len, feat_dim, num_label, noise):
+    """Variable-hold phoneme band signatures + chirp + noise."""
+    labels = rs.randint(1, num_phonemes + 1, (num_label,))
+    feats = rs.normal(0, noise, (seq_len, feat_dim)).astype(np.float32)
+    t = 0
+    band = feat_dim // (num_phonemes + 1)
+    for ph in labels:
+        hold = rs.randint(seq_len // (2 * num_label),
+                          seq_len // num_label + 1)
+        lo = (ph - 1) * band
+        for k in range(hold):
+            if t >= seq_len:
+                break
+            # slight upward chirp within the band across the hold
+            feats[t, lo + min(band - 1, k * band // max(1, hold))] += 1.2
+            feats[t, lo:lo + band] += 0.6
+            t += 1
+    return feats, labels
+
+
+class SpeechIter(mx.io.DataIter):
+    def __init__(self, count, batch_size, num_phonemes, seq_len,
+                 feat_dim, num_label, noise, seed):
+        super().__init__(batch_size)
+        self.rs = np.random.RandomState(seed)
+        self.count = count
+        self.num_phonemes, self.seq_len = num_phonemes, seq_len
+        self.feat_dim, self.num_label, self.noise = feat_dim, num_label, \
+            noise
+        self.cur = 0
+        self.provide_data = [mx.io.DataDesc(
+            "data", (batch_size, seq_len, feat_dim))]
+        self.provide_label = [mx.io.DataDesc(
+            "label", (batch_size, num_label))]
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= self.count:
+            raise StopIteration
+        self.cur += 1
+        data = np.zeros((self.batch_size, self.seq_len, self.feat_dim),
+                        np.float32)
+        label = np.zeros((self.batch_size, self.num_label), np.float32)
+        for i in range(self.batch_size):
+            data[i], label[i] = gen_utterance(
+                self.rs, self.num_phonemes, self.seq_len, self.feat_dim,
+                self.num_label, self.noise)
+        return mx.io.DataBatch(data=[mx.nd.array(data)],
+                               label=[mx.nd.array(label)], pad=0)
+
+
+def edit_distance(a, b):
+    dp = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        prev = dp.copy()
+        dp[0] = i
+        for j in range(1, len(b) + 1):
+            dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                        prev[j - 1] + (a[i - 1] != b[j - 1]))
+    return int(dp[-1])
+
+
+def greedy_decode(tnc_scores):
+    best = np.argmax(tnc_scores, axis=-1)   # (T, N)
+    out = []
+    for n in range(best.shape[1]):
+        seq, prev = [], -1
+        for t in best[:, n]:
+            if t != prev and t != BLANK:
+                seq.append(int(t))
+            prev = t
+        out.append(seq)
+    return out
+
+
+class CERMetric(mx.metric.EvalMetric):
+    """Character error rate: edit distance / reference length
+    (reference stt_metric.STTMetric)."""
+
+    def __init__(self):
+        super().__init__("cer")
+
+    def update(self, labels, preds):
+        decoded = greedy_decode(preds[1].asnumpy())
+        for seq, row in zip(decoded, labels[0].asnumpy()):
+            truth = [int(v) for v in row if v > 0]
+            self.sum_metric += edit_distance(seq, truth)
+            self.num_inst += max(1, len(truth))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="deepspeech training")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-phonemes", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--feat-dim", type=int, default=36)
+    parser.add_argument("--num-label", type=int, default=4)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=20)
+    parser.add_argument("--batches-per-epoch", type=int, default=25)
+    parser.add_argument("--noise", type=float, default=0.15)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    mx.random.seed(17)
+    num_classes = args.num_phonemes + 1  # + blank
+    train_it = SpeechIter(args.batches_per_epoch, args.batch_size,
+                          args.num_phonemes, args.seq_len, args.feat_dim,
+                          args.num_label, args.noise, seed=1)
+    val_it = SpeechIter(8, args.batch_size, args.num_phonemes,
+                        args.seq_len, args.feat_dim, args.num_label,
+                        args.noise, seed=2)
+
+    sym = deepspeech_symbol(args.seq_len, args.feat_dim, args.num_hidden,
+                            num_classes)
+    mod = mx.Module(sym, context=mx.current_context(),
+                    data_names=["data"], label_names=["label"])
+    mod.fit(train_it, eval_data=val_it, num_epoch=args.num_epochs,
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(magnitude=2.0),
+            eval_metric=CERMetric())
+    metric = CERMetric()
+    cer = mod.score(val_it, metric)[0][1]
+    print("final CER %.3f" % cer)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Torch layers inside a native training graph (reference
+example/torch/torch_module.py: an MNIST MLP whose layers are
+`mx.symbol.TorchModule` lua-torch modules, optionally trained against a
+`TorchCriterion` and scored with `metric.Torch`).
+
+Here the bridge is modern PyTorch via ``plugin.torch_bridge``: torch
+``nn.Module`` activations compose with native FullyConnected layers in
+one symbol (the torch hop is a host callback, so the XLA program splits
+around it — fine for the long tail, not for hot-path layers, which is
+why the learnable layers stay native).  ``--torch-criterion`` swaps the
+SoftmaxOutput head for a torch ``NLLLoss`` driven manually through
+``TorchCriterion`` and scored with ``metric.Torch`` — the reference's
+`use_torch_criterion = True` path.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(CURR, "..", ".."))
+sys.path.insert(0, os.path.join(CURR, "..", "autoencoder"))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.plugin.torch_bridge import (TorchCriterion,  # noqa: E402
+                                           torch_module_symbol)
+from mnist_sae import synthetic_digits  # noqa: E402
+
+
+def mlp_with_torch_activations(torch):
+    """fc -> torch Softplus -> fc -> torch Tanh -> fc, softmax head
+    (reference interleaves TorchModule layers the same way)."""
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = torch_module_symbol(torch.nn.Softplus(), h, name="torch_act1")
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = torch_module_symbol(torch.nn.Tanh(), h, name="torch_act2")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    return h
+
+
+def train_native_head(torch, it, val_it, args):
+    net = mx.sym.SoftmaxOutput(mlp_with_torch_activations(torch),
+                               name="softmax")
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(it, eval_data=val_it, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-5},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="accuracy")
+    return mod.score(val_it, "accuracy")[0][1]
+
+
+def train_torch_criterion(torch, it, val_it, args):
+    """Manual fit loop: native+torch body, torch LogSoftmax+NLLLoss head
+    through TorchCriterion, progress tracked by metric.Torch."""
+    body = mlp_with_torch_activations(torch)
+    mod = mx.Module(body, context=mx.cpu(), label_names=[])
+    mod.bind(data_shapes=it.provide_data, label_shapes=None,
+             for_training=True)
+    mod.init_params(mx.initializer.Xavier())
+    # torch's mean-reduced criterion grad is 1/batch the scale of the
+    # summed SoftmaxOutput grad the fit path sees; adam normalizes it
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    # labels cross the bridge as float arrays (mx.nd int-to-float
+    # semantics); cast back to class indices on the torch side
+    crit = TorchCriterion(
+        lambda p, t: torch.nn.functional.cross_entropy(p, t.long()))
+    loss_metric = mx.metric.Torch()
+    for epoch in range(args.num_epochs):
+        it.reset()
+        loss_metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            logits = mod.get_outputs()[0]
+            label = mx.nd.array(batch.label[0].asnumpy().astype("int64"))
+            loss = crit(logits, label)
+            loss_metric.update(None, [mx.nd.array([loss])])
+            mod.backward([crit.backward()])
+            mod.update()
+        logging.info("epoch %d %s %.4f", epoch, *loss_metric.get())
+
+    correct = total = 0
+    val_it.reset()
+    for batch in val_it:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = batch.label[0].asnumpy().astype("int64")
+        correct += int((pred == lab).sum())
+        total += len(lab)
+    return correct / total
+
+
+def main():
+    parser = argparse.ArgumentParser(description="torch-layer MLP")
+    parser.add_argument("--num-examples", type=int, default=2048)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--torch-criterion", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import torch
+    mx.random.seed(7)
+    rs = np.random.RandomState(3)
+    X, y = synthetic_digits(args.num_examples, rs)
+    Xv, yv = synthetic_digits(max(256, args.num_examples // 4), rs)
+    it = mx.io.NDArrayIter(X, y.astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True)
+    val_it = mx.io.NDArrayIter(Xv, yv.astype(np.float32),
+                               batch_size=args.batch_size)
+
+    if args.torch_criterion:
+        acc = train_torch_criterion(torch, it, val_it, args)
+    else:
+        acc = train_native_head(torch, it, val_it, args)
+    print("final accuracy %.3f" % acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -390,6 +390,90 @@ class MNISTIter(NDArrayIter):
                          label_name="softmax_label")
 
 
+class _PermutedRecordStream:
+    """Record stream that visits the whole file in a fresh random order
+    each epoch via the .idx sidecar (reference ImageRecordIter
+    shuffle=True with path_imgidx: full random access).
+
+    A background reader thread stays ``capacity`` permuted records ahead
+    so the random seek+read overlaps decode/assembly — the same overlap
+    the sequential path gets from its native prefetcher."""
+
+    def __init__(self, idx_path, rec_path, capacity=16):
+        from . import recordio
+        self._rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+        if not self._rec.keys:
+            raise MXNetError("empty or missing index file %s" % idx_path)
+        self._cap = capacity
+        self._q = None
+        self._thread = None
+        self._eof = False
+        self._start_epoch()
+
+    def _start_epoch(self):
+        order = np.random.permutation(len(self._rec.keys))
+        q = queue.Queue(maxsize=self._cap)
+
+        def pump():
+            for j in order:
+                q.put(self._rec.read_idx(self._rec.keys[j]))
+            q.put(None)
+
+        self._q = q
+        self._eof = False
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+
+    def read(self):
+        if self._eof:
+            return None
+        s = self._q.get()
+        if s is None:
+            self._eof = True
+        return s
+
+    def reset(self):
+        # drain the old epoch (unless its end-marker was already
+        # consumed) so the pump thread can exit, then re-permute
+        while not self._eof:
+            if self._q.get() is None:
+                self._eof = True
+        self._thread.join()
+        self._start_epoch()
+
+
+class _ShuffleBuffer:
+    """Streaming window shuffle over a sequential record stream: keep a
+    reservoir of up to ``capacity`` records, emit a uniformly random one
+    as each new record arrives.  Gives index-free record files epoch
+    randomization within a bounded memory window (exact when the file
+    fits the window)."""
+
+    def __init__(self, stream, capacity):
+        self._stream = stream
+        self._cap = max(2, int(capacity))
+        self._buf = []
+        self._eof = False
+
+    def read(self):
+        while not self._eof and len(self._buf) < self._cap:
+            s = self._stream.read()
+            if s is None:
+                self._eof = True
+                break
+            self._buf.append(s)
+        if not self._buf:
+            return None
+        i = np.random.randint(len(self._buf))
+        self._buf[i], self._buf[-1] = self._buf[-1], self._buf[i]
+        return self._buf.pop()
+
+    def reset(self):
+        self._stream.reset()
+        self._buf = []
+        self._eof = False
+
+
 class _NativeRecordStream:
     """Background-prefetched sequential record stream (native runtime)."""
 
@@ -426,17 +510,26 @@ class ImageRecordIter(DataIter):
                  preprocess_threads=4, max_rotate_angle=0,
                  max_shear_ratio=0.0, min_random_scale=1.0,
                  max_random_scale=1.0, max_aspect_ratio=0.0, random_h=0,
-                 random_s=0, random_l=0, pad=0, fill_value=255, **kwargs):
+                 random_s=0, random_l=0, pad=0, fill_value=255,
+                 path_imgidx=None, shuffle_buffer=4096, **kwargs):
         super().__init__(batch_size)
         from . import recordio
         from .image_util import decode_record_image
         from .pipeline import ThreadedBatchPipeline
         self._recordio = recordio
         self._decode = decode_record_image
-        if recordio._use_native():
+        # shuffle (reference iter_image_recordio_2.cc shuffle_): with an
+        # .idx sidecar, a full fresh permutation per epoch via random
+        # access; without, a streaming window shuffle over the
+        # sequential stream (capacity `shuffle_buffer` records)
+        if shuffle and path_imgidx:
+            self.record = _PermutedRecordStream(path_imgidx, path_imgrec)
+        elif recordio._use_native():
             self.record = _NativeRecordStream(path_imgrec, 16)
         else:
             self.record = recordio.MXRecordIO(path_imgrec, "r")
+        if shuffle and not path_imgidx:
+            self.record = _ShuffleBuffer(self.record, shuffle_buffer)
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle

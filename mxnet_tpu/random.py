@@ -21,10 +21,19 @@ _generation = [0]
 
 
 def seed(seed_state):
-    """Seed the global PRNG (mx.random.seed equivalent)."""
+    """Seed the global PRNG (mx.random.seed equivalent).
+
+    Covers BOTH random sources the framework draws from: the jax key
+    (device sampling ops, dropout, compiled-step RNG carries) and
+    numpy's global RNG (host-side initializers draw via np.random, as
+    the reference's initializers draw from its mx.random-seeded engine
+    — reference mx.random.seed makes init deterministic, so ours must).
+    """
+    import numpy as _np
     with _lock:
         _seed[0] = int(seed_state)
         _key[0] = jax.random.key(int(seed_state))
+        _np.random.seed(int(seed_state) & 0xFFFFFFFF)
         # consumers that carry device-resident successor keys (fused
         # trainers) watch this to know their carried key is stale
         _generation[0] += 1

@@ -361,10 +361,100 @@ def test_rnn_time_major():
     """Reference example/rnn-time-major: same LM trained in TNC and NTC
     layouts converges equivalently."""
     import re
+    # 8 epochs trains to ~1.4 perplexity vs the 2.5 gate; 5 epochs sat
+    # exactly at the boundary (2.48-2.57 run to run) and flaked
     p = _run("examples/rnn-time-major/rnn_cell_demo.py",
-             "--num-examples", "1024", "--num-epochs", "5", timeout=480)
+             "--num-examples", "1024", "--num-epochs", "8", timeout=480)
     m = re.findall(r"perplexity TNC ([0-9.]+) \(([0-9.]+)s/epoch\) "
                    r"NTC ([0-9.]+)", p.stderr + p.stdout)
     assert m, (p.stderr + p.stdout)[-500:]
     tnc, _, ntc = m[-1]
     assert float(tnc) < 2.5 and float(ntc) < 2.5, m
+
+
+def test_torch_layers_native_head():
+    """Reference example/torch/torch_module.py: torch modules as graph
+    layers, native softmax head."""
+    import re
+    pytest.importorskip("torch")
+    p = _run("examples/torch/torch_module.py",
+             "--num-examples", "1024", "--num-epochs", "3", timeout=480)
+    m = re.findall(r"final accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
+
+
+def test_torch_criterion_path():
+    """use_torch_criterion=True path: TorchCriterion drives backward and
+    metric.Torch tracks the loss."""
+    import re
+    pytest.importorskip("torch")
+    p = _run("examples/torch/torch_module.py",
+             "--num-examples", "1024", "--num-epochs", "3",
+             "--torch-criterion", timeout=480)
+    m = re.findall(r"final accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.9, (p.stderr + p.stdout)[-500:]
+
+
+def test_dec_clustering():
+    """Reference example/dec/dec.py: DEC refinement must beat its own
+    k-means initialization."""
+    import re
+    p = _run("examples/dec/dec.py", "--num-examples", "1024",
+             timeout=480)
+    m = re.findall(r"cluster acc: kmeans ([0-9.]+) final ([0-9.]+)",
+                   p.stderr + p.stdout)
+    assert m, (p.stderr + p.stdout)[-500:]
+    km, final = float(m[-1][0]), float(m[-1][1])
+    assert final > 0.75 and final > km + 0.03, m
+
+
+def test_kaggle_ndsb1_pipeline(tmp_path):
+    """Reference example/kaggle-ndsb1: class folders -> gen_img_list ->
+    im2rec -> train -> predict -> submission CSV."""
+    import re
+    work = str(tmp_path / "ndsb1")
+    p = _run("examples/kaggle-ndsb1/train_dsb.py", "--work-dir", work,
+             "--num-epochs", "12", timeout=480)
+    m = re.findall(r"val accuracy ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) > 0.55, (p.stderr + p.stdout)[-500:]
+    _run("examples/kaggle-ndsb1/predict_dsb.py",
+         "--model-prefix", os.path.join(work, "dsb"), "--epoch", "12",
+         "--rec", os.path.join(work, "dsb_val.rec"),
+         "--out", os.path.join(work, "probs.npz"))
+    p = _run("examples/kaggle-ndsb1/submission_dsb.py",
+             "--probs", os.path.join(work, "probs.npz"),
+             "--classes", os.path.join(work, "classes.txt"),
+             "--out", os.path.join(work, "submission.csv"))
+    m = re.findall(r"val logloss ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) < 1.2, (p.stderr + p.stdout)[-500:]
+    with open(os.path.join(work, "submission.csv")) as f:
+        header = f.readline().strip().split(",")
+        rows = f.readlines()
+    assert header[0] == "image" and len(header) == 9
+    assert len(rows) > 0
+    probs = [float(v) for v in rows[0].split(",")[1:]]
+    assert abs(sum(probs) - 1.0) < 1e-3
+
+
+def test_kaggle_ndsb2_crps():
+    """Reference example/kaggle-ndsb2/Train.py: CDF volume regression
+    scored by CRPS (chance-level CRPS for a flat 0.5 CDF is 0.25)."""
+    import re
+    p = _run("examples/kaggle-ndsb2/Train.py", "--num-examples", "256",
+             "--num-epochs", "8", timeout=480)
+    m = re.findall(r"CRPS Systole ([0-9.]+) Diastole ([0-9.]+)",
+                   p.stderr + p.stdout)
+    assert m, (p.stderr + p.stdout)[-500:]
+    assert float(m[-1][0]) < 0.06 and float(m[-1][1]) < 0.06, m
+
+
+def test_speech_recognition_ctc():
+    """Reference example/speech_recognition: DeepSpeech-style conv+LSTM
+    +CTC transcribes synthetic utterances (CER near zero; an all-blank
+    collapse scores CER 1.0)."""
+    import re
+    p = _run("examples/speech_recognition/train.py",
+             "--num-epochs", "20", "--batches-per-epoch", "25",
+             timeout=560)
+    m = re.findall(r"final CER ([0-9.]+)", p.stderr + p.stdout)
+    assert m and float(m[-1]) < 0.1, (p.stderr + p.stdout)[-500:]

@@ -185,3 +185,112 @@ def test_hsl_jitter_identity_and_range():
     out = hsl_jitter(img, random_h=30, random_s=40, random_l=40)
     assert out.min() >= 0 and out.max() <= 255
     assert not np.allclose(out, img)
+
+
+def _write_labeled_rec(path, idx_path=None, n=40):
+    """Records whose image pixel value encodes the label exactly."""
+    from mxnet_tpu.io import recordio
+    from mxnet_tpu.io.image_util import encode_image
+    if idx_path:
+        w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    else:
+        w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        lab = i % 8
+        img = np.full((16, 16, 3), lab * 6, np.uint8)
+        head = recordio.IRHeader(0, float(lab), i, 0)
+        buf = recordio.pack(head, encode_image(img, fmt=".png"))
+        if idx_path:
+            w.write_idx(i, buf)
+        else:
+            w.write(buf)
+    w.close()
+
+
+def test_image_record_iter_shuffle_buffer(tmp_path):
+    """shuffle=True without an index must actually permute record order
+    (regression: the flag was silently ignored, so class-sorted .rec
+    files trained on single-class batches)."""
+    rec = str(tmp_path / "s.rec")
+    _write_labeled_rec(rec, n=64)
+
+    def epoch_labels():
+        it = mx.io.ImageRecordIter(path_imgrec=rec,
+                                   data_shape=(3, 16, 16), batch_size=8,
+                                   shuffle=True, preprocess_threads=2)
+        labs = []
+        for b in it:
+            keep = 8 - (b.pad or 0)
+            d = b.data[0].asnumpy()[:keep]
+            lab = b.label[0].asnumpy()[:keep]
+            # pairing must survive the shuffle
+            np.testing.assert_allclose(
+                np.round(d.mean(axis=(1, 2, 3)) / 6.0), lab)
+            labs.extend(lab.astype(int).tolist())
+        return labs
+
+    e1, e2 = epoch_labels(), epoch_labels()
+    sequential = [i % 8 for i in range(64)]
+    assert sorted(e1) == sorted(sequential)
+    assert e1 != sequential, "shuffle was a no-op"
+    assert e1 != e2, "epochs must reshuffle"
+
+
+def test_image_record_iter_shuffle_with_index(tmp_path):
+    """shuffle=True + path_imgidx: full fresh permutation per epoch."""
+    rec = str(tmp_path / "si.rec")
+    idx = str(tmp_path / "si.idx")
+    _write_labeled_rec(rec, idx_path=idx, n=40)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 16, 16), batch_size=8,
+                               shuffle=True, preprocess_threads=2)
+
+    def epoch_labels():
+        it.reset()
+        labs = []
+        for b in it:
+            keep = 8 - (b.pad or 0)
+            d = b.data[0].asnumpy()[:keep]
+            lab = b.label[0].asnumpy()[:keep]
+            np.testing.assert_allclose(
+                np.round(d.mean(axis=(1, 2, 3)) / 6.0), lab)
+            labs.extend(lab.astype(int).tolist())
+        return labs
+
+    e1, e2 = epoch_labels(), epoch_labels()
+    assert sorted(e1) == sorted([i % 8 for i in range(40)])
+    assert e1 != e2, "epochs must reshuffle"
+
+
+def test_im2rec_shuffle_packs_mixed_order(tmp_path):
+    """tools/im2rec.py --shuffle must randomize pack order (regression:
+    flag was accepted but ignored)."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import im2rec
+    from mxnet_tpu.io import recordio
+    from mxnet_tpu.io.image_util import encode_image
+    img_dir = tmp_path / "imgs"
+    img_dir.mkdir()
+    lines = []
+    for i in range(48):
+        lab = i // 6  # class-sorted list
+        img = np.full((8, 8, 3), lab * 10, np.uint8)
+        name = "i%03d.png" % i
+        with open(img_dir / name, "wb") as f:
+            f.write(encode_image(img, fmt=".png"))
+        lines.append("%d\t%d\t%s" % (i, lab, name))
+    lst = tmp_path / "d.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    im2rec.main([str(tmp_path / "d"), str(img_dir), "--shuffle", "1"])
+    r = recordio.MXRecordIO(str(tmp_path / "d.rec"), "r")
+    labs = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        head, _ = recordio.unpack(s)
+        labs.append(int(head.label))
+    assert sorted(labs) == sorted([i // 6 for i in range(48)])
+    assert labs != [i // 6 for i in range(48)], "pack order not shuffled"

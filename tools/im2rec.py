@@ -145,7 +145,12 @@ def convert(args, path_in):
         os.path.join(out_dir, fname_rec), "w")
     tic = time.time()
     cnt = 0
-    for item in read_list(path_in):
+    items = list(read_list(path_in))
+    if args.shuffle:
+        # randomize pack order so sequential readers see mixed classes
+        # (reference im2rec shuffles the list before packing)
+        random.shuffle(items)
+    for item in items:
         img_path = os.path.join(args.root, item[1])
         try:
             buf = image_encode(args, item, img_path)
