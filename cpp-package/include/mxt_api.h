@@ -1,0 +1,102 @@
+/*
+ * mxt_api.h — C training ABI for the mxnet_tpu framework.
+ *
+ * Role model: the training side of include/mxnet/c_api.h in the
+ * reference (NDArray CRUD, MXImperativeInvoke, symbol compose,
+ * MXExecutorBindEX + Forward/Backward, optimizer updates) — the surface
+ * cpp-package/include/mxnet-cpp headers wrap to train models from C++.
+ * The compute engine is XLA reached through JAX, so this library embeds
+ * CPython running the mxnet_tpu package; all state lives behind opaque
+ * int64 handles in a Python-side table (src/mxt_train_glue.py) and only
+ * ints/flat float buffers cross this boundary.
+ *
+ * All functions return 0 on success, -1 on failure (MXTGetLastError for
+ * the message, thread-local).  Handles are freed with MXTFree; freeing
+ * is idempotent.  Calls are GIL-serialized internally — the ABI is
+ * thread-safe but not parallel.
+ */
+#ifndef MXT_API_H_
+#define MXT_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int64_t MXTHandle;
+
+const char *MXTGetLastError(void);
+
+/* Initialize the embedded interpreter and import mxnet_tpu.
+ * repo_root: directory containing the mxnet_tpu package (and
+ * cpp-package/src for the glue).  Safe to call more than once. */
+int MXTInit(const char *repo_root);
+
+/* Free any handle kind (ndarray / symbol / executor / optimizer). */
+int MXTFree(MXTHandle h);
+
+/* Seed the framework RNG (mx.random.seed: jax keys + numpy, so weight
+ * init through MXTNDArraySetUniform becomes deterministic). */
+int MXTRandomSeed(int seed);
+
+/* -- NDArray ------------------------------------------------------- */
+int MXTNDArrayCreate(const int64_t *shape, int ndim, MXTHandle *out);
+int MXTNDArrayFromData(const int64_t *shape, int ndim, const float *data,
+                       MXTHandle *out);
+/* Copy the array into out (size = element count, must match). */
+int MXTNDArrayCopyTo(MXTHandle h, float *out, size_t size);
+/* Write `size` float32 elements into the array (in place). */
+int MXTNDArraySetData(MXTHandle h, const float *data, size_t size);
+/* shape==NULL: only *ndim is written. */
+int MXTNDArrayShape(MXTHandle h, int64_t *shape, int *ndim);
+int MXTNDArraySetUniform(MXTHandle h, float lo, float hi);
+
+/* Invoke a registered ndarray op: out = op(ins..., **{keys: vals}).
+ * Attribute values are strings; the op registry's typed specs coerce
+ * them (the reference C API has the same contract). */
+int MXTImperativeInvoke(const char *op, const MXTHandle *ins, int nin,
+                        const char **keys, const char **vals, int nkw,
+                        MXTHandle *out);
+
+/* -- Symbol -------------------------------------------------------- */
+int MXTSymbolVariable(const char *name, MXTHandle *out);
+int MXTSymbolCompose(const char *op, const char *name,
+                     const MXTHandle *ins, int nin, const char **keys,
+                     const char **vals, int nkw, MXTHandle *out);
+/* JSON is copied into buf (cap bytes incl. NUL); *needed gets the full
+ * length so callers can retry with a larger buffer. */
+int MXTSymbolSaveJSON(MXTHandle h, char *buf, size_t cap, size_t *needed);
+/* List arguments: call with names==NULL to get the count. Each name is
+ * copied into the caller's buffers (name_cap bytes each). */
+int MXTSymbolListArguments(MXTHandle h, char **names, int name_cap,
+                           int *count);
+
+/* -- Executor ------------------------------------------------------ */
+/* grad_req: "write" | "null".  arg i has shapes[offsets[i]..+ndims[i]). */
+int MXTExecutorSimpleBind(MXTHandle sym, const char *grad_req,
+                          const char **arg_names, const int64_t *shapes,
+                          const int *ndims, int n_args, MXTHandle *out);
+int MXTExecutorForward(MXTHandle ex, int is_train);
+int MXTExecutorBackward(MXTHandle ex);
+int MXTExecutorNumOutputs(MXTHandle ex, int *out);
+int MXTExecutorOutput(MXTHandle ex, int index, MXTHandle *out);
+/* Bound argument / gradient arrays by name (live views: SetData on the
+ * returned handle feeds the next Forward). */
+int MXTExecutorArgArray(MXTHandle ex, const char *name, MXTHandle *out);
+int MXTExecutorGradArray(MXTHandle ex, const char *name, MXTHandle *out);
+
+/* -- Optimizer ----------------------------------------------------- */
+int MXTOptimizerCreate(const char *name, const char **keys,
+                       const char **vals, int nkw, MXTHandle *out);
+/* In-place weight update; idx identifies the parameter (per-index
+ * optimizer state, reference Optimizer semantics). */
+int MXTOptimizerUpdate(MXTHandle opt, int idx, MXTHandle weight,
+                       MXTHandle grad);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXT_API_H_ */

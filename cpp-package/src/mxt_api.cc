@@ -1,0 +1,376 @@
+// mxt_api.cc — C training ABI over the embedded mxnet_tpu runtime.
+//
+// Reference role: the training slice of src/c_api/c_api.cc (NDArray
+// CRUD, MXImperativeInvoke, symbol compose, executor bind/forward/
+// backward, optimizer updates).  State lives in the Python-side handle
+// table (src/mxt_train_glue.py); this file converts C buffers <-> numpy
+// under the GIL and maps exceptions to MXTGetLastError.
+//
+// Build: see cpp-package/Makefile (libmxt.so target).
+
+#include "../include/mxt_api.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "mxt_embed_common.h"
+
+namespace {
+
+using mxt_embed::Gil;
+using mxt_embed::g_err;
+using mxt_embed::set_err;
+using mxt_embed::set_err_from_python;
+
+PyObject *g_glue = nullptr;  // mxt_train_glue module
+
+// Call glue.<fn>(*args); returns new ref or nullptr (error already set).
+PyObject *glue_call(const char *fn, PyObject *args) {
+  if (g_glue == nullptr) {
+    Py_XDECREF(args);
+    set_err("MXTInit was not called");
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(g_glue, fn);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    set_err_from_python();
+    return nullptr;
+  }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) set_err_from_python();
+  return r;
+}
+
+// Call returning an int64 handle into *out.
+int glue_call_handle(const char *fn, PyObject *args, MXTHandle *out) {
+  PyObject *r = glue_call(fn, args);
+  if (r == nullptr) return -1;
+  *out = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  if (*out == -1 && PyErr_Occurred()) {
+    set_err_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+// Call where the result is discarded (glue returns 0).
+int glue_call_void(const char *fn, PyObject *args) {
+  PyObject *r = glue_call(fn, args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+PyObject *shape_tuple(const int64_t *shape, int ndim) {
+  PyObject *t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(shape[i]));
+  return t;
+}
+
+PyObject *str_list(const char **strs, int n) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(strs[i]));
+  return l;
+}
+
+PyObject *handle_list(const MXTHandle *hs, int n) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromLongLong(hs[i]));
+  return l;
+}
+
+// numpy float32 C-contiguous array object wrapping a COPY of data.
+PyObject *numpy_from_buffer(const int64_t *shape, int ndim,
+                            const float *data) {
+  // build via python: np.frombuffer is zero-copy (unsafe); go through
+  // bytes -> np.frombuffer(...).reshape(shape).copy() using the glue's
+  // numpy to avoid linking numpy headers.
+  size_t count = 1;
+  for (int i = 0; i < ndim; ++i) count *= static_cast<size_t>(shape[i]);
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (np == nullptr) return nullptr;
+  PyObject *frombuffer = PyObject_GetAttrString(np, "frombuffer");
+  Py_DECREF(np);
+  if (frombuffer == nullptr) return nullptr;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(count * sizeof(float)));
+  PyObject *args = Py_BuildValue("(Os)", bytes, "float32");
+  PyObject *flat = PyObject_CallObject(frombuffer, args);
+  Py_DECREF(args);
+  Py_DECREF(bytes);
+  Py_DECREF(frombuffer);
+  if (flat == nullptr) return nullptr;
+  PyObject *shape_t = shape_tuple(shape, ndim);
+  PyObject *reshaped = PyObject_CallMethod(flat, "reshape", "(O)", shape_t);
+  Py_DECREF(shape_t);
+  Py_DECREF(flat);
+  if (reshaped == nullptr) return nullptr;
+  PyObject *copy = PyObject_CallMethod(reshaped, "copy", nullptr);
+  Py_DECREF(reshaped);
+  return copy;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTGetLastError(void) { return g_err; }
+
+int MXTInit(const char *repo_root) {
+  if (!mxt_embed::ensure_python()) {
+    set_err("could not initialize python");
+    return -1;
+  }
+  Gil gil;
+  if (g_glue != nullptr) return 0;
+  if (repo_root != nullptr) {
+    PyObject *sys_path = PySys_GetObject("path");  // borrowed
+    std::string root(repo_root);
+    std::string glue_dir = root + "/cpp-package/src";
+    for (const std::string &p : {root, glue_dir}) {
+      PyObject *dir = PyUnicode_FromString(p.c_str());
+      if (sys_path == nullptr || dir == nullptr ||
+          PyList_Insert(sys_path, 0, dir) != 0) {
+        Py_XDECREF(dir);
+        set_err_from_python();
+        return -1;
+      }
+      Py_DECREF(dir);
+    }
+  }
+  g_glue = PyImport_ImportModule("mxt_train_glue");
+  if (g_glue == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+int MXTFree(MXTHandle h) {
+  Gil gil;
+  return glue_call_void("free", Py_BuildValue("(L)", h));
+}
+
+int MXTNDArrayCreate(const int64_t *shape, int ndim, MXTHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(N)", shape_tuple(shape, ndim));
+  return glue_call_handle("nd_create", args, out);
+}
+
+int MXTNDArrayFromData(const int64_t *shape, int ndim, const float *data,
+                       MXTHandle *out) {
+  Gil gil;
+  PyObject *arr = numpy_from_buffer(shape, ndim, data);
+  if (arr == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  return glue_call_handle("nd_from_numpy", Py_BuildValue("(N)", arr), out);
+}
+
+int MXTNDArrayCopyTo(MXTHandle h, float *out, size_t size) {
+  Gil gil;
+  PyObject *arr = glue_call("nd_to_numpy", Py_BuildValue("(L)", h));
+  if (arr == nullptr) return -1;
+  PyObject *bytes = PyObject_CallMethod(arr, "tobytes", nullptr);
+  Py_DECREF(arr);
+  if (bytes == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &len) != 0 ||
+      static_cast<size_t>(len) != size * sizeof(float)) {
+    Py_DECREF(bytes);
+    set_err("size mismatch in MXTNDArrayCopyTo");
+    return -1;
+  }
+  std::memcpy(out, buf, len);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXTNDArraySetData(MXTHandle h, const float *data, size_t size) {
+  Gil gil;
+  // flat 1-D buffer: the glue reshapes to the array's own shape and
+  // raises on element-count mismatch, so no extra shape round-trip
+  const int64_t flat = static_cast<int64_t>(size);
+  PyObject *arr = numpy_from_buffer(&flat, 1, data);
+  if (arr == nullptr) {
+    set_err_from_python();
+    return -1;
+  }
+  return glue_call_void("nd_set_from_numpy",
+                        Py_BuildValue("(LN)", h, arr));
+}
+
+int MXTRandomSeed(int seed) {
+  Gil gil;
+  return glue_call_void("seed", Py_BuildValue("(i)", seed));
+}
+
+int MXTNDArrayShape(MXTHandle h, int64_t *shape, int *ndim) {
+  Gil gil;
+  PyObject *shp = glue_call("nd_shape", Py_BuildValue("(L)", h));
+  if (shp == nullptr) return -1;
+  int n = static_cast<int>(PyTuple_Size(shp));
+  if (shape != nullptr)
+    for (int i = 0; i < n && i < *ndim; ++i)
+      shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(shp, i));
+  *ndim = n;
+  Py_DECREF(shp);
+  return 0;
+}
+
+int MXTNDArraySetUniform(MXTHandle h, float lo, float hi) {
+  Gil gil;
+  return glue_call_void("nd_set_uniform",
+                        Py_BuildValue("(Lff)", h, lo, hi));
+}
+
+int MXTImperativeInvoke(const char *op, const MXTHandle *ins, int nin,
+                        const char **keys, const char **vals, int nkw,
+                        MXTHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(sNNN)", op, handle_list(ins, nin),
+                                 str_list(keys, nkw), str_list(vals, nkw));
+  return glue_call_handle("invoke", args, out);
+}
+
+int MXTSymbolVariable(const char *name, MXTHandle *out) {
+  Gil gil;
+  return glue_call_handle("sym_variable", Py_BuildValue("(s)", name), out);
+}
+
+int MXTSymbolCompose(const char *op, const char *name,
+                     const MXTHandle *ins, int nin, const char **keys,
+                     const char **vals, int nkw, MXTHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue(
+      "(ssNNN)", op, name == nullptr ? "" : name, handle_list(ins, nin),
+      str_list(keys, nkw), str_list(vals, nkw));
+  return glue_call_handle("sym_compose", args, out);
+}
+
+int MXTSymbolSaveJSON(MXTHandle h, char *buf, size_t cap, size_t *needed) {
+  Gil gil;
+  PyObject *s = glue_call("sym_to_json", Py_BuildValue("(L)", h));
+  if (s == nullptr) return -1;
+  Py_ssize_t len = 0;
+  const char *c = PyUnicode_AsUTF8AndSize(s, &len);
+  if (c == nullptr) {
+    Py_DECREF(s);
+    set_err_from_python();
+    return -1;
+  }
+  if (needed != nullptr) *needed = static_cast<size_t>(len) + 1;
+  if (buf != nullptr && cap > 0) {
+    size_t n = static_cast<size_t>(len) < cap - 1
+                   ? static_cast<size_t>(len) : cap - 1;
+    std::memcpy(buf, c, n);
+    buf[n] = '\0';
+  }
+  Py_DECREF(s);
+  return 0;
+}
+
+int MXTSymbolListArguments(MXTHandle h, char **names, int name_cap,
+                           int *count) {
+  Gil gil;
+  PyObject *lst = glue_call("sym_list_arguments", Py_BuildValue("(L)", h));
+  if (lst == nullptr) return -1;
+  int n = static_cast<int>(PyList_Size(lst));
+  if (names != nullptr) {
+    for (int i = 0; i < n && i < *count; ++i) {
+      const char *c = PyUnicode_AsUTF8(PyList_GET_ITEM(lst, i));
+      std::snprintf(names[i], name_cap, "%s", c == nullptr ? "" : c);
+    }
+  }
+  *count = n;
+  Py_DECREF(lst);
+  return 0;
+}
+
+int MXTExecutorSimpleBind(MXTHandle sym, const char *grad_req,
+                          const char **arg_names, const int64_t *shapes,
+                          const int *ndims, int n_args, MXTHandle *out) {
+  Gil gil;
+  PyObject *names = str_list(arg_names, n_args);
+  PyObject *shape_list = PyList_New(n_args);
+  const int64_t *p = shapes;
+  for (int i = 0; i < n_args; ++i) {
+    PyList_SET_ITEM(shape_list, i, shape_tuple(p, ndims[i]));
+    p += ndims[i];
+  }
+  PyObject *args = Py_BuildValue("(LsNN)", sym, grad_req, names,
+                                 shape_list);
+  return glue_call_handle("simple_bind", args, out);
+}
+
+int MXTExecutorForward(MXTHandle ex, int is_train) {
+  Gil gil;
+  return glue_call_void("executor_forward",
+                        Py_BuildValue("(Li)", ex, is_train));
+}
+
+int MXTExecutorBackward(MXTHandle ex) {
+  Gil gil;
+  return glue_call_void("executor_backward", Py_BuildValue("(L)", ex));
+}
+
+int MXTExecutorNumOutputs(MXTHandle ex, int *out) {
+  Gil gil;
+  PyObject *r = glue_call("executor_num_outputs", Py_BuildValue("(L)", ex));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTExecutorOutput(MXTHandle ex, int index, MXTHandle *out) {
+  Gil gil;
+  return glue_call_handle("executor_output",
+                          Py_BuildValue("(Li)", ex, index), out);
+}
+
+int MXTExecutorArgArray(MXTHandle ex, const char *name, MXTHandle *out) {
+  Gil gil;
+  return glue_call_handle("executor_arg",
+                          Py_BuildValue("(Ls)", ex, name), out);
+}
+
+int MXTExecutorGradArray(MXTHandle ex, const char *name, MXTHandle *out) {
+  Gil gil;
+  return glue_call_handle("executor_grad",
+                          Py_BuildValue("(Ls)", ex, name), out);
+}
+
+int MXTOptimizerCreate(const char *name, const char **keys,
+                       const char **vals, int nkw, MXTHandle *out) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(sNN)", name, str_list(keys, nkw),
+                                 str_list(vals, nkw));
+  return glue_call_handle("optimizer_create", args, out);
+}
+
+int MXTOptimizerUpdate(MXTHandle opt, int idx, MXTHandle weight,
+                       MXTHandle grad) {
+  Gil gil;
+  return glue_call_void(
+      "optimizer_update", Py_BuildValue("(LiLL)", opt, idx, weight, grad));
+}
+
+}  // extern "C"

@@ -1,0 +1,61 @@
+// mxt_embed_common.h — interpreter plumbing shared by the predict and
+// training ABIs (each .so carries its own copy of the thread-local
+// error buffer; the helpers must stay identical, which is why they
+// live here and not pasted per file).
+#ifndef MXT_EMBED_COMMON_H_
+#define MXT_EMBED_COMMON_H_
+
+#include <Python.h>
+
+#include <cstdio>
+#include <string>
+
+namespace mxt_embed {
+
+inline thread_local char g_err[2048];
+
+inline void set_err(const char *what) {
+  std::snprintf(g_err, sizeof(g_err), "%s", what);
+}
+
+// Capture the pending Python exception into g_err.
+inline void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  set_err(msg.c_str());
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Initialize the interpreter; release the init-acquired GIL so
+// PyGILState_Ensure nests correctly from any caller thread.
+inline bool ensure_python() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  PyEval_SaveThread();
+  return Py_IsInitialized() != 0;
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace mxt_embed
+
+#endif  // MXT_EMBED_COMMON_H_

@@ -22,33 +22,14 @@
 #include <string>
 #include <vector>
 
+#include "mxt_embed_common.h"
+
 namespace {
 
-thread_local char g_err[2048];
-
-void set_err(const char *what) {
-  std::snprintf(g_err, sizeof(g_err), "%s", what);
-}
-
-// Capture the pending Python exception into g_err.
-void set_err_from_python() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  std::string msg = "python error";
-  if (value != nullptr) {
-    PyObject *s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char *c = PyUnicode_AsUTF8(s);
-      if (c != nullptr) msg = c;
-      Py_DECREF(s);
-    }
-  }
-  set_err(msg.c_str());
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-}
+using mxt_embed::Gil;
+using mxt_embed::g_err;
+using mxt_embed::set_err;
+using mxt_embed::set_err_from_python;
 
 struct Pred {
   PyObject *predictor = nullptr;           // mxnet_predict.Predictor
@@ -64,23 +45,7 @@ struct Pred {
   }
 };
 
-bool ensure_python() {
-  if (Py_IsInitialized()) return true;
-  Py_InitializeEx(0);
-  // release the GIL acquired by initialization so PyGILState_Ensure
-  // nests correctly from any caller thread
-  PyEval_SaveThread();
-  return Py_IsInitialized() != 0;
-}
-
-class Gil {
- public:
-  Gil() : state_(PyGILState_Ensure()) {}
-  ~Gil() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
+using mxt_embed::ensure_python;
 
 PyObject *call_method(PyObject *obj, const char *name, PyObject *args) {
   PyObject *fn = PyObject_GetAttrString(obj, name);

@@ -1,0 +1,173 @@
+"""Handle-table glue between the C training ABI and mxnet_tpu.
+
+Role model: the reference's C API marshals every binding through
+integer-safe handles (src/c_api/c_api.cc); here the handle table lives
+on the Python side so the embedded-interpreter C layer
+(cpp-package/src/mxt_api.cc) only ever passes ints and flat buffers —
+no PyObject ownership crosses the boundary except transiently under the
+GIL.
+
+Every public function either returns a plain int/tuple/numpy array or
+raises; the C layer converts exceptions into MXTGetLastError strings.
+Attribute values arrive as strings and are coerced by the op registry's
+typed AttrSpecs — exactly how the reference parses C-API kwargs into
+dmlc parameter structs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+_handles = {}
+_next_handle = [1]
+
+
+def _put(obj):
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _handles[h] = obj
+    return h
+
+
+def _get(h):
+    return _handles[h]
+
+
+def free(h):
+    _handles.pop(h, None)
+    return 0
+
+
+# -- ndarray ----------------------------------------------------------------
+def nd_create(shape):
+    return _put(mx.nd.zeros(tuple(int(d) for d in shape)))
+
+
+def nd_from_numpy(arr):
+    return _put(mx.nd.array(np.asarray(arr, dtype=np.float32)))
+
+
+def nd_to_numpy(h):
+    return np.ascontiguousarray(_get(h).asnumpy(), dtype=np.float32)
+
+
+def nd_shape(h):
+    return tuple(int(d) for d in _get(h).shape)
+
+
+def nd_set_uniform(h, lo, hi):
+    arr = _get(h)
+    arr[:] = np.random.uniform(float(lo), float(hi), arr.shape) \
+        .astype("float32")
+    return 0
+
+
+def nd_set_from_numpy(h, src):
+    arr = _get(h)
+    arr[:] = np.asarray(src, dtype=np.float32).reshape(arr.shape)
+    return 0
+
+
+def invoke(op, in_handles, keys, vals):
+    fn = getattr(mx.nd, op, None)
+    if fn is None:
+        raise mx.base.MXNetError("unknown ndarray op %r" % op)
+    out = fn(*[_get(h) for h in in_handles], **dict(zip(keys, vals)))
+    return _put(out)
+
+
+# -- symbol -----------------------------------------------------------------
+def sym_variable(name):
+    return _put(mx.sym.Variable(name))
+
+
+def sym_compose(op, name, in_handles, keys, vals):
+    fn = getattr(mx.sym, op, None)
+    if fn is None:
+        raise mx.base.MXNetError("unknown symbol op %r" % op)
+    kwargs = dict(zip(keys, vals))
+    if name:
+        kwargs["name"] = name
+    return _put(fn(*[_get(h) for h in in_handles], **kwargs))
+
+
+def sym_to_json(h):
+    return _get(h).tojson()
+
+
+def sym_list_arguments(h):
+    return list(_get(h).list_arguments())
+
+
+def sym_list_outputs(h):
+    return list(_get(h).list_outputs())
+
+
+# -- executor ---------------------------------------------------------------
+def simple_bind(sym_h, grad_req, names, shapes):
+    kwargs = {n: tuple(int(d) for d in s) for n, s in zip(names, shapes)}
+    ex = _get(sym_h).simple_bind(mx.current_context(), grad_req=grad_req,
+                                 **kwargs)
+    return _put(ex)
+
+
+def executor_forward(h, is_train):
+    _get(h).forward(is_train=bool(is_train))
+    return 0
+
+
+def executor_backward(h):
+    _get(h).backward()
+    return 0
+
+
+def executor_num_outputs(h):
+    return len(_get(h).outputs)
+
+
+def executor_output(h, i):
+    return _put(_get(h).outputs[int(i)])
+
+
+def executor_arg(h, name):
+    return _put(_get(h).arg_dict[name])
+
+
+def executor_grad(h, name):
+    grad = _get(h).grad_dict.get(name)
+    if grad is None:
+        raise mx.base.MXNetError("no gradient bound for %r" % name)
+    return _put(grad)
+
+
+# -- random -----------------------------------------------------------------
+def seed(n):
+    mx.random.seed(int(n))
+    return 0
+
+
+# -- optimizer --------------------------------------------------------------
+def _coerce(v):
+    if v in ("True", "true", "False", "false"):
+        return v in ("True", "true")
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    return v
+
+
+def optimizer_create(name, keys, vals):
+    opt = mx.optimizer.create(name, **{
+        k: _coerce(v) for k, v in zip(keys, vals)})
+    # Updater owns the per-index lazy state exactly like the host
+    # training path (and stays checkpoint-compatible via its
+    # get_states/set_states)
+    return _put(mx.optimizer.get_updater(opt))
+
+
+def optimizer_update(opt_h, idx, weight_h, grad_h):
+    _get(opt_h)(int(idx), _get(grad_h), _get(weight_h))
+    return 0

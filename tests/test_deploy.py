@@ -103,3 +103,24 @@ def test_c_abi_demo_runs_inference(tmp_path):
     assert demo.returncode == 0, (demo.stdout[-800:], demo.stderr[-800:])
     assert "PREDICT_DEMO_OK" in demo.stdout
     assert "output 0 shape: [2, 3]" in demo.stdout
+
+
+def test_c_abi_demo_trains(tmp_path):
+    """Build libmxt.so + train_demo and train an MLP from C++ through
+    the training ABI (reference cpp-package trains MLPs from C++;
+    train_demo exits nonzero unless accuracy > 0.9)."""
+    build = subprocess.run(["make", "-C",
+                            os.path.join(REPO, "cpp-package"),
+                            "libmxt.so", "train_demo"],
+                           capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip("cpp toolchain unavailable: %s"
+                    % build.stderr[-400:])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    demo = subprocess.run(
+        [os.path.join(REPO, "cpp-package", "train_demo"), REPO, "10"],
+        capture_output=True, text=True, env=env, timeout=480)
+    assert demo.returncode == 0, (demo.stdout[-800:], demo.stderr[-800:])
+    import re
+    m = re.findall(r"train accuracy ([0-9.]+)", demo.stdout)
+    assert m and float(m[-1]) > 0.9, demo.stdout[-400:]
