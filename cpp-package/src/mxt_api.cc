@@ -28,6 +28,16 @@ using mxt_embed::set_err_from_python;
 
 PyObject *g_glue = nullptr;  // mxt_train_glue module
 
+// PyGILState_Ensure on an uninitialized interpreter is a fatal abort,
+// so every entry point must bounce cleanly before touching the GIL.
+#define MXT_REQUIRE_INIT()                          \
+  do {                                              \
+    if (!Py_IsInitialized() || g_glue == nullptr) { \
+      set_err("MXTInit was not called");            \
+      return -1;                                    \
+    }                                               \
+  } while (0)
+
 // Call glue.<fn>(*args); returns new ref or nullptr (error already set).
 PyObject *glue_call(const char *fn, PyObject *args) {
   if (g_glue == nullptr) {
@@ -78,8 +88,19 @@ PyObject *shape_tuple(const int64_t *shape, int ndim) {
 
 PyObject *str_list(const char **strs, int n) {
   PyObject *l = PyList_New(n);
-  for (int i = 0; i < n; ++i)
-    PyList_SET_ITEM(l, i, PyUnicode_FromString(strs[i]));
+  for (int i = 0; i < n; ++i) {
+    // "replace" decoding: a non-UTF-8 C string must not plant a NULL
+    // element (the glue would segfault iterating the list)
+    PyObject *s = PyUnicode_DecodeUTF8(strs[i],
+                                       static_cast<Py_ssize_t>(
+                                           std::strlen(strs[i])),
+                                       "replace");
+    if (s == nullptr) {
+      PyErr_Clear();
+      s = PyUnicode_FromString("");
+    }
+    PyList_SET_ITEM(l, i, s);
+  }
   return l;
 }
 
@@ -159,11 +180,13 @@ int MXTInit(const char *repo_root) {
 }
 
 int MXTFree(MXTHandle h) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_void("free", Py_BuildValue("(L)", h));
 }
 
 int MXTNDArrayCreate(const int64_t *shape, int ndim, MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(N)", shape_tuple(shape, ndim));
   return glue_call_handle("nd_create", args, out);
@@ -171,6 +194,7 @@ int MXTNDArrayCreate(const int64_t *shape, int ndim, MXTHandle *out) {
 
 int MXTNDArrayFromData(const int64_t *shape, int ndim, const float *data,
                        MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *arr = numpy_from_buffer(shape, ndim, data);
   if (arr == nullptr) {
@@ -181,6 +205,7 @@ int MXTNDArrayFromData(const int64_t *shape, int ndim, const float *data,
 }
 
 int MXTNDArrayCopyTo(MXTHandle h, float *out, size_t size) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *arr = glue_call("nd_to_numpy", Py_BuildValue("(L)", h));
   if (arr == nullptr) return -1;
@@ -204,6 +229,7 @@ int MXTNDArrayCopyTo(MXTHandle h, float *out, size_t size) {
 }
 
 int MXTNDArraySetData(MXTHandle h, const float *data, size_t size) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   // flat 1-D buffer: the glue reshapes to the array's own shape and
   // raises on element-count mismatch, so no extra shape round-trip
@@ -218,11 +244,13 @@ int MXTNDArraySetData(MXTHandle h, const float *data, size_t size) {
 }
 
 int MXTRandomSeed(int seed) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_void("seed", Py_BuildValue("(i)", seed));
 }
 
 int MXTNDArrayShape(MXTHandle h, int64_t *shape, int *ndim) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *shp = glue_call("nd_shape", Py_BuildValue("(L)", h));
   if (shp == nullptr) return -1;
@@ -236,6 +264,7 @@ int MXTNDArrayShape(MXTHandle h, int64_t *shape, int *ndim) {
 }
 
 int MXTNDArraySetUniform(MXTHandle h, float lo, float hi) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_void("nd_set_uniform",
                         Py_BuildValue("(Lff)", h, lo, hi));
@@ -244,6 +273,7 @@ int MXTNDArraySetUniform(MXTHandle h, float lo, float hi) {
 int MXTImperativeInvoke(const char *op, const MXTHandle *ins, int nin,
                         const char **keys, const char **vals, int nkw,
                         MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(sNNN)", op, handle_list(ins, nin),
                                  str_list(keys, nkw), str_list(vals, nkw));
@@ -251,6 +281,7 @@ int MXTImperativeInvoke(const char *op, const MXTHandle *ins, int nin,
 }
 
 int MXTSymbolVariable(const char *name, MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_handle("sym_variable", Py_BuildValue("(s)", name), out);
 }
@@ -258,6 +289,7 @@ int MXTSymbolVariable(const char *name, MXTHandle *out) {
 int MXTSymbolCompose(const char *op, const char *name,
                      const MXTHandle *ins, int nin, const char **keys,
                      const char **vals, int nkw, MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue(
       "(ssNNN)", op, name == nullptr ? "" : name, handle_list(ins, nin),
@@ -266,6 +298,7 @@ int MXTSymbolCompose(const char *op, const char *name,
 }
 
 int MXTSymbolSaveJSON(MXTHandle h, char *buf, size_t cap, size_t *needed) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *s = glue_call("sym_to_json", Py_BuildValue("(L)", h));
   if (s == nullptr) return -1;
@@ -289,6 +322,7 @@ int MXTSymbolSaveJSON(MXTHandle h, char *buf, size_t cap, size_t *needed) {
 
 int MXTSymbolListArguments(MXTHandle h, char **names, int name_cap,
                            int *count) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *lst = glue_call("sym_list_arguments", Py_BuildValue("(L)", h));
   if (lst == nullptr) return -1;
@@ -307,6 +341,7 @@ int MXTSymbolListArguments(MXTHandle h, char **names, int name_cap,
 int MXTExecutorSimpleBind(MXTHandle sym, const char *grad_req,
                           const char **arg_names, const int64_t *shapes,
                           const int *ndims, int n_args, MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *names = str_list(arg_names, n_args);
   PyObject *shape_list = PyList_New(n_args);
@@ -321,17 +356,20 @@ int MXTExecutorSimpleBind(MXTHandle sym, const char *grad_req,
 }
 
 int MXTExecutorForward(MXTHandle ex, int is_train) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_void("executor_forward",
                         Py_BuildValue("(Li)", ex, is_train));
 }
 
 int MXTExecutorBackward(MXTHandle ex) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_void("executor_backward", Py_BuildValue("(L)", ex));
 }
 
 int MXTExecutorNumOutputs(MXTHandle ex, int *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *r = glue_call("executor_num_outputs", Py_BuildValue("(L)", ex));
   if (r == nullptr) return -1;
@@ -341,18 +379,21 @@ int MXTExecutorNumOutputs(MXTHandle ex, int *out) {
 }
 
 int MXTExecutorOutput(MXTHandle ex, int index, MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_handle("executor_output",
                           Py_BuildValue("(Li)", ex, index), out);
 }
 
 int MXTExecutorArgArray(MXTHandle ex, const char *name, MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_handle("executor_arg",
                           Py_BuildValue("(Ls)", ex, name), out);
 }
 
 int MXTExecutorGradArray(MXTHandle ex, const char *name, MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_handle("executor_grad",
                           Py_BuildValue("(Ls)", ex, name), out);
@@ -360,6 +401,7 @@ int MXTExecutorGradArray(MXTHandle ex, const char *name, MXTHandle *out) {
 
 int MXTOptimizerCreate(const char *name, const char **keys,
                        const char **vals, int nkw, MXTHandle *out) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(sNN)", name, str_list(keys, nkw),
                                  str_list(vals, nkw));
@@ -368,6 +410,7 @@ int MXTOptimizerCreate(const char *name, const char **keys,
 
 int MXTOptimizerUpdate(MXTHandle opt, int idx, MXTHandle weight,
                        MXTHandle grad) {
+  MXT_REQUIRE_INIT();
   Gil gil;
   return glue_call_void(
       "optimizer_update", Py_BuildValue("(LiLL)", opt, idx, weight, grad));

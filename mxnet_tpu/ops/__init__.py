@@ -21,4 +21,9 @@ from . import contrib       # noqa: F401
 from . import rnn_op        # noqa: F401
 from . import custom        # noqa: F401
 
+# curated docs for loop-registered ops (inline doc= always wins)
+from . import docs as _docs  # noqa: E402
+
+_docs.apply()
+
 __all__ = ["get_op", "list_ops", "register", "OpDef"]

@@ -1,0 +1,188 @@
+"""Curated docstrings for ops whose registration sites build them in
+loops or from shared helpers (reference: per-op descriptions live in
+the ``describe(...)`` strings of each NNVM/legacy registration and feed
+the generated API docs; here the docgen source of truth is OpDef.doc).
+
+Applied once at package init, after every op module has registered.
+Inline ``doc=`` at a registration site always wins — this module only
+fills ops whose doc is still empty.
+"""
+from __future__ import annotations
+
+from .registry import get_op, list_ops
+
+_DOCS = {
+    # nn layers
+    "Activation": "Elementwise activation selected by `act_type` "
+                  "(relu/sigmoid/tanh/softrelu).",
+    "LeakyReLU": "Leaky/parametric/randomized rectifier family "
+                 "selected by `act_type` (leaky/prelu/rrelu/elu).",
+    "Deconvolution": "Transposed convolution (fractionally-strided); "
+                     "the gradient of Convolution w.r.t. its input.",
+    "LRN": "Local response normalization across channels "
+           "(AlexNet-style).",
+    "InstanceNorm": "Instance normalization: per-sample, per-channel "
+                    "mean/variance normalization with learned scale "
+                    "and shift.",
+    "L2Normalization": "Scale the input to unit L2 norm over the mode "
+                       "axis (instance/channel/spatial).",
+    "UpSampling": "Spatial upsampling by integer `scale` (nearest or "
+                  "bilinear kernel).",
+    # softmax family / output heads
+    "softmax": "Softmax along `axis` (normalized exponentials).",
+    "log_softmax": "Log of the softmax along `axis` (numerically "
+                   "stable).",
+    "SoftmaxActivation": "Softmax over channels (legacy layer form; "
+                         "`mode=instance` normalizes each sample).",
+    "softmax_cross_entropy": "Fused softmax + cross-entropy against "
+                             "integer labels; returns the summed loss.",
+    "LinearRegressionOutput": "Identity output head with squared-error "
+                              "gradient (d(out)/d(pred) = pred-label).",
+    "LogisticRegressionOutput": "Sigmoid output head with logistic "
+                                "loss gradient.",
+    "MAERegressionOutput": "Identity output head with mean-absolute-"
+                           "error (sign) gradient.",
+    "SVMOutput": "Hinge-loss output head (linear or squared hinge via "
+                 "`use_linear`) over class scores.",
+    "IdentityAttachKLSparseReg": "Identity that attaches a KL-"
+                                 "divergence sparsity penalty gradient "
+                                 "to the activations.",
+    # sequence ops
+    "SequenceLast": "Select the last valid timestep of each sequence "
+                    "(per-sequence lengths when `use_sequence_length`).",
+    "SequenceMask": "Zero (or `value`-fill) positions past each "
+                    "sequence's length.",
+    "SequenceReverse": "Reverse each sequence along the time axis, "
+                       "respecting per-sequence lengths.",
+    # vision ops
+    "ROIPooling": "Max-pool each region of interest onto a fixed "
+                  "`pooled_size` grid (Fast-RCNN head input).",
+    "BilinearSampler": "Sample the input at real-valued grid "
+                       "coordinates with bilinear interpolation (STN "
+                       "sampler).",
+    "GridGenerator": "Generate a sampling grid from an affine "
+                     "transform or a flow field (STN localisation "
+                     "output -> sampler input).",
+    "SpatialTransformer": "Spatial transformer: affine grid + "
+                          "bilinear sampling of the input.",
+    "Crop": "Crop the input to a reference symbol's spatial size (or "
+            "an explicit `h_w`), from the center or `offset`.",
+    "Correlation": "Correlation volume between two feature maps over a "
+                   "search window (FlowNet matching layer).",
+    # indexing
+    "Embedding": "Look up integer indices in a learned "
+                 "(input_dim, output_dim) table.",
+    "take": "Gather slices of `a` along axis 0 by integer `indices`.",
+    "batch_take": "Per-row gather: out[i] = a[i, indices[i]].",
+    "one_hot": "Expand integer indices into one-hot vectors of "
+               "`depth` (with `on_value`/`off_value`).",
+    # init/shape ops
+    "_arange": "Evenly spaced values in [start, stop) with `step`, "
+               "`repeat` times each (mx.nd.arange).",
+    "_zeros": "A new array of zeros of the given shape/dtype.",
+    "_ones": "A new array of ones of the given shape/dtype.",
+    "zeros_like": "Zeros with the shape/dtype of the input.",
+    "ones_like": "Ones with the shape/dtype of the input.",
+    "broadcast_to": "Broadcast the input to the target `shape` "
+                    "(zeros keep the source dim).",
+    "transpose": "Permute axes (reversed when `axes` is empty).",
+    "expand_dims": "Insert a size-1 axis at `axis`.",
+    "clip": "Clamp values into [a_min, a_max].",
+    "repeat": "Repeat each element `repeats` times along `axis` "
+              "(flattened when axis is None).",
+    "tile": "Tile the whole array by `reps` per axis.",
+    "slice_axis": "Slice [begin, end) along one axis (None end = to "
+                  "the end).",
+    "batch_dot": "Batched matrix product over leading batch dims, "
+                 "with `transpose_a`/`transpose_b`.",
+    "where": "Elementwise select: condition ? x : y (row-wise when "
+             "condition is 1-D).",
+    # reductions / ordering
+    "mean": "Arithmetic mean over `axis` (all axes when unset).",
+    "prod": "Product over `axis`.",
+    "nansum": "Sum over `axis` treating NaN as zero.",
+    "nanprod": "Product over `axis` treating NaN as one.",
+    "argmax": "Index of the maximum along `axis` (float output, "
+              "reference convention).",
+    "argmin": "Index of the minimum along `axis`.",
+    "argmax_channel": "Per-row argmax over the trailing axis of a 2-D "
+                      "input (reference argmax_channel).",
+    "sort": "Sort values along `axis` (descending when is_ascend=0).",
+    "argsort": "Indices that would sort along `axis` (float output).",
+    "topk": "Top-k values/indices/mask along `axis` (`ret_typ` "
+            "selects the output form).",
+    # shape / layout ops
+    "Reshape": "Reshape with the reference's special codes (0 copy "
+               "dim, -1 infer, -2 copy rest, -3 merge, -4 split).",
+    "Flatten": "Collapse all trailing axes into one: (d0, d1*...*dn).",
+    "Cast": "Convert to `dtype`.",
+    "Concat": "Join `num_args` inputs along `dim`.",
+    "SliceChannel": "Split into `num_outputs` equal parts along "
+                    "`axis` (squeezed when `squeeze_axis`).",
+    "SwapAxis": "Exchange axes `dim1` and `dim2`.",
+    "Pad": "Pad spatial axes (constant/edge/reflect `mode`; pad_width "
+           "in the reference's 2N layout).",
+    "Pooling": "Max/avg/sum spatial pooling with kernel/stride/pad "
+               "(`global_pool` reduces the whole map).",
+    "Pooling_v1": "Legacy pooling (v0.8 layer): same semantics as "
+                  "Pooling with the old default conventions.",
+    "slice": "Slice [begin, end) per axis (None keeps the full axis).",
+    "reverse": "Reverse along the given axes (alias flip).",
+    "broadcast_axis": "Broadcast size-1 axes to the given sizes.",
+    # reductions with axis aliases
+    "sum": "Sum over `axis` (all axes when unset; keepdims "
+           "supported).",
+    "max": "Maximum over `axis`.",
+    "min": "Minimum over `axis`.",
+    # sampling (both _random_* functional and _sample_* legacy names)
+    "_random_uniform": "Draw from Uniform(low, high) into the given "
+                       "shape.",
+    "_random_normal": "Draw from Normal(loc, scale).",
+    "_random_gamma": "Draw from Gamma(alpha, beta).",
+    "_random_exponential": "Draw from Exponential(lam).",
+    "_random_poisson": "Draw from Poisson(lam).",
+    "_random_negbinomial": "Draw from NegativeBinomial(k, p).",
+    # contrib
+    "_contrib_MultiBoxPrior": "Generate SSD anchor boxes for each "
+                              "feature-map cell (sizes x ratios).",
+    "_contrib_MultiBoxTarget": "Match anchors to ground-truth boxes: "
+                               "classification targets + box "
+                               "regression targets/masks (SSD).",
+    "_contrib_MultiBoxDetection": "Decode anchor offsets to detections "
+                                  "with per-class NMS (SSD output).",
+    "_contrib_Proposal": "RPN proposal layer: decode anchors, clip, "
+                         "NMS, top-k ROIs (Faster-RCNN).",
+    "_contrib_count_sketch": "Count-sketch projection of the input "
+                             "rows into `out_dim` buckets.",
+    "_contrib_fft": "FFT of the trailing axis; complex output packed "
+                    "as interleaved re/im floats.",
+    "_contrib_ifft": "Inverse FFT of interleaved re/im input.",
+    "_contrib_quantize": "Quantize float32 to uint8 given min/max "
+                         "calibration ranges.",
+    "_contrib_dequantize": "Dequantize uint8 back to float32 given "
+                           "min/max ranges.",
+    # fused optimizer update kernels
+    "sgd_update": "Fused SGD step: w -= lr * (rescale*clip(grad) + "
+                  "wd*w), in place.",
+    "sgd_mom_update": "Fused SGD-momentum step updating (weight, "
+                      "momentum) in place.",
+    "adam_update": "Fused Adam step updating (weight, mean, var) in "
+                   "place.",
+    "rmsprop_update": "Fused RMSProp step (uncentered) updating "
+                      "(weight, n) in place.",
+    "rmspropalex_update": "Fused centered RMSProp (Alex Graves "
+                          "variant) updating (weight, n, g, delta) in "
+                          "place.",
+}
+
+
+def apply():
+    for name, doc in _DOCS.items():
+        op = get_op(name)
+        if not op.doc:
+            op.doc = doc
+
+
+def missing():
+    """Op names that still have no doc (docgen/test hook)."""
+    return [n for n in list_ops() if not get_op(n).doc]

@@ -22,7 +22,22 @@ _f = Float
 # ---------------------------------------------------------------------------
 # Unary math
 # ---------------------------------------------------------------------------
+_UNARY_DESC = {
+    "relu": "max(x, 0)", "sigmoid": "1/(1+exp(-x))",
+    "softsign": "x/(1+|x|)", "_copy": "identity copy",
+    "negative": "-x", "rsqrt": "1/sqrt(x)", "rcbrt": "1/cbrt(x)",
+    "fix": "round toward zero", "rint": "round to nearest integer",
+    "square": "x*x", "expm1": "exp(x)-1 (accurate near 0)",
+    "log1p": "log(1+x) (accurate near 0)",
+    "gamma": "the gamma function", "gammaln": "log|gamma(x)|",
+    "erf": "the error function",
+    "degrees": "radians -> degrees", "radians": "degrees -> radians",
+}
+
+
 def _unary(name, fn, aliases=(), doc=""):
+    doc = doc or ("Elementwise %s." % _UNARY_DESC.get(
+        name, "`%s(x)`" % name.lstrip("_")))
     register(name, fcompute=lambda attrs, x: fn(x), doc=doc)
     for a in aliases:
         register_alias(name, a)
@@ -150,9 +165,11 @@ register_alias("Cast", "cast")
 # ---------------------------------------------------------------------------
 # Binary (same-shape) — reference elemwise_binary_op_basic.cc
 # ---------------------------------------------------------------------------
-def _binary(name, fn, aliases=()):
+def _binary(name, fn, aliases=(), doc=""):
+    doc = doc or ("Elementwise `%s(lhs, rhs)` on same-shape inputs."
+                  % getattr(fn, "__name__", name.lstrip("_")))
     register(name, fcompute=lambda attrs, a, b: fn(a, b),
-             arguments=("lhs", "rhs"))
+             arguments=("lhs", "rhs"), doc=doc)
     for a in aliases:
         register_alias(name, a)
 
@@ -161,7 +178,9 @@ _binary("elemwise_add", jnp.add, aliases=("_plus", "_add"))
 _binary("elemwise_sub", jnp.subtract, aliases=("_minus", "_sub"))
 _binary("elemwise_mul", jnp.multiply, aliases=("_mul",))
 _binary("elemwise_div", jnp.divide, aliases=("_div",))
-_binary("_grad_add", jnp.add)
+_binary("_grad_add", jnp.add,
+        doc="Gradient accumulation add (reference _grad_add: chained "
+            "in-place sums past the inplace-sum cap).")
 _binary("_maximum", jnp.maximum)
 _binary("_minimum", jnp.minimum)
 _binary("_power", jnp.power)
@@ -187,11 +206,14 @@ def _bcast_infer_shape(attrs, in_shapes):
 
 
 def _bcast(name, fn, logic=False):
+    base = getattr(fn, "__name__", name)
+    doc = ("Elementwise `%s(lhs, rhs)` with numpy-style broadcasting%s."
+           % (base, "; returns float32 0/1" if logic else ""))
     it = (lambda attrs, ts: (ts, ["float32"], [])) if logic else None
     register(name, fcompute=lambda attrs, a, b: (
         fn(a, b).astype(jnp.float32) if logic else fn(a, b)),
         arguments=("lhs", "rhs"), infer_shape=_bcast_infer_shape,
-        infer_type=it)
+        infer_type=it, doc=doc)
 
 
 _bcast("broadcast_add", jnp.add)
@@ -217,10 +239,17 @@ _bcast("broadcast_lesser_equal", jnp.less_equal, logic=True)
 # Scalar binary — reference elemwise_binary_scalar_op_*.cc
 # ---------------------------------------------------------------------------
 def _scalar(name, fn):
+    base = name.lstrip("_").replace("_scalar", "")
+    if base.startswith("r") and base[1:] in (
+            "minus", "div", "power", "mod"):
+        doc = ("Elementwise reversed scalar op: `%s(scalar, x)` with "
+               "the scalar on the left." % base[1:])
+    else:
+        doc = "Elementwise `%s(x, scalar)`." % base
     register(name,
              fcompute=lambda attrs, x: fn(x, jnp.asarray(
                  attrs["scalar"], dtype=x.dtype)),
-             attrs={"scalar": _f(required=True)})
+             attrs={"scalar": _f(required=True)}, doc=doc)
 
 
 _scalar("_plus_scalar", jnp.add)
