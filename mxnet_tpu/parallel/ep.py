@@ -20,7 +20,12 @@ __all__ = ["moe_ffn", "top1_dispatch", "init_moe_params"]
 
 def top1_dispatch(x, gate_w, num_experts, capacity):
     """Top-1 gating with capacity: returns (dispatch [T,E,C] one-hot,
-    combine [T,E,C] gate-weighted, aux_loss scalar).
+    combine [T,E,C] gate-weighted, (frac_tokens [E], frac_probs [E])).
+
+    The caller forms the Switch load-balance loss as
+    ``sum(frac_tokens * frac_probs) * E`` — across shards the fractions
+    must be averaged over every token-sharding axis BEFORE that product
+    (see moe_ffn's frac_axis_names).
 
     Dense-tensor dispatch (Shazeer-style) — static shapes, no sorting, maps
     straight onto the MXU; tokens overflowing an expert's capacity are
@@ -41,15 +46,18 @@ def top1_dispatch(x, gate_w, num_experts, capacity):
     dispatch = slot * in_cap[..., None]
     combine = dispatch * gate[:, None, None]
 
-    # load-balancing auxiliary loss (Switch-Transformer form)
+    # load-balancing fractions (Switch-Transformer aux loss inputs);
+    # the caller forms sum(frac_tokens*frac_probs)*E — across shards
+    # the fractions must be averaged BEFORE that product (the product
+    # of local means is not the product of the global means, which
+    # would make the loss layout-dependent)
     frac_tokens = jnp.mean(onehot, axis=0)
     frac_probs = jnp.mean(probs, axis=0)
-    aux = jnp.sum(frac_tokens * frac_probs) * num_experts
-    return dispatch, combine, aux
+    return dispatch, combine, (frac_tokens, frac_probs)
 
 
 def moe_ffn(x, params, axis_name="ep", capacity_factor=2.0,
-            activation=jax.nn.gelu):
+            activation=jax.nn.gelu, frac_axis_names=None):
     """MoE FFN body — call INSIDE shard_map with experts sharded over
     ``axis_name`` and tokens (batch) sharded over the same axis.
 
@@ -60,7 +68,12 @@ def moe_ffn(x, params, axis_name="ep", capacity_factor=2.0,
         b1    [E_local, H]
         w2    [E_local, H, D]
         b2    [E_local, D]
-    Returns ([T_local, D], aux_loss).
+    frac_axis_names: EVERY mesh axis that shards tokens (defaults to
+        (axis_name,)).  The Switch aux loss is formed from fractions
+        averaged over these axes; leaving a token-sharding axis out
+        makes the loss depend on the device layout.
+    Returns ([T_local, D], aux_loss) — aux replicated over the named
+    axes.
     """
     ep = jax.lax.axis_size(axis_name)
     T, D = x.shape
@@ -68,7 +81,8 @@ def moe_ffn(x, params, axis_name="ep", capacity_factor=2.0,
     E = e_local * ep
     capacity = max(1, int(capacity_factor * T / E))
 
-    dispatch, combine, aux = top1_dispatch(x, params["gate"], E, capacity)
+    dispatch, combine, (frac_tokens, frac_probs) = top1_dispatch(
+        x, params["gate"], E, capacity)
     # [T,E,C] x [T,D] -> expert inputs [E, C, D]
     exp_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     # all-to-all: split expert axis across devices, gather everyone's slots
@@ -83,7 +97,15 @@ def moe_ffn(x, params, axis_name="ep", capacity_factor=2.0,
     out = jax.lax.all_to_all(out, axis_name, split_axis=1,
                              concat_axis=0, tiled=True)   # [E, C, D]
     y = jnp.einsum("tec,ecd->td", combine, out)
-    aux = jax.lax.pmean(aux, axis_name)
+    # aux loss from GLOBAL fractions: average the per-shard means over
+    # EVERY axis that shards tokens (callers with dp/sp axes must name
+    # them via frac_axis_names), THEN take the Switch product — the
+    # product of local means is not the product of the global means, so
+    # anything less makes the loss depend on the device layout
+    axes = tuple(frac_axis_names or (axis_name,))
+    frac_tokens = jax.lax.pmean(frac_tokens, axes)
+    frac_probs = jax.lax.pmean(frac_probs, axes)
+    aux = jnp.sum(frac_tokens * frac_probs) * E
     return y.astype(x.dtype), aux
 
 

@@ -154,7 +154,8 @@ def _block_fn(blk, x, cfg, pos0):
         # chunk (no redundant gating compute, grads come out 1x)
         local = jax.lax.dynamic_slice_in_dim(flat, rank * chunk, chunk, 0)
         y_local, aux = moe_ffn(local, blk["moe"], axis_name="tp",
-                               capacity_factor=cfg.capacity_factor)
+                               capacity_factor=cfg.capacity_factor,
+                               frac_axis_names=("dp", "sp", "tp"))
         # exit `g`: scatter into the full buffer + psum (== all-gather
         # forward, identity backward — each rank's chunk cotangent is 1x)
         y = jnp.zeros((T, D), y_local.dtype)
@@ -198,12 +199,10 @@ def transformer_loss(params, tokens, targets, cfg):
                         params["embed"].astype(cdt)).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    # global mean over (dp × sp × local) tokens; aux is tp-replicated but
-    # varies across dp/sp token shards, so it needs the same reduction for
-    # the returned scalar to be the true global objective on every rank
+    # global mean over (dp × sp × local) tokens; aux_total is already
+    # replicated across every mesh axis (moe_ffn averages the balance
+    # fractions over frac_axis_names before forming the Switch product)
     loss = jax.lax.pmean(jax.lax.pmean(jnp.mean(nll), "dp"), "sp")
-    if not isinstance(aux_total, float):
-        aux_total = jax.lax.pmean(jax.lax.pmean(aux_total, "dp"), "sp")
     return loss + 0.01 * aux_total
 
 
