@@ -102,7 +102,11 @@ def moe_ffn(x, params, axis_name="ep", capacity_factor=2.0,
     # them via frac_axis_names), THEN take the Switch product — the
     # product of local means is not the product of the global means, so
     # anything less makes the loss depend on the device layout
-    axes = tuple(frac_axis_names or (axis_name,))
+    if frac_axis_names is None:
+        frac_axis_names = (axis_name,)
+    elif isinstance(frac_axis_names, str):
+        frac_axis_names = (frac_axis_names,)  # not tuple("dp") -> ('d','p')
+    axes = tuple(frac_axis_names)
     frac_tokens = jax.lax.pmean(frac_tokens, axes)
     frac_probs = jax.lax.pmean(frac_probs, axes)
     aux = jnp.sum(frac_tokens * frac_probs) * E
