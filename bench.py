@@ -505,6 +505,11 @@ def main():
         except Exception as e:
             rows.append(_error_row(tag, e))
         print("# %s" % json.dumps(rows[-1]), flush=True)
+        # bank incrementally: a tunnel drop mid-sweep must not lose the
+        # rows that already completed on chip
+        partial = _assemble_out(rows, chip, smoke, t0)
+        partial["partial"] = True
+        _bank_witness(partial)
 
     guard("train.resnet-50.trainer_direct", bench_trainer_direct, iters,
           warmup, chip, smoke)
@@ -522,10 +527,19 @@ def main():
           chip, smoke)
     guard("comm", bench_comm, chip)
 
-    # headline: trainer-direct resnet-50 (round-1 protocol continuity);
-    # falls back to the Module.fit row if the direct row errored
+    out = _assemble_out(rows, chip, smoke, t0)
+    _bank_witness(out)
+    print(json.dumps(out))
+
+
+def _assemble_out(rows, chip, smoke, t0):
+    """Driver-contract output dict from whatever rows exist so far.
+
+    Headline: trainer-direct resnet-50 (round-1 protocol continuity),
+    falling back to the Module.fit row if the direct row errored."""
     headline = None
-    for m in ("train.resnet-50.trainer_direct", "train.resnet-50.module_fit"):
+    for m in ("train.resnet-50.trainer_direct",
+              "train.resnet-50.module_fit"):
         for r in rows:
             if r["metric"] == m and r.get("unit") != "error":
                 headline = r
@@ -549,15 +563,14 @@ def main():
         "smoke": smoke,
         "fit_vs_direct": fit_vs_direct,
         "total_seconds": round(time.time() - t0, 1),
-        "rows": rows,
+        "rows": list(rows),
     }
     if smoke and fit_vs_direct is not None:
         # tiny-net smoke steps are overhead-dominated; the ratio is
         # plumbing validation, not the on-chip parity gate
         out["fit_vs_direct_note"] = ("smoke mode: tiny stand-in nets, "
                                      "not the +/-10%% parity gate")
-    _bank_witness(out)
-    print(json.dumps(out))
+    return out
 
 
 if __name__ == "__main__":

@@ -102,10 +102,10 @@ def moe_ffn(x, params, axis_name="ep", capacity_factor=2.0,
     # them via frac_axis_names), THEN take the Switch product — the
     # product of local means is not the product of the global means, so
     # anything less makes the loss depend on the device layout
-    if frac_axis_names is None:
-        frac_axis_names = (axis_name,)
-    elif isinstance(frac_axis_names, str):
+    if isinstance(frac_axis_names, str):
         frac_axis_names = (frac_axis_names,)  # not tuple("dp") -> ('d','p')
+    elif not frac_axis_names:   # None and () both mean "just my axis"
+        frac_axis_names = (axis_name,)
     axes = tuple(frac_axis_names)
     frac_tokens = jax.lax.pmean(frac_tokens, axes)
     frac_probs = jax.lax.pmean(frac_probs, axes)
