@@ -766,6 +766,10 @@ class Executor:
             name = self._arg_names[i]
             req = self.grad_req.get(name, "write")
             gbuf = self.grad_arrays[i]
+            if g.dtype == jax.dtypes.float0:
+                # jax's zero-tangent for non-differentiable (integer)
+                # primals: surface usable zeros, not a float0 array
+                g = jnp.zeros(g.shape, gbuf._data.dtype)
             if req == "add":
                 gbuf._data = gbuf._data + g
             else:

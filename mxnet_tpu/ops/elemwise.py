@@ -233,6 +233,15 @@ _bcast("broadcast_greater", jnp.greater, logic=True)
 _bcast("broadcast_greater_equal", jnp.greater_equal, logic=True)
 _bcast("broadcast_lesser", jnp.less, logic=True)
 _bcast("broadcast_lesser_equal", jnp.less_equal, logic=True)
+# same-shape comparison names (reference elemwise_binary_op_logic.cc);
+# broadcasting subsumes the same-shape case
+for _b, _a in (("broadcast_equal", "_equal"),
+               ("broadcast_not_equal", "_not_equal"),
+               ("broadcast_greater", "_greater"),
+               ("broadcast_greater_equal", "_greater_equal"),
+               ("broadcast_lesser", "_lesser"),
+               ("broadcast_lesser_equal", "_lesser_equal")):
+    register_alias(_b, _a)
 
 
 # ---------------------------------------------------------------------------

@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import Dtype, Float, Int, Str, register
+from .registry import Bool, Dtype, Float, Int, Str, register
 
 
 def _embedding_fc(attrs, data, weight):
@@ -82,3 +82,39 @@ register("one_hot", fcompute=_one_hot_fc, arguments=("indices",),
                 "off_value": Float(0.0), "dtype": Dtype("float32")},
          infer_shape=_one_hot_infer,
          infer_type=lambda attrs, ts: (ts, [attrs["dtype"] or "float32"], []))
+
+
+def _pick_fc(attrs, data, index):
+    axis = attrs["axis"]
+    idx = index.astype(jnp.int32)
+    if attrs["mode"] == "wrap":
+        idx = jnp.mod(idx, data.shape[axis])
+    else:  # clip (reference default): OOB indices must not yield NaN
+        idx = jnp.clip(idx, 0, data.shape[axis] - 1)
+    idx = jnp.expand_dims(idx, axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not attrs["keepdims"]:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+def _pick_infer(attrs, in_shapes):
+    ds, _ = in_shapes
+    if ds is None:
+        return in_shapes, [None], []
+    axis = attrs["axis"] % len(ds)
+    out = list(ds)
+    if attrs["keepdims"]:
+        out[axis] = 1
+    else:
+        out.pop(axis)
+    return in_shapes, [tuple(out)], []
+
+
+register("pick", fcompute=_pick_fc, arguments=("data", "index"),
+         attrs={"axis": Int(-1), "keepdims": Bool(False),
+                "mode": Str("clip", doc="OOB index handling: clip|wrap")},
+         infer_shape=_pick_infer,
+         doc="Pick data[i, ..., index[i, ...], ...] along `axis` "
+             "(per-row element selection; reference pick / "
+             "choose_element_0index).")

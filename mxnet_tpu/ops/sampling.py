@@ -40,14 +40,14 @@ _register_sampler(
     lambda attrs, rng, shape, dtype: jax.random.uniform(
         rng, shape, dtype=dtype, minval=attrs["low"], maxval=attrs["high"]),
     {"low": Float(0.0), "high": Float(1.0)},
-    aliases=("uniform", "_random_uniform"))
+    aliases=("uniform", "_random_uniform", "random_uniform"))
 
 _register_sampler(
     "_sample_normal",
     lambda attrs, rng, shape, dtype: attrs["loc"] +
     attrs["scale"] * jax.random.normal(rng, shape, dtype=dtype),
     {"loc": Float(0.0), "scale": Float(1.0)},
-    aliases=("normal", "_random_normal"))
+    aliases=("normal", "_random_normal", "random_normal"))
 
 _register_sampler(
     "_sample_gamma",

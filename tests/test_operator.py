@@ -397,3 +397,57 @@ def test_pad():
     assert out.shape == (1, 1, 4, 4)
     assert out[0, 0, 0, 0] == 5.0
     np.testing.assert_allclose(out[0, 0, 1:3, 1:3], x[0, 0])
+
+
+def test_pick():
+    """pick: per-row selection along an axis (reference pick op)."""
+    d = mx.nd.array(np.arange(12.0).reshape(3, 4).astype("float32"))
+    i = mx.nd.array([1, 0, 3])
+    np.testing.assert_allclose(mx.nd.pick(d, i, axis=1).asnumpy(),
+                               [1, 4, 11])
+    assert mx.nd.pick(d, i, axis=-1, keepdims=True).shape == (3, 1)
+
+    x = mx.sym.Variable("x")
+    idx = mx.sym.Variable("i")
+    ex = mx.sym.pick(x, idx, axis=1).simple_bind(mx.cpu(), x=(3, 4),
+                                                 i=(3,))
+    ex.forward(is_train=True, x=d, i=i)
+    ex.backward([mx.nd.array([1.0, 1, 1])])
+    expect = np.zeros((3, 4), np.float32)
+    expect[0, 1] = expect[1, 0] = expect[2, 3] = 1
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), expect)
+
+
+def test_int_input_grad_is_zeros_not_float0():
+    """Gradients w.r.t. integer-dtype inputs surface as usable zeros
+    (jax's float0 zero-tangent must not leak into grad arrays)."""
+    d = mx.nd.array(np.arange(12).reshape(3, 4))   # int32 (numpy src)
+    assert d.dtype == np.int32
+    i = mx.nd.array([1, 0, 3])
+    ex = mx.sym.pick(mx.sym.Variable("x"), mx.sym.Variable("i"),
+                     axis=1).simple_bind(mx.cpu(), x=(3, 4), i=(3,))
+    ex.forward(is_train=True, x=d, i=i)
+    ex.backward([mx.nd.array([1.0, 1, 1])])
+    g = ex.grad_dict["x"].asnumpy()
+    assert g.dtype.kind == "f" and float(np.abs(g).sum()) == 0.0
+
+
+def test_same_shape_comparison_aliases():
+    a = mx.nd.array([1.0, 2, 3])
+    b = mx.nd.array([1.0, 5, 1])
+    np.testing.assert_allclose(mx.nd._equal(a, b).asnumpy(), [1, 0, 0])
+    np.testing.assert_allclose(mx.nd._greater(a, b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose(mx.nd._lesser_equal(a, b).asnumpy(),
+                               [1, 1, 0])
+
+
+def test_pick_oob_modes():
+    d = mx.nd.array(np.arange(12.0).reshape(3, 4).astype("float32"))
+    bad = mx.nd.array([-1, 5, 2])
+    # clip (default, reference semantics): no NaN, no wrap
+    np.testing.assert_allclose(
+        mx.nd.pick(d, bad, axis=1, mode="clip").asnumpy(), [0, 7, 10])
+    np.testing.assert_allclose(
+        mx.nd.pick(d, bad, axis=1).asnumpy(), [0, 7, 10])
+    np.testing.assert_allclose(
+        mx.nd.pick(d, bad, axis=1, mode="wrap").asnumpy(), [3, 5, 10])
