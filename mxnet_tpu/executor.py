@@ -795,6 +795,10 @@ class Executor:
             name = self._arg_names[i]
             req = self.grad_req.get(name, "write")
             gbuf = self.grad_arrays[i]
+            if g.dtype == jax.dtypes.float0:
+                # zero-tangent for integer primals: usable zeros (same
+                # rule as the non-staged backward)
+                g = jnp.zeros(g.shape, gbuf._data.dtype)
             g = jax.device_put(g, self._arg_devs[i])
             if req == "add":
                 gbuf._data = gbuf._data + g

@@ -154,6 +154,14 @@ class NDArray:
     def astype(self, dtype):
         return NDArray(self._data.astype(_as_jnp_dtype(dtype)))
 
+    def broadcast_to(self, shape):
+        """Broadcast to ``shape`` via the registered op (keeps the
+        reference's 0-sentinel 'copy my dim' semantics consistent with
+        ``mx.nd.broadcast_to``)."""
+        # the op function is installed on this module by
+        # _init_ndarray_module at import time
+        return globals()["broadcast_to"](self, shape=tuple(shape))
+
     def copy(self):
         return NDArray(self._data + 0 if self._data.dtype != jnp.bool_
                        else jnp.array(self._data))

@@ -115,6 +115,9 @@ register("pick", fcompute=_pick_fc, arguments=("data", "index"),
          attrs={"axis": Int(-1), "keepdims": Bool(False),
                 "mode": Str("clip", doc="OOB index handling: clip|wrap")},
          infer_shape=_pick_infer,
+         # output follows the DATA dtype; default elemwise inference
+         # would let an int index dtype poison data/output
+         infer_type=lambda attrs, ts: (ts, [ts[0]], []),
          doc="Pick data[i, ..., index[i, ...], ...] along `axis` "
              "(per-row element selection; reference pick / "
              "choose_element_0index).")

@@ -54,28 +54,28 @@ _register_sampler(
     lambda attrs, rng, shape, dtype: jax.random.gamma(
         rng, attrs["alpha"], shape, dtype=dtype) * attrs["beta"],
     {"alpha": Float(1.0), "beta": Float(1.0)},
-    aliases=("_random_gamma",))
+    aliases=("_random_gamma", "random_gamma"))
 
 _register_sampler(
     "_sample_exponential",
     lambda attrs, rng, shape, dtype: jax.random.exponential(
         rng, shape, dtype=dtype) / attrs["lam"],
     {"lam": Float(1.0)},
-    aliases=("_random_exponential",))
+    aliases=("_random_exponential", "random_exponential"))
 
 _register_sampler(
     "_sample_poisson",
     lambda attrs, rng, shape, dtype: jax.random.poisson(
         rng, attrs["lam"], shape).astype(dtype),
     {"lam": Float(1.0)},
-    aliases=("_random_poisson",))
+    aliases=("_random_poisson", "random_poisson"))
 
 _register_sampler(
     "_sample_negbinomial",
     lambda attrs, rng, shape, dtype: _neg_binomial(
         rng, attrs["k"], attrs["p"], shape, dtype),
     {"k": Float(1.0), "p": Float(1.0)},
-    aliases=("_random_negbinomial",))
+    aliases=("_random_negbinomial", "random_negative_binomial"))
 
 
 def _neg_binomial(rng, k, p, shape, dtype):

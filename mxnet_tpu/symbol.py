@@ -298,6 +298,16 @@ class Symbol:
         return _infer_pass(self, known, kind="type")
 
     # -- serialization ------------------------------------------------------
+    def grad(self, wrt):
+        """Symbolic gradient w.r.t. ``wrt`` — NOT implemented, matching
+        the reference contract (python/mxnet/symbol.py:1208-1213 declares
+        it 'currently not implemented').  Bind an executor and call
+        ``backward()``, or use ``mx.autograd``, to get gradients."""
+        raise MXNetError(
+            "Symbol.grad is not implemented (reference parity: the "
+            "reference declares it not implemented); use "
+            "executor.backward() or mx.autograd instead")
+
     def tojson(self):
         nodes = self._nodes()
         nid = {id(n): i for i, n in enumerate(nodes)}
