@@ -1,0 +1,88 @@
+"""Trainer-level convergence tier (reference tests/python/train/:
+test_mlp.py, test_conv.py, test_dtype.py — small end-to-end fits with
+accuracy thresholds, the tier above per-op unit tests)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _digits(n, side=16, seed=0):
+    """Separable class-conditional blobs (shared synthetic protocol)."""
+    rs = np.random.RandomState(seed)
+    ys = rs.randint(0, 10, n)
+    grid = np.stack(np.meshgrid(np.arange(side), np.arange(side)),
+                    -1).reshape(-1, 2)
+    cx = 3 + (ys % 5) * 2.2
+    cy = 3 + (ys // 5) * 7.0
+    d = ((grid[None, :, 0] - cx[:, None]) ** 2 +
+         (grid[None, :, 1] - cy[:, None]) ** 2) / 6.0
+    X = (np.exp(-d) + rs.uniform(0, 0.15, (n, side * side))) \
+        .astype("float32")
+    return X, ys.astype("float32")
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=64,
+                                                name="fc1"),
+                          act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=10, name="fc2"),
+        name="softmax")
+
+
+def _lenet(side=16):
+    data = mx.sym.Reshape(mx.sym.Variable("data"),
+                          shape=(-1, 1, side, side))
+    h = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                           name="conv1")
+    h = mx.sym.Pooling(mx.sym.Activation(h, act_type="relu"),
+                       kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.Convolution(h, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                           name="conv2")
+    h = mx.sym.Pooling(mx.sym.Activation(h, act_type="relu"),
+                       kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.FullyConnected(mx.sym.Flatten(h), num_hidden=64,
+                              name="fc1")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Activation(h, act_type="relu"),
+                              num_hidden=10, name="fc2"),
+        name="softmax")
+
+
+def _fit_and_score(sym, X, y, epochs, lr=0.2, **module_kw):
+    mx.random.seed(42)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    mod = mx.Module(sym, context=mx.cpu(), **module_kw)
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="accuracy")
+    return mod.score(it, "accuracy")[0][1]
+
+
+def test_mlp_convergence():
+    """Reference tests/python/train/test_mlp.py: MLP fits past the
+    accuracy threshold."""
+    X, y = _digits(1024)
+    assert _fit_and_score(_mlp(), X, y, epochs=6) > 0.95
+
+
+def test_conv_convergence():
+    """Reference tests/python/train/test_conv.py: conv net fits.
+    (lr 0.05: 0.2+momentum overshoots this net in ANY precision.)"""
+    X, y = _digits(1024)
+    assert _fit_and_score(_lenet(), X, y, epochs=6, lr=0.05) > 0.95
+
+
+def test_bf16_convergence_matches_fp32():
+    """Reference tests/python/train/test_dtype.py (fp16 cifar): the
+    reduced-precision compute path must converge like full precision —
+    here compute_dtype='bfloat16' (fp32 master weights, bf16
+    forward/backward, the TPU mixed-precision recipe)."""
+    X, y = _digits(1024)
+    acc_bf16 = _fit_and_score(_lenet(), X, y, epochs=6, lr=0.05,
+                              compute_dtype="bfloat16")
+    acc_fp32 = _fit_and_score(_lenet(), X, y, epochs=6, lr=0.05)
+    assert acc_bf16 > 0.95, acc_bf16
+    assert abs(acc_bf16 - acc_fp32) < 0.05, (acc_bf16, acc_fp32)
