@@ -1,0 +1,114 @@
+"""Throughput sweep driver (reference
+example/image-classification/benchmark.py: sweeps networks x batch
+sizes x device counts by launching train_imagenet benchmark runs and
+collecting images/sec into a CSV).
+
+Same workflow on the TPU stack: each cell launches
+``train_imagenet.py --benchmark 1`` (synthetic data, drain-bounded
+Speedometer timing) in a subprocess, parses the samples/sec lines, and
+writes one CSV row per (network, batch_size) plus a JSON summary.
+Multi-host sweeps go through tools/launch.py exactly as training does;
+this driver stays single-host and sweeps the local mesh.
+
+Example::
+
+    python benchmark.py --networks resnet:50:32 alexnet::64 \
+        --num-examples 256 --out sweep
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+CURR = os.path.dirname(os.path.abspath(__file__))
+
+
+def parse_network_spec(spec):
+    """'name[:num_layers][:batch_size]' -> (name, layers, batch)."""
+    parts = spec.split(":")
+    name = parts[0]
+    layers = int(parts[1]) if len(parts) > 1 and parts[1] else None
+    batch = int(parts[2]) if len(parts) > 2 and parts[2] else 32
+    return name, layers, batch
+
+
+def run_cell(network, num_layers, batch_size, args):
+    cmd = [sys.executable, os.path.join(CURR, "train_imagenet.py"),
+           "--benchmark", "1", "--network", network,
+           "--batch-size", str(batch_size),
+           "--num-examples", str(args.num_examples),
+           "--num-epochs", "1", "--image-shape", args.image_shape,
+           "--num-classes", str(args.num_classes),
+           "--kv-store", args.kv_store,
+           "--disp-batches", str(args.disp_batches)]
+    if num_layers:
+        cmd += ["--num-layers", str(num_layers)]
+    tic = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=args.timeout)
+        out = proc.stderr + proc.stdout
+        rc = proc.returncode
+    except subprocess.TimeoutExpired as e:
+        # one hung cell must not kill the sweep: it becomes an
+        # ok=False row and the finished rows still get written
+        out = "%s%s\nTIMEOUT after %ds" % (
+            (e.stderr or ""), (e.stdout or ""), args.timeout)
+        rc = -1
+    speeds = [float(s) for s in
+              re.findall(r"Speed: ([0-9.]+) samples/sec", out)]
+    row = {"network": network, "num_layers": num_layers,
+           "batch_size": batch_size,
+           "images_per_sec": round(max(speeds), 2) if speeds else None,
+           "mean_images_per_sec":
+               round(sum(speeds) / len(speeds), 2) if speeds else None,
+           "wall_seconds": round(time.time() - tic, 1),
+           "ok": rc == 0 and bool(speeds)}
+    if not row["ok"]:
+        row["tail"] = out[-300:]
+    return row
+
+
+def main():
+    parser = argparse.ArgumentParser(description="throughput sweep")
+    parser.add_argument("--networks", nargs="+",
+                        default=["resnet:18:32", "alexnet::64"],
+                        help="network[:num_layers][:batch_size] specs")
+    parser.add_argument("--num-examples", type=int, default=256)
+    parser.add_argument("--image-shape", type=str, default="3,64,64")
+    parser.add_argument("--num-classes", type=int, default=100)
+    parser.add_argument("--kv-store", type=str, default="device")
+    parser.add_argument("--disp-batches", type=int, default=2)
+    parser.add_argument("--timeout", type=int, default=1800)
+    parser.add_argument("--out", type=str, default="benchmark")
+    args = parser.parse_args()
+
+    rows = []
+    for spec in args.networks:
+        network, layers, batch = parse_network_spec(spec)
+        row = run_cell(network, layers, batch, args)
+        rows.append(row)
+        print(json.dumps(row))
+
+    csv_path = args.out + ".csv"
+    fields = ["network", "num_layers", "batch_size", "images_per_sec",
+              "mean_images_per_sec", "wall_seconds", "ok"]
+    with open(csv_path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+        w.writeheader()
+        w.writerows(rows)
+    with open(args.out + ".json", "w") as f:
+        json.dump(rows, f, indent=1)
+    print("wrote %s / %s.json (%d cells, %d ok)"
+          % (csv_path, args.out, len(rows),
+             sum(1 for r in rows if r["ok"])))
+
+
+if __name__ == "__main__":
+    main()

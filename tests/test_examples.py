@@ -458,3 +458,18 @@ def test_speech_recognition_ctc():
              timeout=560)
     m = re.findall(r"final CER ([0-9.]+)", p.stderr + p.stdout)
     assert m and float(m[-1]) < 0.1, (p.stderr + p.stdout)[-500:]
+
+
+def test_benchmark_sweep_driver(tmp_path):
+    """Reference example/image-classification/benchmark.py: the sweep
+    driver launches benchmark cells and collects images/sec rows."""
+    import csv
+    out = str(tmp_path / "sweep")
+    _run("examples/image-classification/benchmark.py",
+         "--networks", "mlp::64", "--num-examples", "256",
+         "--image-shape", "1,28,28", "--num-classes", "10",
+         "--kv-store", "local", "--out", out, timeout=480)
+    with open(out + ".csv") as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 1 and rows[0]["ok"] == "True"
+    assert float(rows[0]["images_per_sec"]) > 0
