@@ -1,0 +1,33 @@
+"""Execute the tutorials' python blocks (reference
+tests/python/doctest/: docstring examples run in CI so documentation
+cannot rot)."""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _snippets(md_path):
+    text = open(md_path).read()
+    return re.findall(r"```python\n(.*?)```", text, re.S)
+
+
+@pytest.mark.parametrize("doc", ["mnist.md", "autograd.md"])
+def test_tutorial_code_runs(doc, tmp_path):
+    path = os.path.join(REPO, "docs", "tutorials", doc)
+    blocks = _snippets(path)
+    assert blocks, "no python blocks found in %s" % doc
+    # blocks build on one another: run them as one program, in order
+    program = "\n\n".join(blocks)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    # cwd=tmp_path: snippets may write checkpoints relative to cwd
+    p = subprocess.run([sys.executable, "-c", program], env=env,
+                       cwd=str(tmp_path),
+                       capture_output=True, text=True, timeout=560)
+    assert p.returncode == 0, (p.stdout[-1000:], p.stderr[-1000:])
