@@ -53,18 +53,28 @@ class DataParallelTrainer:
     def __init__(self, symbol, data_shapes, label_shapes=None, mesh=None,
                  optimizer="sgd", optimizer_params=None, initializer=None,
                  batch_axis="dp", dtype="float32", compute_dtype=None,
-                 fixed_params=(), share_state_with=None):
+                 fixed_params=(), share_state_with=None,
+                 shard_optimizer_state=False):
         """``compute_dtype='bfloat16'`` enables mixed precision: parameters
         and optimizer state stay fp32 (master weights), the traced forward/
         backward runs in bf16 on the MXU, and gradients emerge fp32 through
         the cast's vjp — the TPU-idiomatic replacement for the reference's
-        fp16 model variants (symbols/*_fp16.py)."""
+        fp16 model variants (symbols/*_fp16.py).
+
+        ``shard_optimizer_state=True`` (ZeRO-1, beyond-reference):
+        optimizer state of replicated parameters is sharded over the
+        batch axis instead of replicated — each rank updates its shard
+        and XLA all-gathers the new weights, cutting optimizer-state HBM
+        by the dp degree (1/8 on a v5e-8; for Adam that is 2x params'
+        worth of memory back).  Numerically identical to the replicated
+        path (tests/test_parallel.py asserts parity)."""
         self.symbol = symbol
         self.mesh = mesh if mesh is not None else local_mesh(batch_axis)
         self.batch_axis = batch_axis
         self._fixed = set(fixed_params)
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype else None)
+        self._zero1 = bool(shard_optimizer_state)
 
         shapes = dict(data_shapes)
         if label_shapes:
@@ -115,6 +125,9 @@ class DataParallelTrainer:
                 if self._arg_shapes[n] != other._arg_shapes[n]:
                     raise MXNetError("param %s shape mismatch across "
                                      "shared trainers" % n)
+            # the shared opt state's layout is the primary's decision;
+            # a mismatched flag here would silently re-place it
+            self._zero1 = other._zero1
             self._st = other._st
         else:
             self._st = _TrainerState()
@@ -152,6 +165,24 @@ class DataParallelTrainer:
         MeshTrainer overrides with tensor-parallel rules)."""
         return self._replicated
 
+    def _opt_sharding_for(self, name, state_shape):
+        """Sharding for one optimizer-state tensor (ZeRO-1 seam).
+
+        Shard axis 0 over the batch axis when (a) the flag is on,
+        (b) the owning parameter is replicated (tensor-parallel params
+        keep state co-sharded with the weight), and (c) axis 0 divides
+        evenly — otherwise fall back to the parameter's sharding."""
+        base = self._sharding_for(name)
+        if not self._zero1 or base.spec != P():
+            return base
+        dp = self.mesh.shape[self.batch_axis]
+        if (state_shape and state_shape[0] % dp == 0 and
+                state_shape[0] >= dp):
+            return NamedSharding(
+                self.mesh,
+                P(self.batch_axis, *([None] * (len(state_shape) - 1))))
+        return base
+
     def _init_params(self, initializer):
         attrs = self.symbol.attr_dict()
         params = {}
@@ -162,7 +193,7 @@ class DataParallelTrainer:
                                           self._sharding_for(name))
         self.params = params
         self.opt_state = {n: tuple(
-            jax.device_put(s, self._sharding_for(n))
+            jax.device_put(s, self._opt_sharding_for(n, s.shape))
             for s in self._opt_init(params[n])) for n in self.param_names}
         aux = {}
         init_aux = nd.zeros((1,))
@@ -214,6 +245,13 @@ class DataParallelTrainer:
         fixed = self._fixed
         cdt = self._compute_dtype
         label_set = set(self.label_names)
+        # ZeRO-1: the per-shard update would propagate a dp-sharded
+        # layout onto the weights (silent retrace + broken replication
+        # contract); pin updated weights back to their own sharding so
+        # XLA inserts the all-gather inside the step
+        param_shardings = ({n: self._sharding_for(n)
+                            for n in param_names}
+                           if self._zero1 else None)
 
         def _cast(tree):
             if cdt is None:
@@ -253,6 +291,9 @@ class DataParallelTrainer:
                                       opt_state[name], lrs[idx], wds[idx],
                                       jax.random.fold_in(rng, (1 << 20) +
                                                          idx))
+                    if param_shardings is not None:
+                        w = jax.lax.with_sharding_constraint(
+                            w, param_shardings[name])
                     new_params[name] = w
                     new_opt[name] = s
             return new_params, new_opt, new_aux, outs, rng_next
@@ -309,7 +350,12 @@ class DataParallelTrainer:
         gen = _random.generation()
         rng = getattr(self, "_rng_dev", None)
         if rng is None or getattr(self, "_rng_gen", None) != gen:
-            rng = self._rng_dev = _random.next_key()
+            # commit the fresh key to the replicated layout the carried
+            # successor keys come back with — otherwise the second step
+            # sees a different arg sharding and recompiles the whole
+            # fused program
+            rng = self._rng_dev = jax.device_put(_random.next_key(),
+                                                 self._replicated)
             self._rng_gen = gen
         return rng
 
@@ -381,7 +427,10 @@ class DataParallelTrainer:
     def set_updater_states(self, states):
         for i, name in enumerate(self.param_names):
             if i in states and name not in self._fixed:
+                arrs = [jnp.asarray(s._data if isinstance(s, NDArray)
+                                    else s)
+                        for s in self._ingraph.state_from_host(states[i])]
                 self.opt_state[name] = tuple(
-                    jax.device_put(jnp.asarray(s._data if isinstance(
-                        s, NDArray) else s), self._sharding_for(name))
-                    for s in self._ingraph.state_from_host(states[i]))
+                    jax.device_put(a, self._opt_sharding_for(name,
+                                                             a.shape))
+                    for a in arrs)
