@@ -294,3 +294,28 @@ def test_im2rec_shuffle_packs_mixed_order(tmp_path):
         labs.append(int(head.label))
     assert sorted(labs) == sorted([i // 6 for i in range(48)])
     assert labs != [i // 6 for i in range(48)], "pack order not shuffled"
+
+
+def test_bandwidth_measure_tool():
+    """tools/bandwidth/measure.py (reference comm benchmark): runs on
+    the virtual mesh, validates the reduction (error column == 0)."""
+    import re
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=repo + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    p = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "bandwidth",
+                                       "measure.py"),
+         "--num-batches", "3", "--sizes", "1000000"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    out = p.stdout + p.stderr
+    rows = re.findall(r"\d+\s+([0-9.]+)\s+([0-9.]+)\s+([0-9.e+-]+)",
+                      out)
+    assert len(rows) >= 3, out[-500:]
+    for _, bw, err in rows:
+        assert float(bw) > 0 and float(err) == 0.0
