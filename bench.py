@@ -442,6 +442,12 @@ def _bank_witness(out):
                          if r.get("unit") != "error")
         if prev_valid > n_valid:
             return
+        # a mid-sweep partial bank may not displace an equally-valid
+        # complete witness: a later stale emission would then present
+        # partial data although a complete run had been banked
+        if (out.get("partial") and not prev.get("partial")
+                and prev_valid == n_valid):
+            return
     banked = dict(out)
     banked["witness_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                           time.gmtime())

@@ -413,13 +413,36 @@ class _PermutedRecordStream:
     def _start_epoch(self):
         order = np.random.permutation(len(self._rec.keys))
         q = queue.Queue(maxsize=self._cap)
+        stop = threading.Event()
+
+        def put_interruptible(item):
+            """Blocking put that aborts when reset() raises the stop
+            flag.  Returns False once stopped."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def pump():
-            for j in order:
-                q.put(self._rec.read_idx(self._rec.keys[j]))
-            q.put(None)
+            # the epoch-end sentinel (or the reader's exception, handed
+            # to the consumer to re-raise) is enqueued even when a
+            # corrupt record kills the loop — otherwise read() would
+            # block forever on an empty queue
+            tail = None
+            try:
+                for j in order:
+                    rec = self._rec.read_idx(self._rec.keys[j])
+                    if not put_interruptible(rec):
+                        return
+            except Exception as e:  # noqa: BLE001 — handed to consumer
+                tail = e
+            put_interruptible(tail)
 
         self._q = q
+        self._stop = stop
         self._eof = False
         self._thread = threading.Thread(target=pump, daemon=True)
         self._thread.start()
@@ -428,16 +451,24 @@ class _PermutedRecordStream:
         if self._eof:
             return None
         s = self._q.get()
+        if isinstance(s, Exception):
+            self._eof = True
+            raise s
         if s is None:
             self._eof = True
         return s
 
     def reset(self):
-        # drain the old epoch (unless its end-marker was already
-        # consumed) so the pump thread can exit, then re-permute
-        while not self._eof:
-            if self._q.get() is None:
-                self._eof = True
+        # signal the pump thread to stop rather than draining the rest
+        # of the epoch through the queue (a mid-epoch reset on a large
+        # .rec would otherwise re-read essentially the whole file); a
+        # small timed drain unblocks a pump stuck on a full queue
+        self._stop.set()
+        while self._thread.is_alive():
+            try:
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                pass
         self._thread.join()
         self._start_epoch()
 

@@ -85,7 +85,14 @@ class EvalMetric:
 
                 def accum(state, ls, ps):
                     s, c = split(ls, ps)
-                    return state[0] + s, state[1] + c
+                    # saturate the count lane on i32 wrap (sum of
+                    # non-negatives got smaller) so overflow is always
+                    # detectable at drain, no matter how many batches
+                    # accumulate past it
+                    nc = state[1] + c
+                    nc = jnp.where(nc < state[1], jnp.int32(2**31 - 1),
+                                   nc)
+                    return state[0] + s, nc
 
                 self._dev_stat_jit = jax.jit(split)
                 self._dev_accum_jit = jax.jit(accum)
@@ -102,9 +109,19 @@ class EvalMetric:
     def _drain_device(self):
         if self._dev_state is not None:
             s, c = self._dev_state
+            c = int(c)
+            # the i32 count lane saturates to INT32_MAX on wrap (see
+            # accum above), so any overflow of the accumulation window
+            # between get() calls surfaces here — fail loudly, before
+            # mutating any state, instead of corrupting the statistics
+            if c < 0 or c == 2**31 - 1:
+                raise OverflowError(
+                    "device metric count lane overflowed int32: drain "
+                    "(get()) at least once per 2**31 accumulated "
+                    "instances")
             self._dev_state = None
             self.sum_metric += float(s)
-            self.num_inst += int(c)
+            self.num_inst += c
 
     def reset(self):
         self._dev_state = None

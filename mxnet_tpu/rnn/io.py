@@ -150,7 +150,7 @@ class BucketSentenceIter(DataIter):
         self._cursor += 1
 
         rows = self._perms[b][off:off + self.batch_size]
-        toks = self._tokens[b][rows]                       # (N, T) int64
+        toks = self._tokens[b][rows]                       # (N, T) int32
         labs = np.roll(toks, -1, axis=1)
         labs[:, -1] = self.invalid_label
         if self.major_axis == 1:

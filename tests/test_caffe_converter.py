@@ -187,3 +187,93 @@ layer { name: "scale3" type: "Scale" bottom: "bn3" top: "bn3" }
     # still paired; a Convolution breaks the blob lineage; an in-place
     # ReLU also breaks it (gamma*relu(x) != relu(gamma*x+beta))
     assert pairs == {"bn1": "scale1"}
+
+
+def test_bn_scale_noninplace_branch_refuses_fold():
+    from caffe_parser import bn_scale_pairs, get_layers, parse_prototxt
+    # scale1 is NOT in-place (top "s1" != bottom "bn1") and the raw BN
+    # blob also feeds conv_b: folding gamma/beta into the BatchNorm would
+    # hand conv_b scaled values, so the pairing must be refused.
+    branching = """
+layer { name: "bn1" type: "BatchNorm" bottom: "x" top: "bn1" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "s1" }
+layer { name: "conv_b" type: "Convolution" bottom: "bn1" top: "cb" }
+"""
+    assert bn_scale_pairs(get_layers(parse_prototxt(branching))) == {}
+
+    # same non-in-place Scale with NO other reader of the raw blob is
+    # still safely foldable
+    linear = """
+layer { name: "bn1" type: "BatchNorm" bottom: "x" top: "bn1" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "s1" }
+layer { name: "conv1" type: "Convolution" bottom: "s1" top: "c1" }
+"""
+    assert bn_scale_pairs(get_layers(parse_prototxt(linear))) == {
+        "bn1": "scale1"}
+
+    # an in-place Dropout on the lineage does not count as a branch
+    with_drop = """
+layer { name: "bn1" type: "BatchNorm" bottom: "x" top: "bn1" }
+layer { name: "drop1" type: "Dropout" bottom: "bn1" top: "bn1" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "s1" }
+"""
+    assert bn_scale_pairs(get_layers(parse_prototxt(with_drop))) == {
+        "bn1": "scale1"}
+
+
+def test_bn_scale_fold_window_is_order_aware():
+    from caffe_parser import bn_scale_pairs, get_layers, parse_prototxt
+    # in-place BN followed by a non-in-place Scale: the BN's own read of
+    # its in-place blob is not a branch — still foldable
+    inplace_bn = """
+layer { name: "conv1" type: "Convolution" bottom: "x" top: "c1" }
+layer { name: "bn1" type: "BatchNorm" bottom: "c1" top: "c1" }
+layer { name: "scale1" type: "Scale" bottom: "c1" top: "s1" }
+"""
+    assert bn_scale_pairs(get_layers(parse_prototxt(inplace_bn))) == {
+        "bn1": "scale1"}
+
+    # a reader BETWEEN the BN and an in-place Scale sees raw BN output;
+    # folding would hand it scaled values -> refuse even though the
+    # Scale is in-place
+    read_before_inplace_scale = """
+layer { name: "bn1" type: "BatchNorm" bottom: "x" top: "bn1" }
+layer { name: "conv_b" type: "Convolution" bottom: "bn1" top: "cb" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1" }
+"""
+    assert bn_scale_pairs(
+        get_layers(parse_prototxt(read_before_inplace_scale))) == {}
+
+    # a reader AFTER an in-place Scale sees scaled values either way ->
+    # still foldable
+    read_after_inplace_scale = """
+layer { name: "bn1" type: "BatchNorm" bottom: "x" top: "bn1" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1" }
+layer { name: "conv2" type: "Convolution" bottom: "bn1" top: "c2" }
+"""
+    assert bn_scale_pairs(
+        get_layers(parse_prototxt(read_after_inplace_scale))) == {
+            "bn1": "scale1"}
+
+
+def test_bn_scale_raw_window_ends_at_blob_rewrite():
+    from caffe_parser import bn_scale_pairs, get_layers, parse_prototxt
+    # blob name "bn1" is REUSED after the Scale: the later conv reads the
+    # rewritten blob, not raw BN output, so the fold is still legal
+    reuse = """
+layer { name: "bn1" type: "BatchNorm" bottom: "x" top: "bn1" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "s1" }
+layer { name: "conv1" type: "Convolution" bottom: "s1" top: "bn1" }
+layer { name: "conv2" type: "Convolution" bottom: "bn1" top: "c2" }
+"""
+    assert bn_scale_pairs(get_layers(parse_prototxt(reuse))) == {
+        "bn1": "scale1"}
+
+    # ...but an in-place rewriter at the window boundary reads the raw
+    # value itself -> refuse
+    inplace_boundary = """
+layer { name: "bn1" type: "BatchNorm" bottom: "x" top: "bn1" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "s1" }
+layer { name: "relu_b" type: "ReLU" bottom: "bn1" top: "bn1" }
+"""
+    assert bn_scale_pairs(get_layers(parse_prototxt(inplace_boundary))) == {}

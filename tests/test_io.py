@@ -319,3 +319,49 @@ def test_bandwidth_measure_tool():
     assert len(rows) >= 3, out[-500:]
     for _, bw, err in rows:
         assert float(bw) > 0 and float(err) == 0.0
+
+
+def test_permuted_stream_reader_error_propagates(tmp_path):
+    """A record-read failure inside the pump thread must surface in
+    read() (not hang the consumer), and a mid-epoch reset must not
+    drain the remaining epoch through the queue."""
+    import time
+    from mxnet_tpu.io.io import _PermutedRecordStream
+
+    rec = str(tmp_path / "e.rec")
+    idx = str(tmp_path / "e.idx")
+    _write_labeled_rec(rec, idx_path=idx, n=30)
+    st = _PermutedRecordStream(idx, rec, capacity=4)
+
+    # corrupt reads after a couple of successes: read() must raise, not
+    # block forever on an empty queue
+    orig = st._rec.read_idx
+    calls = {"n": 0}
+
+    def flaky(key):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise OSError("truncated record")
+        return orig(key)
+
+    st._rec.read_idx = flaky
+    got, err = 0, None
+    try:
+        for _ in range(30):
+            if st.read() is None:
+                break
+            got += 1
+    except OSError as e:
+        err = e
+    assert err is not None and "truncated" in str(err)
+    assert got <= 6  # 2 good reads + up to capacity already queued
+
+    # recovery: reset() restarts a clean epoch quickly (no full drain)
+    st._rec.read_idx = orig
+    t0 = time.time()
+    st.reset()
+    assert time.time() - t0 < 5.0
+    n = 0
+    while st.read() is not None:
+        n += 1
+    assert n == 30

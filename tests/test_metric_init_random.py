@@ -260,3 +260,30 @@ def test_eval_rng_semantics():
     ex2.arg_dict["data"][:] = x
     out = ex2.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_device_metric_count_overflow_fails_loudly():
+    """The i32 count lane saturates on wrap; drain raises instead of
+    silently corrupting num_inst, and the raise is state-neutral."""
+    import jax.numpy as jnp
+    import pytest
+
+    m = mx.metric.Accuracy()
+    l = mx.nd.array(np.array([1, 0], dtype=np.int32))
+    p = mx.nd.array(np.array([[0.1, 0.9], [0.8, 0.2]], dtype=np.float32))
+    assert m.update_device([l], [p])
+    # simulate a window that accumulated near the i32 limit, then push it
+    # over: the saturating accumulator must pin the lane at INT32_MAX
+    s, _ = m._dev_state
+    m._dev_state = (s, jnp.int32(2**31 - 2))
+    assert m.update_device([l], [p])
+    assert int(m._dev_state[1]) == 2**31 - 1
+    before = (m.sum_metric, m.num_inst)
+    with pytest.raises(OverflowError):
+        m.get()
+    # state-neutral: host counters untouched, device state preserved
+    assert (m.sum_metric, m.num_inst) == before
+    assert m._dev_state is not None
+    m.reset()
+    assert m.update_device([l], [p])
+    m.get()  # clean after reset

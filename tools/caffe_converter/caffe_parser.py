@@ -137,16 +137,59 @@ def bn_scale_pairs(layers):
     one rule so they can never disagree.
     """
     inference_identity = {"Dropout"}
+
+    # Position of every blob read, minus in-place inference-identity
+    # layers (those commute with the fold: part of the lineage, not a
+    # branch).  Used below to refuse a Scale pairing when some OTHER
+    # layer reads the blob while it still holds raw (unfolded) BN
+    # output: folding gamma/beta into the BatchNorm would silently hand
+    # that reader scaled values.
+    read_at = {}   # blob name -> [reader layer index, ...]
+    rewrite_at = {}  # blob name -> [rewriter layer index, ...]
+    for i, lay in enumerate(layers):
+        tops = lay.as_list("top")
+        bottoms = lay.as_list("bottom")
+        if (lay.get("type") in inference_identity and tops and bottoms
+                and tops[0] == bottoms[0]):
+            continue  # identity at inference: neither a branch nor a rewrite
+        for b in bottoms:
+            read_at.setdefault(b, []).append(i)
+        for t in tops:
+            rewrite_at.setdefault(t, []).append(i)
+
     pairs = {}
-    bn_of = {}  # blob name -> BatchNorm layer that (still) owns it
-    for lay in layers:
+    bn_of = {}  # blob name -> (BatchNorm layer name, layer index)
+    for j, lay in enumerate(layers):
         ltype = lay.get("type")
         tops = lay.as_list("top")
         bottoms = lay.as_list("bottom")
         if ltype == "BatchNorm" and tops:
-            bn_of[tops[0]] = lay.get("name")
+            bn_of[tops[0]] = (lay.get("name"), j)
         elif ltype == "Scale" and bottoms and bottoms[0] in bn_of:
-            pairs[bn_of.pop(bottoms[0])] = lay.get("name")
+            blob = bottoms[0]
+            bn_name, bn_idx = bn_of[blob]
+            scale_in_place = bool(tops) and tops[0] == blob
+            # window in which the blob holds raw BN output: from the BN
+            # to the Scale for an in-place Scale (the Scale rewrites it);
+            # for a non-in-place Scale the raw blob lives on until some
+            # later layer rewrites the name (an in-place rewriter at the
+            # boundary reads the raw value itself, hence <=)
+            if scale_in_place:
+                raw_reads = [i for i in read_at.get(blob, ())
+                             if bn_idx < i < j]
+            else:
+                end = min((k for k in rewrite_at.get(blob, ())
+                           if k > bn_idx), default=len(layers))
+                raw_reads = [i for i in read_at.get(blob, ())
+                             if bn_idx < i <= end and i != j]
+            if not raw_reads:
+                del bn_of[blob]
+                pairs[bn_name] = lay.get("name")
+            else:
+                # branching net: leave the Scale unpaired so conversion
+                # fails loudly (fix_gamma BN + standalone-Scale error)
+                # instead of folding scaled values into the other branch
+                del bn_of[blob]
         else:
             for t in tops:
                 # any other layer rewriting the blob breaks the lineage
