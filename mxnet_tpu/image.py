@@ -58,15 +58,20 @@ def imdecode(buf, to_rgb=1, flag=1, **kwargs):
 
 
 def scale_down(src_size, size):
-    """Scale `size` down proportionally so it fits in `src_size`
-    (reference image.py:62)."""
-    w, h = size
+    """Scale `size` down proportionally so it fits in `src_size`; a
+    size that already fits is returned unchanged (role of reference
+    image.py:62).
+
+    The dimension that binds is set to the source bound EXACTLY (no
+    float-ratio round-trip: int(truncation) of e.g. 343 * (49/343.)
+    would undershoot to 48, or collapse a 1-pixel bound to 0)."""
     sw, sh = src_size
-    if sh < h:
-        w, h = float(w * sh) / h, sh
-    if sw < w:
-        w, h = sw, float(h * sw) / w
-    return int(w), int(h)
+    w, h = size
+    if w <= sw and h <= sh:
+        return int(w), int(h)
+    if w * sh >= h * sw:  # sw/w <= sh/h: width is the tighter bound
+        return sw, int(h * sw / float(w))
+    return int(w * sh / float(h)), sh
 
 
 def _resize(src, w, h, interp=2):
@@ -326,46 +331,48 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, pca_noise=0, random_h=0,
                     random_s=0, random_l=0, inter_method=2):
-    """Build the standard augmenter list (reference image.py:289)."""
-    auglist = []
-    if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
+    """Build the standard augmenter pipeline (role of reference
+    image.py:289): geometry first (resize, crop, flip), then cast, then
+    photometric jitter, then normalization."""
+    if rand_resize and not rand_crop:
+        raise MXNetError("rand_resize requires rand_crop")
+    out_wh = (data_shape[2], data_shape[1])
+    cropper = (
+        RandomSizedCropAug(out_wh, 0.3, (3.0 / 4.0, 4.0 / 3.0),
+                           inter_method) if rand_resize
+        else RandomCropAug(out_wh, inter_method) if rand_crop
+        else CenterCropAug(out_wh, inter_method))
 
-    crop_size = (data_shape[2], data_shape[1])
-    if rand_resize:
-        assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0,
-                                                           4.0 / 3.0),
-                                          inter_method))
-    elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
-    else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
-
-    if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
-
-    if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
-    if random_h or random_s or random_l:
-        # HLS-space jitter, the record-augmenter's random_h/s/l surface
-        # (image_aug_default.cc) on the python ImageIter path
-        auglist.append(HLSJitterAug(random_h, random_s, random_l))
-    if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    # ImageNet defaults when the caller just says True
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
         std = np.array([58.395, 57.12, 57.375])
-    if mean is not None:
-        assert std is not None
-        auglist.append(ColorNormalizeAug(mean, std))
-    return auglist
+    if mean is not None and std is None:
+        raise MXNetError("mean normalization requires std")
+
+    # ILSVRC RGB PCA basis (public AlexNet lighting-noise constants)
+    pca_eigval = np.array([55.46, 4.794, 1.148])
+    pca_eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+
+    stages = [
+        ResizeAug(resize, inter_method) if resize > 0 else None,
+        cropper,
+        HorizontalFlipAug(0.5) if rand_mirror else None,
+        CastAug(),
+        (ColorJitterAug(brightness, contrast, saturation)
+         if brightness or contrast or saturation else None),
+        # HLS-space jitter, the record-augmenter's random_h/s/l surface
+        # (image_aug_default.cc) on the python ImageIter path
+        (HLSJitterAug(random_h, random_s, random_l)
+         if random_h or random_s or random_l else None),
+        (LightingAug(pca_noise, pca_eigval, pca_eigvec)
+         if pca_noise > 0 else None),
+        ColorNormalizeAug(mean, std) if mean is not None else None,
+    ]
+    return [s for s in stages if s is not None]
 
 
 class ImageIter(_io_mod.DataIter):
@@ -464,25 +471,29 @@ class ImageIter(_io_mod.DataIter):
 
     def next_sample(self):
         """Return (label, decoded HWC image)."""
-        if self.seq is not None:
-            if self.cur >= len(self.seq):
+        if self.seq is None:
+            # index-free mode: stream the record file in order
+            packed = self.imgrec.read()
+            if packed is None:
                 raise StopIteration
-            idx = self.seq[self.cur]
-            self.cur += 1
-            if self.imgrec is not None:
-                s = self.imgrec.read_idx(idx)
-                header, img = recordio.unpack(s)
-                if self.imglist is not None:
-                    # combined mode: imglist relabels the rec contents
-                    return self.imglist[idx][0], imdecode(img)
-                return header.label, imdecode(img)
+            header, raw = recordio.unpack(packed)
+            return header.label, imdecode(raw)
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        return self._sample_at(idx)
+
+    def _sample_at(self, idx):
+        """Random-access one sample by sequence index."""
+        if self.imgrec is None:
             label, fname = self.imglist[idx]
             return label, self.read_image(fname)
-        s = self.imgrec.read()
-        if s is None:
-            raise StopIteration
-        header, img = recordio.unpack(s)
-        return header.label, imdecode(img)
+        header, raw = recordio.unpack(self.imgrec.read_idx(idx))
+        if self.imglist is not None:
+            # combined mode: imglist relabels the rec contents
+            return self.imglist[idx][0], imdecode(raw)
+        return header.label, imdecode(raw)
 
     def next(self):
         batch_size = self.batch_size

@@ -1,34 +1,45 @@
-"""PythonModule / PythonLossModule: user-defined computation as a module.
+"""Modules whose computation is plain Python, not a bound Symbol.
 
-Reference: ``python/mxnet/module/python_module.py:338``.
+Role parity with the reference's ``python/mxnet/module/python_module.py``
+(PythonModule base + PythonLossModule); used to splice host-side losses
+or glue stages into a SequentialModule pipeline.  Parameter-free by
+definition: ``get_params`` is empty and optimizer hooks are no-ops, so
+the surrounding training loop needs no special casing.
 """
 from __future__ import annotations
 
 import logging
 
-import numpy as np
-
 from .. import ndarray as nd
+from ..base import MXNetError
 from .base_module import BaseModule
 
 
+def _desc_name(d):
+    return d[0] if isinstance(d, (list, tuple)) else d.name
+
+
+def _desc_shape(d):
+    return d.shape if hasattr(d, "shape") else d[1]
+
+
 class PythonModule(BaseModule):
-    """A module whose computation is defined in python (subclass and
-    override)."""
+    """Base for python-defined modules.  Subclasses implement
+    ``forward`` / ``backward`` / ``get_outputs`` / ``get_input_grads``
+    and ``_compute_output_shapes``; everything parameter- or
+    optimizer-shaped is already stubbed out here."""
 
     def __init__(self, data_names, label_names, output_names, logger=logging):
         super().__init__(logger=logger)
-        if isinstance(data_names, tuple):
-            data_names = list(data_names)
-        if isinstance(label_names, tuple):
-            label_names = list(label_names)
-        self._data_names = data_names
-        self._label_names = label_names
-        self._output_names = output_names
+        self._data_names = list(data_names)
+        self._label_names = (None if label_names is None
+                             else list(label_names))
+        self._output_names = list(output_names)
         self._data_shapes = None
         self._label_shapes = None
         self._output_shapes = None
 
+    # read-only views of the bound interface
     @property
     def data_names(self):
         return self._data_names
@@ -49,20 +60,29 @@ class PythonModule(BaseModule):
     def output_shapes(self):
         return self._output_shapes
 
+    # no parameters, so these are all trivially satisfied
     def get_params(self):
-        return (dict(), dict())
+        return {}, {}
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
         pass
 
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        pass
+
     def update(self):
         pass
 
+    def install_monitor(self, mon):
+        pass
+
     def update_metric(self, eval_metric, labels):
-        if self._label_shapes is None:
-            return
-        eval_metric.update(labels, self.get_outputs())
+        # a label-less python module contributes nothing to the metric
+        if self._label_shapes is not None:
+            eval_metric.update(labels, self.get_outputs())
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -70,54 +90,54 @@ class PythonModule(BaseModule):
         if self.binded and not force_rebind:
             self.logger.warning("Already binded, ignoring bind()")
             return
+        got = [_desc_name(d) for d in data_shapes]
+        if got != self._data_names:
+            raise MXNetError(
+                "%s bound with data %s but declares data_names %s"
+                % (type(self).__name__, got, self._data_names))
+        if label_shapes is not None and self._label_names is None:
+            raise MXNetError(
+                "%s takes no labels but was bound with label_shapes"
+                % type(self).__name__)
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        assert len(data_shapes) == len(self._data_names)
-        assert [x[0] if isinstance(x, (list, tuple)) else x.name
-                for x in data_shapes] == self._data_names
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
-        if label_shapes is not None:
-            assert self._label_names is not None
         self._output_shapes = self._compute_output_shapes()
         self.binded = True
 
     def _compute_output_shapes(self):
+        """[(name, shape), ...] of this module's outputs, given the
+        bound ``self._data_shapes`` / ``self._label_shapes``."""
         raise NotImplementedError()
-
-    def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
-        pass
-
-    def install_monitor(self, mon):
-        pass
 
 
 class PythonLossModule(PythonModule):
-    """A convenient loss-module: forward is identity, backward applies a
-    gradient function (reference PythonLossModule)."""
+    """Host-side loss head: forward passes scores through unchanged,
+    backward produces d(loss)/d(scores) via ``grad_func(scores, labels)``
+    (subclasses may instead override ``_backward_impl``).  Outputs equal
+    inputs, so downstream scoring sees the raw scores."""
 
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
-        super().__init__(list(data_names), list(label_names),
-                         [name + "_output"], logger=logger)
+        if len(data_names) != 1 or len(label_names) != 1:
+            raise MXNetError(
+                "PythonLossModule handles exactly one data and one "
+                "label stream")
+        if grad_func is not None and not callable(grad_func):
+            raise MXNetError("grad_func must be callable")
+        super().__init__(data_names, label_names, [name + "_output"],
+                         logger=logger)
         self._name = name
-        assert len(data_names) == 1
-        assert len(label_names) == 1
+        self._grad_func = grad_func
         self._scores = None
         self._labels = None
         self._scores_grad = None
-        if grad_func is not None:
-            assert callable(grad_func)
-        self._grad_func = grad_func
 
     def _compute_output_shapes(self):
-        return [(self._name + "_output",
-                 self._data_shapes[0].shape
-                 if hasattr(self._data_shapes[0], "shape")
-                 else self._data_shapes[0][1])]
+        # identity head: one output, shaped like the one input
+        return [(self._name + "_output", _desc_shape(self._data_shapes[0]))]
 
     def forward(self, data_batch, is_train=None):
         self._scores = data_batch.data[0]
@@ -131,19 +151,19 @@ class PythonLossModule(PythonModule):
         return [self._scores]
 
     def backward(self, out_grads=None):
-        assert out_grads is None, "For a loss module, out_grads should be " \
-            "None"
+        if out_grads is not None:
+            raise MXNetError("a loss module is the end of the chain; "
+                             "out_grads must be None")
         assert self.for_training
         self._backward_impl()
 
     def _backward_impl(self):
-        if self._grad_func is not None:
-            grad = self._grad_func(self._scores, self._labels)
-            if not isinstance(grad, nd.NDArray):
-                grad = nd.array(grad)
-            self._scores_grad = grad
-        else:
-            raise NotImplementedError()
+        if self._grad_func is None:
+            raise NotImplementedError(
+                "pass grad_func or override _backward_impl")
+        grad = self._grad_func(self._scores, self._labels)
+        self._scores_grad = (grad if isinstance(grad, nd.NDArray)
+                             else nd.array(grad))
 
     def get_input_grads(self, merge_multi_context=True):
         assert merge_multi_context is True

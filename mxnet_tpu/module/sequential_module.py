@@ -1,107 +1,141 @@
-"""SequentialModule: chain modules, feeding outputs to the next's inputs.
+"""SequentialModule: run a list of modules as one pipeline, each stage
+consuming the previous stage's outputs.
 
-Reference: ``python/mxnet/module/sequential_module.py``.
+Role parity with ``python/mxnet/module/sequential_module.py`` in the
+reference (chained bind / forward / backward, ``take_labels`` and
+``auto_wiring`` stage options); the wiring implementation here is its
+own: stage options are resolved into per-stage records at ``add()``
+time and the bind-time shape handoff is a single fold over those
+records.
 """
 from __future__ import annotations
 
 import copy
 import logging
+from collections import namedtuple
 
 from ..base import MXNetError
 from ..io.io import DataDesc
 from .base_module import BaseModule
 
+# A stage = one child module plus its resolved chain options:
+#   feed_labels -- this stage receives the pipeline's label batch
+#   rewire      -- rename incoming descs to the stage's own input names
+_Stage = namedtuple("_Stage", ["module", "feed_labels", "rewire"])
+
+
+def _as_desc(entry):
+    """Normalize a (name, shape) pair or DataDesc to DataDesc."""
+    if isinstance(entry, DataDesc):
+        return entry
+    return DataDesc(entry[0], entry[1])
+
 
 class SequentialModule(BaseModule):
+    # Option names kept as class attributes for reference-API parity.
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
-        self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith("META_")])
+        self._stages = []
+        self._bound_label_shapes = None
 
-    def add(self, module, **kwargs):
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, \
-                "Unknown meta \"%s\", a typo?" % key
-        self._metas.append(kwargs)
+    # -- construction -----------------------------------------------------
+
+    def add(self, module, **opts):
+        """Append ``module`` to the pipeline.  Options:
+
+        take_labels : bool
+            Feed the pipeline's labels to this stage (loss stages).
+        auto_wiring : bool
+            Rename the previous stage's output descs to this module's
+            ``data_names`` so differently-named interfaces connect.
+
+        Returns ``self`` so calls chain.
+        """
+        unknown = set(opts) - {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        if unknown:
+            raise MXNetError(
+                "SequentialModule.add: unknown option(s) %s (supported: "
+                "%s, %s)" % (sorted(unknown), self.META_TAKE_LABELS,
+                             self.META_AUTO_WIRING))
+        self._stages.append(_Stage(
+            module=module,
+            feed_labels=bool(opts.get(self.META_TAKE_LABELS, False)),
+            rewire=bool(opts.get(self.META_AUTO_WIRING, False))))
+        # the pipeline shape changed: every bind-derived state is void
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    # -- introspection ----------------------------------------------------
+
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0].module.data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1].module.output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0].module.data_shapes
 
     @property
     def label_shapes(self):
         assert self.binded
-        return self._label_shapes
+        return self._bound_label_shapes
 
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1].module.output_shapes
+
+    # -- parameters -------------------------------------------------------
 
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for st in self._stages:
+            a, x = st.module.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        for module in self._modules:
-            module.init_params(initializer=initializer,
-                               arg_params=arg_params, aux_params=aux_params,
-                               allow_missing=allow_missing,
-                               force_init=force_init)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, \
-                    "Duplicated parameter names: " + \
-                    ("name \"%s\" in layer %d (%s) is already used in " +
-                     "layer %d (%s).") % (
-                        name, i, type(modules[i]),
-                        known_names[name], type(modules[known_names[name]]))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        for st in self._stages:
+            st.module.init_params(
+                initializer=initializer, arg_params=arg_params,
+                aux_params=aux_params, allow_missing=allow_missing,
+                force_init=force_init)
+        self._assert_unique_param_names()
         self.params_initialized = True
+
+    def _assert_unique_param_names(self):
+        """A name owned by two stages would silently alias in
+        get_params()/checkpoints — refuse it up front."""
+        owner = {}
+        for pos, st in enumerate(self._stages):
+            a, x = st.module.get_params()
+            for name in list(a) + list(x):
+                if name in owner:
+                    raise MXNetError(
+                        "duplicate parameter name %r: stage %d (%s) and "
+                        "stage %d (%s)" % (
+                            name, owner[name],
+                            type(self._stages[owner[name]].module).__name__,
+                            pos, type(st.module).__name__))
+                owner[name] = pos
+
+    # -- binding ----------------------------------------------------------
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -109,50 +143,49 @@ class SequentialModule(BaseModule):
         if self.binded and not force_rebind:
             self.logger.warning("Already binded, ignoring bind()")
             return
-        if inputs_need_grad:
-            assert for_training
-        assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty " \
-            "SequentialModule"
+        if inputs_need_grad and not for_training:
+            raise MXNetError("inputs_need_grad requires for_training")
+        if shared_module is not None:
+            raise MXNetError(
+                "SequentialModule does not support shared_module")
+        if not self._stages:
+            raise MXNetError("cannot bind an empty SequentialModule")
 
         self.binded = True
-        self._label_shapes = label_shapes
+        self.inputs_need_grad = inputs_need_grad
+        feed = [_as_desc(d) for d in data_shapes]
+        any_labels = False
+        for pos, st in enumerate(self._stages):
+            if st.rewire:
+                feed = self._rename_to_inputs(feed, st.module)
+            st.module.bind(
+                data_shapes=feed,
+                label_shapes=label_shapes if st.feed_labels else None,
+                for_training=for_training,
+                # interior stages need input grads to continue the chain;
+                # the head only if the caller asked for them
+                inputs_need_grad=(inputs_need_grad if pos == 0
+                                  else for_training),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            any_labels |= st.feed_labels
+            feed = [DataDesc(n, s) for n, s in st.module.output_shapes]
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
+        # label_shapes is part of this module's bound signature only if
+        # some stage actually consumes labels
+        self._bound_label_shapes = label_shapes if any_labels else None
 
-            my_inputs_need_grad = bool(inputs_need_grad or
-                                       (for_training and i_layer > 0))
+    @staticmethod
+    def _rename_to_inputs(feed, module):
+        names = module.data_names
+        if len(names) != len(feed):
+            raise MXNetError(
+                "auto_wiring: previous stage produces %d outputs but %s "
+                "expects %d inputs" % (len(feed), type(module).__name__,
+                                       len(names)))
+        return [DataDesc(n, d.shape) for n, d in zip(names, feed)]
 
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [DataDesc(new_name, shape.shape
-                                           if hasattr(shape, "shape")
-                                           else shape[1])
-                                  for new_name, shape in
-                                  zip(data_names, my_data_shapes)]
-
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-
-            my_data_shapes = [DataDesc(name, shape) for name, shape
-                              in module.output_shapes]
-
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+    # -- training loop pieces ---------------------------------------------
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -161,56 +194,58 @@ class SequentialModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for st in self._stages:
+            st.module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                     optimizer_params=optimizer_params,
+                                     force_init=force_init)
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        data_batch = copy.copy(data_batch)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
-                break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_batch.provide_data = [
-                    DataDesc(name, x.shape) for name, x in
-                    zip(module.output_names, module.get_outputs())]
+        batch = copy.copy(data_batch)
+        last = len(self._stages) - 1
+        for pos, st in enumerate(self._stages):
+            st.module.forward(batch, is_train=is_train)
+            if pos == last:
+                return
+            # hand this stage's outputs to the next as its data batch
+            outs = st.module.get_outputs()
+            batch.data = outs
+            if hasattr(batch, "provide_data"):
+                batch.provide_data = [
+                    DataDesc(n, o.shape)
+                    for n, o in zip(st.module.output_names, outs)]
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(enumerate(self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+        for pos in range(len(self._stages) - 1, -1, -1):
+            mod = self._stages[pos].module
+            mod.backward(out_grads=out_grads)
+            if pos:
+                out_grads = mod.get_input_grads()
 
     def update(self):
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        assert (self.binded and self.params_initialized
+                and self.optimizer_initialized)
+        for st in self._stages:
+            st.module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._stages[-1].module.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and \
-            self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        assert (self.binded and self.params_initialized
+                and self.inputs_need_grad)
+        return self._stages[0].module.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels)
+        for st in self._stages:
+            if st.feed_labels:
+                st.module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for st in self._stages:
+            st.module.install_monitor(mon)

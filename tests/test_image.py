@@ -121,3 +121,17 @@ def test_imdecode_grayscale_and_bgr():
     assert np.array_equal(image.imdecode(buf, to_rgb=0), rgb[:, :, ::-1])
     gray = image.imdecode(buf, flag=0)
     assert gray.shape == (10, 12, 1)
+
+
+def test_scale_down_exact_fit_and_degenerate_bounds():
+    from mxnet_tpu.image import scale_down
+    # binding dimension must hit the bound exactly (no float undershoot)
+    assert scale_down((49, 49), (343, 343)) == (49, 49)
+    # 1-pixel bound must not collapse to zero
+    assert scale_down((1, 2), (49, 98)) == (1, 2)
+    # already fits: unchanged
+    assert scale_down((200, 200), (80, 60)) == (80, 60)
+    # one-sided clamps, aspect preserved
+    assert scale_down((40, 40), (100, 50)) == (40, 20)
+    assert scale_down((100, 30), (80, 60)) == (40, 30)
+    assert scale_down((10, 40), (100, 50)) == (10, 5)
