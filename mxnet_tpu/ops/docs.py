@@ -176,13 +176,381 @@ _DOCS = {
 }
 
 
+# Attribute docs (reference: every op parameter carries a
+# ``DMLC_DECLARE_FIELD(...).describe(...)`` string at its declaration
+# site, e.g. src/operator/fully_connected-inl.h:36-38, and that text
+# flows into every binding's generated docs).  Same layering as _DOCS:
+# inline ``doc=`` at the registration site wins; ``_ATTR_DOCS``
+# ("Op.attr") covers op-specific meanings; ``_COMMON_ATTR_DOCS`` covers
+# attributes whose meaning is uniform across the registry.
+
+_COMMON_ATTR_DOCS = {
+    "axis": "Axis (or axes) the operation is applied along.",
+    "keepdims": "Keep reduced axes as size-1 dims instead of dropping "
+                "them.",
+    "exclude": "Reduce over all axes EXCEPT the ones given in `axis`.",
+    "dtype": "Output data type.",
+    "ctx": "Device context for the result (accepted for API parity; "
+           "placement follows the executor's devices).",
+    "shape": "Shape of the output array.",
+    "scalar": "The scalar operand applied elementwise with the input.",
+    "lr": "Learning rate for this update step.",
+    "wd": "Weight decay: adds wd*weight to the gradient (L2 penalty).",
+    "rescale_grad": "Multiply the gradient by this factor before the "
+                    "update (typically 1/batch_size).",
+    "clip_gradient": "Clip each gradient element into [-clip_gradient, "
+                     "clip_gradient] before the update (off when <= 0).",
+    "clip_weights": "Clamp updated weights into [-clip_weights, "
+                    "clip_weights] (off when <= 0).",
+    "epsilon": "Small constant in the denominator for numerical "
+               "stability.",
+    "eps": "Small constant added to the variance for numerical "
+           "stability.",
+    "num_args": "Number of inputs (variadic ops need the count "
+                "up front).",
+    "kernel": "Kernel window shape (h, w).",
+    "stride": "Stride between window applications (h, w).",
+    "pad": "Implicit zero padding added on each spatial edge (h, w).",
+    "dilate": "Dilation between kernel taps (h, w).",
+    "num_filter": "Number of output channels.",
+    "num_group": "Split input/output channels into this many groups "
+                 "(grouped convolution).",
+    "no_bias": "Omit the bias term.",
+    "workspace": "Scratch-space limit in MB (accepted for API parity; "
+                 "XLA manages its own workspace).",
+    "layout": "Tensor layout, e.g. NCHW (accepted for API parity).",
+    "cudnn_off": "Disable cuDNN (accepted for API parity; no-op on "
+                 "TPU).",
+    "cudnn_tune": "cuDNN autotune policy (accepted for API parity; "
+                  "no-op on TPU).",
+    "is_ascend": "Ascending order (1) instead of descending (0).",
+    "transpose_a": "Transpose the first operand before the product.",
+    "transpose_b": "Transpose the second operand before the product.",
+    "grad_scale": "Multiplier applied to this head's backward "
+                  "gradient.",
+    "use_sequence_length": "Read per-sequence lengths from the extra "
+                           "input (otherwise every sequence spans the "
+                           "whole time axis).",
+    "temperature": "Divide the logits by this before normalizing.",
+    "begin": "Per-axis start indices (None = from the start).",
+    "end": "Per-axis end indices, exclusive (None = to the end).",
+}
+
+_ATTR_DOCS = {
+    # nn layers
+    "Activation.act_type": "Nonlinearity: relu, sigmoid, tanh or "
+                           "softrelu.",
+    "BatchNorm.fix_gamma": "Hold gamma fixed at 1; train only beta.",
+    "BatchNorm.momentum": "Exponential-average factor for the running "
+                          "mean/var.",
+    "BatchNorm.output_mean_var": "Also output the batch mean and "
+                                 "inverse std.",
+    "BatchNorm.use_global_stats": "Normalize with the running "
+                                  "statistics even in training mode.",
+    "Cast.dtype": "Target data type.",
+    "Concat.dim": "Axis along which to concatenate.",
+    "Convolution.kernel": "Convolution window shape (h, w).",
+    "Correlation.is_multiply": "Multiplicative matching (correlation) "
+                               "instead of subtraction.",
+    "Correlation.kernel_size": "Side of the square patch compared at "
+                               "each displacement.",
+    "Correlation.max_displacement": "Maximum search displacement in "
+                                    "pixels.",
+    "Correlation.pad_size": "Zero padding applied to both feature "
+                            "maps.",
+    "Correlation.stride1": "Stride over the first feature map's "
+                           "positions.",
+    "Correlation.stride2": "Stride over displacement candidates in "
+                           "the search window.",
+    "Crop.center_crop": "Crop from the center instead of `offset`.",
+    "Crop.h_w": "Explicit output (h, w) when no reference input "
+                "supplies the size.",
+    "Crop.offset": "Top-left (y, x) crop offset.",
+    "Crop.num_args": "2 when a reference symbol supplies the target "
+                     "size, else 1.",
+    "Deconvolution.adj": "Extra pixels appended to the output spatial "
+                         "size (disambiguates stride > 1 shapes).",
+    "Deconvolution.target_shape": "Explicit output spatial size "
+                                  "(h, w); overrides `adj`.",
+    "Dropout.p": "Fraction of activations zeroed (rest rescaled by "
+                 "1/(1-p)) during training.",
+    "Embedding.input_dim": "Vocabulary size (rows of the table).",
+    "Embedding.output_dim": "Embedding dimension (columns of the "
+                            "table).",
+    "FullyConnected.num_hidden": "Number of output units.",
+    "GridGenerator.transform_type": "affine (6-dof matrix input) or "
+                                    "warp (dense flow input).",
+    "GridGenerator.target_shape": "Output spatial size (h, w) of the "
+                                  "sampling grid.",
+    "IdentityAttachKLSparseReg.penalty": "Weight of the KL sparsity "
+                                         "penalty gradient.",
+    "IdentityAttachKLSparseReg.sparseness_target": "Target mean "
+                                                   "activation rho.",
+    "IdentityAttachKLSparseReg.momentum": "Exponential-average factor "
+                                          "for the tracked mean "
+                                          "activation.",
+    "InstanceNorm.eps": "Small constant added to the per-instance "
+                        "variance.",
+    "L2Normalization.mode": "Norm scope: instance (whole sample), "
+                            "channel (each channel vector) or spatial "
+                            "(each position).",
+    "LRN.alpha": "Scale of the squared-sum term.",
+    "LRN.beta": "Exponent of the normalization denominator.",
+    "LRN.knorm": "Additive constant in the denominator.",
+    "LRN.nsize": "Number of neighboring channels summed (window "
+                 "size).",
+    "LeakyReLU.act_type": "Variant: leaky, prelu, rrelu or elu.",
+    "LeakyReLU.slope": "Negative-side slope (leaky) / saturation "
+                       "scale (elu).",
+    "LeakyReLU.lower_bound": "Lower end of the rrelu random-slope "
+                             "range.",
+    "LeakyReLU.upper_bound": "Upper end of the rrelu random-slope "
+                             "range.",
+    "MakeLoss.normalization": "Divide the loss by: null (nothing), "
+                              "batch (batch size) or valid (count of "
+                              "valid elements).",
+    "MakeLoss.valid_thresh": "Elements <= this threshold count as "
+                             "invalid under normalization=valid.",
+    "Pad.constant_value": "Fill value for mode=constant.",
+    "Pad.mode": "constant, edge or reflect.",
+    "Pad.pad_width": "Per-axis (before, after) pad sizes — 2N values "
+                     "in the reference layout.",
+    "Pooling.global_pool": "Pool the entire spatial map regardless of "
+                           "kernel.",
+    "Pooling.pool_type": "max, avg or sum.",
+    "Pooling.pooling_convention": "Output-size rounding: valid "
+                                  "(floor) or full (ceil).",
+    "Pooling_v1.global_pool": "Pool the entire spatial map regardless "
+                              "of kernel.",
+    "Pooling_v1.pool_type": "max, avg or sum.",
+    "Pooling_v1.pooling_convention": "Output-size rounding: valid "
+                                     "(floor) or full (ceil).",
+    "RNN.bidirectional": "Run both directions and concatenate the "
+                         "outputs.",
+    "RNN.lstm_q_": "Accepted for parity with the reference's fused "
+                   "kernel (unused).",
+    "RNN.pkeep_": "Accepted for parity with the reference's fused "
+                  "kernel (unused).",
+    "RNN.mode": "Cell type: rnn_relu, rnn_tanh, lstm or gru.",
+    "RNN.num_layers": "Number of stacked layers.",
+    "RNN.p": "Dropout fraction applied between stacked layers.",
+    "RNN.state_outputs": "Also output the final hidden (and cell) "
+                         "states.",
+    "RNN.state_size": "Hidden state dimension.",
+    "ROIPooling.pooled_size": "Output grid (h, w) per ROI.",
+    "ROIPooling.spatial_scale": "Feature-map scale relative to the "
+                                "image (e.g. 1/16).",
+    "Reshape.reverse": "Match special codes from the right instead of "
+                       "the left.",
+    "Reshape.shape": "Target shape with the reference's special codes "
+                     "(0 copy, -1 infer, -2 copy rest, -3 merge, "
+                     "-4 split).",
+    "SVMOutput.margin": "Hinge margin.",
+    "SVMOutput.regularization_coefficient": "Scale on the "
+                                            "regularization gradient "
+                                            "term.",
+    "SVMOutput.use_linear": "Linear hinge instead of squared hinge.",
+    "SequenceMask.value": "Fill value for masked positions.",
+    "SliceChannel.axis": "Axis to split.",
+    "SliceChannel.num_outputs": "Number of equal parts.",
+    "SliceChannel.squeeze_axis": "Drop the split axis when each part "
+                                 "has size 1.",
+    "SoftmaxOutput.ignore_label": "Label value whose rows get zero "
+                                  "gradient (with use_ignore).",
+    "SoftmaxOutput.multi_output": "Softmax over axis 1 with trailing "
+                                  "axes as extra prediction positions.",
+    "SoftmaxOutput.normalization": "Gradient normalization: null, "
+                                   "batch or valid.",
+    "SoftmaxOutput.out_grad": "Multiply the backward gradient by the "
+                              "incoming head gradient.",
+    "SoftmaxOutput.preserve_shape": "Softmax over the last axis, "
+                                    "keeping the input shape.",
+    "SoftmaxOutput.smooth_alpha": "Label-smoothing mass spread over "
+                                  "non-target classes.",
+    "SoftmaxOutput.use_ignore": "Enable ignore_label handling.",
+    "SoftmaxActivation.mode": "instance (softmax per sample) or "
+                              "channel (per spatial position).",
+    "SpatialTransformer.sampler_type": "Sampling kernel (bilinear "
+                                       "only).",
+    "SpatialTransformer.transform_type": "Transform family (affine "
+                                         "only).",
+    "SpatialTransformer.target_shape": "Output spatial size (h, w).",
+    "SwapAxis.dim1": "First axis to exchange.",
+    "SwapAxis.dim2": "Second axis to exchange.",
+    "UpSampling.multi_input_mode": "Combine multiple inputs by concat "
+                                   "or sum after upsampling.",
+    "UpSampling.num_filter": "Channels of the learned bilinear kernel "
+                             "(sample_type=bilinear).",
+    "UpSampling.sample_type": "nearest or bilinear.",
+    "UpSampling.scale": "Integer upsampling factor.",
+    # contrib
+    "_contrib_MultiBoxDetection.background_id": "Class id treated as "
+                                                "background.",
+    "_contrib_MultiBoxDetection.clip": "Clip box corners into "
+                                       "[0, 1].",
+    "_contrib_MultiBoxDetection.force_suppress": "NMS across all "
+                                                 "classes, not within "
+                                                 "each class.",
+    "_contrib_MultiBoxDetection.nms_threshold": "IoU above which "
+                                                "overlapping "
+                                                "detections are "
+                                                "suppressed.",
+    "_contrib_MultiBoxDetection.nms_topk": "Boxes entering NMS at "
+                                           "most (-1 = all).",
+    "_contrib_MultiBoxDetection.threshold": "Minimum class score to "
+                                            "emit a detection.",
+    "_contrib_MultiBoxDetection.variances": "Decoding variances for "
+                                            "the (dx, dy, dw, dh) "
+                                            "offsets.",
+    "_contrib_MultiBoxPrior.clip": "Clip anchor corners into [0, 1].",
+    "_contrib_MultiBoxPrior.offsets": "Center offset (y, x) of each "
+                                      "anchor within its cell.",
+    "_contrib_MultiBoxPrior.ratios": "Aspect ratios of the generated "
+                                     "anchors.",
+    "_contrib_MultiBoxPrior.sizes": "Anchor scales as a fraction of "
+                                    "the image.",
+    "_contrib_MultiBoxPrior.steps": "Anchor step (y, x) between cells "
+                                    "(-1 = 1/feature size).",
+    "_contrib_MultiBoxTarget.ignore_label": "Class target assigned to "
+                                            "anchors the matcher "
+                                            "ignores.",
+    "_contrib_MultiBoxTarget.minimum_negative_samples": "Lower bound "
+                                                        "on sampled "
+                                                        "negatives.",
+    "_contrib_MultiBoxTarget.negative_mining_ratio": "Max negatives "
+                                                     "kept per "
+                                                     "positive (-1 = "
+                                                     "no mining).",
+    "_contrib_MultiBoxTarget.negative_mining_thresh": "Score above "
+                                                      "which an "
+                                                      "unmatched "
+                                                      "anchor may be "
+                                                      "mined as "
+                                                      "negative.",
+    "_contrib_MultiBoxTarget.overlap_threshold": "IoU above which an "
+                                                 "anchor matches a "
+                                                 "ground-truth box.",
+    "_contrib_MultiBoxTarget.variances": "Encoding variances for the "
+                                         "(dx, dy, dw, dh) offsets.",
+    "_contrib_Proposal.feature_stride": "Total downsample factor from "
+                                        "image to feature map.",
+    "_contrib_Proposal.iou_loss": "Use the IoU-loss box "
+                                  "parameterization when decoding.",
+    "_contrib_Proposal.output_score": "Also output each ROI's score.",
+    "_contrib_Proposal.ratios": "Anchor aspect ratios.",
+    "_contrib_Proposal.scales": "Anchor scales.",
+    "_contrib_Proposal.rpn_min_size": "Discard proposals smaller than "
+                                      "this (image scale).",
+    "_contrib_Proposal.rpn_post_nms_top_n": "Proposals kept after "
+                                            "NMS.",
+    "_contrib_Proposal.rpn_pre_nms_top_n": "Top-scoring proposals "
+                                           "entering NMS.",
+    "_contrib_Proposal.threshold": "NMS IoU threshold.",
+    "_contrib_count_sketch.out_dim": "Sketch output dimension (hash "
+                                     "buckets).",
+    "_contrib_count_sketch.processing_batch_size": "Rows processed "
+                                                   "per chunk "
+                                                   "(accepted for "
+                                                   "parity).",
+    "_contrib_dequantize.out_type": "Output float type.",
+    "_contrib_quantize.out_type": "Output quantized type.",
+    "_contrib_fft.compute_size": "FFT batch chunk size (accepted for "
+                                 "parity).",
+    "_contrib_ifft.compute_size": "FFT batch chunk size (accepted for "
+                                  "parity).",
+    # init / range ops
+    "_arange.start": "Interval start.",
+    "_arange.stop": "Interval end, exclusive (None: [0, start) is "
+                    "generated).",
+    "_arange.step": "Spacing between consecutive values.",
+    "_arange.repeat": "Emit each value this many times.",
+    # optimizer update kernels
+    "adam_update.beta1": "Decay of the first-moment average.",
+    "adam_update.beta2": "Decay of the second-moment average.",
+    "rmsprop_update.gamma1": "Decay of the squared-gradient average.",
+    "rmspropalex_update.gamma1": "Decay of the squared-gradient "
+                                 "average.",
+    "rmspropalex_update.gamma2": "Decay of the gradient average "
+                                 "(centering term).",
+    "sgd_mom_update.momentum": "Momentum coefficient on the "
+                               "accumulated update.",
+    # tensor / shape ops
+    "broadcast_axis.axis": "Axes (of size 1) to broadcast.",
+    "broadcast_axis.size": "Target size for each broadcast axis.",
+    "broadcast_to.shape": "Target shape (0 keeps the source dim).",
+    "clip.a_min": "Lower clamp bound.",
+    "clip.a_max": "Upper clamp bound.",
+    "expand_dims.axis": "Position of the inserted size-1 axis.",
+    "one_hot.depth": "Size of the one-hot dimension.",
+    "one_hot.on_value": "Value written at each index position.",
+    "one_hot.off_value": "Value written everywhere else.",
+    "pick.axis": "Axis along which the indices pick elements.",
+    "repeat.axis": "Axis along which to repeat (None = flattened).",
+    "repeat.repeats": "Repetitions per element.",
+    "reverse.axis": "Axes to reverse.",
+    "slice_axis.axis": "Axis to slice.",
+    "slice_axis.begin": "Start index on `axis`.",
+    "slice_axis.end": "End index, exclusive (None = to the end).",
+    "smooth_l1.scalar": "Transition sharpness sigma: quadratic inside "
+                        "|x| < 1/sigma^2, linear outside.",
+    "softmax.axis": "Axis over which to normalize.",
+    "log_softmax.axis": "Axis over which to normalize.",
+    "take.axis": "Axis of `a` to gather along (axis 0, reference "
+                 "parity).",
+    "take.mode": "Out-of-range index handling: clip, wrap or raise.",
+    "tile.reps": "Repetitions per axis (numpy.tile semantics).",
+    "topk.k": "Number of elements to keep.",
+    "topk.ret_typ": "Output form: value, indices, mask or both.",
+    "topk.axis": "Axis along which to select the top-k.",
+    "topk.is_ascend": "Select smallest (1) instead of largest (0).",
+    "transpose.axes": "Permutation of the axes (empty = reverse "
+                      "them).",
+    "sort.axis": "Axis to sort along.",
+    "argsort.axis": "Axis to sort along.",
+    # samplers (legacy _sample_* names; _random_* aliases share specs)
+    "_sample_uniform.low": "Lower bound of the range.",
+    "_sample_uniform.high": "Upper bound of the range.",
+    "_sample_normal.loc": "Mean of the distribution.",
+    "_sample_normal.scale": "Standard deviation of the distribution.",
+    "_sample_gamma.alpha": "Gamma shape parameter.",
+    "_sample_gamma.beta": "Gamma scale parameter.",
+    "_sample_exponential.lam": "Rate parameter lambda.",
+    "_sample_poisson.lam": "Mean lambda.",
+    "_sample_negbinomial.k": "Number-of-failures parameter.",
+    "_sample_negbinomial.p": "Success probability of each trial.",
+}
+
+
 def apply():
     for name, doc in _DOCS.items():
         op = get_op(name)
         if not op.doc:
             op.doc = doc
+    for name in list_ops():
+        op = get_op(name)
+        for attr, spec in op.attr_specs.items():
+            if spec.doc:
+                continue
+            doc = (_ATTR_DOCS.get("%s.%s" % (op.name, attr))
+                   or _COMMON_ATTR_DOCS.get(attr))
+            if doc:
+                spec.doc = doc
 
 
 def missing():
     """Op names that still have no doc (docgen/test hook)."""
     return [n for n in list_ops() if not get_op(n).doc]
+
+
+def missing_attr_docs():
+    """(op, attr) pairs whose AttrSpec still has no doc (test hook)."""
+    out = []
+    seen = set()
+    for name in list_ops():
+        op = get_op(name)
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        out.extend((op.name, a) for a, s in sorted(op.attr_specs.items())
+                   if not s.doc)
+    return out
