@@ -39,10 +39,17 @@ class InitDesc(str):
 
 
 class Initializer:
+    """Base initializer (role of reference ``mxnet.initializer``):
+    called as ``init(desc, arr)`` it fills ``arr`` in place using the
+    parameter name's suffix rules (``_weight`` -> ``_init_weight``,
+    ``_bias`` -> zeros, BatchNorm ``_gamma``/``_var`` -> ones, ...)."""
+
     def __init__(self, **kwargs):
         self._kwargs = kwargs
 
     def dumps(self):
+        """JSON ``[name, kwargs]`` form (stored in checkpoints so
+        fine-tune runs can re-create the initializer)."""
         return json.dumps([self.__class__.__name__.lower(), self._kwargs])
 
     def __call__(self, desc, arr):
@@ -97,6 +104,8 @@ class Initializer:
 
 @register
 class Zero(Initializer):
+    """Fill with zeros."""
+
     def _init_weight(self, _, arr):
         arr[:] = 0.0
 
@@ -105,6 +114,8 @@ class Zero(Initializer):
 
 @register
 class One(Initializer):
+    """Fill with ones."""
+
     def _init_weight(self, _, arr):
         arr[:] = 1.0
 
@@ -113,6 +124,8 @@ class One(Initializer):
 
 @register
 class Constant(Initializer):
+    """Fill with a constant ``value``."""
+
     def __init__(self, value=0.0):
         super().__init__(value=value)
         self.value = value
@@ -125,6 +138,8 @@ class Constant(Initializer):
 
 @register
 class Uniform(Initializer):
+    """Draw from Uniform(-scale, scale)."""
+
     def __init__(self, scale=0.07):
         super().__init__(scale=scale)
         self.scale = scale
@@ -135,6 +150,8 @@ class Uniform(Initializer):
 
 @register
 class Normal(Initializer):
+    """Draw from Normal(0, sigma)."""
+
     def __init__(self, sigma=0.01):
         super().__init__(sigma=sigma)
         self.sigma = sigma
@@ -145,6 +162,9 @@ class Normal(Initializer):
 
 @register
 class Orthogonal(Initializer):
+    """Orthogonal matrix init (Saxe et al.): scaled Q of a random
+    Gaussian's QR/SVD."""
+
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)
         self.scale = scale
@@ -164,6 +184,9 @@ class Orthogonal(Initializer):
 
 @register
 class Xavier(Initializer):
+    """Glorot/Xavier scaling from fan-in/fan-out (uniform or gaussian
+    ``rnd_type``; ``factor_type`` in avg/in/out)."""
+
     def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
         super().__init__(rnd_type=rnd_type, factor_type=factor_type,
                          magnitude=magnitude)
@@ -195,6 +218,9 @@ class Xavier(Initializer):
 
 @register
 class MSRAPrelu(Xavier):
+    """He/MSRA init for PReLU nets: gaussian Xavier with magnitude
+    2/(1+slope^2)."""
+
     def __init__(self, factor_type="avg", slope=0.25):
         magnitude = 2.0 / (1 + slope ** 2)
         super().__init__("gaussian", factor_type, magnitude)
@@ -203,6 +229,9 @@ class MSRAPrelu(Xavier):
 
 @register
 class Bilinear(Initializer):
+    """Bilinear-interpolation kernel for upsampling Deconvolution
+    weights."""
+
     def _init_weight(self, _, arr):
         weight = np.zeros(int(np.prod(arr.shape)), dtype="float32")
         shape = arr.shape

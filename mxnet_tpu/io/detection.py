@@ -137,11 +137,14 @@ class ImageDetRecordIter(DataIter):
     # -- DataIter interface --------------------------------------------
     @property
     def provide_data(self):
+        """DataDescs of the image batches this iterator yields."""
         return [DataDesc(self.data_name,
                          (self.batch_size,) + self.data_shape)]
 
     @property
     def provide_label(self):
+        """DataDescs of the padded (batch, max_objects, 6) detection
+        label tensor."""
         return [DataDesc(self.label_name,
                          (self.batch_size, self.max_objects,
                           self._object_width))]

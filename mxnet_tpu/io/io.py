@@ -35,12 +35,17 @@ class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
 
     @staticmethod
     def get_batch_axis(layout):
+        """Position of the batch axis ('N') in a layout string."""
         if layout is None:
             return 0
         return layout.find("N")
 
 
 class DataBatch:
+    """One batch: ``data``/``label`` NDArray lists plus ``pad`` (fill
+    rows in the final batch), ``index``, and optional ``bucket_key`` /
+    ``provide_*`` overrides for bucketing iterators."""
+
     def __init__(self, data, label=None, pad=None, index=None,
                  bucket_key=None, provide_data=None, provide_label=None):
         self.data = data
@@ -62,9 +67,12 @@ class DataIter:
         return self
 
     def reset(self):
-        pass
+        """Rewind to the start of the data (new epoch; shuffling
+        iterators re-permute here)."""
 
     def next(self):
+        """Return the next ``DataBatch``; raises ``StopIteration`` at
+        epoch end."""
         if self.iter_next():
             return DataBatch(data=self.getdata(), label=self.getlabel(),
                              pad=self.getpad(), index=self.getindex())
@@ -74,18 +82,25 @@ class DataIter:
         return self.next()
 
     def iter_next(self):
+        """Advance to the next batch; False at epoch end."""
         raise NotImplementedError()
 
     def getdata(self):
+        """Data NDArrays of the current batch."""
         raise NotImplementedError()
 
     def getlabel(self):
+        """Label NDArrays of the current batch."""
         raise NotImplementedError()
 
     def getindex(self):
+        """Example indices of the current batch (None when the source
+        has no index)."""
         return None
 
     def getpad(self):
+        """Number of padding examples appended to fill the final
+        batch (0 elsewhere)."""
         raise NotImplementedError()
 
 
@@ -149,15 +164,19 @@ class NDArrayIter(DataIter):
 
     @property
     def provide_data(self):
+        """DataDescs of the data this iterator yields."""
         return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
                 for k, v in self.data]
 
     @property
     def provide_label(self):
+        """DataDescs of the labels this iterator yields."""
         return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
                 for k, v in self.label]
 
     def hard_reset(self):
+        """Reset ignoring roll-over state (always back to the first
+        sample)."""
         self.cursor = -self.batch_size
 
     def reset(self):
@@ -266,6 +285,8 @@ class PrefetchingIter(DataIter):
 
     @property
     def provide_data(self):
+        """Combined (optionally renamed) data DataDescs of the wrapped
+        iterators."""
         if self.rename_data is None:
             return sum([i.provide_data for i in self.iters], [])
         return sum([[DataDesc(r[n], s.shape, s.dtype)
@@ -276,6 +297,8 @@ class PrefetchingIter(DataIter):
 
     @property
     def provide_label(self):
+        """Combined (optionally renamed) label DataDescs of the
+        wrapped iterators."""
         if self.rename_label is None:
             return sum([i.provide_label for i in self.iters], [])
         return sum([[DataDesc(r[n], s.shape, s.dtype)
@@ -603,10 +626,12 @@ class ImageRecordIter(DataIter):
 
     @property
     def provide_data(self):
+        """DataDescs of the data this iterator yields."""
         return [DataDesc("data", (self.batch_size,) + self.data_shape)]
 
     @property
     def provide_label(self):
+        """DataDescs of the labels this iterator yields."""
         shape = (self.batch_size,) if self.label_width == 1 else \
             (self.batch_size, self.label_width)
         return [DataDesc("softmax_label", shape)]

@@ -45,6 +45,12 @@ def _ctx_group_sum(vals):
 
 
 class KVStore:
+    """Synchronized key-value parameter store (role of the reference's
+    ``mxnet.kvstore.KVStore``): ``init`` once per key, ``push``
+    gradients (aggregated across devices), ``pull`` the updated value.
+    With ``set_optimizer`` the update runs where the store lives —
+    in-process for local/device, on the servers for ``dist_*``."""
+
     def __init__(self, kv_type="local"):
         self.type = kv_type
         self._store = {}
@@ -57,12 +63,16 @@ class KVStore:
 
     # -- core API ----------------------------------------------------------
     def init(self, key, value):
+        """Initialize key(s) with starting value(s); must precede
+        push/pull."""
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vv = v[0] if isinstance(v, (list, tuple)) else v
             self._store[k] = vv.copy()
 
     def push(self, key, value, priority=0):
+        """Push value(s) for key(s); a list-of-lists is summed across
+        devices first, then handed to the updater (or accumulated)."""
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vals = v if isinstance(v, (list, tuple)) else [v]
@@ -76,6 +86,7 @@ class KVStore:
                 self._store[k] += merged
 
     def pull(self, key, out=None, priority=0):
+        """Copy the stored value of key(s) into ``out`` array(s)."""
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
             targets = o if isinstance(o, (list, tuple)) else [o]
@@ -90,6 +101,8 @@ class KVStore:
 
     # -- updater / optimizer ------------------------------------------------
     def set_updater(self, updater):
+        """Install ``updater(key, pushed, stored)`` to run on every
+        push (replaces the default accumulate)."""
         self._updater = updater
 
     def set_optimizer(self, optimizer):
@@ -106,10 +119,12 @@ class KVStore:
     # -- topology -----------------------------------------------------------
     @property
     def rank(self):
+        """This worker's index in [0, num_workers)."""
         return self._rank
 
     @property
     def num_workers(self):
+        """Number of worker processes in the group."""
         return self._size
 
     def barrier(self):
@@ -125,12 +140,15 @@ class KVStore:
 
     # -- optimizer state save/load (Module.save_checkpoint support) ----------
     def save_optimizer_states(self, fname):
+        """Serialize the updater's optimizer state to ``fname``
+        (Module.save_checkpoint support)."""
         if self._updater is None:
             raise MXNetError("updater is not initialized")
         with open(fname, "wb") as f:
             f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        """Restore state written by ``save_optimizer_states``."""
         if self._updater is None:
             raise MXNetError("updater is not initialized")
         with open(fname, "rb") as f:

@@ -91,6 +91,9 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
 
 def load_checkpoint(prefix, epoch):
+    """Load ``(symbol, arg_params, aux_params)`` from a checkpoint
+    prefix/epoch written by ``save_checkpoint`` /
+    ``Module.save_checkpoint``."""
     symbol = sym_mod.load("%s-symbol.json" % prefix)
     save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
     arg_params = {}
@@ -135,12 +138,15 @@ class FeedForward(BASE_ESTIMATOR):
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):
+        """Recreate a FeedForward from a checkpoint prefix/epoch."""
         symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
         return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
                            aux_params=aux_params, begin_epoch=epoch,
                            **kwargs)
 
     def save(self, prefix, epoch=None):
+        """Checkpoint symbol + parameters as ``prefix-symbol.json`` /
+        ``prefix-NNNN.params``."""
         if epoch is None:
             epoch = self.num_epoch
         assert epoch is not None
@@ -154,6 +160,8 @@ class FeedForward(BASE_ESTIMATOR):
                batch_end_callback=None, kvstore="local", logger=None,
                work_load_list=None, eval_end_callback=None,
                eval_batch_end_callback=None, **kwargs):
+        """Build a FeedForward and fit it in one call (reference
+        convenience constructor)."""
         model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
                             epoch_size=epoch_size, optimizer=optimizer,
                             initializer=initializer, **kwargs)
@@ -183,6 +191,8 @@ class FeedForward(BASE_ESTIMATOR):
             kvstore="local", logger=None, work_load_list=None,
             monitor=None, eval_end_callback=None,
             eval_batch_end_callback=None):
+        """Train on ``X``/``y`` (numpy arrays, NDArrays or a DataIter)
+        for ``num_epoch`` epochs via an internal Module."""
         from .module import Module
         data = self._init_iter(X, y, is_train=True)
         if eval_data is not None and not hasattr(eval_data, "provide_data"):
@@ -212,6 +222,8 @@ class FeedForward(BASE_ESTIMATOR):
         return self
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Forward ``X`` and return the output array(s) (optionally the
+        consumed data/labels too)."""
         data = self._init_iter(X, None, is_train=False)
         from .module import Module
         if self._module is None:
@@ -233,6 +245,8 @@ class FeedForward(BASE_ESTIMATOR):
 
     def score(self, X, y=None, eval_metric="acc", num_batch=None,
               batch_end_callback=None, reset=True):
+        """Evaluate ``eval_metric`` on ``X``/``y`` and return the
+        value."""
         data = self._init_iter(X, y, is_train=False)
         if self._module is None:
             self.predict(data, num_batch=0)

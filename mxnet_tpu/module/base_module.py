@@ -38,6 +38,13 @@ def _check_input_names(symbol, names, typ, throw):
 
 
 class BaseModule:
+    """The module API contract (role of the reference's
+    ``mxnet.module.BaseModule``): a trainable/predictable computation
+    with bound data shapes, parameters and optimizer state.  High-level
+    ``fit``/``score``/``predict`` are implemented here on top of the
+    abstract ``bind``/``forward``/``backward``/``update`` primitives
+    that concrete modules provide."""
+
     def __init__(self, logger=logging):
         self.logger = logger
         self.binded = False
@@ -50,12 +57,16 @@ class BaseModule:
 
     # -- high-level --------------------------------------------------------
     def forward_backward(self, data_batch):
+        """Run ``forward(is_train=True)`` then ``backward`` on one
+        batch."""
         self.forward(data_batch, is_train=True)
         self.backward()
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
+        """Evaluate ``eval_metric`` over ``eval_data`` (forward-only)
+        and return ``[(metric_name, value), ...]``."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
@@ -82,6 +93,8 @@ class BaseModule:
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
+        """Yield ``(outputs, batch_index, batch)`` per batch of
+        forward-only prediction, with padding rows stripped."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
@@ -96,6 +109,10 @@ class BaseModule:
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
+        """Forward the whole ``eval_data`` and return the collected
+        outputs — one NDArray when the net has a single output and
+        ``merge_batches`` (default), else a list (of lists).  A bare
+        NDArray/numpy input is wrapped in an NDArrayIter first."""
         assert self.binded and self.params_initialized
         if isinstance(eval_data, (nd.NDArray, np.ndarray)):
             from ..io.io import NDArrayIter
@@ -198,18 +215,60 @@ class BaseModule:
 
     # -- abstract ----------------------------------------------------------
     @property
+    def data_names(self):
+        """Names of the data inputs this module consumes."""
+        raise NotImplementedError()
+
+    @property
+    def output_names(self):
+        """Names of the outputs this module produces."""
+        raise NotImplementedError()
+
+    @property
+    def data_shapes(self):
+        """Bound data DataDescs (valid after ``bind``)."""
+        raise NotImplementedError()
+
+    @property
+    def label_shapes(self):
+        """Bound label DataDescs (None/[] when the module takes no
+        labels)."""
+        raise NotImplementedError()
+
+    @property
+    def output_shapes(self):
+        """(name, shape) of each output under the bound input
+        shapes."""
+        raise NotImplementedError()
+
+    def get_input_grads(self, merge_multi_context=True):
+        """Gradients w.r.t. the data inputs from the last ``backward``
+        (requires binding with ``inputs_need_grad=True``)."""
+        raise NotImplementedError()
+
+    @property
     def symbol(self):
+        """The Symbol this module computes (None for python-defined
+        modules)."""
         return self._symbol
 
     def get_params(self):
+        """Return ``(arg_params, aux_params)``: name -> NDArray dicts
+        of the current parameters and auxiliary states."""
         raise NotImplementedError()
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
+        """Initialize parameters: values from ``arg_params`` /
+        ``aux_params`` when given, else drawn from ``initializer``
+        (missing names allowed only with ``allow_missing``).  A no-op
+        when already initialized unless ``force_init``."""
         raise NotImplementedError()
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
+        """Assign parameter values directly (an ``init_params`` with
+        no initializer)."""
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init)
@@ -217,29 +276,50 @@ class BaseModule:
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
+        """Allocate the executor(s) for the given input shapes.  On
+        TPU this is where the fused forward/backward XLA program is
+        traced and compiled; ``shared_module`` reuses another module's
+        parameter/pool memory (bucketing), ``grad_req`` in
+        write/add/null controls gradient accumulation."""
         raise NotImplementedError()
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
+        """Create the optimizer and hook it to the kvstore (by name
+        or instance); must follow ``bind`` + ``init_params``."""
         raise NotImplementedError()
 
     def forward(self, data_batch, is_train=None):
+        """Run the forward pass on one ``DataBatch``
+        (``is_train=None`` follows the bound ``for_training`` flag).
+        Outputs are read back with ``get_outputs``."""
         raise NotImplementedError()
 
     def backward(self, out_grads=None):
+        """Run the backward pass (``out_grads`` seeds the head
+        gradients when the net does not end in a loss op)."""
         raise NotImplementedError()
 
     def update(self):
+        """Apply one optimizer step to the parameters from the
+        gradients accumulated by the last ``backward``."""
         raise NotImplementedError()
 
     def get_outputs(self, merge_multi_context=True):
+        """Outputs of the last ``forward`` as a list of NDArrays
+        (``merge_multi_context`` concatenates per-device shards)."""
         raise NotImplementedError()
 
     def update_metric(self, eval_metric, labels):
+        """Feed the last forward's outputs and ``labels`` into
+        ``eval_metric`` (device-side accumulation when the metric
+        supports it)."""
         raise NotImplementedError()
 
     def install_monitor(self, mon):
+        """Attach a ``Monitor`` that records intermediate
+        activations/gradients for debugging."""
         raise NotImplementedError()
 
     def get_states(self, merge_multi_context=True):

@@ -104,6 +104,9 @@ class Module(BaseModule):
     # -- checkpointing -----------------------------------------------------
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create a Module from a ``save_checkpoint`` prefix/epoch
+        (symbol + params; optimizer states restored lazily at
+        ``init_optimizer`` when requested)."""
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
         mod._arg_params = args
@@ -114,6 +117,8 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Write ``prefix-symbol.json`` + ``prefix-NNNN.params`` (and
+        ``.states`` when asked) — the reference checkpoint format."""
         self._symbol.save("%s-symbol.json" % prefix)
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
@@ -124,12 +129,15 @@ class Module(BaseModule):
             logging.info("Saved optimizer state to \"%s\"", state_name)
 
     def save_params(self, fname):
+        """Save current parameters (``arg:``/``aux:`` key convention,
+        interoperable with reference ``.params`` files)."""
         arg_params, aux_params = self.get_params()
         save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
         save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
         nd.save(fname, save_dict)
 
     def load_params(self, fname):
+        """Load parameters written by ``save_params``."""
         save_dict = nd.load(fname)
         arg_params = {}
         aux_params = {}
@@ -144,6 +152,8 @@ class Module(BaseModule):
         self.set_params(arg_params, aux_params)
 
     def save_optimizer_states(self, fname):
+        """Pickle the optimizer state (momentum etc.) to ``fname``;
+        layout matches update_on_kvstore (shared state per param)."""
         assert self.optimizer_initialized
         if self._fused is not None:
             # Updater.states pickle keyed by plain param index — the
@@ -163,6 +173,8 @@ class Module(BaseModule):
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        """Restore optimizer state written by
+        ``save_optimizer_states``."""
         assert self.optimizer_initialized
         if self._fused is not None:
             with open(fname, "rb") as f:
@@ -180,6 +192,8 @@ class Module(BaseModule):
 
     @property
     def label_names(self):
+        """Names of the label inputs (may be empty for label-free
+        nets)."""
         return self._label_names
 
     @property
@@ -324,6 +338,8 @@ class Module(BaseModule):
         self._fused_outputs = None
 
     def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind to new input shapes keeping the current parameters
+        (new shapes trigger one fresh XLA compile, then cache)."""
         assert self.binded
         if self._fused is not None:
             self._sync_params_from_devices()

@@ -91,38 +91,49 @@ class NDArray:
     # -- basic properties ---------------------------------------------------
     @property
     def shape(self):
+        """Dimensions as a tuple of ints."""
         return tuple(self._data.shape)
 
     @property
     def size(self):
+        """Total number of elements."""
         return int(np.prod(self._data.shape, dtype=np.int64)) if self._data.shape else 1
 
     @property
     def ndim(self):
+        """Number of dimensions."""
         return self._data.ndim
 
     @property
     def dtype(self):
+        """Element type (numpy dtype)."""
         return self._data.dtype
 
     @property
     def context(self):
+        """Device this array lives on (``mx.cpu()`` / ``mx.tpu(i)``)."""
         return _ctx_of(self._data)
 
     ctx = context
 
     @property
     def T(self):
+        """Transposed copy (real transpose, not a view — reference
+        NDArray.T semantics)."""
         return NDArray(self._data.T)
 
     # -- sync / host access -------------------------------------------------
     def wait_to_read(self):
+        """Block until all pending writes to this array finish (the
+        async-engine sync point)."""
         jax.block_until_ready(self._data)
 
     def asnumpy(self):
+        """Copy to a host numpy array (waits on pending work)."""
         return np.asarray(jax.device_get(self._data))
 
     def asscalar(self):
+        """The single element of a size-1 array as a python scalar."""
         if self.size != 1:
             raise MXNetError("The current array is not a scalar")
         return self.asnumpy().reshape(())[()]
@@ -145,6 +156,7 @@ class NDArray:
 
     # -- views / copies -----------------------------------------------------
     def reshape(self, shape, *more):
+        """View with a new shape (accepts a tuple or varargs dims)."""
         if more:
             shape = (shape,) + tuple(more)
         if isinstance(shape, int):
@@ -152,6 +164,7 @@ class NDArray:
         return NDArray(self._data.reshape(shape))
 
     def astype(self, dtype):
+        """Copy converted to ``dtype``."""
         return NDArray(self._data.astype(_as_jnp_dtype(dtype)))
 
     def broadcast_to(self, shape):
@@ -163,6 +176,7 @@ class NDArray:
         return globals()["broadcast_to"](self, shape=tuple(shape))
 
     def copy(self):
+        """Deep copy on the same device."""
         return NDArray(self._data + 0 if self._data.dtype != jnp.bool_
                        else jnp.array(self._data))
 
@@ -177,28 +191,36 @@ class NDArray:
         raise MXNetError("copyto does not support type %s" % type(other))
 
     def as_in_context(self, ctx):
+        """This array on ``ctx`` (self when already there, else a
+        copy)."""
         if ctx == self.context:
             return self
         return self.copyto(ctx)
 
     def slice(self, start, stop):
+        """Rows [start, stop) along axis 0."""
         return NDArray(self._data[start:stop])
 
     def slice_axis(self, axis, begin, end):
+        """[begin, end) along ``axis`` (None end = to the end)."""
         idx = [_py_slice(None)] * self.ndim
         idx[axis] = _py_slice(begin, end)
         return NDArray(self._data[tuple(idx)])
 
     def at(self, idx):
+        """Row ``idx`` along axis 0 (reference ``NDArray.at``)."""
         return NDArray(self._data[idx])
 
     def flatten(self):
+        """Collapse all trailing axes: (d0, d1*...*dn)."""
         return self.reshape((self.shape[0], -1)) if self.ndim > 1 else self
 
     def expand_dims(self, axis):
+        """Copy with a size-1 axis inserted at ``axis``."""
         return NDArray(jnp.expand_dims(self._data, axis))
 
     def transpose(self, axes=None):
+        """Permute axes (reversed when ``axes`` is None)."""
         return NDArray(jnp.transpose(self._data, axes))
 
     # -- indexing -----------------------------------------------------------
@@ -293,18 +315,24 @@ class NDArray:
                               self._data))
 
     def sum(self, axis=None, keepdims=False):
+        """Sum over ``axis`` (all axes when None)."""
         return self._reduce("sum", jnp.sum, axis, keepdims)
 
     def mean(self, axis=None, keepdims=False):
+        """Arithmetic mean over ``axis``."""
         return self._reduce("mean", jnp.mean, axis, keepdims)
 
     def max(self, axis=None, keepdims=False):
+        """Maximum over ``axis``."""
         return self._reduce("max", jnp.max, axis, keepdims)
 
     def min(self, axis=None, keepdims=False):
+        """Minimum over ``axis``."""
         return self._reduce("min", jnp.min, axis, keepdims)
 
     def argmax(self, axis=None):
+        """Index of the maximum along ``axis`` (float output,
+        reference convention)."""
         return NDArray(jnp.argmax(self._data, axis=axis).astype(jnp.float32))
 
     def __repr__(self):
@@ -313,15 +341,21 @@ class NDArray:
 
     # -- autograd hooks (contrib.autograd; see autograd.py) ------------------
     def attach_grad(self, grad_req="write"):
+        """Mark this array as a differentiation root for
+        ``autograd.record()`` (allocates its ``.grad`` buffer)."""
         from . import autograd
         autograd.mark_variables([self], [zeros_like(self)], grad_req)
 
     @property
     def grad(self):
+        """Gradient buffer filled by ``backward()`` (after
+        ``attach_grad``)."""
         from . import autograd
         return autograd.get_grad(self)
 
     def backward(self, out_grad=None, retain_graph=False):
+        """Backprop from this array through the recorded tape into
+        every attached ``.grad``."""
         from . import autograd
         autograd.backward([self], [out_grad] if out_grad is not None else None,
                           retain_graph=retain_graph)
@@ -355,10 +389,13 @@ def array(source, ctx=None, dtype=None):
 
 
 def empty(shape, ctx=None, dtype=None):
+    """New uninitialized array (zero-filled here: XLA has no cheaper
+    uninitialized allocation)."""
     return zeros(shape, ctx, dtype)
 
 
 def zeros(shape, ctx=None, dtype=None):
+    """New array of zeros."""
     if isinstance(shape, int):
         shape = (shape,)
     return NDArray(jax.device_put(
@@ -366,6 +403,7 @@ def zeros(shape, ctx=None, dtype=None):
 
 
 def ones(shape, ctx=None, dtype=None):
+    """New array of ones."""
     if isinstance(shape, int):
         shape = (shape,)
     return NDArray(jax.device_put(
@@ -373,6 +411,7 @@ def ones(shape, ctx=None, dtype=None):
 
 
 def full(shape, val, ctx=None, dtype=None):
+    """New array filled with ``val``."""
     if isinstance(shape, int):
         shape = (shape,)
     return NDArray(jax.device_put(
@@ -388,6 +427,8 @@ def ones_like(other):
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    """Evenly spaced values in [start, stop), each repeated ``repeat``
+    times."""
     arr = jnp.arange(start, stop, step, dtype=_as_jnp_dtype(dtype))
     if repeat > 1:
         arr = jnp.repeat(arr, repeat)
@@ -395,10 +436,13 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
 
 
 def concatenate(arrays, axis=0, always_copy=True):
+    """Join NDArrays along ``axis``."""
     return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis))
 
 
 def onehot_encode(indices, out):
+    """One-hot encode ``indices`` into the preallocated 2-D ``out``
+    (legacy reference API)."""
     depth = out.shape[1]
     out._data = jax.nn.one_hot(indices._data.astype(jnp.int32), depth,
                                dtype=out._data.dtype)
@@ -406,6 +450,8 @@ def onehot_encode(indices, out):
 
 
 def waitall():
+    """Block until every pending async operation (device compute and
+    checkpoint writes) has finished; re-raises async write errors."""
     _engine.waitall()
 
 
@@ -495,6 +541,10 @@ def _wait_pending_write(fname):
 
 
 def save(fname, data):
+    """Save an NDArray / list / dict-of-named NDArrays to ``fname``
+    (role of reference NDArray::Save; npz container, written
+    asynchronously on the host engine — ``load``/``waitall``
+    synchronize)."""
     # np.savez always appends .npz to names lacking it; canonical on-disk
     # name is therefore fname + '.npz' and load() resolves the same way.
     # Values are snapshotted (asnumpy) before returning; the file write
@@ -515,6 +565,7 @@ def save(fname, data):
 
 
 def load(fname):
+    """Load what ``save`` wrote: a list or dict of NDArrays."""
     _wait_pending_write(fname)
     with np.load(_npz_load_name(fname)) as zf:
         fmt = str(zf["__mx_format__"])
@@ -543,6 +594,9 @@ def _npz_load_name(fname):
 #  python/mxnet/_ctypes/ndarray.py:44+)
 # ---------------------------------------------------------------------------
 def imperative_invoke(op_name, args, kwargs):
+    """Run a registered op eagerly on NDArrays (the engine behind every
+    ``mx.nd.<op>`` function; handles aux-state carry, mutation ops,
+    ``out=`` and autograd recording)."""
     from . import autograd
     op = get_op(op_name)
     out = kwargs.pop("out", None)
@@ -628,18 +682,22 @@ def _as_nd(x):
 
 
 def add(lhs, rhs):
+    """Elementwise sum (array or scalar operands)."""
     return _as_nd(lhs) + rhs
 
 
 def subtract(lhs, rhs):
+    """Elementwise difference (array or scalar operands)."""
     return _as_nd(lhs) - rhs
 
 
 def multiply(lhs, rhs):
+    """Elementwise product (array or scalar operands)."""
     return _as_nd(lhs) * rhs
 
 
 def divide(lhs, rhs):
+    """Elementwise quotient (array or scalar operands)."""
     return _as_nd(lhs) / rhs
 
 
@@ -647,6 +705,7 @@ true_divide = divide
 
 
 def power(lhs, rhs):
+    """Elementwise power (array or scalar operands)."""
     return _as_nd(lhs) ** rhs
 
 
@@ -671,6 +730,7 @@ def maximum(lhs, rhs):
 
 
 def minimum(lhs, rhs):
+    """Elementwise minimum with scalar broadcast."""
     return _minmax("_minimum", "_minimum_scalar", lhs, rhs)
 
 
@@ -686,22 +746,27 @@ def equal(lhs, rhs):
 
 
 def not_equal(lhs, rhs):
+    """1.0 where different else 0.0."""
     return _compare(jnp.not_equal, lhs, rhs)
 
 
 def greater(lhs, rhs):
+    """1.0 where lhs > rhs else 0.0."""
     return _compare(jnp.greater, lhs, rhs)
 
 
 def greater_equal(lhs, rhs):
+    """1.0 where lhs >= rhs else 0.0."""
     return _compare(jnp.greater_equal, lhs, rhs)
 
 
 def lesser(lhs, rhs):
+    """1.0 where lhs < rhs else 0.0."""
     return _compare(jnp.less, lhs, rhs)
 
 
 def lesser_equal(lhs, rhs):
+    """1.0 where lhs <= rhs else 0.0."""
     return _compare(jnp.less_equal, lhs, rhs)
 
 

@@ -27,15 +27,26 @@ __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "DCASGD", "Adam",
 
 
 class Optimizer:
+    """Base class of the optimizer zoo (role of the reference's
+    ``mxnet.optimizer.Optimizer``): per-parameter learning-rate /
+    weight-decay multipliers, update counting, gradient rescale and
+    clipping.  Subclasses define ``create_state`` + ``update``; under
+    the fused Module path the same math runs in-graph on device
+    (``parallel/ingraph_opt.py``)."""
+
     opt_registry = {}
 
     @staticmethod
     def register(klass):
+        """Class decorator adding an Optimizer subclass to the
+        by-name registry used by ``create``."""
         Optimizer.opt_registry[klass.__name__.lower()] = klass
         return klass
 
     @staticmethod
     def create_optimizer(name, **kwargs):
+        """Instantiate a registered optimizer by (case-insensitive)
+        name."""
         if name.lower() in Optimizer.opt_registry:
             return Optimizer.opt_registry[name.lower()](**kwargs)
         raise ValueError("Cannot find optimizer %s" % name)
@@ -63,12 +74,18 @@ class Optimizer:
         self.set_wd_mult({})
 
     def create_state(self, index, weight):
+        """Allocate the per-parameter optimizer state for ``weight``
+        (None when the rule is stateless)."""
         return None
 
     def update(self, index, weight, grad, state):
+        """Apply one update step to ``weight`` in place from ``grad``
+        and this parameter's ``state``."""
         raise NotImplementedError()
 
     def set_lr_mult(self, args_lr_mult):
+        """Per-parameter learning-rate multipliers (explicit dict wins
+        over ``__lr_mult__`` symbol attributes)."""
         self.lr_mult = {}
         if self.sym is not None:
             attr = self.sym.attr_dict()
@@ -78,6 +95,9 @@ class Optimizer:
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
+        """Per-parameter weight-decay multipliers; biases/gammas
+        default to 0 (no decay), ``__wd_mult__`` attributes and the
+        explicit dict override."""
         self.wd_mult = {}
         for n in self.idx2name.values():
             if not (n.endswith("_weight") or n.endswith("_gamma")):
@@ -171,6 +191,9 @@ class NAG(SGD):
 
 @register
 class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics: SGD plus Gaussian noise
+    scaled by the learning rate (Bayesian sampling)."""
+
     """Stochastic Gradient Langevin Dynamics."""
 
     def update(self, index, weight, grad, state):
@@ -194,6 +217,9 @@ class ccSGD(SGD):
 
 @register
 class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py DCASGD):
+    corrects stale gradients with a curvature term."""
+
     """Delay-compensated async SGD (reference DCASGD)."""
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
@@ -228,6 +254,9 @@ class DCASGD(Optimizer):
 
 @register
 class Adam(Optimizer):
+    """Adam: bias-corrected first/second-moment adaptive steps; uses
+    the fused ``adam_update`` op."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -259,6 +288,9 @@ class Adam(Optimizer):
 
 @register
 class AdaGrad(Optimizer):
+    """AdaGrad: per-coordinate learning rates from accumulated squared
+    gradients."""
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -281,6 +313,10 @@ class AdaGrad(Optimizer):
 
 @register
 class RMSProp(Optimizer):
+    """RMSProp (Tieleman/Hinton; centered Graves variant when
+    ``centered=True``); uses the fused ``rmsprop_update`` /
+    ``rmspropalex_update`` ops."""
+
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -318,6 +354,9 @@ class RMSProp(Optimizer):
 
 @register
 class AdaDelta(Optimizer):
+    """AdaDelta: scale steps by the ratio of running RMS of updates to
+    RMS of gradients (no explicit learning rate needed)."""
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho = rho
@@ -345,6 +384,9 @@ class AdaDelta(Optimizer):
 
 @register
 class Ftrl(Optimizer):
+    """FTRL-Proximal: L1/L2-regularized online learning (sparse
+    models)."""
+
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1 = lamda1
@@ -378,6 +420,9 @@ class Ftrl(Optimizer):
 
 @register
 class Test(Optimizer):
+    """Trivial optimizer used by the reference test-suite: state is a
+    weight-shaped buffer, update adds grad into it."""
+
     def create_state(self, index, weight):
         return nd.zeros(weight.shape, weight.context)
 
@@ -387,6 +432,7 @@ class Test(Optimizer):
 
 
 def create(name, **kwargs):
+    """Create a registered optimizer by name (``mx.optimizer.create("sgd", learning_rate=0.1)``)."""
     return Optimizer.create_optimizer(name, **kwargs)
 
 

@@ -82,6 +82,7 @@ class Symbol:
     # -- structure ---------------------------------------------------------
     @property
     def name(self):
+        """Name of the single-output symbol's node (None for groups)."""
         if len(self._outputs) == 1:
             return self._outputs[0][0].name
         return None
@@ -113,6 +114,8 @@ class Symbol:
                    for n in self._nodes())
 
     def list_arguments(self):
+        """Names of all input arguments (data variables + parameters),
+        in topological order."""
         args = []
         for node in self._nodes():
             if node.is_variable and not _is_aux_node(node, self):
@@ -120,6 +123,7 @@ class Symbol:
         return args
 
     def list_outputs(self):
+        """Names of the outputs (``<node>_<out>`` convention)."""
         names = []
         for node, oi in self._outputs:
             if node.is_variable:
@@ -130,6 +134,8 @@ class Symbol:
         return names
 
     def list_auxiliary_states(self):
+        """Names of auxiliary states (non-gradient buffers such as
+        BatchNorm running stats)."""
         aux = []
         seen = set()
         for node in self._nodes():
@@ -154,6 +160,8 @@ class Symbol:
         return Symbol(outs)
 
     def get_children(self):
+        """Inputs of this symbol's head node as a grouped Symbol (None
+        for leaf variables)."""
         node = self._outputs[0][0]
         if not node.inputs:
             return None
@@ -177,6 +185,7 @@ class Symbol:
             node.extra_attrs[k] = str(v)
 
     def attr_dict(self):
+        """{node name: {attr: value}} for every node in the graph."""
         ret = {}
         for node in self._nodes():
             d = dict(node.extra_attrs)
@@ -265,6 +274,9 @@ class Symbol:
 
     # -- inference ---------------------------------------------------------
     def infer_shape(self, *args, **kwargs):
+        """Infer ``(arg_shapes, out_shapes, aux_shapes)`` from known
+        input shapes (positional in ``list_arguments`` order or by
+        keyword); raises when the graph cannot be fully inferred."""
         res = self.infer_shape_partial(*args, **kwargs)
         arg_shapes, out_shapes, aux_shapes = res
         if arg_shapes is not None and any(s is None for s in arg_shapes):
@@ -275,6 +287,8 @@ class Symbol:
         return res
 
     def infer_shape_partial(self, *args, **kwargs):
+        """Like ``infer_shape`` but unknown shapes come back as None
+        instead of raising."""
         arg_names = self.list_arguments()
         known = {}
         if args:
@@ -287,6 +301,8 @@ class Symbol:
         return shapes
 
     def infer_type(self, *args, **kwargs):
+        """Infer ``(arg_dtypes, out_dtypes, aux_dtypes)`` from known
+        input dtypes."""
         arg_names = self.list_arguments()
         known = {}
         if args:
@@ -309,6 +325,8 @@ class Symbol:
             "executor.backward() or mx.autograd instead")
 
     def tojson(self):
+        """Serialize the graph to the reference's JSON format
+        (round-trips through ``load_json``)."""
         nodes = self._nodes()
         nid = {id(n): i for i, n in enumerate(nodes)}
         jnodes, arg_nodes = [], []
@@ -329,12 +347,15 @@ class Symbol:
                            "attrs": {"mxnet_tpu_version": "0.1"}}, indent=2)
 
     def save(self, fname):
+        """Write ``tojson()`` to a file (pair of ``symbol.load``)."""
         with open(fname, "w") as f:
             f.write(self.tojson())
 
     # -- binding ------------------------------------------------------------
     def simple_bind(self, ctx, grad_req="write", type_dict=None,
                     group2ctx=None, shared_exec=None, **kwargs):
+        """Infer shapes from the given input shapes, allocate all
+        argument/gradient/aux arrays, and return the bound Executor."""
         from . import executor as _executor
         from . import ndarray as nd
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
@@ -362,6 +383,10 @@ class Symbol:
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
+        """Bind with caller-provided argument arrays (list in
+        ``list_arguments`` order or dict by name) and return the
+        Executor; the executor's fused forward/backward is one compiled
+        XLA program."""
         from . import executor as _executor
         arg_names = self.list_arguments()
         if isinstance(args, dict):
@@ -389,6 +414,8 @@ class Symbol:
                                   shared_exec=shared_exec)
 
     def eval(self, ctx=None, **kwargs):
+        """One-shot evaluation: bind with the given named NDArrays and
+        return the forward outputs."""
         from .context import current_context
         ctx = ctx or current_context()
         ex = self.bind(ctx, kwargs)
@@ -396,6 +423,7 @@ class Symbol:
 
     # -- misc ---------------------------------------------------------------
     def debug_str(self):
+        """Human-readable dump of the graph (one line per node)."""
         lines = []
         for n in self._nodes():
             if n.is_variable:
@@ -616,6 +644,7 @@ def _sym_binary(op_name, scalar_op_name, lhs, rhs):
 # JSON load
 # ---------------------------------------------------------------------------
 def load_json(json_str):
+    """Rebuild a Symbol from its ``tojson()`` serialization."""
     data = json.loads(json_str)
     jnodes = data["nodes"]
     nodes = []
@@ -635,6 +664,7 @@ def load_json(json_str):
 
 
 def load(fname):
+    """Load a Symbol saved with ``Symbol.save``."""
     with open(fname) as f:
         return load_json(f.read())
 
@@ -694,14 +724,17 @@ _init_symbol_module()
 
 
 def zeros(shape, dtype="float32", **kwargs):
+    """Symbol producing a zero-filled array."""
     return _invoke("_zeros", [], {"shape": shape, "dtype": dtype}, **kwargs)
 
 
 def ones(shape, dtype="float32", **kwargs):
+    """Symbol producing a one-filled array."""
     return _invoke("_ones", [], {"shape": shape, "dtype": dtype}, **kwargs)
 
 
 def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
+    """Symbol producing evenly spaced values in [start, stop)."""
     return _invoke("_arange", [], {"start": start, "stop": stop,
                                    "step": step, "repeat": repeat,
                                    "dtype": dtype}, name=name)

@@ -16,6 +16,26 @@ def _snippets(md_path):
     return re.findall(r"```python\n(.*?)```", text, re.S)
 
 
+API_PAGES = ["ndarray.md", "symbol.md", "module.md", "io.md",
+             "kvstore.md", "optimization.md", "model.md"]
+
+
+@pytest.mark.parametrize("doc", API_PAGES)
+def test_api_reference_snippets_run(doc, tmp_path):
+    """The generated Python-API pages' intro examples execute."""
+    path = os.path.join(REPO, "docs", "api", "python", doc)
+    blocks = _snippets(path)
+    assert blocks, "no python blocks found in %s" % doc
+    program = "\n\n".join(blocks)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    p = subprocess.run([sys.executable, "-c", program], env=env,
+                       cwd=str(tmp_path),
+                       capture_output=True, text=True, timeout=560)
+    assert p.returncode == 0, (p.stdout[-1000:], p.stderr[-1000:])
+
+
 @pytest.mark.parametrize("doc", ["mnist.md", "autograd.md"])
 def test_tutorial_code_runs(doc, tmp_path):
     path = os.path.join(REPO, "docs", "tutorials", doc)
