@@ -1,0 +1,37 @@
+# Local entry points for the CI stages defined in ci.yaml.
+PY ?= python
+
+.PHONY: test quick build dist convergence ci-quick ci-full docs bench
+
+quick:
+	$(PY) -m pytest tests/ -m quick -q
+
+build:
+	$(PY) -m pytest tests/ -m build -q
+
+dist:
+	$(PY) -m pytest tests/ -m dist -q
+
+convergence:
+	$(PY) -m pytest tests/ -m convergence -q
+
+test:
+	$(PY) -m pytest tests/ -q
+
+docs:
+	$(PY) tools/docgen.py
+	$(PY) tools/docgen_python.py
+
+docs-check:
+	$(PY) tools/docgen.py --check
+	$(PY) tools/docgen_python.py --check
+
+ci-quick: quick docs-check
+
+ci-full: build dist convergence quick docs-check
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	$(PY) bench.py

@@ -28,7 +28,9 @@ class StochasticGate(mx.operator.CustomOp):
     def __init__(self, survival):
         super().__init__()
         self.survival = float(survival)
-        self._rs = np.random.RandomState()
+        # seeded from the global stream so a seeded run is fully
+        # deterministic while distinct gates still draw independently
+        self._rs = np.random.RandomState(np.random.randint(2 ** 31))
         self._last_gate = 1.0
 
     def forward(self, is_train, req, in_data, out_data, aux):
@@ -109,6 +111,9 @@ def main():
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
+    # deterministic end to end: data split, iterator shuffle, Xavier
+    # init and the stochastic gates all draw from seeded streams
+    np.random.seed(7)
     rs = np.random.RandomState(13)
     X, y = make_digits(rs, args.num_examples)
     n_train = int(0.8 * args.num_examples)

@@ -29,3 +29,48 @@ def _seed_rngs():
     import mxnet_tpu as _mx
     _mx.random.seed(0)
     yield
+
+
+# ---------------------------------------------------------------------------
+# Test tiers (reference: Jenkinsfile stages split quick sanity from the
+# full matrix).  Every test gets exactly one tier marker:
+#   quick       -- every subsystem, < 5 min single-core (inner loop / CI
+#                  per-change)
+#   convergence -- example workloads + training-to-accuracy tiers
+#   build       -- compiles the native C++ runtime / C ABI
+#   dist        -- multi-process parameter-server protocol
+# Selection: pytest -m quick | -m "not quick" | -m "convergence or dist"
+# ---------------------------------------------------------------------------
+_TIER_BY_FILE = {
+    "test_train_tier.py": "convergence",
+    "test_bench_smoke.py": "convergence",
+    "test_doc_snippets.py": "convergence",
+    "test_deploy.py": "build",
+    "test_native.py": "build",
+    "test_dist_kvstore.py": "dist",
+}
+# slow training-parity tests inside otherwise-quick files
+_CONVERGENCE_TESTS = {
+    "test_ssd_train_step",
+    "test_transformer_trainer_composes_dp_sp_tp",
+    "test_ring_attention_grads_match_dense",
+    "test_moe_transformer_trains_with_parity_vs_single_device",
+    "test_transformer_sharded_matches_single_device",
+    "test_pipeline_grads_flow",
+}
+# one cheap example stays quick so the example-runner + CustomOp path is
+# covered in the quick tier
+_QUICK_EXAMPLES = {"test_numpy_ops_custom_softmax"}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        base = item.name.split("[")[0]
+        if fname == "test_examples.py":
+            tier = "quick" if base in _QUICK_EXAMPLES else "convergence"
+        elif base in _CONVERGENCE_TESTS:
+            tier = "convergence"
+        else:
+            tier = _TIER_BY_FILE.get(fname, "quick")
+        item.add_marker(getattr(pytest.mark, tier))

@@ -317,7 +317,7 @@ def test_stochastic_depth():
     callbacks-in-fused-program deadlock regression."""
     import re
     p = _run("examples/stochastic-depth/sd_mnist.py",
-             "--num-examples", "2048", "--num-epochs", "10",
+             "--num-examples", "2048", "--num-epochs", "12",
              "--death-rate", "0.3", timeout=480)
     m = re.findall(r"val accuracy ([0-9.]+)", p.stderr + p.stdout)
     assert m and float(m[-1]) > 0.6, (p.stderr + p.stdout)[-500:]
