@@ -21,10 +21,12 @@ test:
 docs:
 	$(PY) tools/docgen.py
 	$(PY) tools/docgen_python.py
+	$(PY) tools/gen_cpp_ops.py
 
 docs-check:
 	$(PY) tools/docgen.py --check
 	$(PY) tools/docgen_python.py --check
+	$(PY) tools/gen_cpp_ops.py --check
 
 ci-quick: quick docs-check
 

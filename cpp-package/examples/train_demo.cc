@@ -1,13 +1,15 @@
-// train_demo.cc — train an MLP classifier from C++ through the mxt_api
-// training ABI.
+// train_demo.cc — train an MLP classifier from C++ through the typed
+// operator layer (mxt_op.h) over the mxt_api training ABI.
 //
 // Reference role: cpp-package/examples/mlp.cpp — the reference's C++
-// package builds a Symbol, simple_binds an Executor, and drives
+// package composes typed op calls from include/mxnet-cpp/op.h
+// (OpWrapperGenerator output), simple_binds an Executor, and drives
 // forward/backward/SGD from C++.  Same flow here over libmxt.so:
 // synthetic blob-digit data (the same class-conditional gaussian bumps
-// the python train_mnist example uses), 2-layer MLP, softmax, SGD with
-// momentum.  Exits 0 and prints "train accuracy" >0.9 when learning
-// works end to end.
+// the python train_mnist example uses), 2-layer MLP composed as
+// mxt::FullyConnected(...) / mxt::Activation(...) with compile-time
+// checked attributes, softmax, SGD with momentum.  Exits 0 and prints
+// "train accuracy" >0.9 when learning works end to end.
 //
 // Usage: ./train_demo <repo_root> [epochs]
 
@@ -19,7 +21,7 @@
 #include <string>
 #include <vector>
 
-#include "../include/mxt_api.h"
+#include "../include/mxt_op.h"
 
 namespace {
 
@@ -58,16 +60,6 @@ void make_digits(std::mt19937 *rng, int n, std::vector<float> *xs,
   }
 }
 
-MXTHandle compose1(const char *op, const char *name, MXTHandle in,
-                   const char *key, const char *val) {
-  MXTHandle out = 0;
-  const char *keys[] = {key};
-  const char *vals[] = {val};
-  CHECK_OK(MXTSymbolCompose(op, name, &in, 1, keys, vals,
-                            key == nullptr ? 0 : 1, &out));
-  return out;
-}
-
 }  // namespace
 
 int main(int argc, char **argv) {
@@ -80,15 +72,14 @@ int main(int argc, char **argv) {
   CHECK_OK(MXTRandomSeed(5));  // deterministic weight init
 
   // -- symbol: data -> fc(64) -> relu -> fc(10) -> softmax ----------
-  MXTHandle data = 0;
-  CHECK_OK(MXTSymbolVariable("data", &data));
-  MXTHandle fc1 = compose1("FullyConnected", "fc1", data, "num_hidden",
-                           "64");
-  MXTHandle act = compose1("Activation", "relu1", fc1, "act_type", "relu");
-  MXTHandle fc2 = compose1("FullyConnected", "fc2", act, "num_hidden",
-                           "10");
-  MXTHandle net = compose1("SoftmaxOutput", "softmax", fc2, nullptr,
-                           nullptr);
+  // typed compose: attribute names/types are checked by the compiler
+  // (mxt_op.h is generated from the op registry by tools/gen_cpp_ops.py)
+  mxt::Symbol data = mxt::Symbol::Variable("data");
+  mxt::Symbol fc1 = mxt::FullyConnected("fc1", data, /*num_hidden=*/64);
+  mxt::Symbol act = mxt::Activation("relu1", fc1, /*act_type=*/"relu");
+  mxt::Symbol fc2 = mxt::FullyConnected("fc2", act, /*num_hidden=*/10);
+  mxt::Symbol net_s = mxt::SoftmaxOutput("softmax", fc2);
+  MXTHandle net = net_s.handle();
 
   // -- bind ---------------------------------------------------------
   const char *bind_names[] = {"data", "softmax_label"};

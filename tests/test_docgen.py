@@ -61,3 +61,14 @@ def test_python_api_reference_current_and_fully_documented():
     from tools.docgen_python import generate_all
     _, undocumented = generate_all()
     assert undocumented == {}, undocumented
+
+
+def test_cpp_op_header_current():
+    """The typed C++ operator layer (cpp-package/include/mxt_op.h, the
+    OpWrapperGenerator role) must match the live registry."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "gen_cpp_ops.py"),
+         "--check"], capture_output=True, text=True, env=env,
+        timeout=240)
+    assert p.returncode == 0, p.stdout + p.stderr
