@@ -87,8 +87,13 @@ class Context:
 
 
 def _platform_devices(platform):
+    # local_devices: a context must never resolve to another process's
+    # device (multi-process jax.distributed — arrays created through the
+    # NDArray layer are per-process; only the mesh spans processes).
+    # backend=platform keeps the CPU backend reachable on accelerator
+    # hosts, where the default backend's local_devices has no cpu rows.
     try:
-        return jax.devices(platform)
+        return list(jax.local_devices(backend=platform))
     except RuntimeError:
         return []
 
@@ -102,8 +107,8 @@ def _accelerator_devices():
     suite substitutes cpu contexts for gpus — tests/python/unittest)."""
     global _ACCEL_CACHE
     if _ACCEL_CACHE is None:
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
-        _ACCEL_CACHE = devs if devs else list(jax.devices())
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+        _ACCEL_CACHE = devs if devs else list(jax.local_devices())
     return _ACCEL_CACHE
 
 

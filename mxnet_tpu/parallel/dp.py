@@ -183,24 +183,49 @@ class DataParallelTrainer:
                 P(self.batch_axis, *([None] * (len(state_shape) - 1))))
         return base
 
+    @staticmethod
+    def _place(value, sharding):
+        """Place a host value onto a (possibly cross-process) sharding.
+
+        Staged through host memory: a committed jax array device_put
+        directly onto a sharding that spans OTHER processes' devices is
+        a cross-host transfer (unsupported on the CPU/gloo backend).
+        Under multi-process jax.distributed, device_put also rejects
+        non-addressable shardings outright, so each process hands the
+        full host value to make_array_from_process_local_data
+        (global_shape == local shape tells it every process holds the
+        whole array) and fills only its own shards."""
+        if jax.process_count() == 1:
+            return jax.device_put(value, sharding)
+        if (hasattr(value, "dtype")
+                and jnp.issubdtype(value.dtype, jax.dtypes.prng_key)):
+            # typed PRNG keys cannot cross host memory directly; move
+            # the underlying uint32 data and re-wrap
+            data = DataParallelTrainer._place(
+                jax.random.key_data(value), sharding)
+            return jax.random.wrap_key_data(
+                data, impl=jax.random.key_impl(value))
+        host = np.asarray(value)
+        return jax.make_array_from_process_local_data(
+            sharding, host, global_shape=host.shape)
+
     def _init_params(self, initializer):
         attrs = self.symbol.attr_dict()
         params = {}
         for name in self.param_names:
             arr = nd.zeros(self._arg_shapes[name], dtype=self._dtype)
             initializer(InitDesc(name, attrs.get(name)), arr)
-            params[name] = jax.device_put(arr._data,
-                                          self._sharding_for(name))
+            params[name] = self._place(arr._data,
+                                       self._sharding_for(name))
         self.params = params
         self.opt_state = {n: tuple(
-            jax.device_put(s, self._opt_sharding_for(n, s.shape))
+            self._place(s, self._opt_sharding_for(n, s.shape))
             for s in self._opt_init(params[n])) for n in self.param_names}
         aux = {}
-        init_aux = nd.zeros((1,))
         for name in self.aux_names:
             arr = nd.zeros(self._aux_shapes[name], dtype=self._dtype)
             initializer(InitDesc(name, attrs.get(name)), arr)
-            aux[name] = jax.device_put(arr._data, self._replicated)
+            aux[name] = self._place(arr._data, self._replicated)
         self.aux = aux
 
     def _compile(self):
@@ -316,8 +341,18 @@ class DataParallelTrainer:
     def _shard_batch(self, batch):
         out = {}
         for k, v in batch.items():
-            arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-            out[k] = jax.device_put(arr, self._batched)
+            if jax.process_count() > 1:
+                # each process holds the full global batch; hand the
+                # HOST buffer over directly (no device round-trip) and
+                # fill only the addressable shards
+                host = np.asarray(v._data if isinstance(v, NDArray)
+                                  else v)
+                out[k] = jax.make_array_from_process_local_data(
+                    self._batched, host, global_shape=host.shape)
+            else:
+                arr = (v._data if isinstance(v, NDArray)
+                       else jnp.asarray(v))
+                out[k] = jax.device_put(arr, self._batched)
         return out
 
     def step(self, data, label=None, rng=None):
@@ -354,8 +389,8 @@ class DataParallelTrainer:
             # successor keys come back with — otherwise the second step
             # sees a different arg sharding and recompiles the whole
             # fused program
-            rng = self._rng_dev = jax.device_put(_random.next_key(),
-                                                 self._replicated)
+            rng = self._rng_dev = self._place(_random.next_key(),
+                                              self._replicated)
             self._rng_gen = gen
         return rng
 
@@ -406,12 +441,12 @@ class DataParallelTrainer:
     def set_params(self, arg_params, aux_params=None):
         for n, v in arg_params.items():
             if n in self.params:
-                self.params[n] = jax.device_put(
+                self.params[n] = self._place(
                     v._data if isinstance(v, NDArray) else jnp.asarray(v),
                     self._replicated)
         for n, v in (aux_params or {}).items():
             if n in self.aux:
-                self.aux[n] = jax.device_put(
+                self.aux[n] = self._place(
                     v._data if isinstance(v, NDArray) else jnp.asarray(v),
                     self._replicated)
 
@@ -431,6 +466,5 @@ class DataParallelTrainer:
                                     else s)
                         for s in self._ingraph.state_from_host(states[i])]
                 self.opt_state[name] = tuple(
-                    jax.device_put(a, self._opt_sharding_for(name,
-                                                             a.shape))
+                    self._place(a, self._opt_sharding_for(name, a.shape))
                     for a in arrs)
