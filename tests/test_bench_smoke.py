@@ -49,7 +49,10 @@ def test_bench_smoke_rows():
     # tiny net; the ratio must be same-order, not the 20x dispatch-rate
     # artifact the async callback clock used to produce
     ratio = out["fit_vs_direct"]
-    assert ratio is not None and 0.2 < ratio < 5.0, ratio
+    # steady-state parity is ~1.0 (the old 0.55 readings were the
+    # metric-accumulator compile landing inside a warmup=1 window);
+    # bounds stay loose only for 1-core host noise
+    assert ratio is not None and 0.5 < ratio < 2.0, ratio
     assert "fit_vs_direct_note" in out
 
     # perf-regression gate vs the banked CPU baseline.  Absolute
