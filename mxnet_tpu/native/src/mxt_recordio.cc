@@ -28,8 +28,9 @@ constexpr uint32_t kMagic = 0xced7230a;
 constexpr uint32_t kLengthMask = (1u << 29) - 1;
 
 // Record payloads live in pooled buffers; capacities are bucketed to 4KB
-// multiples so variable-size records (JPEGs) still hit the exact-size
-// free pool.
+// multiples on top of the pool's own 64-byte size classes — coarser
+// classes keep bucket diversity low for variable-size records (JPEGs),
+// so recycled blocks actually get re-hit.
 struct PooledBuf {
   char *p = nullptr;
   uint64_t cap = 0;

@@ -1,12 +1,17 @@
-// Pooled host-memory storage manager — native analog of the reference's
-// storage layer (src/storage/pooled_storage_manager.h GPUPooledStorageManager
-// + src/storage/cpu_device_storage.h).
+// Pooled host-memory storage manager for staging buffers.
 //
-// Same policy, applied to host staging buffers (the TPU equivalent of the
-// reference's pinned-host memory used by data pipelines): recycle freed
-// blocks by exact size (the reference's free_pool_ keyed on size), 64-byte
-// alignment (reference CPUDeviceStorage::alignment_ = 16, widened for
-// cacheline/AVX), DirectFree bypassing the pool, and ReleaseAll.
+// Role of the reference's storage layer (src/storage/
+// pooled_storage_manager.h, cpu_device_storage.h), redesigned for the
+// host side of a TPU pipeline:
+//  - every request is first rounded up to a 64-byte size class and the
+//    recycle pool is keyed on the CLASS, so requests of 100 and 120
+//    bytes share one bucket instead of fragmenting the pool;
+//  - the idle pool is capped (MXT_STORAGE_POOL_CAP_MB, default 256):
+//    frees beyond the cap return memory to the OS instead of growing
+//    the pool without bound;
+//  - DirectFree bypasses recycling, ReleaseAll drops every idle block,
+//    and used/pooled byte counters feed the profiler.
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <mutex>
@@ -15,79 +20,117 @@
 
 namespace {
 
-constexpr size_t kAlign = 64;
+constexpr uint64_t kAlign = 64;
 
-struct Pool {
-  std::mutex mu;
-  std::unordered_map<uint64_t, std::vector<void *>> free_pool;
-  uint64_t used_bytes = 0;
-  uint64_t pooled_bytes = 0;
+inline uint64_t SizeClass(uint64_t size) {
+  if (size == 0) size = 1;
+  // (size + 63) would wrap for absurd requests and hand back a
+  // near-empty block for a "2^64-byte" ask — refuse via 0 instead
+  if (size > UINT64_MAX - (kAlign - 1)) return 0;
+  return (size + kAlign - 1) / kAlign * kAlign;
+}
 
+uint64_t PoolCapBytes() {
+  static uint64_t cap = [] {
+    const char *env = std::getenv("MXT_STORAGE_POOL_CAP_MB");
+    uint64_t mb = 256;
+    if (env && *env) {
+      char *end = nullptr;
+      unsigned long long v = std::strtoull(env, &end, 10);
+      if (end != env) mb = static_cast<uint64_t>(v);
+    }
+    return mb * (1ull << 20);
+  }();
+  return cap;
+}
+
+class HostPool {
+ public:
   void *Alloc(uint64_t size) {
-    if (size == 0) size = kAlign;
+    const uint64_t cls = SizeClass(size);
+    if (cls == 0) return nullptr;  // overflowed size class
     {
-      std::lock_guard<std::mutex> lk(mu);
-      auto it = free_pool.find(size);
-      if (it != free_pool.end() && !it->second.empty()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = idle_.find(cls);
+      if (it != idle_.end() && !it->second.empty()) {
         void *p = it->second.back();
         it->second.pop_back();
-        pooled_bytes -= size;
-        used_bytes += size;
+        idle_bytes_ -= cls;
+        used_bytes_ += cls;
         return p;
       }
-      used_bytes += size;
     }
-    uint64_t rounded = (size + kAlign - 1) / kAlign * kAlign;
-    return std::aligned_alloc(kAlign, rounded);
+    void *p = std::aligned_alloc(kAlign, cls);
+    if (p) used_bytes_ += cls;  // charge only what was really handed out
+    return p;
   }
 
-  void Free(void *p, uint64_t size) {
+  void Recycle(void *p, uint64_t size) {
     if (!p) return;
-    if (size == 0) size = kAlign;
-    std::lock_guard<std::mutex> lk(mu);
-    free_pool[size].push_back(p);
-    used_bytes -= size;
-    pooled_bytes += size;
+    const uint64_t cls = SizeClass(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      used_bytes_ -= cls;
+      if (idle_bytes_ + cls <= PoolCapBytes()) {
+        idle_[cls].push_back(p);
+        idle_bytes_ += cls;
+        return;
+      }
+    }
+    std::free(p);  // pool at cap: hand the block back to the OS
   }
 
   void DirectFree(void *p, uint64_t size) {
     if (!p) return;
-    if (size == 0) size = kAlign;
     std::free(p);
-    std::lock_guard<std::mutex> lk(mu);
-    used_bytes -= size;
+    std::lock_guard<std::mutex> lk(mu_);
+    used_bytes_ -= SizeClass(size);
   }
 
   void ReleaseAll() {
-    std::lock_guard<std::mutex> lk(mu);
-    for (auto &kv : free_pool)
-      for (void *p : kv.second) std::free(p);
-    free_pool.clear();
-    pooled_bytes = 0;
+    std::unordered_map<uint64_t, std::vector<void *>> drop;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      drop.swap(idle_);
+      idle_bytes_ = 0;
+    }
+    for (auto &bucket : drop)
+      for (void *p : bucket.second) std::free(p);
   }
+
+  uint64_t used_bytes() const { return used_bytes_.load(); }
+  uint64_t idle_bytes() const { return idle_bytes_.load(); }
+
+ private:
+  std::mutex mu_;
+  // size class -> idle blocks of exactly that class
+  std::unordered_map<uint64_t, std::vector<void *>> idle_;
+  // atomics: the profiler thread reads while workers alloc/free
+  std::atomic<uint64_t> used_bytes_{0};
+  std::atomic<uint64_t> idle_bytes_{0};
 };
 
-Pool *Global() {
-  static Pool pool;
-  return &pool;
+HostPool &Global() {
+  static HostPool pool;
+  return pool;
 }
 
 }  // namespace
 
 extern "C" {
 
-void *mxt_storage_alloc(uint64_t size) { return Global()->Alloc(size); }
+void *mxt_storage_alloc(uint64_t size) { return Global().Alloc(size); }
 
-void mxt_storage_free(void *p, uint64_t size) { Global()->Free(p, size); }
+void mxt_storage_free(void *p, uint64_t size) { Global().Recycle(p, size); }
 
 void mxt_storage_direct_free(void *p, uint64_t size) {
-  Global()->DirectFree(p, size);
+  Global().DirectFree(p, size);
 }
 
-void mxt_storage_release_all() { Global()->ReleaseAll(); }
+void mxt_storage_release_all() { Global().ReleaseAll(); }
 
-uint64_t mxt_storage_used_bytes() { return Global()->used_bytes; }
+uint64_t mxt_storage_used_bytes() { return Global().used_bytes(); }
 
-uint64_t mxt_storage_pooled_bytes() { return Global()->pooled_bytes; }
+uint64_t mxt_storage_pooled_bytes() { return Global().idle_bytes(); }
 
 }  // extern "C"

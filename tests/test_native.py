@@ -249,3 +249,41 @@ def test_async_checkpoint_write_through_host_engine(tmp_path):
     engine_mod.waitall()
     back2 = nd.load(path)
     assert list(back2) == ["w"]
+
+
+def test_storage_pool_size_classes_and_cap():
+    """Redesigned pool semantics: requests in the same 64-byte size
+    class share one recycle bucket, and the idle pool is capped
+    (MXT_STORAGE_POOL_CAP_MB) — frees beyond the cap go back to the OS
+    instead of growing the pool without bound."""
+    l = native.lib()
+    # 100 and 120 round to the same 128-byte class: the freed block is
+    # recycled for the differently-sized request
+    p1 = l.mxt_storage_alloc(100)
+    l.mxt_storage_free(p1, 100)
+    p2 = l.mxt_storage_alloc(120)
+    assert p2 == p1
+    l.mxt_storage_direct_free(p2, 120)
+
+    # cap behavior needs a fresh process (the cap env is latched once)
+    import subprocess
+    import sys as _sys
+    code = """
+import os
+os.environ["MXT_STORAGE_POOL_CAP_MB"] = "1"
+from mxnet_tpu import native
+l = native.lib()
+blocks = [l.mxt_storage_alloc(1 << 19) for _ in range(8)]  # 4 MB live
+for b in blocks:
+    l.mxt_storage_free(b, 1 << 19)
+pooled = int(l.mxt_storage_pooled_bytes())
+assert pooled <= (1 << 20), pooled  # idle pool respects the 1 MB cap
+print("CAP_OK", pooled)
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([_sys.executable, "-c", code], env=env, text=True,
+                       capture_output=True, timeout=120,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert p.returncode == 0, (p.stdout, p.stderr[-800:])
+    assert "CAP_OK" in p.stdout
