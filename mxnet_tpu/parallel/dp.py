@@ -352,7 +352,12 @@ class DataParallelTrainer:
             else:
                 arr = (v._data if isinstance(v, NDArray)
                        else jnp.asarray(v))
-                out[k] = jax.device_put(arr, self._batched)
+                # already laid out (steady-state loops feed pre-sharded
+                # arrays): skip the ~0.1ms/array device_put round-trip
+                if getattr(arr, "sharding", None) == self._batched:
+                    out[k] = arr
+                else:
+                    out[k] = jax.device_put(arr, self._batched)
         return out
 
     def step(self, data, label=None, rng=None):
