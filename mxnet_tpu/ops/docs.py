@@ -526,8 +526,12 @@ def apply():
         op = get_op(name)
         if not op.doc:
             op.doc = doc
+    seen = set()
     for name in list_ops():
         op = get_op(name)
+        if id(op) in seen:  # aliases share the OpDef
+            continue
+        seen.add(id(op))
         for attr, spec in op.attr_specs.items():
             if spec.doc:
                 continue

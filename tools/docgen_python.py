@@ -20,12 +20,10 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import importlib
 import inspect
 import io
 import os
 import sys
-import textwrap
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -391,20 +389,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--check", action="store_true")
     args = ap.parse_args(argv)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from genutil import sync_file
     result, undocumented = generate_all()
-    stale = []
-    for name, text in result.items():
-        path = os.path.join(OUT_DIR, name)
-        try:
-            current = open(path).read()
-        except OSError:
-            current = ""
-        if current != text:
-            stale.append(name)
-            if not args.check:
-                os.makedirs(OUT_DIR, exist_ok=True)
-                with open(path, "w") as f:
-                    f.write(text)
+    stale = [name for name, text in result.items()
+             if sync_file(os.path.join(OUT_DIR, name), text, args.check)]
     n_undoc = sum(len(v) for v in undocumented.values())
     if n_undoc:
         print("undocumented entries: %d %s" % (n_undoc, undocumented))

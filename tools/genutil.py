@@ -1,0 +1,26 @@
+"""Shared helper for the generated-artifact tools (docgen.py,
+docgen_python.py, gen_cpp_ops.py): write the artifact, or under
+``--check`` report staleness without writing (the CI contract)."""
+from __future__ import annotations
+
+import os
+
+
+def sync_file(path, text, check):
+    """Returns True when ``path``'s content differs from ``text``.
+
+    check=False: writes the file (creating directories) when stale.
+    check=True: never writes — the caller turns staleness into rc 1.
+    """
+    try:
+        with open(path) as f:
+            current = f.read()
+    except OSError:
+        current = ""
+    if current == text:
+        return False
+    if not check:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+    return True
