@@ -36,12 +36,12 @@ SMOKE_ENV = {"JAX_PLATFORMS": "cpu", "BENCH_SMOKE": "1",
              # batch 2; with warmup 1 that compile lands inside the
              # measured window and distorts the fit row
              "BENCH_ITERS": "4", "BENCH_WARMUP": "2",
-             "BENCH_ROWS": "train.resnet-50,comm",
+             "BENCH_ROWS": "train.resnet-50,lstm,comm",
              # single-device protocol, pinned against ambient XLA_FLAGS
              "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
 # images/sec rows are gated; bandwidth is recorded but not gated (host
 # memory bandwidth varies too much across machine classes)
-GATED_UNITS = ("images/sec",)
+GATED_UNITS = ("images/sec", "samples/sec")
 
 
 def run_sweep():
