@@ -36,7 +36,8 @@ def test_api_reference_snippets_run(doc, tmp_path):
     assert p.returncode == 0, (p.stdout[-1000:], p.stderr[-1000:])
 
 
-@pytest.mark.parametrize("doc", ["mnist.md", "autograd.md"])
+@pytest.mark.parametrize("doc", ["mnist.md", "autograd.md",
+                                 "ndarray_symbol.md"])
 def test_tutorial_code_runs(doc, tmp_path):
     path = os.path.join(REPO, "docs", "tutorials", doc)
     blocks = _snippets(path)
