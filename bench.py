@@ -259,6 +259,9 @@ def bench_lstm_bucketing(iters, warmup, chip, smoke=False):
     from mxnet_tpu.models.lstm_lm import sym_gen_factory
 
     batch, seq_len, vocab = (8, 8, 100) if smoke else (32, 32, 10000)
+    # the drain-bounded window needs at least 2 measured batches
+    # (BENCH_ITERS=1 sweeps would otherwise fail this row's assert)
+    iters = max(iters, 2)
     rs = np.random.RandomState(0)
     sent = [list(rs.randint(1, vocab, seq_len))
             for _ in range(batch * (warmup + iters))]
