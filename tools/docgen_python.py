@@ -28,6 +28,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# doc generation is platform-independent and must never touch (or hang
+# on) an accelerator backend: force the CPU platform before any jax
+# use (the env alone is not enough -- the axon plugin re-prepends
+# itself -- and the package import-time pin only honors the env var)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 OUT_DIR = os.path.join(REPO, "docs", "api", "python")
 
 
