@@ -72,6 +72,30 @@ def main():
         gw = (X @ wr.T - y).T @ X
         wr = wr - LR * (gw / BATCH)
     np.testing.assert_allclose(w, wr.ravel(), rtol=1e-4)
+
+    # ZeRO-1 across processes: momentum state sharded over the SAME
+    # cross-process mesh must stay numerically identical to the
+    # replicated path (here: the closed-form recursion with momentum)
+    mom = 0.9
+    tz = DataParallelTrainer(
+        net, data_shapes={"data": (BATCH, FEAT)},
+        label_shapes={"lro_label": (BATCH, 1)},
+        optimizer="sgd",
+        optimizer_params={"learning_rate": LR, "momentum": mom,
+                          "wd": 0.0},
+        initializer=mx.initializer.Zero(),
+        shard_optimizer_state=True)
+    for _ in range(STEPS):
+        tz.step(X, y)
+    wz = np.asarray(tz.params["fc_weight"]).reshape(-1)
+    wm = np.zeros((1, FEAT), np.float32)
+    vm = np.zeros((1, FEAT), np.float32)
+    for _ in range(STEPS):
+        g = ((X @ wm.T - y).T @ X) / BATCH
+        vm = mom * vm - LR * g
+        wm = wm + vm
+    np.testing.assert_allclose(wz, wm.ravel(), rtol=1e-4)
+
     print("DIST_FUSED_DP_OK rank=%d w=%s" % (pid, w.tolist()),
           flush=True)
 
