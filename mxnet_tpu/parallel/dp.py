@@ -350,14 +350,19 @@ class DataParallelTrainer:
                 out[k] = jax.make_array_from_process_local_data(
                     self._batched, host, global_shape=host.shape)
             else:
+                was_jax = isinstance(v, NDArray) or isinstance(v, jax.Array)
                 arr = (v._data if isinstance(v, NDArray)
                        else jnp.asarray(v))
                 # already laid out (steady-state loops feed pre-sharded
                 # arrays): skip the ~0.1ms/array device_put round-trip
                 if getattr(arr, "sharding", None) == self._batched:
                     out[k] = arr
-                else:
+                elif was_jax:
                     out[k] = self._place_cached(k, arr)
+                else:
+                    # mutable host source (plain numpy): placement must
+                    # not be cached — in-place edits would be served stale
+                    out[k] = jax.device_put(arr, self._batched)
         return out
 
     def _place_cached(self, name, arr):
@@ -369,10 +374,7 @@ class DataParallelTrainer:
         host->device upload per step — over a remote PJRT tunnel that
         upload dominates the whole step.  jax arrays are immutable, so
         identity of the buffer is a sound cache key; the cached source
-        reference keeps the id from being recycled.  Mutable host buffers
-        (plain numpy) are never cached."""
-        if not isinstance(arr, jax.Array):
-            return jax.device_put(arr, self._batched)
+        reference keeps the id from being recycled."""
         cache = getattr(self, "_placement_cache", None)
         if cache is None:
             cache = self._placement_cache = {}

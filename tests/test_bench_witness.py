@@ -94,6 +94,27 @@ def test_incremental_banking_order(tmp_path, monkeypatch):
                    for r in _read(b)["rows"]) == k
 
 
+def test_protocol_generation_outranks_row_count(tmp_path, monkeypatch):
+    """Round 5: pre-calibration rows measured dispatch rate, not device
+    compute (implied >200% of chip peak).  A fetch-forced run must
+    displace an old-protocol witness regardless of row count, and an
+    old-protocol run must never displace a fetch-forced witness."""
+    b = _load_bench(tmp_path, monkeypatch)
+    old = _out(5)           # no protocol field: pre-v2 artifact
+    b._bank_witness(old)
+    new = _out(2)
+    new["protocol"] = b.PROTOCOL
+    b._bank_witness(new)    # fewer rows, honest protocol: replaces
+    assert _read(b).get("protocol") == b.PROTOCOL
+    assert len(_read(b)["rows"]) == 2
+    b._bank_witness(_out(9))  # old protocol, more rows: rejected
+    assert _read(b).get("protocol") == b.PROTOCOL
+    more = _out(3)
+    more["protocol"] = b.PROTOCOL
+    b._bank_witness(more)   # same protocol: row count rules as before
+    assert len(_read(b)["rows"]) == 3
+
+
 def test_outage_emits_stale_witness(tmp_path, monkeypatch, capsys):
     b = _load_bench(tmp_path, monkeypatch)
     b._bank_witness(_out(3))
