@@ -196,8 +196,15 @@ class BaseModule:
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
 
+            # get_params syncs device -> host; the host dicts returned
+            # ARE this module's canonical copies and are untouched here,
+            # so the reference's epoch-end set_params(arg, aux) write-back
+            # (base_module.py:460-461) would re-upload every parameter
+            # unchanged — over a remote PJRT device that is two full
+            # parameter-set transfers per epoch for a no-op.  Callers
+            # that DO mutate the returned dicts must call set_params
+            # themselves (fine-tune surgery does).
             arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
