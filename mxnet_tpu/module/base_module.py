@@ -196,15 +196,7 @@ class BaseModule:
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
 
-            # get_params syncs device -> host; the host dicts returned
-            # ARE this module's canonical copies and are untouched here,
-            # so the reference's epoch-end set_params(arg, aux) write-back
-            # (base_module.py:460-461) would re-upload every parameter
-            # unchanged — over a remote PJRT device that is two full
-            # parameter-set transfers per epoch for a no-op.  Callers
-            # that DO mutate the returned dicts must call set_params
-            # themselves (fine-tune surgery does).
-            arg_params_, aux_params_ = self.get_params()
+            arg_params_, aux_params_ = self._epoch_end_param_sync()
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
@@ -345,6 +337,18 @@ class BaseModule:
         """Per-batch preparation hook, called by the fit loop before
         ``forward_backward`` (reference base_module.py:719; a no-op for
         dense modules — BucketingModule binds the batch's bucket here)."""
+
+    def _epoch_end_param_sync(self):
+        """Epoch-end device->host sync + device write-back (reference
+        fit's ``get_params``/``set_params`` pair, base_module.py:460-461).
+        The write-back re-broadcasts the host-averaged state — per-device
+        BatchNorm moving stats diverge under multi-executor data
+        parallelism and this is what reconverges them each epoch.
+        Subclasses whose device state cannot diverge (one compiled mesh
+        program with replicated aux) override to skip the re-upload."""
+        arg_params_, aux_params_ = self.get_params()
+        self.set_params(arg_params_, aux_params_)
+        return arg_params_, aux_params_
 
 
 class _BatchEndParam:

@@ -276,6 +276,19 @@ class Module(BaseModule):
         return {name: (ex0.aux_dict[name].shape, ex0.aux_dict[name].dtype)
                 for name in self._aux_names}
 
+    def _epoch_end_param_sync(self):
+        """Fused fast path: the step is ONE compiled program over the
+        mesh — parameters and aux state are replicated arrays that cannot
+        diverge per device, so the reference's epoch-end write-back would
+        re-upload every parameter unchanged (two full parameter-set
+        transfers per epoch over a remote PJRT device).  Sync down only.
+        The executor-group path (and single-device, where the upload is
+        an identical no-op with nothing to reconverge) keeps the
+        reference write-back for per-device BN-stat reconvergence."""
+        if self._fused is not None or len(self._context) == 1:
+            return self.get_params()
+        return super()._epoch_end_param_sync()
+
     def _sync_params_from_devices(self):
         if self._fused is not None:
             self._sync_from_trainer(self._fused)

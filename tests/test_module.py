@@ -255,3 +255,32 @@ def test_module_states_api():
     # scalar fill
     mod.set_states(value=2.0)
     np.testing.assert_allclose(mod.get_states()[0].asnumpy(), 2.0)
+
+
+def test_epoch_end_param_sync_routing():
+    """Epoch-end write-back policy: the fused single-program path (and
+    single-device executor groups) skip the redundant device re-upload,
+    while multi-device executor groups keep the reference's
+    get_params/set_params pair — it is what reconverges per-device
+    BatchNorm moving stats each epoch (reference base_module.py:460-461)."""
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params()
+    mod.init_optimizer()
+    calls = []
+    orig = mod.set_params
+    mod.set_params = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
+
+    # fused: sync down only
+    assert mod._fused is not None
+    a, x = mod._epoch_end_param_sync()
+    assert a is mod._arg_params and not calls
+
+    # multi-device executor group: write-back runs
+    mod._defuse("test: force executor-group path")
+    mod._context = [mx.cpu(0), mx.cpu(0)]
+    mod._epoch_end_param_sync()
+    assert calls, "multi-device exec-group epoch end must re-broadcast"
