@@ -29,22 +29,28 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     chip = None
-    try:
-        # the fresh perf witness (the loop runs this tool right after
-        # banking one) already identified the chip — no backend init
-        with open(os.path.join(REPO, "BENCH_witness.json")) as f:
-            w = json.load(f)
-        if "stale" not in w:
-            chip = w.get("chip")
-    except OSError:
-        pass
+    # JAX initializes the FIRST platform listed in JAX_PLATFORMS; the
+    # training subprocess inherits this env (mxnet_tpu/__init__.py
+    # re-applies it over the axon plugin's self-prepend)
+    first_plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if first_plat and first_plat not in ("tpu", "axon"):
+        # the run will NOT be on the TPU — the banked witness's chip
+        # must not be attributed to it (a CPU dry-run once banked
+        # itself as silicon evidence)
+        chip = {"platform": first_plat, "device_kind": first_plat}
+    else:
+        try:
+            # the fresh perf witness (the loop runs this tool right
+            # after banking one) already identified the chip — no
+            # second backend init
+            with open(os.path.join(REPO, "BENCH_witness.json")) as f:
+                w = json.load(f)
+            if "stale" not in w:
+                chip = w.get("chip")
+        except (OSError, ValueError):
+            pass
     if chip is None:
         import jax
-        if os.environ.get("JAX_PLATFORMS") == "cpu":
-            # the axon plugin re-prepends itself over the env var; a
-            # CPU verification run must not touch the (possibly dead)
-            # tunnel
-            jax.config.update("jax_platforms", "cpu")
         dev = jax.devices()[0]
         chip = {"platform": dev.platform,
                 "device_kind": getattr(dev, "device_kind",
