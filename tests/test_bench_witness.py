@@ -136,3 +136,17 @@ def test_outage_emits_stale_witness(tmp_path, monkeypatch, capsys):
     out = json.loads([l for l in capsys.readouterr().out.splitlines()
                       if l.startswith("{")][-1])
     assert out["value"] == 0.0 and out["rows"] == []
+
+
+def test_fetch_sync_forces_on_ndarray_and_trees(tmp_path, monkeypatch):
+    """_fetch_sync is the honest-timing primitive (every timed window
+    starts and stops on it): it must unwrap NDArray handles and pytree
+    containers down to a fetchable leaf without error."""
+    import numpy as _np
+    import jax.numpy as _jnp
+    import mxnet_tpu as _mx
+    b = _load_bench(tmp_path, monkeypatch)
+    b._fetch_sync(_jnp.ones((3,)))
+    b._fetch_sync([_jnp.zeros((2, 2)), _jnp.ones(())])
+    b._fetch_sync(_mx.nd.array(_np.eye(2)))
+    b._fetch_sync((_mx.nd.ones((1,)),))
