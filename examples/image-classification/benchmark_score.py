@@ -36,16 +36,19 @@ def score(network, dev, batch_size, num_batches, num_layers=None,
         data=[mx.nd.array(rs.uniform(-1, 1,
                                      (batch_size,) + tuple(image_shape))
                           .astype(dtype))], label=[])
-    # warmup (compile)
+    # warmup (compile); fetch-forced syncs bracket the clock — over a
+    # remote PJRT device wait_to_read can return at enqueue-ack
+    # (docs/perf.md, measuring honestly; shared primitive in bench.py)
+    from bench import _fetch_sync
     for _ in range(2):
         mod.forward(batch, is_train=False)
     for o in mod.get_outputs():
-        o.wait_to_read()
+        _fetch_sync(o)
     tic = time.time()
     for _ in range(num_batches):
         mod.forward(batch, is_train=False)
     for o in mod.get_outputs():
-        o.wait_to_read()
+        _fetch_sync(o)
     return num_batches * batch_size / (time.time() - tic)
 
 

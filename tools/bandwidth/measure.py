@@ -88,8 +88,10 @@ def make_bench(sizes, test_results=True):
     host = rs.uniform(-1, 1, (n, total)).astype(np.float32)
     x = jax.device_put(jnp.asarray(host), NamedSharding(mesh, P("dp")))
 
-    out = allreduce(x)   # warmup/compile
-    jax.block_until_ready(out)
+    # warmup/compile: the chained form (mul/add + collective) AND the
+    # fetch-slice program, so the first timed window compiles nothing
+    out = allreduce(x * 0 + x)
+    np.asarray(out[:1, :1])
     err = 0.0
     if test_results:
         expect = host.sum(axis=0)
@@ -104,7 +106,9 @@ def make_bench(sizes, test_results=True):
         o = x
         for _ in range(num_batches):
             o = allreduce(o * 0 + x)  # chained: forces sequential exec
-        jax.block_until_ready(o)
+        # fetch-forced sync: block_until_ready over a remote PJRT
+        # device can return at enqueue-ack (docs/perf.md)
+        np.asarray(o[:1, :1])
         elapsed = (time.perf_counter() - tic) / num_batches
         algo_bw = 2 * (n - 1) / max(n, 1) * nbytes / elapsed / 1e9 \
             if n > 1 else nbytes / elapsed / 1e9
