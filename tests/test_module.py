@@ -284,3 +284,43 @@ def test_epoch_end_param_sync_routing():
     mod._context = [mx.cpu(0), mx.cpu(0)]
     mod._epoch_end_param_sync()
     assert calls, "multi-device exec-group epoch end must re-broadcast"
+
+
+def test_speedometer_windows_are_fetch_bounded():
+    """Speedometer windows must open and close on a sync that
+    data-depends on the accumulated batches (the metric's host read) —
+    callback-to-callback wall time alone measures dispatch rate
+    (docs/perf.md, measuring honestly)."""
+    from mxnet_tpu.callback import Speedometer
+    import logging
+
+    class _FakeMetric:
+        def __init__(self):
+            self.fetches = 0
+            self.resets = 0
+
+        def get_name_value(self):
+            self.fetches += 1
+            return [("acc", 0.5)]
+
+        def reset(self):
+            self.resets += 1
+
+    class _Param:
+        def __init__(self, epoch, nbatch, metric):
+            self.epoch = epoch
+            self.nbatch = nbatch
+            self.eval_metric = metric
+
+    m = _FakeMetric()
+    spd = Speedometer(batch_size=4, frequent=2)
+    spd(_Param(0, 1, m))            # window opens: one fetch, no log
+    assert (m.fetches, m.resets) == (1, 0)
+    spd(_Param(0, 2, m))            # window closes: fetch + reset
+    assert (m.fetches, m.resets) == (2, 1)
+    spd(_Param(0, 3, m))            # mid-window: no sync
+    assert m.fetches == 2
+    spd(_Param(0, 4, m))            # next close
+    assert (m.fetches, m.resets) == (3, 2)
+    spd(_Param(1, 1, m))            # epoch restart: window re-opens
+    assert m.fetches == 4 and m.resets == 2
