@@ -85,6 +85,16 @@ class BucketingModule(BaseModule):
         self._params_dirty = False
         return params
 
+    def _epoch_end_param_sync(self):
+        """Delegate fit's epoch-end sync policy to the active bucket's
+        module: fused buckets share one replicated state (sync down
+        only), executor-group buckets keep the reference write-back
+        (see Module._epoch_end_param_sync)."""
+        self._curr_module._params_dirty = self._params_dirty
+        params = self._curr_module._epoch_end_param_sync()
+        self._params_dirty = False
+        return params
+
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:

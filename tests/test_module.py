@@ -324,3 +324,36 @@ def test_speedometer_windows_are_fetch_bounded():
     assert (m.fetches, m.resets) == (3, 2)
     spd(_Param(1, 1, m))            # epoch restart: window re-opens
     assert m.fetches == 4 and m.resets == 2
+
+
+def test_bucketing_epoch_end_param_sync_delegates():
+    """BucketingModule routes fit's epoch-end sync policy through the
+    active bucket's module, propagating its own dirty flag so the host
+    dicts are fresh even when the last update ran on a non-default
+    bucket."""
+    from mxnet_tpu.rnn import BucketSentenceIter
+    from mxnet_tpu.models.lstm_lm import sym_gen_factory
+    rs = np.random.RandomState(0)
+    sent = [list(rs.randint(1, 30, 8)) for _ in range(32)]
+    it = BucketSentenceIter(sent, 8, buckets=[8], invalid_label=0)
+    mod = mx.module.BucketingModule(
+        sym_gen=sym_gen_factory(num_layers=1, num_hidden=8, num_embed=8,
+                                vocab_size=30),
+        default_bucket_key=it.default_bucket_key, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+        break
+    assert mod._params_dirty
+    calls = []
+    orig = type(mod._curr_module)._epoch_end_param_sync
+    mod._curr_module._epoch_end_param_sync = \
+        lambda: (calls.append(mod._curr_module._params_dirty),
+                 orig(mod._curr_module))[1]
+    a, x = mod._epoch_end_param_sync()
+    assert calls == [True], "dirty flag not propagated to curr module"
+    assert not mod._params_dirty
+    assert a is mod._curr_module._arg_params
