@@ -666,6 +666,21 @@ def _bank_witness(out):
     n_valid = sum(1 for r in out["rows"] if r.get("unit") != "error")
     if n_valid == 0:
         return
+    # the driver's end-of-round run and the probe loop's sweep may both
+    # be live when the tunnel is: serialize load-compare-replace so a
+    # weaker run can never displace a better witness banked in between
+    import contextlib
+    import fcntl
+    with contextlib.ExitStack() as stack:
+        try:
+            lk = stack.enter_context(open(WITNESS_PATH + ".lock", "w"))
+            fcntl.flock(lk, fcntl.LOCK_EX)
+        except OSError:
+            pass  # lock is best-effort; banking must still proceed
+        _bank_witness_locked(out, n_valid)
+
+
+def _bank_witness_locked(out, n_valid):
     prev = _load_witness()
     if prev is not None:
         # the timing protocol outranks row count: a newer-generation run
@@ -690,13 +705,21 @@ def _bank_witness(out):
     banked = dict(out)
     banked["witness_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                           time.gmtime())
+    tmp = WITNESS_PATH + ".tmp.%d" % os.getpid()
     try:
-        with open(WITNESS_PATH, "w") as f:
+        # atomic replace: a reader (or the stale-emission path) must
+        # never see a torn file
+        with open(tmp, "w") as f:
             json.dump(banked, f, indent=1)
+        os.replace(tmp, WITNESS_PATH)
         print("# banked witness: %d valid rows -> %s"
               % (n_valid, WITNESS_PATH), flush=True)
     except OSError as e:
         print("# witness write failed: %s" % e, flush=True)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def main():
