@@ -1,7 +1,16 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence ci-quick ci-full docs bench
+.PHONY: test quick build dist convergence ci-quick ci-full docs bench hygiene
+
+# fail if any binary / scratch artifact is tracked (ci.yaml per-change
+# `hygiene` stage; the lazy builder regenerates *.so)
+hygiene:
+	@bad=$$(git ls-files | grep -E '\.(so|log|o|a|dylib|pyc|bin)$$' || true); \
+	if [ -n "$$bad" ]; then \
+		echo "tracked binary/scratch artifacts (git rm them):"; \
+		echo "$$bad"; exit 1; \
+	fi; echo "hygiene: clean"
 
 quick:
 	$(PY) -m pytest tests/ -m quick -q
@@ -28,7 +37,7 @@ docs-check:
 	$(PY) tools/docgen_python.py --check
 	$(PY) tools/gen_cpp_ops.py --check
 
-ci-quick: quick docs-check
+ci-quick: hygiene quick docs-check
 
 ci-full: build dist convergence quick docs-check
 	JAX_PLATFORMS=cpu \

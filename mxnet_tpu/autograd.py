@@ -143,13 +143,20 @@ def backward(outputs, out_grads=None, retain_graph=False):
              else (gy._data if isinstance(gy, NDArray) else jnp.asarray(gy)))
         _accum(grad_map, id(y._data), g)
 
+    from . import engine as _engine
+    eng = _engine.get()
     for node in reversed(s.tape):
         cots = [grad_map.get(id(o)) for o in node.outs]
         if all(c is None for c in cots):
             continue
         cots = tuple(jnp.zeros_like(o) if c is None else c
                      for c, o in zip(cots, node.outs))
-        in_grads = node.vjp(cots)
+        # pullback application goes through the engine seam: profiler
+        # spans (cat="backward") and the NaiveEngine sync contract cover
+        # tape replay exactly like forward dispatch.  node.vjp is either
+        # an eager jax.vjp closure or a cached-op jitted pullback
+        # (cached_op._CachedPullback).
+        in_grads = _dispatch_bwd(eng, node.op_name, node.vjp, cots)
         for arr, g in zip(node.in_arrs, in_grads):
             if g is not None:
                 _accum(grad_map, id(arr), g)
@@ -170,6 +177,23 @@ def backward(outputs, out_grads=None, retain_graph=False):
 def _accum(grad_map, key, g):
     prev = grad_map.get(key)
     grad_map[key] = g if prev is None else prev + g
+
+
+def _dispatch_bwd(eng, op_name, vjp, cots):
+    """Apply one tape node's pullback through the engine seam."""
+    import time
+
+    import jax
+
+    prof = eng._profiler
+    if prof is None and not eng.naive:
+        return vjp(cots)
+    t0 = time.perf_counter_ns()
+    in_grads = vjp(cots)
+    jax.block_until_ready(in_grads)
+    if prof is not None:
+        prof.record(op_name, t0, time.perf_counter_ns(), cat="backward")
+    return in_grads
 
 
 # ---------------------------------------------------------------------------

@@ -98,6 +98,23 @@ register_env("MXNET_PROFILER_AUTOSTART", bool, False,
 register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 20,
              "Threshold (elements) above which dist kvstore shards a value "
              "across servers/hosts.")
+register_env("MXNET_IMPERATIVE_JIT", bool, True,
+             "Route imperative NDArray dispatch (registry ops, dunders, "
+             "in-place writes) through the bounded jax.jit compilation "
+             "cache (cached_op.py).  '0' restores the eager "
+             "primitive-by-primitive path bit-for-bit.")
+register_env("MXNET_IMPERATIVE_JIT_CACHE_SIZE", int, 1024,
+             "Max compiled executables held by the imperative cached-op "
+             "LRU; least-recently-used entries are evicted beyond it.")
+register_env("MXNET_IMPERATIVE_JIT_THRESHOLD", int, 2,
+             "Sightings of a cache key before it compiles (tiered "
+             "dispatch): below it calls run eagerly, so one-off shapes "
+             "never pay a trace+compile.  1 compiles immediately.")
+register_env("MXNET_IMPERATIVE_JIT_DONATE", bool, True,
+             "Allow the cached imperative path to donate dead input "
+             "buffers (optimizer mutate ops, __setitem__) to XLA on "
+             "backends that support donation.  '0' disables donation "
+             "while keeping cached dispatch.")
 
 
 _UID_LOCK = threading.Lock()

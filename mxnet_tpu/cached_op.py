@@ -1,0 +1,534 @@
+"""Cached-op JIT dispatch for the imperative NDArray path.
+
+Reference: ``MXImperativeInvoke`` routes every imperative call through cached
+engine ops (``src/c_api/c_api_ndarray.cc:322``), later formalized as
+``CachedOp`` — the reference's headline design is that *eager* NDArray code
+runs through the same async engine as compiled graphs.  In this port the
+symbolic side compiles (``executor.py``) but the imperative side executed
+every ``fcompute`` primitive-by-primitive in python.
+
+This module closes that gap: a bounded LRU of ``jax.jit``-compiled
+executables keyed by
+
+    (entry kind, op name, canonicalized attrs/statics,
+     input/aux avals, is_train, has_rng, recording)
+
+Three imperative entry points route through it (``ndarray.py``):
+
+* ``imperative_invoke`` — registry ops, via :func:`invoke_op`
+  (``OpDef.apply_cached``);
+* the ``_eager`` dunder funnel (``x * y``, ``x.sum()``...), via
+  :func:`eager_call`;
+* ``__setitem__`` / ``copyto``, via :func:`setitem` / :func:`copy_value`.
+
+Inside ``autograd.record()`` the cache compiles the forward+VJP *pair* once
+per key (jit-of-``jax.vjp`` returning the pullback as a ``tree_util.Partial``
+pytree — the same residual-stash idiom as ``executor.py``'s split
+forward/backward), so taped imperative code stops retracing its VJP on every
+call; the pullback is applied through one shared jitted applier.
+
+Donation: optimizer ``mutate`` writes and ``__setitem__`` rebind their input
+handle immediately, so the old buffer is donated to XLA (in-place update on
+chip) when ALL of the following hold: the backend supports donation (not
+CPU), the autograd tape is empty (taped residuals may reference the buffer),
+the op is not ``Custom`` (host-callback + donated buffers deadlock — see
+``parallel/dp.py``), and ``MXNET_IMPERATIVE_JIT_DONATE`` is not 0.
+
+Escape hatch: ``MXNET_IMPERATIVE_JIT=0`` (or
+``engine.get().set_imperative_jit(False)``) restores the eager path
+bit-for-bit.  NaiveEngine mode keeps its sync-debugging contract: every
+cached dispatch is followed by ``block_until_ready``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import engine as _engine
+from .base import get_env
+
+__all__ = ["invoke_op", "eager_call", "setitem", "copy_value",
+           "stats", "reset", "configure", "enabled"]
+
+# ops never routed through the cache: Custom runs host callbacks
+# (io_callback) — jit adds nothing and donation can deadlock the callback
+# (the same exclusion parallel/dp.py applies to whole-graph donation)
+JIT_EXCLUDE = frozenset({"Custom"})
+
+
+class _Bypass(Exception):
+    """Raised while building a cache key for an uncacheable call."""
+
+
+# ---------------------------------------------------------------------------
+# The bounded LRU of compiled entries
+# ---------------------------------------------------------------------------
+class _Entry:
+    __slots__ = ("fn", "op_name", "bwd")
+
+    def __init__(self, fn, op_name, bwd=None):
+        self.fn = fn
+        self.op_name = op_name
+        # recording entries carry their own jitted pullback applier so
+        # evicting the entry also frees the backward executables (a
+        # single global applier would retain every evicted pullback
+        # lowering in its internal jit cache forever)
+        self.bwd = bwd
+
+
+class _Cache:
+    def __init__(self, max_size, threshold):
+        # a zero/negative bound would break the eviction loop; caching
+        # itself is disabled via MXNET_IMPERATIVE_JIT=0, not size 0
+        self.max_size = max(1, int(max_size))
+        threshold = max(1, int(threshold))
+        # tiered dispatch: a key must be seen `threshold` times before it
+        # compiles — the first sighting(s) take the eager path, so one-off
+        # shapes (test suites, setup code) never pay a compile, while any
+        # repeated call pattern compiles on its second occurrence
+        self.threshold = threshold
+        self._entries = OrderedDict()
+        self._seen = OrderedDict()  # pre-threshold sighting counts
+        self._stats = {}  # op_name -> [hits, misses, evictions]
+        self.lock = threading.Lock()
+
+    def _stat(self, op_name):
+        s = self._stats.get(op_name)
+        if s is None:
+            s = self._stats[op_name] = [0, 0, 0]
+        return s
+
+    def acquire(self, key, op_name, builder):
+        """Return ``(entry, was_hit)``, or None when the caller should
+        take the eager path (key below the compile threshold).  Compiles
+        through ``builder()`` outside the lock on first crossing."""
+        with self.lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self._stat(op_name)[0] += 1
+                return entry, True
+            self._stat(op_name)[1] += 1
+            if self.threshold > 1:
+                n = self._seen.get(key, 0) + 1
+                if n < self.threshold:
+                    self._seen[key] = n
+                    self._seen.move_to_end(key)
+                    while len(self._seen) > 4 * self.max_size:
+                        self._seen.popitem(last=False)
+                    return None
+                self._seen.pop(key, None)
+        entry = builder()
+        with self.lock:
+            raced = self._entries.get(key)
+            if raced is not None:
+                return raced, True
+            while len(self._entries) >= self.max_size:
+                _, old = self._entries.popitem(last=False)
+                self._stat(old.op_name)[2] += 1
+            self._entries[key] = entry
+            return entry, False
+
+    def snapshot(self):
+        with self.lock:
+            per_op = {k: {"hits": v[0], "misses": v[1], "evictions": v[2]}
+                      for k, v in self._stats.items()}
+            totals = [sum(v[i] for v in self._stats.values())
+                      for i in range(3)]
+            return {"per_op": per_op, "hits": totals[0], "misses": totals[1],
+                    "evictions": totals[2], "size": len(self._entries),
+                    "max_size": self.max_size, "threshold": self.threshold}
+
+
+_cache = None
+_cache_lock = threading.Lock()
+
+
+def _env_max_size():
+    return int(get_env("MXNET_IMPERATIVE_JIT_CACHE_SIZE") or 1024)
+
+
+def _env_threshold():
+    return int(get_env("MXNET_IMPERATIVE_JIT_THRESHOLD") or 2)
+
+
+def _get_cache():
+    global _cache
+    if _cache is None:
+        with _cache_lock:
+            if _cache is None:
+                _cache = _Cache(_env_max_size(), _env_threshold())
+    return _cache
+
+
+def configure(max_size=None, threshold=None):
+    """(Re)configure the cache; drops all compiled entries and stats.
+
+    ``threshold`` is the number of sightings of a key before it
+    compiles (MXNET_IMPERATIVE_JIT_THRESHOLD, default 2: first call
+    eager, compile on the second, hits from the third)."""
+    global _cache
+    with _cache_lock:
+        _cache = _Cache(
+            int(max_size) if max_size is not None else _env_max_size(),
+            int(threshold) if threshold is not None else _env_threshold())
+
+
+def reset():
+    """Drop all compiled entries and zero the counters."""
+    cur = _get_cache()
+    configure(cur.max_size, cur.threshold)
+
+
+def reset_stats():
+    """Zero the hit/miss/eviction counters, keeping compiled entries
+    (post-warmup accounting in benchmarks)."""
+    cache = _get_cache()
+    with cache.lock:
+        cache._stats.clear()
+
+
+def stats():
+    """Per-op hit/miss/eviction counters plus totals (engine surface:
+    ``engine.get().imperative_cache_stats()``)."""
+    return _get_cache().snapshot()
+
+
+def enabled():
+    """Is cached-JIT dispatch on?  (MXNET_IMPERATIVE_JIT escape hatch /
+    ``engine.get().set_imperative_jit``)."""
+    return _engine.get().imperative_jit
+
+
+# ---------------------------------------------------------------------------
+# Key building
+# ---------------------------------------------------------------------------
+def _freeze(v):
+    """Canonicalize an attr/static value into a hashable form."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, (str, bytes, int, float, bool, complex,
+                      type(None), np.generic)):
+        return v
+    raise _Bypass
+
+
+def _attrs_key(attrs):
+    return tuple(sorted((k, _freeze(v)) for k, v in attrs.items()))
+
+
+def _arg_key(x):
+    """Cache-key element for one runtime argument."""
+    if isinstance(x, jax.core.Tracer):
+        # already inside someone else's trace: never nest a jit here
+        raise _Bypass
+    if isinstance(x, jax.Array):
+        return ("a", x.shape, str(x.dtype))
+    if isinstance(x, (bool, int, float, complex)):
+        return ("p", type(x).__name__)
+    if isinstance(x, np.ndarray):
+        return ("n", x.shape, str(x.dtype))
+    if x is None:
+        return ("z",)
+    raise _Bypass
+
+
+def _avals(arrs):
+    return tuple(_arg_key(x) for x in arrs)
+
+
+# ---------------------------------------------------------------------------
+# Donation policy
+# ---------------------------------------------------------------------------
+_donate_backend = [None]
+
+
+def _donation_ok():
+    """Buffer donation is usable: backend supports it, the knob is on, and
+    no autograd tape pins buffers that a donated input might alias."""
+    if not get_env("MXNET_IMPERATIVE_JIT_DONATE"):  # registered bool var
+        return False
+    if _donate_backend[0] is None:
+        _donate_backend[0] = jax.default_backend() not in ("cpu",)
+    if not _donate_backend[0]:
+        return False
+    from . import autograd
+    s = autograd._state()
+    return not s.recording and not s.tape
+
+
+# ---------------------------------------------------------------------------
+# Engine-seam execution: profiler events + NaiveEngine sync contract
+# ---------------------------------------------------------------------------
+def _run(name, entry, args, hit):
+    eng = _engine.get()
+    prof = eng._profiler
+    if prof is None and not eng.naive:
+        return entry.fn(*args)
+    t0 = time.perf_counter_ns()
+    out = entry.fn(*args)
+    # NaiveEngine preserves its synchronous-debugging contract through the
+    # cache; profiling measures execution, not async dispatch (engine.py)
+    jax.block_until_ready(out)
+    if prof is not None:
+        prof.record(name, t0, time.perf_counter_ns(),
+                    cat="cache_hit" if hit else "compile")
+    return out
+
+
+class _CachedPullback:
+    """Jitted application of a cached pullback (a ``tree_util.Partial``
+    returned from the compiled forward); stored on the autograd tape in
+    place of an eager ``jax.vjp`` closure.  ``apply`` is the owning
+    entry's applier, so the tape keeps the backward executable alive
+    even past LRU eviction."""
+
+    __slots__ = ("_apply", "_vjp")
+
+    def __init__(self, apply_fn, vjp):
+        self._apply = apply_fn
+        self._vjp = vjp
+
+    def __call__(self, cots):
+        return self._apply(self._vjp, tuple(cots))
+
+
+# ---------------------------------------------------------------------------
+# Registry-op entry (imperative_invoke / OpDef.apply_cached)
+# ---------------------------------------------------------------------------
+def invoke_op(op, attrs, in_arrs, aux_arrs, is_train, rng, recording):
+    """Cached-JIT execution of a registered op.
+
+    Returns ``(outs, new_aux, pullback-or-None)``, or ``None`` when the
+    cache declines (disabled, excluded op, nested trace, unhashable key)
+    and the caller must take the eager path.
+    """
+    if not enabled() or op.name in JIT_EXCLUDE:
+        return None
+    # donation eligibility depends on runtime state (tape, backend), so it
+    # is decided per call and rides in the key: a donating executable can
+    # never be hit from a call where donation would be unsafe
+    donate = bool(op.mutate) and not recording and _donation_ok()
+    try:
+        key = ("op", op.name, _attrs_key(attrs), _avals(in_arrs),
+               _avals(aux_arrs), bool(is_train), rng is not None,
+               bool(recording), donate)
+        hash(key)
+    except (_Bypass, TypeError):
+        return None
+
+    got = _get_cache().acquire(
+        key, op.name,
+        lambda: _compile_op(op, attrs, bool(is_train), rng is not None,
+                            bool(recording), donate))
+    if got is None:
+        return None  # below the compile threshold: eager path
+    entry, hit = got
+
+    args = (tuple(in_arrs), tuple(aux_arrs))
+    if rng is not None:
+        args += (rng,)
+    if recording:
+        outs, new_aux, vjp = _run(op.name, entry, args, hit)
+        return tuple(outs), tuple(new_aux), _CachedPullback(entry.bwd, vjp)
+    outs, new_aux = _run(op.name, entry, args, hit)
+    return tuple(outs), tuple(new_aux), None
+
+
+def _compile_op(op, attrs, is_train, with_rng, recording, donate=False):
+    """Build the jitted executable for one cache key."""
+    if recording:
+        # forward+VJP pair compiled together: the pullback comes back as a
+        # Partial pytree whose residuals live on device (executor.py's
+        # fwd_res idiom), applied later through _vjp_apply
+        if with_rng:
+            def f(inputs, aux, rng):
+                def pure(*xs):
+                    return op.apply(attrs, xs, aux, is_train, rng)
+                outs, vjp, new_aux = jax.vjp(pure, *inputs, has_aux=True)
+                return outs, new_aux, vjp
+        else:
+            def f(inputs, aux):
+                def pure(*xs):
+                    return op.apply(attrs, xs, aux, is_train, None)
+                outs, vjp, new_aux = jax.vjp(pure, *inputs, has_aux=True)
+                return outs, new_aux, vjp
+        return _Entry(jax.jit(f), op.name,
+                      bwd=jax.jit(lambda vjp, cots: vjp(cots)))
+
+    mutated = tuple(sorted({ai for _, ai in op.mutate}))
+    if mutated and donate:
+        # mutated inputs are rebound by imperative_invoke right after the
+        # call — their old buffers are dead, donate them (in-place
+        # optimizer update on chip).  They ride in a separate leading
+        # argument so donate_argnums can name them.
+        def f(donated, rest, aux, *maybe_rng):
+            rng = maybe_rng[0] if maybe_rng else None
+            inputs = list(rest)
+            for pos, arg_idx in enumerate(mutated):
+                inputs.insert(arg_idx, donated[pos])
+            return op.apply(attrs, tuple(inputs), aux, is_train, rng)
+
+        jitted = jax.jit(f, donate_argnums=(0,))
+
+        def call(inputs, aux, *maybe_rng):
+            donated = tuple(inputs[i] for i in mutated)
+            rest = tuple(x for i, x in enumerate(inputs)
+                         if i not in mutated)
+            return jitted(donated, rest, aux, *maybe_rng)
+
+        return _Entry(call, op.name)
+
+    if with_rng:
+        def f(inputs, aux, rng):
+            return op.apply(attrs, inputs, aux, is_train, rng)
+    else:
+        def f(inputs, aux):
+            return op.apply(attrs, inputs, aux, is_train, None)
+    return _Entry(jax.jit(f), op.name)
+
+
+# ---------------------------------------------------------------------------
+# Dunder-funnel entry (ndarray._eager)
+# ---------------------------------------------------------------------------
+def eager_call(name, fn, arrs, statics, recording):
+    """Cached-JIT execution for the NDArray dunder funnel.
+
+    ``(name, statics)`` must fully determine the semantics of ``fn``
+    (closure parameters ride in ``statics``; array operands in ``arrs``).
+    Returns ``(outs_tuple, pullback-or-None)`` or ``None`` to bypass.
+    """
+    if not enabled():
+        return None
+    try:
+        key = ("eager", name, _freeze(statics), _avals(arrs),
+               bool(recording))
+        hash(key)
+    except (_Bypass, TypeError):
+        return None
+
+    def build():
+        if recording:
+            def f(*xs):
+                outs, vjp = jax.vjp(lambda *ys: (fn(*ys),), *xs)
+                return outs, vjp
+            return _Entry(jax.jit(f), name,
+                          bwd=jax.jit(lambda vjp, cots: vjp(cots)))
+
+        def f(*xs):
+            return (fn(*xs),)
+        return _Entry(jax.jit(f), name)
+
+    got = _get_cache().acquire(key, name, build)
+    if got is None:
+        return None  # below the compile threshold: eager path
+    entry, hit = got
+
+    if recording:
+        outs, vjp = _run(name, entry, arrs, hit)
+        return tuple(outs), _CachedPullback(entry.bwd, vjp)
+    outs = _run(name, entry, arrs, hit)
+    return tuple(outs), None
+
+
+# ---------------------------------------------------------------------------
+# In-place write paths: __setitem__ / copyto
+# ---------------------------------------------------------------------------
+def _freeze_index(key):
+    if isinstance(key, (bool, np.bool_)):
+        # bool indices broadcast as masks, not positions — and bool is a
+        # subclass of int, so it must bypass before the int case below
+        raise _Bypass
+    if isinstance(key, (int, np.integer)):
+        return ("i", int(key))
+    if isinstance(key, slice):
+        for part in (key.start, key.stop, key.step):
+            if part is not None and not isinstance(part, (int, np.integer)):
+                raise _Bypass
+        return ("sl", key.start, key.stop, key.step)
+    if key is Ellipsis:
+        return ("e",)
+    if key is None:
+        return ("na",)
+    if isinstance(key, tuple):
+        return ("t",) + tuple(_freeze_index(k) for k in key)
+    raise _Bypass  # array / bool-mask / list indices: eager path
+
+
+def setitem(data, key, value):
+    """Cached (and, off-CPU, buffer-donating) ``x[key] = value``.
+
+    Mirrors the eager ``__setitem__`` computation exactly; returns the new
+    array value, or ``None`` when the caller must take the eager path.
+    """
+    if not enabled():
+        return None
+    full = isinstance(key, slice) and key == slice(None)
+    scalar_fill = full and isinstance(value, (int, float))
+    if isinstance(value, jax.Array) and not isinstance(
+            value, jax.core.Tracer):
+        try:
+            if value.devices() != data.devices():
+                return None  # committed to different devices: eager path
+        except Exception:
+            return None
+    donate = _donation_ok()
+    try:
+        ckey = ("setitem", _freeze_index(key), _arg_key(data),
+                _arg_key(value), scalar_fill, donate)
+        hash(ckey)
+    except (_Bypass, TypeError):
+        return None
+
+    def build():
+        if scalar_fill:
+            def f(d, v):
+                return jnp.full_like(d, v)
+        elif full:
+            def f(d, v):
+                return jnp.broadcast_to(
+                    jnp.asarray(v, dtype=d.dtype), d.shape)
+        else:
+            def f(d, v):
+                return d.at[key].set(v)
+        return _Entry(jax.jit(f, donate_argnums=(0,) if donate else ()),
+                      "_set_item")
+
+    got = _get_cache().acquire(ckey, "_set_item", build)
+    if got is None:
+        return None  # below the compile threshold: eager path
+    entry, hit = got
+    return _run("_set_item", entry, (data, value), hit)
+
+
+def copy_value(src):
+    """Cached compiled deep copy of ``src`` (same device).
+
+    Used by ``copyto``/``copy`` so a same-device copy is a real buffer
+    copy (reference NDArray::Copy semantics) rather than an alias — which
+    in turn keeps the donation story of the in-place paths safe.  Returns
+    ``None`` to bypass.
+    """
+    if not enabled():
+        return None
+    try:
+        ckey = ("copy", _arg_key(src))
+        hash(ckey)
+    except (_Bypass, TypeError):
+        return None
+    got = _get_cache().acquire(
+        ckey, "_copy",
+        lambda: _Entry(jax.jit(lambda s: jnp.array(s)
+                               if s.dtype == jnp.bool_ else s + 0),
+                       "_copy"))
+    if got is None:
+        return None  # below the compile threshold: eager path
+    entry, hit = got
+    return _run("_copy", entry, (src,), hit)

@@ -38,6 +38,9 @@ class Engine:
         self._naive = get_env("MXNET_ENGINE_TYPE") == "NaiveEngine"
         self._profiler = None  # set by profiler module when recording
         self._host = None  # lazily-created native host-task engine
+        # cached-op JIT dispatch for the imperative path (cached_op.py);
+        # MXNET_IMPERATIVE_JIT=0 is the escape hatch to the eager path
+        self._imperative_jit = bool(get_env("MXNET_IMPERATIVE_JIT"))
 
     @staticmethod
     def get():
@@ -57,6 +60,29 @@ class Engine:
     def set_naive(self, value):
         """Force synchronous dispatch (debugging aid)."""
         self._naive = bool(value)
+
+    @property
+    def imperative_jit(self):
+        """Whether imperative dispatch compiles through the cached-op
+        layer (MXNET_IMPERATIVE_JIT)."""
+        return self._imperative_jit
+
+    def set_imperative_jit(self, value):
+        """Toggle cached-JIT imperative dispatch at runtime (the
+        programmatic face of MXNET_IMPERATIVE_JIT)."""
+        self._imperative_jit = bool(value)
+
+    # -- imperative cached-op control surface -------------------------------
+    def imperative_cache_stats(self):
+        """Per-op hit/miss/eviction counters of the imperative cached-op
+        layer plus totals and current size (cached_op.stats())."""
+        from . import cached_op
+        return cached_op.stats()
+
+    def reset_imperative_cache(self):
+        """Drop all compiled imperative executables and zero counters."""
+        from . import cached_op
+        cached_op.reset()
 
     # -- dispatch seam ------------------------------------------------------
     def dispatch(self, name, fn, *args, **kwargs):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import cached_op as _cached_op
 from . import engine as _engine
 from . import random as _random
 from .base import MXNetError, _uid, get_env
@@ -38,15 +39,28 @@ __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
 _py_slice = slice
 
 
-def _eager(name, fn, *arrs):
-    """Eager math entry that participates in the autograd tape.
+def _eager(name, fn, *arrs, statics=()):
+    """Math entry that participates in the autograd tape.
 
     Every NDArray dunder (`x * y`, `-x`, `x.sum()`) funnels through here so
     python-operator expressions inside ``autograd.record()`` get gradients,
     exactly like registry-op calls (reference: python operators dispatch to
-    registered ops through MXImperativeInvoke and hit RecordOp)."""
+    registered ops through MXImperativeInvoke and hit RecordOp).
+
+    Dispatch goes through the cached-op JIT layer (cached_op.py) keyed on
+    ``(name, statics, input avals)`` — so ``(name, statics)`` must fully
+    determine ``fn``'s semantics (closure parameters like scalars or axes
+    ride in ``statics``).  MXNET_IMPERATIVE_JIT=0 restores the eager path
+    below bit-for-bit."""
     from . import autograd
-    if autograd.is_recording():
+    recording = autograd.is_recording()
+    cached = _cached_op.eager_call(name, fn, arrs, statics, recording)
+    if cached is not None:
+        outs, pullback = cached
+        if recording:
+            autograd.record_op(name, pullback, arrs, outs)
+        return outs[0]
+    if recording:
         outs, vjp = jax.vjp(lambda *xs: (fn(*xs),), *arrs)
         autograd.record_op(name, vjp, arrs, outs)
         return outs[0]
@@ -75,6 +89,17 @@ def _ctx_of(jarr):
     if dev.platform == "cpu":
         return Context("cpu", dev.id)
     return Context("tpu", dev.id)
+
+
+def _copy_data(arr):
+    """Deep copy of a jax.Array on its own device — NEVER an alias
+    (reference NDArray::Copy semantics; the donating in-place write
+    paths rely on copies owning their buffer).  Compiled through the
+    cached-op layer when it accepts, eager otherwise."""
+    new = _cached_op.copy_value(arr)
+    if new is not None:
+        return new
+    return jnp.array(arr) if arr.dtype == jnp.bool_ else arr + 0
 
 
 class NDArray:
@@ -177,16 +202,22 @@ class NDArray:
 
     def copy(self):
         """Deep copy on the same device."""
-        return NDArray(self._data + 0 if self._data.dtype != jnp.bool_
-                       else jnp.array(self._data))
+        return NDArray(_copy_data(self._data))
 
     def copyto(self, other):
         """Copy to another NDArray (in place) or to a Context (new array)."""
         if isinstance(other, NDArray):
+            if other.context == self.context and _cached_op.enabled():
+                other._data = _copy_data(self._data)
+                return other
             other._data = jax.device_put(self._data,
                                          other.context.jax_device())
             return other
         if isinstance(other, Context):
+            if other == self.context and _cached_op.enabled():
+                # same hazard as the NDArray branch: a same-device
+                # device_put would alias the source buffer
+                return NDArray(_copy_data(self._data))
             return NDArray(jax.device_put(self._data, other.jax_device()))
         raise MXNetError("copyto does not support type %s" % type(other))
 
@@ -238,6 +269,15 @@ class NDArray:
             dev = next(iter(self._data.devices()))
         except Exception:
             dev = None
+        if isinstance(key, NDArray):
+            key = key._data
+        # cached-JIT write path: compiled (and, off-CPU, buffer-donating)
+        # update when the index canonicalizes; declines to the eager path
+        # below otherwise (cached_op.setitem mirrors it computation-exact)
+        new = _cached_op.setitem(self._data, key, value)
+        if new is not None:
+            self._data = jax.device_put(new, dev) if dev is not None else new
+            return
         if isinstance(key, _py_slice) and key == _py_slice(None):
             if isinstance(value, (int, float)):
                 new = jnp.full_like(self._data, value)
@@ -249,37 +289,46 @@ class NDArray:
             # groups rely on each bound array keeping its placement)
             self._data = jax.device_put(new, dev) if dev is not None else new
             return
-        if isinstance(key, NDArray):
-            key = key._data
         new = self._data.at[key].set(value)
         self._data = jax.device_put(new, dev) if dev is not None else new
 
     # -- arithmetic ---------------------------------------------------------
-    def _binary(self, other, fn, differentiable=True):
+    def _binary(self, other, fn, differentiable=True, name=None):
+        # `name` uniquely identifies `fn` in the dispatch cache (r-op
+        # lambdas all share __name__ == '<lambda>', so it is explicit)
+        if name is None:
+            name = getattr(fn, "__name__", "binary")
         if isinstance(other, NDArray):
             if differentiable:
-                return NDArray(_eager(fn.__name__ if hasattr(fn, "__name__")
-                                      else "binary", fn, self._data,
-                                      other._data))
+                return NDArray(_eager(name, fn, self._data, other._data))
             other = other._data
             return NDArray(fn(self._data, other))
         if differentiable:
-            return NDArray(_eager("binary_scalar",
-                                  lambda a: fn(a, other), self._data))
+            # the scalar is a compile-time constant of the cached entry;
+            # its type AND value ride in the key (2 vs 2.0 promote
+            # differently on integer arrays)
+            return NDArray(_eager(name + "_scalar",
+                                  lambda a: fn(a, other), self._data,
+                                  statics=(type(other).__name__, other)))
         return NDArray(fn(self._data, other))
 
     def __add__(self, o): return self._binary(o, jnp.add)
-    def __radd__(self, o): return self._binary(o, lambda a, b: jnp.add(b, a))
+    def __radd__(self, o): return self._binary(o, lambda a, b: jnp.add(b, a),
+                                               name="radd")
     def __sub__(self, o): return self._binary(o, jnp.subtract)
-    def __rsub__(self, o): return self._binary(o, lambda a, b: jnp.subtract(b, a))
+    def __rsub__(self, o): return self._binary(
+        o, lambda a, b: jnp.subtract(b, a), name="rsub")
     def __mul__(self, o): return self._binary(o, jnp.multiply)
-    def __rmul__(self, o): return self._binary(o, lambda a, b: jnp.multiply(b, a))
+    def __rmul__(self, o): return self._binary(
+        o, lambda a, b: jnp.multiply(b, a), name="rmul")
     def __truediv__(self, o): return self._binary(o, jnp.divide)
-    def __rtruediv__(self, o): return self._binary(o, lambda a, b: jnp.divide(b, a))
+    def __rtruediv__(self, o): return self._binary(
+        o, lambda a, b: jnp.divide(b, a), name="rdiv")
     def __div__(self, o): return self.__truediv__(o)
     def __mod__(self, o): return self._binary(o, jnp.mod)
     def __pow__(self, o): return self._binary(o, jnp.power)
-    def __rpow__(self, o): return self._binary(o, lambda a, b: jnp.power(b, a))
+    def __rpow__(self, o): return self._binary(
+        o, lambda a, b: jnp.power(b, a), name="rpow")
     def __neg__(self):
         return NDArray(_eager("negative", jnp.negative, self._data))
 
@@ -287,11 +336,13 @@ class NDArray:
         return NDArray(_eager("abs", jnp.abs, self._data))
 
     def _ibinary(self, o, fn):
+        name = "i" + fn.__name__
         if isinstance(o, NDArray):
-            self._data = _eager("ibinary", fn, self._data, o._data)
+            self._data = _eager(name, fn, self._data, o._data)
         else:
-            self._data = _eager("ibinary_scalar",
-                                lambda a: fn(a, o), self._data)
+            self._data = _eager(name + "_scalar",
+                                lambda a: fn(a, o), self._data,
+                                statics=(type(o).__name__, o))
         return self
 
     def __iadd__(self, o): return self._ibinary(o, jnp.add)
@@ -310,9 +361,11 @@ class NDArray:
         return id(self)
 
     def _reduce(self, name, fn, axis, keepdims):
+        if isinstance(axis, list):
+            axis = tuple(axis)
         return NDArray(_eager(name, lambda a: fn(a, axis=axis,
                                                  keepdims=keepdims),
-                              self._data))
+                              self._data, statics=(axis, bool(keepdims))))
 
     def sum(self, axis=None, keepdims=False):
         """Sum over ``axis`` (all axes when None)."""
@@ -625,8 +678,15 @@ def imperative_invoke(op_name, args, kwargs):
     aux_arrs = tuple(x._data for x in aux_nds)
     rng = _random.next_key() if (op.needs_rng or op.stateful) else None
     is_train = autograd.is_training()
+    recording = autograd.is_recording()
 
-    if autograd.is_recording():
+    cached = op.apply_cached(attrs, in_arrs, aux_arrs, is_train, rng,
+                             recording)
+    if cached is not None:
+        outs, new_aux, pullback = cached
+        if pullback is not None:
+            autograd.record_op(op_name, pullback, in_arrs, outs)
+    elif recording:
         def pure(*xs):
             o, na = op.apply(attrs, xs, aux_arrs, is_train, rng)
             return o, na

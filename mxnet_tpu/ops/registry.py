@@ -285,6 +285,18 @@ class OpDef:
             outs = self.fcompute(attrs, *inputs)
         return _as_tuple(outs), ()
 
+    def apply_cached(self, attrs, inputs, aux=(), is_train=False, rng=None,
+                     recording=False):
+        """Execute through the imperative cached-op JIT layer.
+
+        Returns ``(outputs_tuple, new_aux_tuple, pullback-or-None)`` when a
+        compiled executable handled the call (the pullback is non-None iff
+        ``recording``), or ``None`` when the cache declines (disabled via
+        MXNET_IMPERATIVE_JIT=0, excluded op, nested trace, unhashable
+        attrs) and the caller must fall back to :meth:`apply`."""
+        from ..cached_op import invoke_op
+        return invoke_op(self, attrs, inputs, aux, is_train, rng, recording)
+
     def __repr__(self):
         return "OpDef(%s)" % self.name
 

@@ -127,7 +127,11 @@ def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         scratch = [pltpu.VMEM((block_q, 1), jnp.float32),
                    pltpu.VMEM((block_q, 1), jnp.float32),
                    pltpu.VMEM((block_q, D), jnp.float32)]
-        params = dict(compiler_params=pltpu.CompilerParams(
+        # renamed across jax releases: TPUCompilerParams (<=0.4.x) ->
+        # CompilerParams (newer)
+        _params_cls = getattr(pltpu, "CompilerParams", None) or \
+            pltpu.TPUCompilerParams
+        params = dict(compiler_params=_params_cls(
             dimension_semantics=("parallel", "parallel", "arbitrary")))
     else:  # pragma: no cover
         scratch = [pl.MemoryRef((block_q, 1), jnp.float32),

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from .mesh import axis_size as _axis_size
+
 __all__ = ["spmd_pipeline", "pipelined", "stack_stage_params"]
 
 
@@ -38,7 +40,7 @@ def spmd_pipeline(stage_fn, stage_params, x, axis_name="pp",
     Returns [M, mb, ...]: outputs of the last stage (valid on every device
         after the closing broadcast).
     """
-    S = jax.lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     M = x.shape[0] if num_microbatches is None else num_microbatches
     assert M == x.shape[0], \

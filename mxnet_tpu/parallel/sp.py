@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from .mesh import axis_size as _axis_size
+
 __all__ = ["ring_attention", "ring_self_attention", "blockwise_attention",
            "local_attention"]
 
@@ -121,7 +123,7 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     Returns [B, H, L_local, D].
     """
     B, H, Lc, D = q.shape
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     if sp == 1:
         # degenerate ring: pure local attention (flash kernel on TPU)
         return local_attention(q, k, v, causal=causal, scale=scale)

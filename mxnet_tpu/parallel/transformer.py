@@ -28,6 +28,8 @@ from jax.experimental.shard_map import shard_map
 from .sp import ring_attention
 from .ep import moe_ffn, init_moe_params
 
+from .mesh import axis_size as _axis_size
+
 __all__ = ["TransformerConfig", "init_transformer_params",
            "transformer_loss", "TransformerTrainer"]
 
@@ -141,7 +143,7 @@ def _block_fn(blk, x, cfg, pos0):
     if "moe" in blk:
         B, L, D = h.shape
         T = B * L
-        ep = jax.lax.axis_size("tp")
+        ep = _axis_size("tp")
         rank = jax.lax.axis_index("tp")
         if T % ep != 0:
             raise ValueError(
@@ -234,7 +236,7 @@ class TransformerTrainer:
                 # that axis; a param SHARDED over an axis comes out
                 # inflated by that axis size (the forward psum's transpose
                 # summed identical cotangents) -> divide by the size.
-                tp_size = jax.lax.axis_size("tp")
+                tp_size = _axis_size("tp")
 
                 def combine(g, spec):
                     g = jax.lax.pmean(jax.lax.pmean(g, "dp"), "sp")

@@ -26,25 +26,28 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
 class Profiler:
     def __init__(self, filename="profile.json"):
         self.filename = filename
-        self.records = []  # (name, start_ns, end_ns, thread_id)
+        self.records = []  # (name, start_ns, end_ns, thread_id, category)
         self._lock = threading.Lock()
         self._t0 = time.perf_counter_ns()
 
-    def record(self, name, start_ns, end_ns):
+    def record(self, name, start_ns, end_ns, cat="operator"):
+        """Record one span.  ``cat`` tags the dispatch kind: "operator"
+        (eager engine seam), "cache_hit" / "compile" (cached-op JIT
+        dispatch, cached_op.py), "backward" (tape replay)."""
         with self._lock:
             self.records.append((name, start_ns, end_ns,
-                                 threading.get_ident()))
+                                 threading.get_ident(), cat))
 
     def dump(self, filename=None):
         filename = filename or self.filename
         events = []
-        for name, start, end, tid in self.records:
+        for name, start, end, tid, cat in self.records:
             events.append({
-                "name": name, "cat": "operator", "ph": "B",
+                "name": name, "cat": cat, "ph": "B",
                 "ts": (start - self._t0) / 1000.0,
                 "pid": 0, "tid": tid % 100000})
             events.append({
-                "name": name, "cat": "operator", "ph": "E",
+                "name": name, "cat": cat, "ph": "E",
                 "ts": (end - self._t0) / 1000.0,
                 "pid": 0, "tid": tid % 100000})
         with open(filename, "w") as f:

@@ -1,0 +1,380 @@
+"""Cached-op JIT dispatch layer (mxnet_tpu/cached_op.py).
+
+Covers the acceptance contract of the imperative dispatch engine:
+hit/miss accounting, LRU eviction at the size bound, autograd-through-
+the-cache numeric-gradient parity with the eager path, and the
+MXNET_IMPERATIVE_JIT=0 escape hatch restoring eager behavior.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, cached_op, engine
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """Each test starts from an empty cache with JIT dispatch on and
+    threshold 1 (compile on first sighting, so accounting is exact);
+    everything is restored afterwards."""
+    eng = engine.get()
+    prev = eng.imperative_jit
+    eng.set_imperative_jit(True)
+    cached_op.configure(threshold=1)
+    yield
+    eng.set_imperative_jit(prev)
+    cached_op.configure()  # back to env-var defaults
+
+
+def _per_op(name):
+    return engine.get().imperative_cache_stats()["per_op"].get(
+        name, {"hits": 0, "misses": 0, "evictions": 0})
+
+
+# ---------------------------------------------------------------------------
+# Accounting
+# ---------------------------------------------------------------------------
+def test_hit_miss_accounting_registry_op():
+    x = mx.nd.array(np.random.rand(4, 8).astype("float32"))
+    mx.nd.softmax(x)
+    assert _per_op("softmax") == {"hits": 0, "misses": 1, "evictions": 0}
+    mx.nd.softmax(x)
+    mx.nd.softmax(x)
+    assert _per_op("softmax") == {"hits": 2, "misses": 1, "evictions": 0}
+    # a new shape is a new cache key, not a hit
+    mx.nd.softmax(mx.nd.ones((3, 3)))
+    assert _per_op("softmax")["misses"] == 2
+
+
+def test_hit_miss_accounting_dunders():
+    x = mx.nd.ones((4, 4))
+    y = mx.nd.ones((4, 4))
+    for _ in range(3):
+        ((x * y) + x).sum()
+    assert _per_op("multiply") == {"hits": 2, "misses": 1, "evictions": 0}
+    assert _per_op("add")["hits"] == 2
+    assert _per_op("sum")["hits"] == 2
+
+
+def test_attrs_distinguish_entries():
+    x = mx.nd.array(np.random.rand(4, 8).astype("float32"))
+    a = mx.nd.softmax(x, axis=0)
+    b = mx.nd.softmax(x, axis=1)
+    assert _per_op("softmax")["misses"] == 2
+    assert not np.allclose(a.asnumpy(), b.asnumpy())
+
+
+def test_scalar_type_distinguishes_entries():
+    """2 and 2.0 promote differently on integer arrays; the cache key
+    carries the scalar's type so entries can never cross-hit."""
+    x = mx.nd.array(np.arange(4), dtype="int32")
+    assert (x + 2).dtype == jnp.int32
+    assert (x + 2.0).dtype == jnp.float32
+
+
+def test_hit_rate_after_warmup():
+    x = mx.nd.array(np.random.rand(16, 16).astype("float32"))
+    mx.nd.softmax(x).wait_to_read()  # warmup: the only miss
+    cached_op.reset_stats()
+    for _ in range(200):
+        mx.nd.softmax(x)
+    mx.nd.waitall()
+    st = engine.get().imperative_cache_stats()
+    assert st["hits"] / (st["hits"] + st["misses"]) >= 0.99
+
+
+# ---------------------------------------------------------------------------
+# LRU eviction
+# ---------------------------------------------------------------------------
+def test_compile_threshold_tiered_dispatch():
+    """Default tiered dispatch: a key's first sighting runs eagerly (no
+    compile), the second compiles, the third hits — one-off shapes never
+    pay a trace+compile."""
+    cached_op.configure(threshold=2)
+    x = mx.nd.ones((5, 6))
+    mx.nd.softmax(x)  # 1st sighting: eager (counted as a miss)
+    st = engine.get().imperative_cache_stats()
+    assert st["per_op"]["softmax"] == {"hits": 0, "misses": 1,
+                                       "evictions": 0}
+    assert st["size"] == 0  # nothing compiled yet
+    mx.nd.softmax(x)  # 2nd: crosses the threshold, compiles
+    st = engine.get().imperative_cache_stats()
+    assert st["per_op"]["softmax"]["misses"] == 2
+    assert st["size"] == 1
+    mx.nd.softmax(x)  # 3rd: hit
+    assert _per_op("softmax")["hits"] == 1
+
+
+def test_threshold_copyto_still_real_copy():
+    """Below the compile threshold copyto falls back to an eager copy —
+    never to a same-device buffer alias (donation safety)."""
+    cached_op.configure(threshold=2)
+    a = mx.nd.ones((3, 3))
+    b = mx.nd.zeros((3, 3))
+    a.copyto(b)  # first sighting: eager path
+    assert b._data is not a._data
+    np.testing.assert_array_equal(b.asnumpy(), a.asnumpy())
+
+
+def test_lru_eviction_at_size_bound():
+    cached_op.configure(max_size=4, threshold=1)
+    try:
+        shapes = [(2, i + 2) for i in range(6)]
+        for s in shapes:
+            mx.nd.softmax(mx.nd.ones(s))
+        st = engine.get().imperative_cache_stats()
+        assert st["size"] <= 4
+        assert st["per_op"]["softmax"]["evictions"] >= 2
+        # the oldest entry was evicted: rerunning it is a miss again
+        before = st["per_op"]["softmax"]["misses"]
+        mx.nd.softmax(mx.nd.ones(shapes[0]))
+        assert _per_op("softmax")["misses"] == before + 1
+        # the most recent entry survived: hit
+        before_hits = _per_op("softmax")["hits"]
+        mx.nd.softmax(mx.nd.ones(shapes[-1]))
+        assert _per_op("softmax")["hits"] == before_hits + 1
+    finally:
+        cached_op.configure(threshold=1)  # fixture default for this file
+
+
+# ---------------------------------------------------------------------------
+# MXNET_IMPERATIVE_JIT=0 escape hatch
+# ---------------------------------------------------------------------------
+def test_jit_off_restores_eager_path():
+    import jax
+
+    x = mx.nd.array(np.random.rand(5, 7).astype("float32"))
+    engine.get().set_imperative_jit(False)
+    y = mx.nd.softmax(x)
+    z = (x * 3.5).sum()
+    # nothing entered the cache: the eager path is bit-for-bit the
+    # pre-cache implementation
+    st = engine.get().imperative_cache_stats()
+    assert st["hits"] == 0 and st["misses"] == 0
+    ref = np.asarray(jax.nn.softmax(jnp.asarray(x.asnumpy()), axis=-1))
+    assert np.array_equal(y.asnumpy(), ref)
+    assert np.allclose(float(z), float((x.asnumpy() * 3.5).sum()),
+                       rtol=1e-6)
+
+
+def test_jit_on_off_equivalence():
+    rs = np.random.RandomState(3)
+    x = mx.nd.array(rs.uniform(-2, 2, (6, 5)).astype("float32"))
+    w = mx.nd.array(rs.uniform(-1, 1, (5, 4)).astype("float32"))
+    y_on = mx.nd.dot(x, w)
+    s_on = mx.nd.softmax(y_on)
+    engine.get().set_imperative_jit(False)
+    y_off = mx.nd.dot(x, w)
+    s_off = mx.nd.softmax(y_off)
+    np.testing.assert_allclose(y_on.asnumpy(), y_off.asnumpy(),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(s_on.asnumpy(), s_off.asnumpy(),
+                               rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Autograd through the cache
+# ---------------------------------------------------------------------------
+def _loss_grads(x_np):
+    """Grad of a composite imperative expression (registry op + dunders)."""
+    x = mx.nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.softmax(x)
+        loss = ((y * y).sum() + (x * 0.5).sum())
+    loss.backward()
+    return x.grad.asnumpy().copy()
+
+
+def test_autograd_cache_matches_eager():
+    x_np = np.random.RandomState(1).uniform(-1, 1, (4, 6)).astype("float32")
+    g_cached = _loss_grads(x_np)
+    st = engine.get().imperative_cache_stats()
+    assert st["misses"] > 0  # the taped forward really hit the cache
+    engine.get().set_imperative_jit(False)
+    g_eager = _loss_grads(x_np)
+    np.testing.assert_allclose(g_cached, g_eager, rtol=1e-5, atol=1e-6)
+
+
+def test_autograd_cache_matches_numeric_gradient():
+    rs = np.random.RandomState(7)
+    x_np = rs.uniform(-1, 1, (3, 4)).astype("float32")
+    g = _loss_grads(x_np)
+
+    def loss_at(v):
+        e = np.exp(v - v.max(axis=-1, keepdims=True))
+        sm = e / e.sum(axis=-1, keepdims=True)
+        return float((sm * sm).sum() + (v * 0.5).sum())
+
+    eps = 1e-3
+    num = np.zeros_like(x_np)
+    for i in np.ndindex(x_np.shape):
+        up, dn = x_np.copy(), x_np.copy()
+        up[i] += eps
+        dn[i] -= eps
+        num[i] = (loss_at(up) - loss_at(dn)) / (2 * eps)
+    np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-3)
+
+
+def test_recorded_forward_reuses_cache():
+    """The jit-of-vjp pair compiles once per key; later taped calls hit."""
+    x = mx.nd.array(np.random.rand(4, 4).astype("float32"))
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            loss = mx.nd.softmax(x).sum()
+        loss.backward()
+    s = _per_op("softmax")
+    assert s["misses"] == 1 and s["hits"] == 2
+    # recording and non-recording entries are distinct keys
+    mx.nd.softmax(x)
+    assert _per_op("softmax")["misses"] == 2
+
+
+def test_backward_twice_retain_graph():
+    x = mx.nd.array(np.random.rand(3, 3).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), g1)
+
+
+# ---------------------------------------------------------------------------
+# Stateful / RNG / mutate ops through the cache
+# ---------------------------------------------------------------------------
+def test_batchnorm_aux_updates_match_eager():
+    rs = np.random.RandomState(0)
+    d_np = rs.uniform(-1, 1, (8, 3, 4, 4)).astype("float32")
+
+    def run():
+        d = mx.nd.array(d_np)
+        gamma, beta = mx.nd.ones((3,)), mx.nd.zeros((3,))
+        mm, mv = mx.nd.zeros((3,)), mx.nd.ones((3,))
+        with autograd.train_mode():
+            out = mx.nd.BatchNorm(d, gamma, beta, mm, mv)
+        return out.asnumpy(), mm.asnumpy(), mv.asnumpy()
+
+    o1, mm1, mv1 = run()
+    o2, mm2, mv2 = run()  # second call: cache hit, same numbers
+    assert _per_op("BatchNorm") == {"hits": 1, "misses": 1, "evictions": 0}
+    engine.get().set_imperative_jit(False)
+    o0, mm0, mv0 = run()
+    for got, ref in ((o1, o0), (mm1, mm0), (mv1, mv0)):
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(o1, o2)
+
+
+def test_rng_not_baked_into_cache():
+    """Dropout draws a fresh key per call even on a cache hit (the key is
+    a traced argument, not a compile-time constant)."""
+    x = mx.nd.ones((64, 64))
+    with autograd.train_mode():
+        a = mx.nd.Dropout(x, p=0.5)
+        b = mx.nd.Dropout(x, p=0.5)
+    assert _per_op("Dropout")["hits"] >= 1
+    assert not np.array_equal(a.asnumpy(), b.asnumpy())
+
+
+def test_mutate_op_through_cache_matches_eager():
+    def run():
+        w = mx.nd.ones((6,))
+        g = mx.nd.full((6,), 0.25)
+        mom = mx.nd.zeros((6,))
+        for _ in range(3):
+            mx.nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+        return w.asnumpy(), mom.asnumpy()
+
+    w1, m1 = run()
+    assert _per_op("sgd_mom_update")["hits"] >= 2
+    engine.get().set_imperative_jit(False)
+    w0, m0 = run()
+    np.testing.assert_allclose(w1, w0, rtol=1e-6)
+    np.testing.assert_allclose(m1, m0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# In-place write paths
+# ---------------------------------------------------------------------------
+def test_setitem_cached_matches_eager():
+    def run():
+        q = mx.nd.zeros((4, 5))
+        q[:] = 3.0
+        q[1:3] = 7.0
+        q[0] = np.arange(5, dtype="float32")
+        q[2, 1:4] = 9.0
+        return q.asnumpy()
+
+    got = run()
+    assert _per_op("_set_item")["misses"] >= 3
+    run()
+    assert _per_op("_set_item")["hits"] >= 3
+    engine.get().set_imperative_jit(False)
+    ref = run()
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_setitem_array_index_falls_back():
+    q = mx.nd.zeros((4,))
+    idx = mx.nd.array(np.array([0, 2]), dtype="int32")
+    q[idx] = 1.0  # NDArray index: uncacheable, eager path
+    np.testing.assert_array_equal(q.asnumpy(), [1.0, 0.0, 1.0, 0.0])
+
+
+def test_copyto_same_device_is_a_real_copy():
+    a = mx.nd.ones((3, 3))
+    b = mx.nd.zeros((3, 3))
+    a.copyto(b)
+    assert b._data is not a._data  # no buffer aliasing (donation safety)
+    np.testing.assert_array_equal(b.asnumpy(), a.asnumpy())
+    b[:] = 5.0
+    np.testing.assert_array_equal(a.asnumpy(), np.ones((3, 3)))
+
+
+# ---------------------------------------------------------------------------
+# Bypass rules
+# ---------------------------------------------------------------------------
+def test_custom_op_bypasses_cache():
+    class Prop(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+            return Op()
+
+    mx.operator.register("cached_op_test_identity")(Prop)
+    x = mx.nd.ones((2, 2))
+    y = mx.nd.Custom(x, op_type="cached_op_test_identity")
+    np.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+    assert "Custom" not in engine.get().imperative_cache_stats()["per_op"]
+
+
+def test_naive_engine_sync_contract():
+    eng = engine.get()
+    eng.set_naive(True)
+    try:
+        x = mx.nd.ones((8, 8))
+        y = mx.nd.softmax(x)  # compiled, then block_until_ready
+        assert np.isfinite(y.asnumpy()).all()
+        assert _per_op("softmax")["misses"] == 1
+    finally:
+        eng.set_naive(False)

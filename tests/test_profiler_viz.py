@@ -26,6 +26,55 @@ def test_profiler_chrome_trace(tmp_path):
     assert any("dot" in (n or "") for n in names), names
 
 
+def test_profiler_tags_cached_op_events(tmp_path):
+    """A jitted imperative op shows up in the Chrome trace with the
+    cached-op dispatch categories: "compile" on the miss that builds the
+    executable, "cache_hit" on the later call (cached_op.py seam)."""
+    from mxnet_tpu import cached_op, engine
+
+    assert engine.get().imperative_jit, \
+        "cached dispatch must be on for this test"
+    cached_op.configure(threshold=1)  # compile on first sighting
+    try:
+        path = str(tmp_path / "profile_cached.json")
+        mx.profiler.profiler_set_config(mode="all", filename=path)
+        mx.profiler.profiler_set_state("run")
+        x = mx.nd.ones((32, 32))
+        mx.nd.softmax(x)      # miss: traced + compiled under the profiler
+        mx.nd.softmax(x)      # hit: cached executable
+        mx.nd.waitall()
+        mx.profiler.profiler_set_state("stop")
+        mx.profiler.dump_profile()
+    finally:
+        cached_op.configure()  # back to env-var defaults
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    cats = {(e.get("name"), e.get("cat")) for e in events}
+    assert ("softmax", "compile") in cats, cats
+    assert ("softmax", "cache_hit") in cats, cats
+
+
+def test_profiler_tags_backward_events(tmp_path):
+    """Tape replay goes through the engine seam: backward spans carry
+    cat="backward" named after the recorded op."""
+    from mxnet_tpu import autograd
+
+    path = str(tmp_path / "profile_bwd.json")
+    mx.profiler.profiler_set_config(mode="all", filename=path)
+    mx.profiler.profiler_set_state("run")
+    x = mx.nd.ones((8, 8))
+    x.attach_grad()
+    with autograd.record():
+        loss = mx.nd.softmax(x).sum()
+    loss.backward()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    bwd = {e["name"] for e in events if e.get("cat") == "backward"}
+    assert "softmax" in bwd and "sum" in bwd, bwd
+
+
 def test_print_summary(capsys):
     data = mx.sym.Variable("data")
     net = mx.sym.SoftmaxOutput(

@@ -23,6 +23,7 @@ import argparse
 import inspect
 import io
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -40,11 +41,18 @@ jax.config.update("jax_platforms", "cpu")
 OUT_DIR = os.path.join(REPO, "docs", "api", "python")
 
 
+_ENV_REPR = re.compile(r"<module '([^']+)' from '[^']*'>")
+
+
 def _sig(obj):
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (TypeError, ValueError):
         return "(...)"
+    # default-arg reprs must not embed this machine's interpreter paths
+    # (e.g. logger=<module 'logging' from '/usr/.../python3.X/...'>), or
+    # --check fails on any host with a different python
+    return _ENV_REPR.sub(r"<module '\1'>", sig)
 
 
 def _doc(obj):
