@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence ci-quick ci-full docs bench hygiene
+.PHONY: test quick build dist convergence dist-smoke ci-quick ci-full docs bench hygiene
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -20,6 +20,13 @@ build:
 
 dist:
 	$(PY) -m pytest tests/ -m dist -q
+
+# seeded fault-injection recovery scenario (server SIGKILLed mid-push,
+# snapshot restore, worker retry/reconnect) under a hard timeout so a
+# kvstore robustness regression fails fast instead of hanging CI
+dist-smoke:
+	timeout -k 10 240 env JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/test_fault_tolerance.py -q -k seeded
 
 convergence:
 	$(PY) -m pytest tests/ -m convergence -q

@@ -45,6 +45,7 @@ from . import kvstore as kv  # mx.kv shorthand (reference __init__.py:36)
 from .kvstore import KVStore, create as create_kvstore  # noqa: E402
 from . import kvstore_server  # noqa: E402  (role hijack runs at kvstore
 # creation, not import — see kvstore_server._init_kvstore_server_module)
+from . import faultinject  # noqa: E402  (deterministic dist fault injection)
 from . import io
 from .io import recordio  # noqa: E402
 from . import module

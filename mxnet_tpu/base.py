@@ -9,6 +9,8 @@ name/registry helpers used across the package.
 """
 from __future__ import annotations
 
+import contextlib
+import itertools
 import os
 import threading
 
@@ -18,6 +20,7 @@ __all__ = [
     "env_registry",
     "register_env",
     "get_env",
+    "atomic_write",
     "string_types",
     "numeric_types",
 ]
@@ -95,9 +98,9 @@ register_env("MXNET_BACKWARD_DO_MIRROR", bool, False,
              "eligible subgraphs; reference: graph_executor.cc:210-223).")
 register_env("MXNET_PROFILER_AUTOSTART", bool, False,
              "Start the Chrome-trace profiler at import time.")
-register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 20,
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
              "Threshold (elements) above which dist kvstore shards a value "
-             "across servers/hosts.")
+             "across servers/hosts (reference default 1e6).")
 register_env("MXNET_IMPERATIVE_JIT", bool, True,
              "Route imperative NDArray dispatch (registry ops, dunders, "
              "in-place writes) through the bounded jax.jit compilation "
@@ -115,6 +118,76 @@ register_env("MXNET_IMPERATIVE_JIT_DONATE", bool, True,
              "buffers (optimizer mutate ops, __setitem__) to XLA on "
              "backends that support donation.  '0' disables donation "
              "while keeping cached dispatch.")
+register_env("MXNET_KVSTORE_BARRIER_TIMEOUT", float, 600.0,
+             "Seconds a worker waits at a barrier (and the reply "
+             "deadline for dist_sync pushes, which block on the "
+             "slowest peer) before concluding a peer died.")
+register_env("MXNET_KVSTORE_RPC_TIMEOUT", float, 60.0,
+             "Deadline (seconds) a dist-kvstore worker waits for one "
+             "server/scheduler RPC reply before treating the endpoint as "
+             "failed and retrying.  0 disables deadlines (block forever, "
+             "the pre-fault-tolerance behavior).")
+register_env("MXNET_KVSTORE_RPC_RETRIES", int, 3,
+             "Retries after the first failed attempt of a dist-kvstore "
+             "RPC (timeout or severed connection); each retry backs off "
+             "exponentially and reconnects through the scheduler's "
+             "current server address table.")
+register_env("MXNET_KVSTORE_RPC_BACKOFF", float, 0.1,
+             "Base (seconds) of the exponential retry backoff: attempt k "
+             "sleeps min(cap, base*2^k), jittered into [d/2, d].")
+register_env("MXNET_KVSTORE_RPC_BACKOFF_CAP", float, 10.0,
+             "Upper bound (seconds) on one retry backoff sleep.")
+register_env("MXNET_KVSTORE_RPC_CB_FAILS", int, 8,
+             "Consecutive RPC failures to one endpoint before its "
+             "circuit breaker opens and calls fail fast with MXNetError "
+             "instead of hanging fanout threads.")
+register_env("MXNET_KVSTORE_RPC_CB_RESET", float, 30.0,
+             "Seconds an open circuit breaker waits before letting one "
+             "half-open trial RPC probe the endpoint again.")
+register_env("MXNET_KVSTORE_SNAPSHOT_DIR", str, "",
+             "Directory where dist-kvstore servers snapshot their "
+             "key->value store and updater state (atomic tmp+rename); "
+             "empty disables snapshots.  A restarted server restores "
+             "from it and rejoins under DMLC_PS_RECOVERY_RANK.")
+register_env("MXNET_KVSTORE_SNAPSHOT_INTERVAL", float, 5.0,
+             "Seconds between server snapshot writes (skipped when "
+             "nothing changed); <= 0 snapshots synchronously after "
+             "every mutation, before the push reply is sent.")
+register_env("MXNET_FAULT_INJECT", str, "",
+             "Deterministic fault-injection schedule for the dist "
+             "kvstore: inline JSON or a path to a JSON file (see "
+             "mxnet_tpu/faultinject.py).  Unset = all fault hooks are "
+             "no-ops.")
+
+
+_ATOMIC_WRITE_SEQ = itertools.count()
+
+
+@contextlib.contextmanager
+def atomic_write(path, mode="wb"):
+    """Crash-safe file write: yields a handle onto a temp file in the
+    same directory, fsyncs, then ``os.replace``s it over ``path`` — a
+    reader never observes a half-written file and a crash mid-write
+    leaves the previous contents intact (checkpoints, server snapshots).
+    Temp names are unique per write, so concurrent writers of the same
+    path each land a complete file (last replace wins) instead of
+    interleaving into a corrupt one.
+    """
+    tmp = "%s.tmp%d.%d" % (path, os.getpid(), next(_ATOMIC_WRITE_SEQ))
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+    except BaseException:
+        f.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    f.close()
+    os.replace(tmp, path)
 
 
 _UID_LOCK = threading.Lock()

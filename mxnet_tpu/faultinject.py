@@ -1,0 +1,218 @@
+"""Deterministic fault injection for the distributed KVStore.
+
+Real multi-host failures (a parameter server SIGKILLed mid-push, a slow
+scheduler, a dropped reply) are timing-dependent and unreproducible in
+CI.  This module turns them into a *seeded schedule*: instrumented seams
+in the PS stack call :func:`hook` on every message, and a schedule loaded
+from ``MXNET_FAULT_INJECT`` decides — by deterministic per-rule counters,
+never wall clock — which event to drop, delay, sever, or die on.
+
+With ``MXNET_FAULT_INJECT`` unset every hook is a single ``is None``
+check returning immediately, so production paths are byte-identical to
+the uninstrumented code.
+
+Schedule spec (inline JSON, or a path to a JSON file)::
+
+    {"seed": 7,
+     "rules": [
+       {"seam": "server.recv", "kind": "push", "nth": 4, "action": "die"},
+       {"seam": "worker.send", "kind": "pull", "nth": 1, "count": 2,
+        "action": "drop"},
+       {"seam": "server.recv", "nth": 1, "count": "inf",
+        "action": "delay", "seconds": 0.2}]}
+
+Rule fields:
+
+* ``seam`` (required) — where the event fires.  Instrumented seams:
+  ``worker.send`` / ``worker.recv`` (``WorkerClient._rpc``, around one
+  request/reply), ``server.recv`` (``Server._serve_one``, before the
+  message is handled).
+* ``kind`` — match only this message kind (``init`` / ``push`` / ``pull``
+  / ``command`` / ``stop``); omitted = any.
+* ``rank`` / ``sid`` — match only this node rank / server index.
+* ``role`` — match only processes whose ``DMLC_ROLE`` equals this.
+* ``nth`` (default 1, 1-based) — fire on the Nth *matching* event.
+* ``count`` (default 1) — how many consecutive matches to affect after
+  ``nth``; ``"inf"`` = every one from ``nth`` on.
+* ``action`` — one of:
+
+  - ``drop``  — the message at the seam is discarded: at ``worker.send``
+    the request is never sent and at ``server.recv`` no reply is made
+    (the peer's RPC deadline fires); at ``worker.recv`` the
+    already-received reply is thrown away (the server DID apply the
+    message — the worker's resend exercises the exactly-once dedup).
+  - ``delay`` — sleep ``seconds`` (default 0.1) then proceed: slow
+    network / GC pause.
+  - ``error`` — raise ``OSError``: severed connection.
+  - ``die``   — ``os._exit(exit_code)`` (default 137, i.e. SIGKILLed):
+    the process vanishes without cleanup, exactly like a real crash.
+
+* ``seconds`` / ``exit_code`` — action parameters, see above.
+
+``seed`` makes companion randomness reproducible: when a plan is active,
+``WorkerClient`` seeds its retry-jitter RNG from it, so a fault run's
+backoff timing is identical across invocations.
+
+Counters are per-rule and ordered by each process's own execution, which
+is what makes single-worker scenarios (the CI recovery test) exactly
+reproducible; cross-process interleavings are scoped out by matching on
+``role``/``rank``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["hook", "install", "active", "seed", "FaultPlan",
+           "InjectedError"]
+
+_ACTIONS = ("drop", "delay", "error", "die")
+
+
+class InjectedError(OSError):
+    """A scheduled connection severance.  Subclasses OSError so worker
+    retry paths treat it like any transport failure; server loops
+    detect it specifically and close the connection WITHOUT an error
+    reply (a real severed socket sends nothing)."""
+
+
+class _Rule:
+    def __init__(self, spec):
+        self.seam = spec["seam"]
+        self.action = spec["action"]
+        if self.action not in _ACTIONS:
+            raise ValueError("unknown fault action %r (want one of %s)"
+                             % (self.action, "/".join(_ACTIONS)))
+        self.kind = spec.get("kind")
+        self.rank = spec.get("rank")
+        self.sid = spec.get("sid")
+        self.role = spec.get("role")
+        self.nth = int(spec.get("nth", 1))
+        count = spec.get("count", 1)
+        self.count = float("inf") if count == "inf" else int(count)
+        self.seconds = float(spec.get("seconds", 0.1))
+        self.exit_code = int(spec.get("exit_code", 137))
+        self.hits = 0
+
+    def matches(self, seam, meta):
+        if seam != self.seam:
+            return False
+        if self.kind is not None and meta.get("kind") != self.kind:
+            return False
+        if self.rank is not None and meta.get("rank") != self.rank:
+            return False
+        if self.sid is not None and meta.get("sid") != self.sid:
+            return False
+        if self.role is not None \
+                and os.environ.get("DMLC_ROLE") != self.role:
+            return False
+        return True
+
+    def fire(self):
+        """Count one matching event; return the action when it's armed."""
+        self.hits += 1
+        if self.nth <= self.hits < self.nth + self.count:
+            return self.action
+        return None
+
+
+class FaultPlan:
+    """A parsed schedule: rules + seed + deterministic counters."""
+
+    def __init__(self, spec):
+        self.seed = int(spec.get("seed", 0))
+        self.rules = [_Rule(r) for r in spec.get("rules", [])]
+        self._lock = threading.Lock()
+
+    def on_event(self, seam, meta):
+        """Advance every matching rule's counter; first armed action wins."""
+        action = None
+        rule = None
+        with self._lock:
+            for r in self.rules:
+                if r.matches(seam, meta):
+                    a = r.fire()
+                    if a is not None and action is None:
+                        action = a
+                        rule = r
+        return action, rule
+
+
+_UNSET = object()
+_plan = _UNSET
+_plan_lock = threading.Lock()
+
+
+def _load():
+    global _plan
+    with _plan_lock:
+        if _plan is not _UNSET:
+            return _plan
+        spec = os.environ.get("MXNET_FAULT_INJECT")
+        if not spec:
+            _plan = None
+        else:
+            text = spec
+            if not spec.lstrip().startswith("{"):
+                with open(spec) as f:
+                    text = f.read()
+            _plan = FaultPlan(json.loads(text))
+        return _plan
+
+
+def install(spec):
+    """Install a schedule programmatically (tests): a dict like the JSON
+    spec, an existing :class:`FaultPlan`, or ``None`` to disable.  Resets
+    all rule counters."""
+    global _plan
+    with _plan_lock:
+        if spec is None:
+            _plan = None
+        elif isinstance(spec, FaultPlan):
+            _plan = spec
+        else:
+            _plan = FaultPlan(spec)
+    return _plan
+
+
+def active():
+    """Whether a fault plan is loaded (env or install())."""
+    plan = _plan if _plan is not _UNSET else _load()
+    return plan is not None
+
+
+def seed():
+    """The active plan's seed, or None — lets companion code (retry
+    jitter) become deterministic exactly when faults are scheduled."""
+    plan = _plan if _plan is not _UNSET else _load()
+    return None if plan is None else plan.seed
+
+
+def hook(seam, **meta):
+    """Fault-point: called by instrumented seams on every message.
+
+    Returns ``None`` (proceed) or ``"drop"`` (caller must discard the
+    message); performs ``delay`` / ``error`` / ``die`` side effects
+    itself.  No-op single comparison when no plan is installed.
+    """
+    plan = _plan
+    if plan is None:
+        return None
+    if plan is _UNSET:
+        plan = _load()
+        if plan is None:
+            return None
+    action, rule = plan.on_event(seam, meta)
+    if action is None:
+        return None
+    if action == "delay":
+        time.sleep(rule.seconds)
+        return None
+    if action == "error":
+        raise InjectedError("fault injected: sever at %s (%s)"
+                            % (seam, meta.get("kind")))
+    if action == "die":
+        os._exit(rule.exit_code)
+    return "drop"

@@ -141,10 +141,12 @@ class KVStore:
     # -- optimizer state save/load (Module.save_checkpoint support) ----------
     def save_optimizer_states(self, fname):
         """Serialize the updater's optimizer state to ``fname``
-        (Module.save_checkpoint support)."""
+        (Module.save_checkpoint support); atomic like every other
+        checkpoint artifact (temp file + rename)."""
         if self._updater is None:
             raise MXNetError("updater is not initialized")
-        with open(fname, "wb") as f:
+        from .base import atomic_write
+        with atomic_write(fname, "wb") as f:
             f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
@@ -180,6 +182,10 @@ class KVStoreDist(KVStore):
         # rank0 flips servers to bulk-sync unless async
         # (reference kvstore.cc:34-42)
         if "async" not in kv_type:
+            # every worker's pushes now block on the slowest peer, so
+            # they get barrier-scale RPC deadlines (kvstore_dist
+            # WorkerClient._deadline_for)
+            self._client.sync_push = True
             if self._rank == 0 and not self._is_recovery:
                 self._client.send_command("sync_mode", b"")
             if not self._is_recovery:

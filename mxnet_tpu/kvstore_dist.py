@@ -32,21 +32,35 @@ step) lives in ``mxnet_tpu.parallel`` as XLA collectives over ICI — on a
 pod you'd use that; the PS backend exists for API/semantics parity and for
 CPU-host clusters, exactly like the reference nightly tests run it as N
 local processes (``tests/nightly/dist_sync_kvstore.py``).
+
+Fault tolerance (docs/architecture/fault_tolerance.md): node death is a
+normal event at production scale, so every worker RPC carries a deadline
+(``MXNET_KVSTORE_RPC_TIMEOUT``) with bounded exponential-backoff retries
+(``_RETRIES`` / ``_BACKOFF``), transparent reconnect that re-resolves the
+server's current address from the scheduler, and a per-endpoint circuit
+breaker; servers snapshot their store + updater state atomically to
+``MXNET_KVSTORE_SNAPSHOT_DIR`` and a restarted server restores it and
+rejoins under ``DMLC_PS_RECOVERY_RANK`` (the same rejoin protocol workers
+use).  The ``faultinject`` seams (``worker.send``/``worker.recv`` in
+``WorkerClient._rpc``, ``server.recv`` in ``Server._serve_one``) let a
+seeded schedule reproduce "server dies mid-push" deterministically on one
+CPU host.
 """
 from __future__ import annotations
 
 import os
 import pickle
+import random
 import threading
 import time
 from multiprocessing.connection import Client, Listener
 
 import numpy as np
 
-from .base import MXNetError
+from . import faultinject
+from .base import MXNetError, atomic_write, get_env
 
 _AUTHKEY = b"mxnet_tpu_ps"
-_BIGARRAY_DEFAULT = 1000000
 
 
 def _env(name, default=None):
@@ -68,6 +82,128 @@ def _connect(addr, retries=600, delay=0.1):
             last = exc
             time.sleep(delay)
     raise MXNetError("cannot connect to %s: %s" % (addr, last))
+
+
+# ---------------------------------------------------------------------------
+# Fault-tolerance policy primitives (docs/architecture/fault_tolerance.md)
+# ---------------------------------------------------------------------------
+class _RPCTimeout(Exception):
+    """A reply missed its deadline (endpoint presumed hung or dead)."""
+
+
+class MXNetConnectError(MXNetError):
+    """(Re)connecting to an endpoint failed within its bounded dial
+    budget; retryable, unlike a generic MXNetError."""
+
+
+def backoff_delay(attempt, base, cap, rng=None):
+    """Exponential backoff with equal jitter: attempt ``k`` (0-based)
+    sleeps ``d = min(cap, base * 2**k)``, jittered uniformly into
+    ``[d/2, d]`` when an ``rng`` is given (AWS "equal jitter"; keeps a
+    floor so retry storms still spread without collapsing to zero).
+    Pure function — the policy-math unit tests drive it directly."""
+    d = min(float(cap), float(base) * (2.0 ** attempt))
+    if rng is None:
+        return d
+    return d * 0.5 + d * 0.5 * rng.random()
+
+
+class RetryPolicy:
+    """Deadline + bounded-retry knobs for one worker's RPCs.
+
+    Defaults come from ``MXNET_KVSTORE_RPC_TIMEOUT`` (seconds per reply,
+    0 = wait forever), ``_RETRIES`` (attempts after the first) and
+    ``_BACKOFF`` / ``_BACKOFF_CAP`` (exponential sleep between
+    attempts).  When a fault-injection plan is active the jitter RNG is
+    seeded from the plan so scheduled-fault runs are reproducible."""
+
+    def __init__(self, timeout=None, retries=None, backoff=None, cap=None,
+                 rng=None):
+        # defaults live in base.py's env registry (single source of truth)
+        self.timeout = float(get_env("MXNET_KVSTORE_RPC_TIMEOUT")) \
+            if timeout is None else float(timeout)
+        self.retries = int(get_env("MXNET_KVSTORE_RPC_RETRIES")) \
+            if retries is None else int(retries)
+        self.backoff = float(get_env("MXNET_KVSTORE_RPC_BACKOFF")) \
+            if backoff is None else float(backoff)
+        self.cap = float(get_env("MXNET_KVSTORE_RPC_BACKOFF_CAP")) \
+            if cap is None else float(cap)
+        if rng is None:
+            fseed = faultinject.seed()
+            rng = random.Random(fseed) if fseed is not None \
+                else random.Random()
+        self.rng = rng
+
+    def delay(self, attempt):
+        return backoff_delay(attempt, self.backoff, self.cap, self.rng)
+
+
+class CircuitBreaker:
+    """Per-endpoint breaker: after ``fail_threshold`` consecutive
+    failures the endpoint is presumed dead and calls fail fast with
+    ``MXNetError`` for ``reset_after`` seconds (no more full
+    timeout+retry cycles hanging every ``_fanout`` thread); then one
+    half-open trial is let through — success re-closes, failure
+    re-opens.  Thread-safe; ``clock`` is injectable for tests."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, fail_threshold=None, reset_after=None,
+                 clock=time.monotonic):
+        self.fail_threshold = int(get_env("MXNET_KVSTORE_RPC_CB_FAILS")) \
+            if fail_threshold is None else int(fail_threshold)
+        self.reset_after = float(get_env("MXNET_KVSTORE_RPC_CB_RESET")) \
+            if reset_after is None else float(reset_after)
+        self.clock = clock
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_at = None
+        self.last_error = None
+        self._trial_inflight = False
+        self._lock = threading.Lock()
+
+    def allow(self):
+        """May a call proceed right now?  Flips OPEN->HALF_OPEN once the
+        cool-down elapsed; exactly ONE caller becomes the trial — other
+        threads keep failing fast until the trial reports back (else a
+        wide _fanout would stampede a dead endpoint every window)."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.HALF_OPEN:
+                return not self._trial_inflight
+            if self.clock() - self.opened_at >= self.reset_after:
+                self.state = self.HALF_OPEN
+                self._trial_inflight = True
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self.state = self.CLOSED
+            self.failures = 0
+            self.last_error = None
+            self._trial_inflight = False
+
+    def record_failure(self, exc=None):
+        with self._lock:
+            self.failures += 1
+            self.last_error = exc
+            if (self.state == self.HALF_OPEN
+                    or self.failures >= self.fail_threshold):
+                self.state = self.OPEN
+                self.opened_at = self.clock()
+            self._trial_inflight = False
+
+
+def _prof_record(name, start_ns, cat):
+    """Report a fault-tolerance span (retry sleep, reconnect) to the
+    engine-seam profiler when one is recording — retries show up in the
+    same Chrome trace as the ops they delay."""
+    from . import engine as _engine
+    prof = _engine.get()._profiler
+    if prof is not None:
+        prof.record(name, start_ns, time.perf_counter_ns(), cat=cat)
 
 
 def _start_heartbeat(role, rank, stop_event=None):
@@ -161,9 +297,16 @@ class Scheduler:
                         return
                     kind = msg[0]
                     if kind == "register_server":
+                        # a restarted server re-joins under its old rank
+                        # and publishes its NEW address; workers pick it
+                        # up via query_servers on reconnect
+                        recover_rank = msg[2] if len(msg) > 2 else None
                         with self.lock:
-                            rank = self.next_server
-                            self.next_server += 1
+                            if recover_rank is not None:
+                                rank = recover_rank
+                            else:
+                                rank = self.next_server
+                                self.next_server += 1
                             self.server_addrs[rank] = msg[1]
                             self._mark("server", rank)
                             self.lock.notify_all()
@@ -203,6 +346,11 @@ class Scheduler:
                         timeout = msg[2] if len(msg) > 2 else 60
                         conn.send(("num_dead",
                                    self._count_dead(mask, timeout)))
+                    elif kind == "query_servers":
+                        # current address table (recovered servers appear
+                        # here under their old rank with a new address)
+                        with self.lock:
+                            conn.send(("servers", list(self.server_addrs)))
                     elif kind == "finalize":
                         if len(msg) > 1:
                             with self.lock:
@@ -263,11 +411,127 @@ class Server:
         self.num_workers = int(_env("DMLC_NUM_WORKER", "1"))
         self.listener = Listener((_node_host(), 0), authkey=_AUTHKEY)
         self.store = {}
-        self.merge = {}          # key -> (buf, count, [pending conns])
-        self.lock = threading.Lock()
+        # sync-mode merge: key -> (buf, {rank: (seq, inc)}, {rank: conn})
+        self.merge = {}
+        # push dedup watermarks: (key, rank) -> (incarnation, last seq).
+        # One entry per (key, rank) — a new incarnation (worker restart)
+        # REPLACES its dead predecessor's entry, so the table is bounded
+        # by #keys x #ranks no matter how many times workers churn
+        self._applied_seq = {}
+        # RLock: synchronous snapshots run inside update critical sections
+        self.lock = threading.RLock()
         self.updater = None
         self.sync_mode = False
         self.stop_event = threading.Event()
+        self.rank = None
+        # -- crash durability (docs/architecture/fault_tolerance.md) --
+        self.snapshot_dir = get_env("MXNET_KVSTORE_SNAPSHOT_DIR") or None
+        self.snapshot_interval = float(
+            get_env("MXNET_KVSTORE_SNAPSHOT_INTERVAL"))
+        if self.snapshot_dir is not None:
+            os.makedirs(self.snapshot_dir, exist_ok=True)
+        self._optimizer_bytes = None   # command-0 payload, re-playable
+        self._mutations = 0            # store/updater generation counter
+        self._snapshotted = 0          # generation at last snapshot
+        # disk-side ordering: _disk_gen (guarded by _disk_lock) is the
+        # generation of the file on disk; a slower writer that captured
+        # an OLDER generation must never replace a newer file.  Lock
+        # order is always self.lock -> _disk_lock, never the reverse
+        self._disk_lock = threading.Lock()
+        self._disk_gen = 0
+
+    # -- snapshots ----------------------------------------------------------
+    def _snap_path(self):
+        return os.path.join(self.snapshot_dir,
+                            "kvserver-%d.snap" % self.rank)
+
+    def save_snapshot(self):
+        """Atomically persist store + optimizer/updater state; returns
+        True when a file was written (skipped while unchanged).  The
+        in-flight sync-mode merge buffers are deliberately NOT saved:
+        workers re-send unacknowledged pushes on reconnect, rebuilding
+        them, and the persisted (rank, incarnation, seq) watermarks
+        dedupe any resend the crash had already applied.
+
+        The store lock covers only the capture (copies), so serving
+        never blocks on disk I/O; the write itself is generation-guarded
+        by _disk_lock so concurrent writers (interval thread vs.
+        shutdown save) can never replace a newer on-disk snapshot with
+        an older one — acknowledged durability never rolls back."""
+        if self.snapshot_dir is None or self.rank is None:
+            return False
+        with self.lock:
+            if self._mutations == self._snapshotted:
+                return False
+            state = {
+                "rank": self.rank,
+                "mutations": self._mutations,
+                "store": {k: v.copy() for k, v in self.store.items()},
+                "sync_mode": self.sync_mode,
+                "optimizer": self._optimizer_bytes,
+                "updater_states": (self.updater.get_states()
+                                   if self.updater is not None else None),
+                # push dedup watermarks: a retried push from before the
+                # crash must not double-apply after restore
+                "applied_seq": dict(self._applied_seq),
+            }
+        gen = state["mutations"]
+        payload = pickle.dumps(state)   # snapshot copies: lock-free
+        wrote = False
+        with self._disk_lock:
+            if gen > self._disk_gen:
+                with atomic_write(self._snap_path(), "wb") as f:
+                    f.write(payload)
+                self._disk_gen = gen
+                wrote = True
+        if wrote:
+            with self.lock:
+                self._snapshotted = max(self._snapshotted, gen)
+        return wrote
+
+    def restore_snapshot(self):
+        """Load the last snapshot (if any) into the live store; returns
+        True on restore.  Runs before the listener accepts workers, so a
+        recovered server never serves pre-crash keys as missing."""
+        if self.snapshot_dir is None or self.rank is None:
+            return False
+        path = self._snap_path()
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        with self.lock:
+            self.store = state["store"]
+            self.sync_mode = state["sync_mode"]
+            self._applied_seq = dict(state.get("applied_seq", {}))
+            if state["optimizer"] is not None:
+                self._install_optimizer(state["optimizer"])
+                if state["updater_states"] is not None:
+                    self.updater.set_states(state["updater_states"])
+            self._mutations = state["mutations"]
+            self._snapshotted = state["mutations"]
+        with self._disk_lock:
+            self._disk_gen = state["mutations"]
+        return True
+
+    def _mutated(self):
+        """Bump the store generation; in synchronous-snapshot mode
+        (interval <= 0) persist before the caller replies, so an
+        acknowledged update is never lost to a crash."""
+        self._mutations += 1
+        if self.snapshot_dir is not None and self.snapshot_interval <= 0:
+            self.save_snapshot()
+
+    def _snapshot_loop(self):
+        import logging
+        while not self.stop_event.wait(self.snapshot_interval):
+            try:
+                self.save_snapshot()
+            except Exception:  # noqa: BLE001 — a pickling error must not
+                # silently kill the durability thread for the server's
+                # remaining life; log, keep ticking, retry next interval
+                logging.exception("kvstore server %s: snapshot failed",
+                                  self.rank)
 
     def _default_update(self, key, recved, stored):
         stored += recved
@@ -287,17 +551,43 @@ class Server:
             self._default_update(key, recved, stored)
 
     def run(self):
-        # register with scheduler
+        # register with scheduler; a restarted server re-claims its old
+        # rank (DMLC_PS_RECOVERY_RANK) so workers can re-resolve it
+        recover = _env("DMLC_PS_RECOVERY_RANK")
+        recover = int(recover) if recover is not None else None
         sched = _connect(_root_addr())
-        sched.send(("register_server", self.listener.address))
+        sched.send(("register_server", self.listener.address, recover))
         _, self.rank = sched.recv()
+        # restore BEFORE serving: in-flight pulls that retry against the
+        # rejoined server must see the recovered state, not an empty
+        # store.  Gated on the recovery rank — a FRESH job pointed at a
+        # reused snapshot dir must start empty, not inherit a previous
+        # run's store/sync-mode
+        if recover is not None:
+            self.restore_snapshot()
+        elif self.snapshot_dir is not None:
+            # fresh start: disarm any stale snapshot a previous job left
+            # in a reused dir — if we crash before our first snapshot, a
+            # recovery relaunch must restore nothing, not another run's
+            # store/optimizer
+            try:
+                os.remove(self._snap_path())
+            except OSError:
+                pass
         _start_heartbeat("server", self.rank, self.stop_event)
+        if self.snapshot_dir is not None and self.snapshot_interval > 0:
+            threading.Thread(target=self._snapshot_loop,
+                             daemon=True).start()
 
         conns = []
         accept_t = threading.Thread(target=self._accept, args=(conns,),
                                     daemon=True)
         accept_t.start()
         self.stop_event.wait()
+        try:
+            self.save_snapshot()
+        except Exception:  # noqa: BLE001 — shutdown must still finalize
+            pass
         self.listener.close()
         sched.send(("finalize", "server", self.rank))
         try:
@@ -325,6 +615,15 @@ class Server:
             try:
                 if self._serve_one(msg, conn):
                     return
+            except faultinject.InjectedError:
+                # scheduled severance: a real broken socket replies with
+                # nothing — close so the worker's deadline/retry path
+                # runs, NOT the ('err', ...) application-error path
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             except Exception as exc:  # noqa: BLE001 — a dead serve thread
                 # would hang the pushing worker forever; reply the error
                 try:
@@ -335,24 +634,47 @@ class Server:
     def _serve_one(self, msg, conn):
         """Handle one request; returns True when the server should stop."""
         kind = msg[0]
+        # fault seam: a scheduled 'die' exits HERE, before the message is
+        # applied — the acknowledged prefix is exactly what the snapshot
+        # holds, so a resend after recovery applies it exactly once
+        if faultinject.hook("server.recv", kind=kind,
+                            rank=self.rank) == "drop":
+            return False  # no reply: the worker's RPC deadline fires
         if kind == "init":
             _, key, arr = msg
             with self.lock:
                 self.store[key] = np.array(arr, dtype=np.float32)
+                self._mutated()
             conn.send(("ok",))
         elif kind == "push":
-            _, key, arr = msg
+            # (push, key, arr, rank, seq, inc): rank+seq+incarnation let
+            # the server dedupe a retried push whose reply (not the push)
+            # was lost — pushes are exactly-once under timeout+resend.
+            # The incarnation token scopes the watermark to one worker
+            # process lifetime, so a DMLC_PS_RECOVERY_RANK replacement
+            # starting its counter over is never falsely deduped against
+            # its dead predecessor.  Bare 3-tuples (direct callers) skip
+            # dedup.
+            _, key, arr = msg[:3]
+            rank = msg[3] if len(msg) > 3 else None
+            seq = msg[4] if len(msg) > 4 else None
+            inc = msg[5] if len(msg) > 5 else None
             with self.lock:
                 known = key in self.store
             if not known:
                 conn.send(("err", "key %r has not been initialized"
                            % (key,)))
             else:
-                self._handle_push(key, arr, conn)
+                self._handle_push(key, arr, conn, rank, seq, inc)
         elif kind == "pull":
             _, key = msg
             with self.lock:
                 val = self.store.get(key)
+                # copy under the lock: the live array is mutated in
+                # place by concurrent pushes, and serialization outside
+                # the lock would otherwise send a torn value
+                if val is not None:
+                    val = val.copy()
             if val is None:
                 conn.send(("err", "key %r has not been initialized"
                            % (key,)))
@@ -368,52 +690,106 @@ class Server:
             return True
         return False
 
-    def _handle_push(self, key, arr, conn):
+    def _already_applied(self, key, rank, seq, inc):
+        if seq is None:
+            return False
+        entry = self._applied_seq.get((key, rank))
+        return (entry is not None and entry[0] == inc
+                and seq <= entry[1])
+
+    def _handle_push(self, key, arr, conn, rank=None, seq=None, inc=None):
         arr = np.asarray(arr, dtype=np.float32)
         if not self.sync_mode:
             with self.lock:
+                if self._already_applied(key, rank, seq, inc):
+                    # retried push whose ack was lost: don't re-apply
+                    conn.send(("ok",))
+                    return
                 self._do_update(key, arr)
+                if seq is not None:
+                    self._applied_seq[(key, rank)] = (inc, seq)
+                self._mutated()
             conn.send(("ok",))
             return
         # bulk-synchronous: merge; Nth worker push triggers one updater run
-        # and releases everyone (kvstore_dist_server.h:179-198)
+        # and releases everyone (kvstore_dist_server.h:179-198).  contrib
+        # maps rank -> (seq, inc) so a resend within an open round
+        # refreshes the worker's release channel without double-counting
+        # its gradient
         with self.lock:
-            buf, cnt, pending = self.merge.get(key, (None, 0, []))
-            buf = arr if buf is None else buf + arr
-            pending.append(conn)
-            cnt += 1
-            if cnt == self.num_workers:
-                self._do_update(key, buf)
-                for c in pending:
-                    c.send(("ok",))
-                self.merge[key] = (None, 0, [])
+            if self._already_applied(key, rank, seq, inc):
+                conn.send(("ok",))
+                return
+            buf, contrib, pending = self.merge.get(key, (None, {}, {}))
+            slot = rank if rank is not None else len(contrib)
+            if slot in contrib:
+                pending[slot] = conn   # duplicate resend: refresh only
             else:
-                self.merge[key] = (buf, cnt, pending)
+                buf = arr if buf is None else buf + arr
+                contrib[slot] = (seq, inc)
+                pending[slot] = conn
+            if len(contrib) == self.num_workers:
+                self._do_update(key, buf)
+                for r, (s, i) in contrib.items():
+                    if s is not None:
+                        self._applied_seq[(key, r)] = (i, s)
+                self._mutated()
+                for c in pending.values():
+                    try:
+                        c.send(("ok",))
+                    except (EOFError, OSError):
+                        pass   # that worker timed out: it will resend
+                self.merge.pop(key, None)
+            else:
+                self.merge[key] = (buf, contrib, pending)
+
+    def _install_optimizer(self, body):
+        from . import optimizer as opt
+        optimizer = pickle.loads(body)
+        self._optimizer_bytes = body
+        self.updater = opt.get_updater(optimizer)
 
     def _handle_command(self, head, body):
         """Command 0 carries a pickled optimizer (reference controller at
         kvstore_dist_server.h:87-115); 'sync_mode' flips bulk-sync on."""
         if head == 0:
-            from . import optimizer as opt
-            optimizer = pickle.loads(body)
-            self.updater = opt.get_updater(optimizer)
+            with self.lock:
+                self._install_optimizer(body)
+                self._mutated()
         elif head == "sync_mode":
-            self.sync_mode = True
+            with self.lock:
+                self.sync_mode = True
+                self._mutated()
 
 
 # ---------------------------------------------------------------------------
 # Worker client
 # ---------------------------------------------------------------------------
 class WorkerClient:
-    """ps::KVWorker: key sharding + push/pull to all servers."""
+    """ps::KVWorker: key sharding + push/pull to all servers.
+
+    Every server RPC runs under a deadline with bounded, backed-off
+    retries and transparent reconnect (re-resolving the server's
+    current address from the scheduler, so a server restarted under
+    ``DMLC_PS_RECOVERY_RANK`` is found at its new port); a per-endpoint
+    circuit breaker turns a permanently dead server into a fast, clear
+    ``MXNetError`` instead of a hung ``_fanout`` thread.  See
+    ``docs/architecture/fault_tolerance.md``."""
 
     def __init__(self):
         self.sched = _connect(_root_addr())
         self.sched_lock = threading.Lock()
+        # dedicated scheduler connection for liveness probes + address
+        # refresh: these must NOT queue behind a barrier blocking the
+        # main connection for minutes (lazy; guarded by _probe_lock)
+        self._probe_conn = None
+        self._probe_lock = threading.Lock()
         # a restarted worker re-joins under its old rank
-        # (ps::Postoffice::is_recovery; kvstore_dist.h:39,77,178)
+        # (ps::Postoffice::is_recovery; kvstore_dist.h:39,77,178).
+        # DMLC_PS_RECOVERY_RANK is role-scoped: on a server process it
+        # means the SERVER's rank (kvstore.create defaults role=worker)
         recover = _env("DMLC_PS_RECOVERY_RANK")
-        self.is_recovery = recover is not None
+        self.is_recovery = recover is not None and role() in ("worker", "")
         if self.is_recovery:
             self.sched.send(("register_worker", int(recover)))
         else:
@@ -423,8 +799,21 @@ class WorkerClient:
         self.server_addrs = msg[2]
         self.servers = [_connect(a) for a in self.server_addrs]
         self.server_locks = [threading.Lock() for _ in self.servers]
-        self.bigarray_bound = int(_env("MXNET_KVSTORE_BIGARRAY_BOUND",
-                                       str(_BIGARRAY_DEFAULT)))
+        self.policy = RetryPolicy()
+        self.breakers = [CircuitBreaker() for _ in self.servers]
+        # flipped by KVStoreDist for dist_sync: pushes then wait with
+        # barrier-scale patience (see _deadline_for)
+        self.sync_push = False
+        self.bigarray_bound = int(get_env("MXNET_KVSTORE_BIGARRAY_BOUND"))
+        # per-key push sequence: servers dedupe retried pushes by
+        # (rank, incarnation, seq) so resend-after-timeout is
+        # exactly-once.  The incarnation token is unique per worker
+        # process lifetime: a recovery replacement restarting its
+        # counter is never matched against its predecessor's watermarks
+        self._push_seq = {}
+        self._push_seq_lock = threading.Lock()
+        self._incarnation = "%d-%08x" % (os.getpid(),
+                                         random.getrandbits(32))
         self._hb_stop = threading.Event()
         _start_heartbeat("worker", self.rank, self._hb_stop)
 
@@ -454,8 +843,129 @@ class WorkerClient:
 
     def _rpc(self, sid, msg):
         with self.server_locks[sid]:
-            self.servers[sid].send(msg)
-            return self.servers[sid].recv()
+            return self._rpc_locked(sid, msg)
+
+    def _rpc_locked(self, sid, msg):
+        """One server RPC under the retry policy: deadline per attempt,
+        exponential backoff + jitter between attempts, reconnect through
+        the scheduler's current address table, circuit-breaker fail-fast
+        once the endpoint is presumed permanently dead."""
+        policy, breaker = self.policy, self.breakers[sid]
+        attempts = policy.retries + 1
+        last = None
+        for attempt in range(attempts):
+            if not breaker.allow():
+                raise MXNetError(
+                    "server %d circuit breaker open after %d consecutive "
+                    "failures (last: %r); endpoint presumed dead — next "
+                    "probe in <= %.1fs" % (sid, breaker.failures,
+                                           breaker.last_error,
+                                           breaker.reset_after))
+            try:
+                r = self._rpc_once(sid, msg)
+                breaker.record_success()
+                return r
+            except (EOFError, OSError, _RPCTimeout, MXNetConnectError) \
+                    as exc:
+                last = exc
+                breaker.record_failure(exc)
+                self._invalidate(sid)
+                if attempt + 1 < attempts:
+                    t0 = time.perf_counter_ns()
+                    time.sleep(policy.delay(attempt))
+                    _prof_record("kvstore_rpc_retry[s%d:%s#%d]"
+                                 % (sid, msg[0], attempt + 1),
+                                 t0, cat="rpc_retry")
+        raise MXNetError(
+            "rpc %r to server %d failed after %d attempts "
+            "(timeout=%.1fs): %r" % (msg[0], sid, attempts,
+                                     policy.timeout, last))
+
+    def _rpc_once(self, sid, msg):
+        conn = self.servers[sid]
+        if conn is None:
+            self._reconnect(sid)
+            conn = self.servers[sid]
+        if faultinject.hook("worker.send", sid=sid, kind=msg[0],
+                            rank=self.rank) != "drop":
+            conn.send(msg)
+        # deadline on the reply, not just the connect: a hung or dead
+        # server must not block a _fanout thread forever (timeout 0 =
+        # wait forever, the pre-fault-tolerance behavior)
+        timeout = self._deadline_for(msg[0])
+        if timeout > 0 and not conn.poll(timeout):
+            raise _RPCTimeout("no reply from server %d within %.1fs"
+                              % (sid, timeout))
+        r = conn.recv()
+        if faultinject.hook("worker.recv", sid=sid, kind=msg[0],
+                            rank=self.rank) == "drop":
+            # lost-reply simulation: the server DID process the message;
+            # the resend exercises the exactly-once dedup path
+            raise _RPCTimeout("fault injected: reply from server %d "
+                              "dropped" % sid)
+        return r
+
+    def _deadline_for(self, kind):
+        """Per-message deadline.  A dist_sync push legitimately blocks
+        until EVERY worker reaches the merge round, so it gets
+        barrier-scale patience (a straggler peer is not a dead server);
+        everything else answers within the plain RPC timeout."""
+        t = self.policy.timeout
+        if t > 0 and kind == "push" and self.sync_push:
+            t = max(t, float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT")))
+        return t
+
+    def _invalidate(self, sid):
+        conn = self.servers[sid]
+        self.servers[sid] = None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reconnect(self, sid):
+        """Re-resolve server sid's address from the scheduler (it may
+        have restarted elsewhere under a recovery rank) and dial it.
+        Bounded: failures surface as MXNetConnectError and count as one
+        retry attempt in _rpc_locked."""
+        t0 = time.perf_counter_ns()
+        try:
+            r = self._sched_probe(("query_servers",))
+            addr = r[1][sid]
+            if addr is not None:
+                self.server_addrs[sid] = addr
+        except (EOFError, OSError, IndexError, _RPCTimeout, MXNetError):
+            pass  # scheduler busy/unreachable: dial the last-known addr
+        try:
+            self.servers[sid] = _connect(self.server_addrs[sid],
+                                         retries=20, delay=0.1)
+        except MXNetError as exc:
+            raise MXNetConnectError(str(exc)) from exc
+        _prof_record("kvstore_rpc_reconnect[s%d]" % sid, t0,
+                     cat="rpc_reconnect")
+
+    def _sched_probe(self, msg):
+        """Send one request on the dedicated probe connection (liveness
+        counts, server address refresh).  Independent of sched_lock so a
+        barrier parked on the main connection cannot stall it."""
+        with self._probe_lock:
+            if self._probe_conn is None:
+                self._probe_conn = _connect(_root_addr(), retries=50)
+            try:
+                self._probe_conn.send(msg)
+                if self.policy.timeout > 0 and not self._probe_conn.poll(
+                        self.policy.timeout):
+                    raise _RPCTimeout("scheduler probe %r timed out"
+                                      % (msg[0],))
+                return self._probe_conn.recv()
+            except (EOFError, OSError, _RPCTimeout):
+                try:
+                    self._probe_conn.close()
+                except OSError:
+                    pass
+                self._probe_conn = None
+                raise
 
     def init(self, key, flat):
         for sid, subkey, lo, hi in self._shard(key, flat.size):
@@ -464,9 +974,12 @@ class WorkerClient:
                 raise MXNetError(str(r))
 
     def _fanout(self, shards, fn):
-        """Run fn(shard) per shard in parallel; re-raise the first failure
-        in the caller (a daemon-thread exception must not be silently
-        dropped — a missing range would otherwise train on garbage)."""
+        """Run fn(shard) per shard in parallel; surface EVERY failure in
+        the caller (a daemon-thread exception must not be silently
+        dropped — a missing range would otherwise train on garbage).  A
+        multi-shard failure raises one MXNetError naming each failed
+        server/shard, so a two-server outage is diagnosable from the
+        message instead of looking like a single bad endpoint."""
         if len(shards) == 1:
             return fn(shards[0])
         errs = []
@@ -475,20 +988,32 @@ class WorkerClient:
             try:
                 fn(s)
             except BaseException as exc:  # noqa: BLE001 - re-raised below
-                errs.append(exc)
+                errs.append((s, exc))
 
         ts = [threading.Thread(target=run, args=(s,)) for s in shards]
         for t in ts:
             t.start()
         for t in ts:
             t.join()
-        if errs:
-            raise errs[0]
+        if not errs:
+            return
+        if len(errs) == 1:
+            raise errs[0][1]
+        detail = "; ".join(
+            "server %d (subkey %r [%d:%d]): %s" % (s[0], s[1], s[2], s[3], e)
+            for s, e in errs)
+        raise MXNetError("%d of %d shards failed — %s"
+                         % (len(errs), len(shards), detail))
 
     def push(self, key, flat):
+        with self._push_seq_lock:
+            seq = self._push_seq.get(key, 0) + 1
+            self._push_seq[key] = seq
+
         def one(shard):
             sid, subkey, lo, hi = shard
-            r = self._rpc(sid, ("push", subkey, flat[lo:hi]))
+            r = self._rpc(sid, ("push", subkey, flat[lo:hi],
+                                self.rank, seq, self._incarnation))
             if r[0] != "ok":
                 raise MXNetError(str(r))
 
@@ -521,7 +1046,7 @@ class WorkerClient:
         seconds, default 600) instead of hanging forever when a peer died
         before reaching it."""
         if timeout is None:
-            timeout = float(_env("MXNET_KVSTORE_BARRIER_TIMEOUT", "600"))
+            timeout = float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT"))
         with self.sched_lock:
             self.sched.send(("barrier",))
             if not self.sched.poll(timeout):
@@ -532,10 +1057,14 @@ class WorkerClient:
     def get_num_dead_node(self, node_id=0, timeout=60):
         """Count of dead nodes in the ps-lite group mask ``node_id``
         (2=servers, 4=workers, 0=all), judged by heartbeat age >
-        ``timeout`` seconds (reference kvstore_dist.h:159-168)."""
-        with self.sched_lock:
-            self.sched.send(("num_dead", node_id, timeout))
-            return self.sched.recv()[1]
+        ``timeout`` seconds (reference kvstore_dist.h:159-168).  Runs on
+        the dedicated probe connection: a barrier parked on the main
+        scheduler connection (up to the full barrier timeout) must never
+        queue a liveness probe behind it."""
+        try:
+            return self._sched_probe(("num_dead", node_id, timeout))[1]
+        except _RPCTimeout as exc:
+            raise MXNetError(str(exc)) from exc
 
     def finalize(self, is_root):
         """rank0 stops the servers (reference kStopServer, kvstore_dist.h:47-59)."""
@@ -544,8 +1073,8 @@ class WorkerClient:
             for sid in range(self.num_servers):
                 try:
                     self._rpc(sid, ("stop",))
-                except (EOFError, OSError):
-                    pass
+                except (EOFError, OSError, MXNetError):
+                    pass  # dead server / open breaker: nothing to stop
         with self.sched_lock:
             try:
                 self.sched.send(("finalize", "worker", self.rank))
@@ -553,8 +1082,16 @@ class WorkerClient:
             except (EOFError, OSError):
                 pass
             self.sched.close()
+        with self._probe_lock:
+            if self._probe_conn is not None:
+                try:
+                    self._probe_conn.close()
+                except OSError:
+                    pass
+                self._probe_conn = None
         for s in self.servers:
-            s.close()
+            if s is not None:
+                s.close()
 
 
 def role():

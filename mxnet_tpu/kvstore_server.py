@@ -17,7 +17,15 @@ __all__ = ["KVStoreServer"]
 
 
 class KVStoreServer:
-    """The key-value store server (reference kvstore_server.py:10-55)."""
+    """The key-value store server (reference kvstore_server.py:10-55).
+
+    Fault tolerance: when ``MXNET_KVSTORE_SNAPSHOT_DIR`` is set the run
+    loop periodically snapshots the key->value store and the unpickled
+    optimizer's updater state; relaunching the same command with
+    ``DMLC_PS_RECOVERY_RANK=<rank>`` restores the snapshot and rejoins
+    the group under the old rank, publishing the new address through the
+    scheduler so workers' in-flight RPCs reconnect and retry against the
+    recovered state (docs/architecture/fault_tolerance.md)."""
 
     def __init__(self, kvstore=None):
         self.kvstore = kvstore

@@ -80,7 +80,12 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save prefix-symbol.json + prefix-NNNN.params (reference format)."""
+    """Save prefix-symbol.json + prefix-NNNN.params (reference format).
+
+    Both files are written atomically (temp file + rename, see
+    ``base.atomic_write``): a crash mid-save leaves the previous epoch's
+    checkpoint intact, never a truncated one — pair with
+    ``load_latest_checkpoint`` for crash-safe auto-resume."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
@@ -107,6 +112,43 @@ def load_checkpoint(prefix, epoch):
         else:
             raise ValueError("Invalid param file")
     return (symbol, arg_params, aux_params)
+
+
+def latest_checkpoint(prefix):
+    """Largest epoch N for which ``prefix-NNNN.params`` (or its ``.npz``
+    twin) exists, or None — the discovery half of crash-safe
+    auto-resume.  Atomic saves guarantee any file found here is a
+    complete checkpoint, never a torn write."""
+    import os
+    import re
+    dirname = os.path.dirname(os.path.abspath(prefix))
+    # {4,}: %04d zero-pads to at least 4 digits but epoch >= 10000
+    # renders wider — those checkpoints must not become invisible
+    pat = re.compile(re.escape(os.path.basename(prefix))
+                     + r"-([0-9]{4,})\.params(\.npz)?$")
+    best = None
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return None
+    for name in names:
+        m = pat.match(name)
+        if m:
+            epoch = int(m.group(1))
+            if best is None or epoch > best:
+                best = epoch
+    return best
+
+
+def load_latest_checkpoint(prefix):
+    """Auto-resume helper: load the newest checkpoint saved under
+    ``prefix``.  Returns ``(symbol, arg_params, aux_params, epoch)``, or
+    None when no checkpoint exists yet (start fresh)."""
+    epoch = latest_checkpoint(prefix)
+    if epoch is None:
+        return None
+    symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+    return (symbol, arg_params, aux_params, epoch)
 
 
 class FeedForward(BASE_ESTIMATOR):

@@ -116,6 +116,19 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
+    @staticmethod
+    def load_latest(prefix, load_optimizer_states=False, **kwargs):
+        """Auto-resume: load the newest epoch checkpointed under
+        ``prefix``.  Returns ``(module, epoch)``, or None when no
+        checkpoint exists yet — the caller starts training from epoch 0
+        in that case."""
+        from ..model import latest_checkpoint
+        epoch = latest_checkpoint(prefix)
+        if epoch is None:
+            return None
+        return (Module.load(prefix, epoch, load_optimizer_states,
+                            **kwargs), epoch)
+
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         """Write ``prefix-symbol.json`` + ``prefix-NNNN.params`` (and
         ``.states`` when asked) — the reference checkpoint format."""
@@ -153,7 +166,10 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         """Pickle the optimizer state (momentum etc.) to ``fname``;
-        layout matches update_on_kvstore (shared state per param)."""
+        layout matches update_on_kvstore (shared state per param).
+        Writes are atomic (temp file + rename) so a crash mid-save never
+        corrupts the previous states file."""
+        from ..base import atomic_write
         assert self.optimizer_initialized
         if self._fused is not None:
             # Updater.states pickle keyed by plain param index — the
@@ -164,12 +180,12 @@ class Module(BaseModule):
             from ..optimizer import _state_to_host
             states = {i: _state_to_host(v) for i, v in
                       self._fused.get_updater_states().items()}
-            with open(fname, "wb") as fout:
+            with atomic_write(fname, "wb") as fout:
                 fout.write(pickle.dumps(states))
         elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
+            with atomic_write(fname, "wb") as fout:
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

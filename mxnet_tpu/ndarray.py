@@ -613,8 +613,15 @@ def save(fname, data):
         fmt = "list"
     else:
         raise MXNetError("save requires NDArray, list or dict")
-    _async_save(path, lambda: np.savez(
-        path, __mx_format__=np.array(fmt), **arrays))
+
+    def _write():
+        # crash-safe: savez into a temp handle, fsync, rename — a crash
+        # mid-save never corrupts the last good checkpoint at `path`
+        from .base import atomic_write
+        with atomic_write(path, "wb") as f:
+            np.savez(f, __mx_format__=np.array(fmt), **arrays)
+
+    _async_save(path, _write)
 
 
 def load(fname):

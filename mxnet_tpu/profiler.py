@@ -33,7 +33,10 @@ class Profiler:
     def record(self, name, start_ns, end_ns, cat="operator"):
         """Record one span.  ``cat`` tags the dispatch kind: "operator"
         (eager engine seam), "cache_hit" / "compile" (cached-op JIT
-        dispatch, cached_op.py), "backward" (tape replay)."""
+        dispatch, cached_op.py), "backward" (tape replay), "rpc_retry" /
+        "rpc_reconnect" (dist-kvstore fault-tolerance events,
+        kvstore_dist.py — the backoff sleeps and redials taken when a
+        parameter server misses its RPC deadline)."""
         with self._lock:
             self.records.append((name, start_ns, end_ns,
                                  threading.get_ident(), cat))

@@ -347,8 +347,10 @@ class Symbol:
                            "attrs": {"mxnet_tpu_version": "0.1"}}, indent=2)
 
     def save(self, fname):
-        """Write ``tojson()`` to a file (pair of ``symbol.load``)."""
-        with open(fname, "w") as f:
+        """Write ``tojson()`` to a file (pair of ``symbol.load``);
+        atomic, so a crash mid-save leaves any previous file intact."""
+        from .base import atomic_write
+        with atomic_write(fname, "w") as f:
             f.write(self.tojson())
 
     # -- binding ------------------------------------------------------------

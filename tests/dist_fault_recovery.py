@@ -1,0 +1,51 @@
+"""Worker script for the seeded fault-injection recovery test.
+
+Scenario (ISSUE 2 acceptance; docs/architecture/fault_tolerance.md):
+
+* one worker pushes ``N_PUSH`` gradients of ones to one server
+  (``dist_async``) and prints the final pulled value;
+* in the FAULT run the server carries a seeded schedule
+  (``MXNET_FAULT_INJECT``: die on the 4th push, *before* applying it)
+  and synchronous snapshots (``MXNET_KVSTORE_SNAPSHOT_INTERVAL=0``) —
+  it SIGKILL-exits mid-push with exactly 3 pushes persisted;
+* the worker's push #4 misses its RPC deadline, backs off, and keeps
+  reconnecting through the scheduler's address table;
+* the harness relaunches the server with ``DMLC_PS_RECOVERY_RANK=0``:
+  it restores the snapshot, re-registers under rank 0 at a new port,
+  and the worker's retried push lands exactly once;
+* the FINAL line must be byte-identical to the no-fault run's.
+
+The same script serves every role: scheduler/server processes block and
+exit inside ``create_kvstore`` (kvstore_server role hijack).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402  (server roles block+exit inside)
+
+SHAPE = (6,)
+N_PUSH = 10
+KEY = 7
+
+
+def main():
+    kv = mx.create_kvstore("dist_async")
+    print("RANK", kv.rank, flush=True)
+    kv.init(KEY, mx.nd.zeros(SHAPE))
+    for _ in range(N_PUSH):
+        kv.push(KEY, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(KEY, out)
+    print("FINAL", " ".join("%.6f" % v for v in out.asnumpy()),
+          flush=True)
+    kv.close()
+
+
+if __name__ == "__main__":
+    main()
