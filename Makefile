@@ -21,12 +21,16 @@ build:
 dist:
 	$(PY) -m pytest tests/ -m dist -q
 
-# seeded fault-injection recovery scenario (server SIGKILLed mid-push,
-# snapshot restore, worker retry/reconnect) under a hard timeout so a
-# kvstore robustness regression fails fast instead of hanging CI
+# seeded fault-injection recovery scenarios (server SIGKILLed mid-push,
+# snapshot restore, worker retry/reconnect — plain AND with the
+# compressed+bucketed data plane enabled) plus the bytes-on-wire
+# assertion (2bit pushes <= 1/8 of fp32 payload on the same schedule),
+# under a hard timeout so a kvstore robustness regression fails fast
+# instead of hanging CI
 dist-smoke:
-	timeout -k 10 240 env JAX_PLATFORMS=cpu \
-		$(PY) -m pytest tests/test_fault_tolerance.py -q -k seeded
+	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/test_fault_tolerance.py -q \
+		-k "seeded or wire_bytes"
 
 convergence:
 	$(PY) -m pytest tests/ -m convergence -q

@@ -153,6 +153,31 @@ register_env("MXNET_KVSTORE_SNAPSHOT_INTERVAL", float, 5.0,
              "Seconds between server snapshot writes (skipped when "
              "nothing changed); <= 0 snapshots synchronously after "
              "every mutation, before the push reply is sent.")
+register_env("MXNET_KVSTORE_BUCKET_BYTES", int, 4 * 1024 * 1024,
+             "Capacity (bytes) of one dist-kvstore fusion bucket: small "
+             "parameters are coalesced in init order into buckets of at "
+             "most this many fp32 payload bytes, and one push/pull RPC "
+             "carries a whole bucket (kvstore_codec.BucketPlan).")
+register_env("MXNET_KVSTORE_PIPELINE", bool, True,
+             "Route dist-kvstore push/pull through the asynchronous "
+             "priority pipeline (bounded in-flight window, bucket "
+             "coalescing, lazy pull resolution at the next forward).  "
+             "'0' restores the blocking per-parameter push-then-pull "
+             "round trips.")
+register_env("MXNET_KVSTORE_INFLIGHT", int, 4,
+             "Max in-flight wire operations of the dist-kvstore "
+             "pipeline (its worker-thread window).  Higher overlaps "
+             "more RPC latency at the cost of more queued gradient "
+             "memory.")
+register_env("MXNET_KVSTORE_CONNS_PER_SERVER", int, 4,
+             "Pooled connections each dist-kvstore worker keeps per "
+             "server (multiprocessing.Connection is one-request-at-a-"
+             "time, so the pipeline needs one connection per concurrent "
+             "RPC to the same server).")
+register_env("MXNET_KVSTORE_COMPRESS_LOWER_BOUND", int, 16,
+             "Minimum elements before an enabled gradient compression "
+             "applies to a key's pushes; smaller keys (and any non-fp32 "
+             "payload: indices, aux state) stay lossless.")
 register_env("MXNET_FAULT_INJECT", str, "",
              "Deterministic fault-injection schedule for the dist "
              "kvstore: inline JSON or a path to a JSON file (see "

@@ -56,6 +56,8 @@ class KVStore:
         self._store = {}
         self._updater = None
         self._optimizer = None
+        self._gc = None   # GradientCompression (set_gradient_compression)
+        self._pending_residuals = None   # loaded before compression set
         # multi-host topology via jax.distributed when initialized
         import jax
         self._rank = jax.process_index() if "dist" in kv_type else 0
@@ -72,32 +74,96 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         """Push value(s) for key(s); a list-of-lists is summed across
-        devices first, then handed to the updater (or accumulated)."""
-        keys, values = self._normalize(key, value)
-        for k, v in zip(keys, values):
+        devices first, then handed to the updater (or accumulated).
+
+        ``priority`` orders communication: numerically larger values
+        run first (model.py pushes ``priority=-index`` so first-layer
+        parameters, which the next forward needs first, jump the
+        queue).  The local store executes synchronously, so honoring
+        it means processing a multi-key call in priority order — the
+        same per-key order the dist backend's async pipeline
+        schedules; a scalar priority keeps issue order."""
+        for k, v, _ in self._by_priority(*self._normalize(key, value),
+                                         priority=priority):
             vals = v if isinstance(v, (list, tuple)) else [v]
             merged = _ctx_group_sum(list(vals))
             if k not in self._store:
                 raise MXNetError("key %r has not been initialized" % k)
+            merged = self._maybe_compress(k, merged)
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
                 # reference default updater: accumulate
                 self._store[k] += merged
 
+    def _maybe_compress(self, key, merged):
+        """Apply 2-bit gradient compression (with this store's
+        error-feedback residual) to one merged gradient when the key
+        negotiates it — the local store runs the same lossy-gradient
+        semantics the dist wire does, so compressed-SGD behavior is
+        testable in-process."""
+        if self._gc is None:
+            return merged
+        import numpy as np
+        orig_dtype = np.dtype(str(merged.dtype))
+        flat = np.asarray(merged.asnumpy(), dtype=np.float32).reshape(-1)
+        if not self._gc.negotiate(key, flat, orig_dtype):
+            return merged
+        cg = self._gc.compress(key, flat)
+        return nd.array(cg.dequantize().reshape(merged.shape))
+
     def pull(self, key, out=None, priority=0):
-        """Copy the stored value of key(s) into ``out`` array(s)."""
-        keys, outs = self._normalize(key, out)
-        for k, o in zip(keys, outs):
+        """Copy the stored value of key(s) into ``out`` array(s), in
+        priority order (see ``push``)."""
+        for k, o, _ in self._by_priority(*self._normalize(key, out),
+                                         priority=priority):
             targets = o if isinstance(o, (list, tuple)) else [o]
             src = self._store[k]
             for t in targets:
                 src.copyto(t)
 
+    def flush(self, *_, **__):
+        """Wait for outstanding asynchronous communication.  The local
+        store is synchronous — no-op; the dist backend drains its
+        pipeline (lazy pulls resolve here, called automatically before
+        the next forward binds the parameters)."""
+
+    def set_gradient_compression(self, compression_params):
+        """Enable lossy gradient compression for pushes
+        (``{'type': '2bit', 'threshold': t}``; ``{'type': 'none'}``
+        disables).  Quantization error is carried per worker in
+        error-feedback residuals; compression is negotiated per key —
+        small keys and non-fp32 payloads (indices, aux state), plus
+        every ``init``/``pull`` (weights), stay lossless.  All workers
+        of a dist group must configure identical parameters."""
+        from .kvstore_codec import GradientCompression
+        gc = GradientCompression(compression_params)
+        self._gc = gc if gc.active else None
+        if self._gc is not None and self._pending_residuals is not None:
+            # load_optimizer_states ran before compression was enabled:
+            # hand the checkpointed residuals over now so the resumed
+            # stream continues exactly
+            self._gc.set_residuals(self._pending_residuals)
+            self._pending_residuals = None
+
     def _normalize(self, key, value):
         if isinstance(key, (int, str)):
             return [key], [value]
         return list(key), list(value)
+
+    def _by_priority(self, keys, values, priority=0):
+        """(key, value, priority) triples of one call, highest priority
+        first (stable).  A scalar priority applies to every key and
+        preserves issue order."""
+        if isinstance(priority, (list, tuple)):
+            prios = list(priority)
+            if len(prios) != len(keys):
+                raise MXNetError("got %d priorities for %d keys"
+                                 % (len(prios), len(keys)))
+        else:
+            return [(k, v, priority) for k, v in zip(keys, values)]
+        order = sorted(range(len(keys)), key=lambda i: -prios[i])
+        return [(keys[i], values[i], prios[i]) for i in order]
 
     # -- updater / optimizer ------------------------------------------------
     def set_updater(self, updater):
@@ -142,19 +208,43 @@ class KVStore:
     def save_optimizer_states(self, fname):
         """Serialize the updater's optimizer state to ``fname``
         (Module.save_checkpoint support); atomic like every other
-        checkpoint artifact (temp file + rename)."""
+        checkpoint artifact (temp file + rename).  When gradient
+        compression is active its error-feedback residuals ride along —
+        they are optimizer-adjacent state a resumed run needs for exact
+        continuation."""
         if self._updater is None:
             raise MXNetError("updater is not initialized")
+        payload = self._updater.get_states()
+        if self._gc is not None and self._gc.residuals:
+            payload = pickle.dumps({"__kvstore_states__": 2,
+                                    "updater": payload,
+                                    "residuals": self._gc.get_residuals()})
         from .base import atomic_write
         with atomic_write(fname, "wb") as f:
-            f.write(self._updater.get_states())
+            f.write(payload)
 
     def load_optimizer_states(self, fname):
-        """Restore state written by ``save_optimizer_states``."""
+        """Restore state written by ``save_optimizer_states`` (either
+        the bare updater pickle or the residual-carrying envelope)."""
         if self._updater is None:
             raise MXNetError("updater is not initialized")
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            data = f.read()
+        try:
+            obj = pickle.loads(data)
+        except Exception:  # noqa: BLE001 — not a pickle: legacy payload
+            obj = None
+        if isinstance(obj, dict) and obj.get("__kvstore_states__") == 2:
+            self._updater.set_states(obj["updater"])
+            if self._gc is not None:
+                self._gc.set_residuals(obj["residuals"])
+            else:
+                # compression not (yet) configured: stash the residuals
+                # so a later set_gradient_compression resumes exactly
+                # instead of silently dropping checkpointed state
+                self._pending_residuals = obj["residuals"]
+        else:
+            self._updater.set_states(data)
 
     def _send_command_to_servers(self, head, body):
         """Reference ps-lite command channel; in-process no-op kept for API
@@ -164,17 +254,39 @@ class KVStore:
 class KVStoreDist(KVStore):
     """Worker-side distributed kvstore over the parameter-server backend
     (reference KVStoreDist, src/kvstore/kvstore_dist.h; transport/server in
-    mxnet_tpu/kvstore_dist.py)."""
+    mxnet_tpu/kvstore_dist.py).
+
+    Data plane (docs/architecture/kvstore_comm.md): small keys are
+    coalesced into fusion buckets at init (one ``push_multi`` /
+    ``pull_multi`` RPC per bucket), pushes may be 2-bit compressed with
+    per-worker error feedback (``set_gradient_compression``), and —
+    unless ``MXNET_KVSTORE_PIPELINE=0`` — push/pull are *asynchronous*:
+    they enqueue into a bounded, priority-ordered in-flight window
+    (``kvstore_pipeline``) and resolve at the next ``flush()`` (Module
+    flushes before every forward, so pulls land lazily at the next
+    bind).  Per-key ordering is preserved, so the PR-2 retry/dedup
+    exactly-once guarantees hold unchanged under the pipeline."""
 
     def __init__(self, kv_type):
         super().__init__(kv_type)
         import os
+        from . import kvstore_codec as codec
         from . import kvstore_dist as ksd
+        from .base import get_env
         self._client = ksd.WorkerClient()
         self._rank = self._client.rank
         self._size = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._shapes = {}
         self._closed = False
+        self._plan = codec.BucketPlan()
+        self._client.plan = self._plan
+        self._pipeline = None
+        if get_env("MXNET_KVSTORE_PIPELINE"):
+            from .kvstore_pipeline import CommPipeline
+            self._pipeline = CommPipeline(
+                self._run_batch,
+                recorder=lambda name, t0, cat: ksd._prof_record(
+                    name, t0, cat=cat))
         # a recovered worker skips startup barriers: the surviving group is
         # already past them (ps::Postoffice::is_recovery skip-barrier,
         # kvstore_dist.h:39,77,178)
@@ -198,6 +310,12 @@ class KVStoreDist(KVStore):
         for k, v in zip(keys, values):
             vv = v[0] if isinstance(v, (list, tuple)) else v
             self._shapes[k] = vv.shape
+            flat_size = 1
+            for d in vv.shape:
+                flat_size *= int(d)
+            # bucket layout is keyed once, in init order — identical on
+            # every worker (and every restart) of the same job
+            self._plan.add(k, flat_size)
             if self._rank == 0 and not self._is_recovery:
                 # rank0 pushes initial weights (kvstore_dist.h:62-80); a
                 # recovered rank0 must NOT re-init — the servers hold the
@@ -210,34 +328,119 @@ class KVStoreDist(KVStore):
         import numpy as np
         return np.asarray(v.asnumpy(), dtype=np.float32).reshape(-1)
 
-    def push(self, key, value, priority=0):
-        """Push (sum-reduced) values.
+    # -- async data plane ---------------------------------------------------
+    def _submit(self, op):
+        if self._pipeline is not None:
+            return self._pipeline.submit(op)
+        self._run_batch([op])   # pipeline disabled: inline, blocking
+        if op.error is not None:
+            raise op.error
+        return op
 
-        In sync mode this BLOCKS until every worker pushed the same key
-        (the reference queues pushes in the async engine instead); all
-        workers must therefore push the same keys in the same order —
-        which Module/model.py's fixed per-parameter order guarantees."""
-        keys, values = self._normalize(key, value)
-        for k, v in zip(keys, values):
+    def _run_batch(self, ops):
+        """Execute one wire batch (single op, or a coalesced set of
+        bucket-mates of one kind) on the transport client."""
+        from . import kvstore_codec as codec
+        client = self._client
+        if ops[0].kind == "push":
+            if len(ops) == 1:
+                client.push(ops[0].key, ops[0].payload)
+                return
+            sid = self._plan.server_of(ops[0].group, client.num_servers)
+            entries = []
+            for op in ops:
+                wire = op.payload.wire() \
+                    if isinstance(op.payload, codec.CompressedGrad) \
+                    else op.payload
+                entries.append((op.key, wire, client.next_seq(op.key)))
+            client.push_multi(sid, entries)
+            return
+        if len(ops) == 1:
+            ops[0].targets(client.pull(ops[0].key, ops[0].size))
+            return
+        sid = self._plan.server_of(ops[0].group, client.num_servers)
+        vals = client.pull_multi(sid, [op.key for op in ops])
+        import numpy as np
+        for op, val in zip(ops, vals):
+            op.targets(np.asarray(val, dtype=np.float32))
+
+    def push(self, key, value, priority=0):
+        """Push (sum-reduced) values; asynchronous under the pipeline
+        (completion at ``flush``).
+
+        In sync mode the wire op BLOCKS until every worker pushed the
+        same key (the reference queues pushes in the async engine
+        instead); all workers must therefore push the same keys with
+        the same priorities — which Module/model.py's fixed
+        per-parameter order guarantees."""
+        from .kvstore_pipeline import CommOp
+        for k, v, p in self._by_priority(*self._normalize(key, value),
+                                         priority=priority):
             vals = v if isinstance(v, (list, tuple)) else [v]
             merged = _ctx_group_sum(list(vals))
-            self._client.push(k, self._flat(merged))
+            orig_dtype = str(merged.dtype)
+            flat = self._flat(merged)
+            payload = flat
+            if self._gc is not None and \
+                    self._gc.negotiate(k, flat, orig_dtype):
+                # quantize on the submitting thread, in program order:
+                # the error-feedback residual stream stays deterministic
+                # however the window reorders the wire
+                payload = self._gc.compress(k, flat)
+            self._submit(CommOp("push", k, priority=p,
+                                group=self._plan.bucket_of(k),
+                                payload=payload, size=flat.size))
 
     def pull(self, key, out=None, priority=0):
+        """Pull value(s) into ``out``.
+
+        A scalar-key call blocks until ``out`` is written (legacy
+        blocking semantics — hand-written scripts read the result on
+        the next line; the wait also drains this key's chained pushes).
+        A *list*-key call is issued ahead: the writes land
+        asynchronously, ordered after the same keys' pushes, and are
+        guaranteed complete after ``flush()`` — which Module calls
+        before the next forward binds the parameters, so weight pulls
+        resolve lazily off the critical path."""
         import numpy as np
-        keys, outs = self._normalize(key, out)
-        for k, o in zip(keys, outs):
+        from .kvstore_pipeline import CommOp
+        lazy = isinstance(key, (list, tuple))
+        for k, o, p in self._by_priority(*self._normalize(key, out),
+                                         priority=priority):
             targets = o if isinstance(o, (list, tuple)) else [o]
             shape = self._shapes.get(k, targets[0].shape)
             size = int(np.prod(shape)) if shape else 1
-            flat = self._client.pull(k, size)
-            src = NDArray(flat.reshape(shape))
-            for t in targets:
-                src.copyto(t)
+
+            def write(flat, _targets=targets, _shape=shape):
+                src = NDArray(flat.reshape(_shape))
+                for t in _targets:
+                    src.copyto(t)
+
+            self._submit(CommOp("pull", k, priority=p,
+                                group=self._plan.bucket_of(k),
+                                targets=write, size=size))
+        if not lazy and self._pipeline is not None:
+            # a full drain, not a per-op wait: errors surface exactly
+            # once, at a synchronization point (waiting the single op
+            # and raising its error would leave the same error queued
+            # for the next unrelated flush to re-raise)
+            self.flush()
+
+    def flush(self, *_, **__):
+        """Drain the async pipeline: every submitted push is acked and
+        every pull's targets are written when this returns."""
+        if self._pipeline is not None:
+            self._pipeline.flush()
+
+    def wire_stats(self):
+        """Payload bytes / RPC counters of the transport (bench rows,
+        CI byte assertions)."""
+        return self._client.wire_stats()
 
     def set_optimizer(self, optimizer):
         """Ship the pickled optimizer to the servers (command 0) — the
         update then runs server-side (python/mxnet/kvstore.py:226-249)."""
+        self.flush()
         body = pickle.dumps(optimizer)
         if self._rank == 0 and not self._is_recovery:
             self._client.send_command(0, body)
@@ -245,6 +448,7 @@ class KVStoreDist(KVStore):
             self._client.barrier()
 
     def barrier(self):
+        self.flush()
         self._client.barrier()
 
     def get_num_dead_node(self, node_id=0, timeout=60):
@@ -258,6 +462,12 @@ class KVStoreDist(KVStore):
             # runs from atexit too: a dead peer/scheduler must not raise or
             # hang here — but healthy stragglers get the FULL barrier
             # timeout before rank0 may stop the servers
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001
+                pass
+            if self._pipeline is not None:
+                self._pipeline.close()
             try:
                 self._client.barrier()
             except Exception:  # noqa: BLE001

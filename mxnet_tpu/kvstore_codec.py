@@ -1,0 +1,268 @@
+"""Gradient-compression codec + fusion-bucket planner for the KVStore
+data plane.
+
+Reference: ``src/kvstore/gradient_compression.{h,cc}`` — MXNet's 2-bit
+gradient compression quantizes each fp32 gradient element to one of
+{-threshold, 0, +threshold} (2 bits each, 16 elements per emitted fp32
+word there; 4 per byte here) and keeps the quantization error in a
+per-worker *residual* that is added back into the next step's gradient
+("error feedback"), so the compressed stream is unbiased over time and
+SGD converges to the same loss as the fp32 stream.
+
+Everything in this module is pure numpy and wire-format-only:
+
+* :func:`quantize_codes` / :func:`pack_codes` / :func:`unpack_codes` /
+  :func:`codes_to_float` — the stateless codec with exact size
+  accounting (:func:`compressed_nbytes`);
+* :class:`CompressedGrad` — one quantized gradient, sliceable into
+  range-shard wire payloads without re-quantizing (quantization is
+  elementwise, so a shard of the whole-array codes is identical to
+  quantizing the shard);
+* :class:`GradientCompression` — the per-worker stateful half:
+  per-key negotiation (small / non-fp32 keys stay lossless) and the
+  error-feedback residuals;
+* :class:`BucketPlan` — deterministic greedy assignment of small keys
+  to fixed-byte fusion buckets in init order, so one RPC can carry a
+  whole bucket (``push_multi``/``pull_multi`` in kvstore_dist.py).
+
+The wire payload for one compressed (range of a) gradient is the tuple
+``("2bit", packed_bytes, n, threshold)`` — ``packed_bytes`` holds
+``ceil(n/4)`` bytes, 4 codes per byte, code 1 = +threshold,
+code 2 = -threshold, code 0 = zero.
+"""
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from .base import MXNetError, get_env
+
+__all__ = ["quantize_codes", "pack_codes", "unpack_codes",
+           "codes_to_float", "compressed_nbytes", "wire_nbytes",
+           "is_compressed_payload", "payload_to_array", "payload_to_codes",
+           "CompressedGrad", "GradientCompression", "BucketPlan"]
+
+WIRE_TAG = "2bit"
+# header bytes accounted per compressed payload beyond the packed codes
+# (the (tag, n, threshold) fields of the wire tuple)
+WIRE_HEADER_BYTES = 8
+
+
+def quantize_codes(x, threshold):
+    """Elementwise 2-bit quantization: int8 codes in {-1, 0, +1} for
+    x >= t / |x| < t / x <= -t.  The represented value is
+    ``codes * threshold``."""
+    x = np.asarray(x, dtype=np.float32)
+    return (np.where(x >= threshold, 1, 0)
+            - np.where(x <= -threshold, 1, 0)).astype(np.int8)
+
+
+def pack_codes(codes):
+    """Pack int8 codes {-1,0,+1} 4-per-byte into ``bytes``
+    (code +1 -> 0b01, -1 -> 0b10, 0 -> 0b00; element i sits at bit
+    2*(i%4) of byte i//4)."""
+    u = np.where(codes > 0, 1, np.where(codes < 0, 2, 0)).astype(np.uint8)
+    pad = (-len(u)) % 4
+    if pad:
+        u = np.concatenate([u, np.zeros(pad, np.uint8)])
+    u = u.reshape(-1, 4)
+    packed = (u[:, 0] | (u[:, 1] << 2) | (u[:, 2] << 4)
+              | (u[:, 3] << 6)).astype(np.uint8)
+    return packed.tobytes()
+
+
+def unpack_codes(packed, n):
+    """Inverse of :func:`pack_codes`: first ``n`` int8 codes."""
+    b = np.frombuffer(packed, dtype=np.uint8)
+    two = np.stack([(b >> s) & 3 for s in (0, 2, 4, 6)], axis=1).reshape(-1)
+    two = two[:n]
+    return (np.where(two == 1, 1, 0)
+            - np.where(two == 2, 1, 0)).astype(np.int8)
+
+
+def codes_to_float(codes, threshold):
+    return codes.astype(np.float32) * np.float32(threshold)
+
+
+def compressed_nbytes(n):
+    """Exact wire bytes for ``n`` compressed elements (packed codes +
+    header); the fp32 equivalent is ``4 * n``."""
+    return (n + 3) // 4 + WIRE_HEADER_BYTES
+
+
+def wire_nbytes(payload):
+    """Exact payload size (bytes-on-wire accounting) of one push/pull
+    value: raw ndarrays count their buffer, compressed tuples count the
+    packed codes + header."""
+    if is_compressed_payload(payload):
+        return len(payload[1]) + WIRE_HEADER_BYTES
+    return np.asarray(payload).nbytes
+
+
+def is_compressed_payload(payload):
+    return (isinstance(payload, tuple) and len(payload) == 4
+            and payload[0] == WIRE_TAG)
+
+
+def payload_to_array(payload):
+    """Decode one wire payload to a float32 array (lossless for raw
+    payloads, dequantization for compressed ones)."""
+    if is_compressed_payload(payload):
+        _, packed, n, threshold = payload
+        return codes_to_float(unpack_codes(packed, n), threshold)
+    return np.asarray(payload, dtype=np.float32)
+
+
+def payload_to_codes(payload):
+    """Codes + threshold of a compressed payload (server-side exact
+    merge accumulates int codes and multiplies by the threshold once)."""
+    _, packed, n, threshold = payload
+    return unpack_codes(packed, n), threshold
+
+
+class CompressedGrad:
+    """One quantized gradient, holding the full int8 code array so
+    range shards can be cut without re-quantizing (elementwise codec:
+    ``codes[lo:hi]`` equals quantizing ``x[lo:hi]``)."""
+
+    __slots__ = ("codes", "threshold", "size")
+
+    def __init__(self, codes, threshold):
+        self.codes = codes
+        self.threshold = float(threshold)
+        self.size = codes.size
+
+    def wire(self, lo=0, hi=None):
+        hi = self.size if hi is None else hi
+        return (WIRE_TAG, pack_codes(self.codes[lo:hi]), hi - lo,
+                self.threshold)
+
+    def dequantize(self, lo=0, hi=None):
+        hi = self.size if hi is None else hi
+        return codes_to_float(self.codes[lo:hi], self.threshold)
+
+
+class GradientCompression:
+    """Worker-side compression state: validated settings, per-key
+    negotiation and error-feedback residuals.
+
+    ``compress(key, flat)`` must be called in program order per key
+    (the data-plane quantizes on the submitting thread, before the
+    async pipeline reorders wire ops) so the residual stream — and
+    therefore every pushed byte — is deterministic for a given call
+    sequence."""
+
+    def __init__(self, params):
+        params = dict(params or {})
+        ctype = params.pop("type", "none")
+        if ctype not in ("none", "2bit"):
+            raise MXNetError("unsupported gradient compression type %r "
+                             "(supported: 'none', '2bit')" % (ctype,))
+        self.type = ctype
+        self.threshold = float(params.pop("threshold", 0.5))
+        if params:
+            raise MXNetError("unknown gradient compression parameters %r"
+                             % sorted(params))
+        if ctype != "none" and self.threshold <= 0:
+            raise MXNetError("gradient compression threshold must be "
+                             "positive, got %r" % self.threshold)
+        self.lower_bound = int(get_env("MXNET_KVSTORE_COMPRESS_LOWER_BOUND"))
+        self.residuals = {}
+
+    @property
+    def active(self):
+        return self.type != "none"
+
+    def negotiate(self, key, flat, orig_dtype=None):
+        """Should pushes of this key be compressed?  Small keys and
+        keys whose *source* array is not fp32 (indices, integer aux
+        state — callers flatten to fp32 for the wire before asking, so
+        they must pass the pre-cast dtype) stay lossless; ``init`` and
+        ``pull`` payloads never come through here at all."""
+        dtype = np.dtype(orig_dtype) if orig_dtype is not None \
+            else flat.dtype
+        return (self.active and flat.size >= self.lower_bound
+                and dtype == np.float32)
+
+    def compress(self, key, flat):
+        """Quantize with error feedback; returns a CompressedGrad and
+        updates this key's residual."""
+        r = self.residuals.get(key)
+        acc = flat + r if r is not None else flat.astype(np.float32, copy=True)
+        codes = quantize_codes(acc, self.threshold)
+        self.residuals[key] = acc - codes_to_float(codes, self.threshold)
+        return CompressedGrad(codes, self.threshold)
+
+    def get_residuals(self):
+        """Residual state as plain numpy (checkpointable alongside
+        optimizer state — error feedback is optimizer-adjacent state
+        that must survive a restart for exact resume)."""
+        return {k: v.copy() for k, v in self.residuals.items()}
+
+    def set_residuals(self, residuals):
+        self.residuals = {k: np.asarray(v, dtype=np.float32)
+                          for k, v in (residuals or {}).items()}
+
+
+class BucketPlan:
+    """Deterministic fusion-bucket layout for small keys.
+
+    Keys are assigned greedily in the order they are ``add``-ed (the
+    kvstore init order, which Module fixes as parameter index order):
+    a key whose payload would overflow the open bucket closes it and
+    opens the next, keys at least as large as one bucket (or past the
+    bigarray range-shard bound) stand alone.  The layout is a pure
+    function of the (key, size) sequence, so every worker — and every
+    restart of the same job — computes the same buckets, and server
+    snapshots (which store per-key entries, never buckets) stay
+    compatible across restarts by construction."""
+
+    def __init__(self, bucket_bytes=None, bigarray_bound=None):
+        self.bucket_bytes = int(get_env("MXNET_KVSTORE_BUCKET_BYTES")) \
+            if bucket_bytes is None else int(bucket_bytes)
+        self.bigarray_bound = int(get_env("MXNET_KVSTORE_BIGARRAY_BOUND")) \
+            if bigarray_bound is None else int(bigarray_bound)
+        self._assign = {}       # key -> bucket index (None = standalone)
+        self._members = {}      # bucket index -> [key, ...]
+        self._open = None       # (bucket index, used bytes)
+        self._next = 0
+
+    def add(self, key, size):
+        """Assign ``key`` (``size`` fp32 elements); idempotent for a
+        known key.  Returns the bucket index or None (standalone)."""
+        if key in self._assign:
+            return self._assign[key]
+        nbytes = int(size) * 4
+        if int(size) >= self.bigarray_bound or nbytes >= self.bucket_bytes:
+            self._assign[key] = None
+            return None
+        if self._open is None or self._open[1] + nbytes > self.bucket_bytes:
+            self._open = (self._next, 0)
+            self._next += 1
+        idx, used = self._open
+        self._open = (idx, used + nbytes)
+        self._assign[key] = idx
+        self._members.setdefault(idx, []).append(key)
+        return idx
+
+    def bucket_of(self, key):
+        """Bucket index of a known small key, else None (standalone /
+        unknown keys keep the hashed or range-sharded path)."""
+        return self._assign.get(key)
+
+    def server_of(self, bucket, num_servers):
+        """Deterministic server owning a bucket (every member key's
+        whole payload lives there, so one RPC covers the bucket)."""
+        return zlib.crc32(("bucket:%d" % bucket).encode()) % num_servers
+
+    def members(self, bucket):
+        return list(self._members.get(bucket, ()))
+
+    def layout(self):
+        """Canonical (bucket, key) tuple — the determinism witness the
+        restart-compatibility test compares across rebuilds."""
+        return tuple((b, tuple(keys))
+                     for b, keys in sorted(self._members.items())) + \
+            tuple(("standalone", k) for k, b in self._assign.items()
+                  if b is None)

@@ -45,6 +45,18 @@ use).  The ``faultinject`` seams (``worker.send``/``worker.recv`` in
 ``WorkerClient._rpc``, ``server.recv`` in ``Server._serve_one``) let a
 seeded schedule reproduce "server dies mid-push" deterministically on one
 CPU host.
+
+Data plane (docs/architecture/kvstore_comm.md): the wire protocol also
+carries *multi-key* messages (``push_multi``/``pull_multi`` — one RPC
+per fusion bucket, see ``kvstore_codec.BucketPlan``) and *compressed*
+payloads (the ``("2bit", packed, n, threshold)`` tuples of
+``kvstore_codec``; the server dequantizes, and dist_sync merges
+same-threshold compressed contributions exactly in the integer code
+domain).  Each worker keeps a small connection pool per server
+(``MXNET_KVSTORE_CONNS_PER_SERVER``) so the async pipeline
+(``kvstore_pipeline.py``) can hold several RPCs to one server in
+flight; every pooled connection runs under the same deadline / retry /
+circuit-breaker policy.
 """
 from __future__ import annotations
 
@@ -58,6 +70,7 @@ from multiprocessing.connection import Client, Listener
 import numpy as np
 
 from . import faultinject
+from . import kvstore_codec as codec
 from .base import MXNetError, atomic_write, get_env
 
 _AUTHKEY = b"mxnet_tpu_ps"
@@ -385,6 +398,33 @@ class Scheduler:
 # ---------------------------------------------------------------------------
 # Server (KVStoreDistServer)
 # ---------------------------------------------------------------------------
+class _MultiAck:
+    """Reply aggregator for one ``push_multi`` RPC: the per-key push
+    handlers each ack once (possibly later, from another worker's serve
+    thread when a dist_sync round releases), and the single wire reply
+    goes out when every key has — first error wins.  Thread-safe."""
+
+    def __init__(self, conn, n):
+        self.conn = conn
+        self.n = n
+        self.count = 0
+        self.err = None
+        self.lock = threading.Lock()
+
+    def send(self, msg):
+        with self.lock:
+            self.count += 1
+            if msg and msg[0] == "err" and self.err is None:
+                self.err = msg
+            if self.count < self.n:
+                return
+            reply = self.err or ("ok",)
+        try:
+            self.conn.send(reply)
+        except (EOFError, OSError):
+            pass   # worker timed out / reconnected: it will resend
+
+
 def _node_host():
     """Address this node is reachable at by peers.
 
@@ -514,12 +554,15 @@ class Server:
             self._disk_gen = state["mutations"]
         return True
 
-    def _mutated(self):
+    def _mutated(self, snap=True):
         """Bump the store generation; in synchronous-snapshot mode
         (interval <= 0) persist before the caller replies, so an
-        acknowledged update is never lost to a crash."""
+        acknowledged update is never lost to a crash.  ``snap=False``
+        lets a multi-key RPC batch several mutations under ONE
+        snapshot taken before its aggregated ack."""
         self._mutations += 1
-        if self.snapshot_dir is not None and self.snapshot_interval <= 0:
+        if snap and self.snapshot_dir is not None \
+                and self.snapshot_interval <= 0:
             self.save_snapshot()
 
     def _snapshot_loop(self):
@@ -654,7 +697,8 @@ class Server:
             # process lifetime, so a DMLC_PS_RECOVERY_RANK replacement
             # starting its counter over is never falsely deduped against
             # its dead predecessor.  Bare 3-tuples (direct callers) skip
-            # dedup.
+            # dedup.  The value may be a raw fp32 array or a compressed
+            # ("2bit", packed, n, threshold) payload.
             _, key, arr = msg[:3]
             rank = msg[3] if len(msg) > 3 else None
             seq = msg[4] if len(msg) > 4 else None
@@ -666,6 +710,41 @@ class Server:
                            % (key,)))
             else:
                 self._handle_push(key, arr, conn, rank, seq, inc)
+        elif kind == "push_multi":
+            # one fusion bucket per RPC: (push_multi, [(key, payload,
+            # seq), ...], rank, inc).  Each key runs the ordinary push
+            # path (same dedup watermarks, same sync-mode merge rounds);
+            # the single wire reply waits for every key via _MultiAck
+            _, entries, rank, inc = msg
+            with self.lock:
+                missing = [k for k, _, _ in entries if k not in self.store]
+            if missing:
+                conn.send(("err", "keys %r have not been initialized"
+                           % (missing,)))
+            else:
+                # +1: the loop below contributes a final barrier ack
+                # AFTER the batched snapshot, so in synchronous-snapshot
+                # mode one RPC costs ONE store snapshot (not one per
+                # key) while 'acked' still implies 'persisted'
+                ack = _MultiAck(conn, len(entries) + 1)
+                for key, payload, seq in entries:
+                    self._handle_push(key, payload, ack, rank, seq, inc,
+                                      snap=False)
+                if self.snapshot_dir is not None \
+                        and self.snapshot_interval <= 0:
+                    self.save_snapshot()
+                ack.send(("ok",))
+        elif kind == "pull_multi":
+            _, keys = msg
+            with self.lock:
+                vals = [self.store[k].copy() if k in self.store else None
+                        for k in keys]
+            miss = [k for k, v in zip(keys, vals) if v is None]
+            if miss:
+                conn.send(("err", "keys %r have not been initialized"
+                           % (miss,)))
+            else:
+                conn.send(("vals", vals))
         elif kind == "pull":
             _, key = msg
             with self.lock:
@@ -697,18 +776,48 @@ class Server:
         return (entry is not None and entry[0] == inc
                 and seq <= entry[1])
 
-    def _handle_push(self, key, arr, conn, rank=None, seq=None, inc=None):
-        arr = np.asarray(arr, dtype=np.float32)
+    @staticmethod
+    def _merge_accum(buf, payload):
+        """Accumulate one push payload into a dist_sync merge buffer.
+
+        Compressed contributions with a shared threshold accumulate in
+        the *integer code domain* (("__codes__", int32 sum, threshold))
+        — the dequantized merge is then exact by construction, not a
+        float-summation approximation; mixed raw/compressed (or
+        mixed-threshold) rounds fall back to float accumulation."""
+        if codec.is_compressed_payload(payload):
+            codes, t = codec.payload_to_codes(payload)
+            if buf is None:
+                return ("__codes__", codes.astype(np.int32), t)
+            if isinstance(buf, tuple) and buf[0] == "__codes__" \
+                    and buf[2] == t:
+                return ("__codes__", buf[1] + codes, t)
+            return Server._merge_value(buf) + codec.codes_to_float(codes, t)
+        arr = np.asarray(payload, dtype=np.float32)
+        if buf is None:
+            return arr
+        return Server._merge_value(buf) + arr
+
+    @staticmethod
+    def _merge_value(buf):
+        """Materialize a merge buffer as fp32 (dequantizing a
+        code-domain accumulator exactly once)."""
+        if isinstance(buf, tuple) and buf[0] == "__codes__":
+            return codec.codes_to_float(buf[1], buf[2])
+        return buf
+
+    def _handle_push(self, key, payload, conn, rank=None, seq=None,
+                     inc=None, snap=True):
         if not self.sync_mode:
             with self.lock:
                 if self._already_applied(key, rank, seq, inc):
                     # retried push whose ack was lost: don't re-apply
                     conn.send(("ok",))
                     return
-                self._do_update(key, arr)
+                self._do_update(key, codec.payload_to_array(payload))
                 if seq is not None:
                     self._applied_seq[(key, rank)] = (inc, seq)
-                self._mutated()
+                self._mutated(snap)
             conn.send(("ok",))
             return
         # bulk-synchronous: merge; Nth worker push triggers one updater run
@@ -725,15 +834,18 @@ class Server:
             if slot in contrib:
                 pending[slot] = conn   # duplicate resend: refresh only
             else:
-                buf = arr if buf is None else buf + arr
+                buf = self._merge_accum(buf, payload)
                 contrib[slot] = (seq, inc)
                 pending[slot] = conn
             if len(contrib) == self.num_workers:
-                self._do_update(key, buf)
+                self._do_update(key, self._merge_value(buf))
                 for r, (s, i) in contrib.items():
                     if s is not None:
                         self._applied_seq[(key, r)] = (i, s)
-                self._mutated()
+                # snap=False only under a multi-key RPC, whose trailing
+                # batched snapshot (before its aggregated ack) covers
+                # every round this message completed
+                self._mutated(snap)
                 for c in pending.values():
                     try:
                         c.send(("ok",))
@@ -797,10 +909,29 @@ class WorkerClient:
         msg = self.sched.recv()
         self.rank = msg[1]
         self.server_addrs = msg[2]
-        self.servers = [_connect(a) for a in self.server_addrs]
-        self.server_locks = [threading.Lock() for _ in self.servers]
+        # small connection pool per server: the async data-plane pipeline
+        # (kvstore_pipeline.py) holds several RPCs to one server in
+        # flight, and multiprocessing.Connection is one-request-at-a-time
+        # — slot 0 dials eagerly (fail fast on a dead cluster), the rest
+        # lazily on first concurrent use
+        self._pool_size = max(1, int(get_env(
+            "MXNET_KVSTORE_CONNS_PER_SERVER")))
+        self.servers = [[_connect(a)] + [None] * (self._pool_size - 1)
+                        for a in self.server_addrs]
+        self._free_slots = [list(range(self._pool_size))
+                            for _ in self.servers]
+        self._pool_cv = threading.Condition()
         self.policy = RetryPolicy()
         self.breakers = [CircuitBreaker() for _ in self.servers]
+        # fusion-bucket layout (set by KVStoreDist at init; None for
+        # direct users = every key keeps the hashed/range-sharded path)
+        self.plan = None
+        # bytes-on-wire accounting (completed RPCs; payloads only, not
+        # pickle framing) — the bench rows and the CI byte assertion
+        # read these through wire_stats()
+        self._wire_lock = threading.Lock()
+        self._wire = {"push_bytes": 0, "pull_bytes": 0,
+                      "push_rpcs": 0, "pull_rpcs": 0}
         # flipped by KVStoreDist for dist_sync: pushes then wait with
         # barrier-scale patience (see _deadline_for)
         self.sync_push = False
@@ -824,9 +955,15 @@ class WorkerClient:
     def _shard(self, key, size):
         """Return [(server_idx, subkey, start, stop), ...] covering [0, size).
 
-        Small arrays: one hashed server gets the whole range; big arrays:
-        even range partition over all servers (EncodeKey semantics)."""
+        Bucketed keys: the whole range on the bucket's server (so one
+        multi-key RPC can carry bucket-mates); other small arrays: one
+        hashed server; big arrays: even range partition over all
+        servers (EncodeKey semantics)."""
         S = self.num_servers
+        if self.plan is not None:
+            b = self.plan.bucket_of(key)
+            if b is not None:
+                return [(self.plan.server_of(b, S), (key, 0), 0, size)]
         if size < self.bigarray_bound or S == 1:
             # deterministic across processes (python's str hash is salted)
             import zlib
@@ -841,11 +978,28 @@ class WorkerClient:
             out.append((i, (key, i), lo, hi))
         return out
 
-    def _rpc(self, sid, msg):
-        with self.server_locks[sid]:
-            return self._rpc_locked(sid, msg)
+    def _acquire_slot(self, sid):
+        with self._pool_cv:
+            while not self._free_slots[sid]:
+                self._pool_cv.wait()
+            return self._free_slots[sid].pop()
 
-    def _rpc_locked(self, sid, msg):
+    def _release_slot(self, sid, slot):
+        with self._pool_cv:
+            self._free_slots[sid].append(slot)
+            # notify_all: the condition is shared across servers, so a
+            # single notify could wake a thread waiting on a DIFFERENT
+            # server's pool and strand the one this slot unblocks
+            self._pool_cv.notify_all()
+
+    def _rpc(self, sid, msg):
+        slot = self._acquire_slot(sid)
+        try:
+            return self._rpc_locked(sid, slot, msg)
+        finally:
+            self._release_slot(sid, slot)
+
+    def _rpc_locked(self, sid, slot, msg):
         """One server RPC under the retry policy: deadline per attempt,
         exponential backoff + jitter between attempts, reconnect through
         the scheduler's current address table, circuit-breaker fail-fast
@@ -862,14 +1016,14 @@ class WorkerClient:
                                            breaker.last_error,
                                            breaker.reset_after))
             try:
-                r = self._rpc_once(sid, msg)
+                r = self._rpc_once(sid, slot, msg)
                 breaker.record_success()
                 return r
             except (EOFError, OSError, _RPCTimeout, MXNetConnectError) \
                     as exc:
                 last = exc
                 breaker.record_failure(exc)
-                self._invalidate(sid)
+                self._invalidate(sid, slot)
                 if attempt + 1 < attempts:
                     t0 = time.perf_counter_ns()
                     time.sleep(policy.delay(attempt))
@@ -881,11 +1035,11 @@ class WorkerClient:
             "(timeout=%.1fs): %r" % (msg[0], sid, attempts,
                                      policy.timeout, last))
 
-    def _rpc_once(self, sid, msg):
-        conn = self.servers[sid]
+    def _rpc_once(self, sid, slot, msg):
+        conn = self.servers[sid][slot]
         if conn is None:
-            self._reconnect(sid)
-            conn = self.servers[sid]
+            self._reconnect(sid, slot)
+            conn = self.servers[sid][slot]
         if faultinject.hook("worker.send", sid=sid, kind=msg[0],
                             rank=self.rank) != "drop":
             conn.send(msg)
@@ -903,32 +1057,64 @@ class WorkerClient:
             # the resend exercises the exactly-once dedup path
             raise _RPCTimeout("fault injected: reply from server %d "
                               "dropped" % sid)
+        self._account(msg, r)
         return r
 
+    def _account(self, msg, reply):
+        """Bytes-on-wire bookkeeping for one completed RPC (payload
+        bytes: push values sent, pull values received)."""
+        kind = msg[0]
+        if kind == "push":
+            n, rpc = codec.wire_nbytes(msg[2]), "push"
+        elif kind == "push_multi":
+            n, rpc = sum(codec.wire_nbytes(p)
+                         for _, p, _ in msg[1]), "push"
+        elif kind == "pull" and reply[0] == "val":
+            n, rpc = codec.wire_nbytes(reply[1]), "pull"
+        elif kind == "pull_multi" and reply[0] == "vals":
+            n, rpc = sum(codec.wire_nbytes(v) for v in reply[1]), "pull"
+        else:
+            return
+        with self._wire_lock:
+            self._wire[rpc + "_bytes"] += int(n)
+            self._wire[rpc + "_rpcs"] += 1
+
+    def wire_stats(self):
+        """Snapshot of the payload-byte / RPC counters."""
+        with self._wire_lock:
+            return dict(self._wire)
+
+    def reset_wire_stats(self):
+        with self._wire_lock:
+            for k in self._wire:
+                self._wire[k] = 0
+
     def _deadline_for(self, kind):
-        """Per-message deadline.  A dist_sync push legitimately blocks
-        until EVERY worker reaches the merge round, so it gets
-        barrier-scale patience (a straggler peer is not a dead server);
-        everything else answers within the plain RPC timeout."""
+        """Per-message deadline.  A dist_sync push (single or
+        bucket-multi) legitimately blocks until EVERY worker reaches
+        the merge round, so it gets barrier-scale patience (a straggler
+        peer is not a dead server); everything else answers within the
+        plain RPC timeout."""
         t = self.policy.timeout
-        if t > 0 and kind == "push" and self.sync_push:
+        if t > 0 and kind in ("push", "push_multi") and self.sync_push:
             t = max(t, float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT")))
         return t
 
-    def _invalidate(self, sid):
-        conn = self.servers[sid]
-        self.servers[sid] = None
+    def _invalidate(self, sid, slot):
+        conn = self.servers[sid][slot]
+        self.servers[sid][slot] = None
         if conn is not None:
             try:
                 conn.close()
             except OSError:
                 pass
 
-    def _reconnect(self, sid):
+    def _reconnect(self, sid, slot):
         """Re-resolve server sid's address from the scheduler (it may
-        have restarted elsewhere under a recovery rank) and dial it.
-        Bounded: failures surface as MXNetConnectError and count as one
-        retry attempt in _rpc_locked."""
+        have restarted elsewhere under a recovery rank) and dial one
+        pooled connection to it.  Bounded: failures surface as
+        MXNetConnectError and count as one retry attempt in
+        _rpc_locked."""
         t0 = time.perf_counter_ns()
         try:
             r = self._sched_probe(("query_servers",))
@@ -938,11 +1124,11 @@ class WorkerClient:
         except (EOFError, OSError, IndexError, _RPCTimeout, MXNetError):
             pass  # scheduler busy/unreachable: dial the last-known addr
         try:
-            self.servers[sid] = _connect(self.server_addrs[sid],
-                                         retries=20, delay=0.1)
+            self.servers[sid][slot] = _connect(self.server_addrs[sid],
+                                               retries=20, delay=0.1)
         except MXNetError as exc:
             raise MXNetConnectError(str(exc)) from exc
-        _prof_record("kvstore_rpc_reconnect[s%d]" % sid, t0,
+        _prof_record("kvstore_rpc_reconnect[s%d.%d]" % (sid, slot), t0,
                      cat="rpc_reconnect")
 
     def _sched_probe(self, msg):
@@ -1005,19 +1191,42 @@ class WorkerClient:
         raise MXNetError("%d of %d shards failed — %s"
                          % (len(errs), len(shards), detail))
 
-    def push(self, key, flat):
+    def next_seq(self, key):
+        """Next per-key push sequence number (dedup identity).  Callers
+        must send seqs of one key in assignment order — the pipeline's
+        per-key chains guarantee that."""
         with self._push_seq_lock:
             seq = self._push_seq.get(key, 0) + 1
             self._push_seq[key] = seq
+            return seq
+
+    def push(self, key, value):
+        """Push one key's gradient: a flat fp32 array, or a
+        ``kvstore_codec.CompressedGrad`` (each range shard is cut from
+        the full code array — elementwise codec, so shard payloads equal
+        per-shard quantization)."""
+        seq = self.next_seq(key)
+        compressed = isinstance(value, codec.CompressedGrad)
 
         def one(shard):
             sid, subkey, lo, hi = shard
-            r = self._rpc(sid, ("push", subkey, flat[lo:hi],
+            payload = value.wire(lo, hi) if compressed else value[lo:hi]
+            r = self._rpc(sid, ("push", subkey, payload,
                                 self.rank, seq, self._incarnation))
             if r[0] != "ok":
                 raise MXNetError(str(r))
 
-        self._fanout(self._shard(key, flat.size), one)
+        self._fanout(self._shard(key, value.size), one)
+
+    def push_multi(self, sid, entries):
+        """One RPC carrying a whole fusion bucket: ``entries`` is
+        ``[(key, wire_payload, seq), ...]``, every key whole on server
+        ``sid`` (the bucket's owner)."""
+        wire = [((key, 0), payload, seq) for key, payload, seq in entries]
+        r = self._rpc(sid, ("push_multi", wire, self.rank,
+                            self._incarnation))
+        if r[0] != "ok":
+            raise MXNetError(str(r))
 
     def pull(self, key, size):
         out = np.empty((size,), dtype=np.float32)
@@ -1036,6 +1245,14 @@ class WorkerClient:
             raise MXNetError("pull(%r): covered %d of %d elements"
                              % (key, sum(filled), size))
         return out
+
+    def pull_multi(self, sid, keys):
+        """One RPC pulling every (whole-array) key of a bucket from its
+        server; returns the values in key order."""
+        r = self._rpc(sid, ("pull_multi", [(key, 0) for key in keys]))
+        if r[0] != "vals":
+            raise MXNetError(str(r))
+        return r[1]
 
     def send_command(self, head, body):
         for sid in range(self.num_servers):
@@ -1089,9 +1306,13 @@ class WorkerClient:
                 except OSError:
                     pass
                 self._probe_conn = None
-        for s in self.servers:
-            if s is not None:
-                s.close()
+        for pool in self.servers:
+            for s in pool:
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
 
 
 def role():

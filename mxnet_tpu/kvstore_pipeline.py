@@ -1,0 +1,201 @@
+"""Asynchronous priority pipeline for KVStore communication.
+
+Reference: the C++ engine queues every ``KVStoreDist`` push/pull as an
+async op with a ``priority`` hint and lets communication overlap
+computation (``kvstore_dist.h`` + ``engine/threaded_engine``); our PR-2
+data plane instead ran one blocking RPC per parameter.  This module
+restores the overlap: operations are *submitted* (returning
+immediately) into a bounded in-flight window of worker threads that
+
+* execute strictly in **priority order** among ready ops (numerically
+  larger priority first — ``model.py`` pushes with ``priority=-index``
+  so first-layer params, needed first by the next forward, jump the
+  queue), FIFO within a priority;
+* keep a **per-key chain**: an op on key K never starts before the
+  previously submitted op on K finished, so push-before-pull and the
+  per-key seq order the PR-2 dedup watermarks rely on are preserved no
+  matter how the window reorders the wire;
+* **coalesce** ready ops that share a fusion-bucket group into one
+  multi-key RPC (see ``kvstore_codec.BucketPlan``);
+* surface as profiler spans: ``kvstore_push`` / ``kvstore_pull`` per
+  wire batch and one ``comm_overlap`` span per submit->flush window.
+
+``flush()`` blocks until everything submitted has completed and
+re-raises the first failure (a failed op also fails the ops chained
+behind it on the same key — a pull after a dead push must not read a
+stale value).  The window size is ``MXNET_KVSTORE_INFLIGHT``;
+``MXNET_KVSTORE_PIPELINE=0`` bypasses this module entirely (the
+kvstore then runs every RPC inline, the PR-2 behavior).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+
+from .base import MXNetError, get_env
+
+__all__ = ["CommOp", "CommPipeline"]
+
+
+class CommOp:
+    """One logical kvstore operation (push or pull of one key)."""
+
+    __slots__ = ("kind", "key", "priority", "group", "payload", "targets",
+                 "size", "done", "error", "_next", "_order", "result")
+
+    def __init__(self, kind, key, priority=0, group=None, payload=None,
+                 targets=None, size=None):
+        self.kind = kind            # "push" | "pull"
+        self.key = key
+        self.priority = priority
+        # ops sharing a non-None group may ride one coalesced RPC
+        self.group = group
+        self.payload = payload      # push: wire value (ndarray/CompressedGrad)
+        self.targets = targets      # pull: completion callback(flat)
+        self.size = size
+        self.done = threading.Event()
+        self.error = None
+        self.result = None
+        self._next = []             # same-key ops waiting on this one
+        self._order = None
+
+
+class CommPipeline:
+    def __init__(self, run_batch, window=None, recorder=None):
+        """``run_batch(ops)`` executes one wire batch (all ops share
+        kind and group, or it's a single op); ``recorder(name, t0, cat)``
+        reports a finished span to the profiler (optional)."""
+        self._run_batch = run_batch
+        self._recorder = recorder
+        window = int(get_env("MXNET_KVSTORE_INFLIGHT")) \
+            if window is None else int(window)
+        self._window = max(1, window)
+        self._cv = threading.Condition()
+        self._heap = []             # (-priority, order, op)
+        self._chains = {}           # key -> last submitted, unfinished op
+        self._outstanding = 0
+        self._errors = []
+        self._counter = itertools.count()
+        self._stopped = False
+        self._epoch_t0 = None       # first submit since last flush
+        self._epoch_ops = 0
+        self._threads = []
+        for i in range(self._window):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name="kvstore-pipeline-%d" % i)
+            t.start()
+            self._threads.append(t)
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, op):
+        """Enqueue; returns the op (its ``done`` event is the
+        completion handle)."""
+        with self._cv:
+            if self._stopped:
+                raise MXNetError("kvstore pipeline is closed")
+            op._order = next(self._counter)
+            if self._epoch_t0 is None:
+                self._epoch_t0 = time.perf_counter_ns()
+            self._epoch_ops += 1
+            self._outstanding += 1
+            prev = self._chains.get(op.key)
+            self._chains[op.key] = op
+            if prev is None:
+                heapq.heappush(self._heap, (-op.priority, op._order, op))
+                self._cv.notify()
+            else:
+                prev._next.append(op)
+        return op
+
+    def flush(self):
+        """Wait for every submitted op; raise the first failure.  Also
+        emits the window's ``comm_overlap`` span."""
+        with self._cv:
+            while self._outstanding > 0:
+                self._cv.wait()
+            errors, self._errors = self._errors, []
+            t0, n = self._epoch_t0, self._epoch_ops
+            self._epoch_t0, self._epoch_ops = None, 0
+        if t0 is not None and n and self._recorder is not None:
+            self._recorder("comm_overlap[%d ops]" % n, t0,
+                           cat="comm_overlap")
+        if errors:
+            first = errors[0]
+            if len(errors) == 1 and isinstance(first, Exception):
+                raise first
+            raise MXNetError("%d kvstore pipeline ops failed; first: %r"
+                             % (len(errors), first))
+
+    def close(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- worker side --------------------------------------------------------
+    def _worker(self):
+        while True:
+            with self._cv:
+                while not self._heap and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._heap:
+                    return
+                _, _, op = heapq.heappop(self._heap)
+                batch = [op]
+                if op.group is not None:
+                    # coalesce every READY op of the same bucket+kind
+                    # into this RPC (bounded by the bucket's byte size
+                    # by construction of the plan)
+                    rest = []
+                    for entry in self._heap:
+                        o = entry[2]
+                        if o.group == op.group and o.kind == op.kind:
+                            batch.append(o)
+                        else:
+                            rest.append(entry)
+                    if len(batch) > 1:
+                        heapq.heapify(rest)
+                        self._heap = rest
+            t0 = time.perf_counter_ns()
+            err = None
+            try:
+                self._run_batch(batch)
+            except BaseException as exc:  # noqa: BLE001 — stored, re-raised
+                err = exc                 # at flush()
+            if self._recorder is not None:
+                name = "kvstore_%s[%s%s]" % (
+                    op.kind, op.key,
+                    " +%d" % (len(batch) - 1) if len(batch) > 1 else "")
+                self._recorder(name, t0, cat="kvstore_" + op.kind)
+            self._complete(batch, err)
+
+    def _complete(self, batch, err):
+        with self._cv:
+            for o in batch:
+                self._finish_locked(o, err)
+            self._cv.notify_all()
+
+    def _finish_locked(self, op, err, record=True):
+        if err is not None and record:
+            self._errors.append(err)
+        op.error = err
+        op.done.set()
+        self._outstanding -= 1
+        if self._chains.get(op.key) is op:
+            del self._chains[op.key]
+        for nxt in op._next:
+            if err is not None:
+                # a chained op behind a failure must not run (a pull
+                # after a dead push would read a stale value); fail it
+                # with the upstream error — but don't RECORD the
+                # synthetic skip, so flush() reports the one root
+                # exception with its type and chain intact
+                self._finish_locked(
+                    nxt, MXNetError("skipped %s(%r): upstream %s failed: %s"
+                                    % (nxt.kind, nxt.key, op.kind, err)),
+                    record=False)
+            else:
+                heapq.heappush(self._heap, (-nxt.priority, nxt._order, nxt))

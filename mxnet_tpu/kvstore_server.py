@@ -25,7 +25,15 @@ class KVStoreServer:
     ``DMLC_PS_RECOVERY_RANK=<rank>`` restores the snapshot and rejoins
     the group under the old rank, publishing the new address through the
     scheduler so workers' in-flight RPCs reconnect and retry against the
-    recovered state (docs/architecture/fault_tolerance.md)."""
+    recovered state (docs/architecture/fault_tolerance.md).
+
+    Data plane: the server also speaks the fast-path wire protocol
+    (docs/architecture/kvstore_comm.md) — multi-key ``push_multi`` /
+    ``pull_multi`` messages carrying whole fusion buckets, and 2-bit
+    compressed gradient payloads, which ``dist_sync`` merges exactly in
+    the integer code domain.  Storage, dedup watermarks and snapshots
+    stay strictly per-key, so snapshots are bucket-layout independent
+    and restore across restarts regardless of data-plane settings."""
 
     def __init__(self, kvstore=None):
         self.kvstore = kvstore

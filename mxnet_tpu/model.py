@@ -56,24 +56,51 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
             kvstore.pull(idx, param_on_devs, priority=-idx)
 
 
-def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
+def _kv_batch(param_arrays, grad_arrays):
+    """(keys, grads, args, priorities) of the parameters that have
+    gradients, priority = -index (reference model.py:88 — larger
+    priority first, so first-layer params, needed first by the next
+    forward, lead the comm queue)."""
+    keys, grads, args, prios = [], [], [], []
+    for index, (arg_list, grad_list) in enumerate(zip(param_arrays,
+                                                      grad_arrays)):
         if grad_list[0] is None:
             continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+        keys.append(index)
+        grads.append(grad_list)
+        args.append(arg_list)
+        prios.append(-index)
+    return keys, grads, args, prios
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """Push gradients and pull back updated weights, as batched
+    multi-key calls: the local store honors the priorities as
+    processing order, the dist store submits the whole window
+    asynchronously (returning immediately) and resolves the pulls
+    lazily at the next forward's ``flush`` — the wire overlaps metric
+    update, data loading and everything else between here and the next
+    forward."""
+    keys, grads, args, prios = _kv_batch(param_arrays, grad_arrays)
+    if not keys:
+        return
+    kvstore.push(keys, grads, priority=prios)
+    kvstore.pull(keys, args, priority=prios)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
+    keys, grads, _, prios = _kv_batch(param_arrays, grad_arrays)
+    if kvstore and keys:
+        kvstore.push(keys, grads, priority=prios)
+        kvstore.pull(keys, grads, priority=prios)
+        # the host updater reads the pulled gradients right below, so
+        # an async kvstore must resolve them here
+        kvstore.flush()
+    for index, (arg_list, grad_list) in enumerate(zip(param_arrays,
+                                                      grad_arrays)):
         if grad_list[0] is None:
             continue
-        if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updater(index * num_device + k, g, w)

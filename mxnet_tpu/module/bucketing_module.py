@@ -173,6 +173,13 @@ class BucketingModule(BaseModule):
                 default._sync_params_from_devices()
                 module._exec_group.set_params(default._arg_params,
                                               default._aux_params)
+        if (module._exec_group is not None
+                and module._exec_group.pre_forward_sync is None
+                and default._kvstore is not None):
+            # wire the shared store's lazy-pull barrier BEFORE this
+            # bucket's first forward: the previous bucket's update() may
+            # still have pulls landing in the shared param handles
+            module._exec_group.pre_forward_sync = default._kvstore.flush
         self._curr_module = module
         self._curr_bucket_key = bucket_key
         if self._monitor is not None:
@@ -238,6 +245,11 @@ class BucketingModule(BaseModule):
                 self._curr_module._kvstore = default._kvstore
                 self._curr_module._update_on_kvstore = \
                     default._update_on_kvstore
+                if default._kvstore is not None:
+                    # the shared store's lazy pulls must resolve before
+                    # this bucket's executors read the params
+                    self._curr_module._exec_group.pre_forward_sync = \
+                        default._kvstore.flush
                 self._curr_module.optimizer_initialized = True
         self._curr_module.update()
 
@@ -256,6 +268,8 @@ class BucketingModule(BaseModule):
             mod._updater = source._updater
             mod._kvstore = source._kvstore
             mod._update_on_kvstore = source._update_on_kvstore
+            if source._kvstore is not None and mod._exec_group is not None:
+                mod._exec_group.pre_forward_sync = source._kvstore.flush
             mod.optimizer_initialized = source.optimizer_initialized
 
     def get_outputs(self, merge_multi_context=True):

@@ -106,6 +106,11 @@ class DataParallelExecutorGroup:
         self.label_shapes = None
         self.slices = None
         self.shared_group = shared_group
+        # called before executors run a forward: Module points this at
+        # kvstore.flush so lazily-issued weight pulls (the async dist
+        # pipeline) resolve exactly when the next forward binds the
+        # parameters — never later
+        self.pre_forward_sync = None
 
         if isinstance(grad_req, str):
             self.grad_req = {}
@@ -242,6 +247,8 @@ class DataParallelExecutorGroup:
         if is_train is None:
             is_train = self.for_training
         self._load_batch(data_batch)
+        if self.pre_forward_sync is not None:
+            self.pre_forward_sync()
         if not is_train and getattr(data_batch, "label", None) and \
                 self.label_arrays:
             _load_general(data_batch.label, self.label_arrays)
@@ -263,6 +270,8 @@ class DataParallelExecutorGroup:
     def forward_backward(self, data_batch):
         """Fused train step: one XLA program per device (forward+backward)."""
         self._load_batch(data_batch)
+        if self.pre_forward_sync is not None:
+            self.pre_forward_sync()
         for ex in self.execs:
             ex.forward_backward()
 

@@ -172,16 +172,18 @@ class Module(BaseModule):
         from ..base import atomic_write
         assert self.optimizer_initialized
         if self._fused is not None:
-            # Updater.states pickle keyed by plain param index — the
+            # Updater.states layout keyed by plain param index — the
             # update_on_kvstore layout, which the fused path semantically
             # is (one shared update per parameter).  Like the reference,
             # files are not portable to the update_on_kvstore=False
             # multi-device host-updater layout (index*num_device+k).
-            from ..optimizer import _state_to_host
+            # Written as the v2 envelope so the optimizer's update
+            # counters (Adam bias-correction schedule) resume too.
+            from ..optimizer import _state_to_host, pack_updater_states
             states = {i: _state_to_host(v) for i, v in
                       self._fused.get_updater_states().items()}
             with atomic_write(fname, "wb") as fout:
-                fout.write(pickle.dumps(states))
+                fout.write(pack_updater_states(states, self._optimizer))
         elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -193,8 +195,14 @@ class Module(BaseModule):
         ``save_optimizer_states``."""
         assert self.optimizer_initialized
         if self._fused is not None:
+            from ..optimizer import unpack_updater_states
             with open(fname, "rb") as f:
-                self._fused.set_updater_states(pickle.loads(f.read()))
+                states, counts, num_update = \
+                    unpack_updater_states(f.read())
+            self._fused.set_updater_states(states)
+            if counts is not None:
+                self._optimizer._index_update_count = dict(counts)
+                self._optimizer.num_update = num_update
         elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
@@ -309,6 +317,9 @@ class Module(BaseModule):
         if self._fused is not None:
             self._sync_from_trainer(self._fused)
             return
+        if self._kvstore is not None:
+            # lazily-issued pulls must land before device params are read
+            self._kvstore.flush()
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
@@ -466,6 +477,10 @@ class Module(BaseModule):
                                 arg_params=self._arg_params,
                                 param_names=self._param_names,
                                 update_on_kvstore=update_on_kvstore)
+        # lazily-issued kvstore pulls must resolve exactly when the next
+        # forward binds the parameters (async dist data plane)
+        self._exec_group.pre_forward_sync = \
+            kvstore.flush if kvstore is not None else None
         if update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
         else:
@@ -618,6 +633,8 @@ class Module(BaseModule):
                                 arg_params=self._arg_params,
                                 param_names=self._param_names,
                                 update_on_kvstore=False)
+        self._exec_group.pre_forward_sync = \
+            kvstore.flush if kvstore is not None else None
         num_device = len(self._context)
         # host updater indexes params as index*num_device + k; remap the
         # optimizer's idx2name, update counts, and replicate per-device
@@ -773,6 +790,13 @@ class Module(BaseModule):
             self._fused_batch = None
             return
         if self._update_on_kvstore:
+            # pushes and pulls are submitted asynchronously (dist
+            # pipeline) and return immediately; the wire overlaps the
+            # rest of this step — metric update, data loading — until
+            # the next forward's pre_forward_sync resolves the pulls.
+            # Weights change only here, never in forward_backward, so
+            # skip-step patterns (e.g. NaN-loss guards) keep reference
+            # semantics
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
                                       self._kvstore)

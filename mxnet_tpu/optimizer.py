@@ -73,6 +73,16 @@ class Optimizer:
         self.set_lr_mult({})
         self.set_wd_mult({})
 
+    def __getstate__(self):
+        """Pickle support (dist kvstore ships the optimizer to the PS
+        servers via command 0): drop the bound symbol — it exists only
+        to harvest ``__lr_mult__``/``__wd_mult__`` attributes, which
+        ``set_lr_mult``/``set_wd_mult`` already baked into plain dicts,
+        and its compiled closures cannot pickle."""
+        state = self.__dict__.copy()
+        state["sym"] = None
+        return state
+
     def create_state(self, index, weight):
         """Allocate the per-parameter optimizer state for ``weight``
         (None when the rule is stateless)."""
@@ -450,15 +460,46 @@ class Updater:
         self.optimizer.update(index, weight, grad, self.states[index])
 
     def set_states(self, states):
-        if isinstance(states, bytes):
-            states = pickle.loads(states)
+        states, counts, num_update = unpack_updater_states(states)
+        if counts is not None:
+            # v2 envelope: restore the optimizer's update counters too —
+            # without them a resumed Adam restarts its bias-correction
+            # schedule (t=0) and RMSProp-family warmups re-run
+            self.optimizer._index_update_count = dict(counts)
+            self.optimizer.num_update = num_update
         # numpy payloads from get_states come back as NDArrays so fused
         # update ops keep working after a checkpoint resume
         self.states = {k: _state_from_host(v) for k, v in states.items()}
 
     def get_states(self):
-        return pickle.dumps({k: _state_to_host(v)
-                             for k, v in self.states.items()})
+        return pack_updater_states({k: _state_to_host(v)
+                                    for k, v in self.states.items()},
+                                   self.optimizer)
+
+
+def unpack_updater_states(obj):
+    """Split an optimizer-states payload into ``(states, counts,
+    num_update)``: accepts the bare ``{index: state}`` dict every
+    pre-v2 checkpoint holds (counts come back None) or the v2 envelope
+    ``Updater.get_states`` writes.  Shared by the host Updater and the
+    fused trainer's checkpoint interop so both speak both formats."""
+    if isinstance(obj, bytes):
+        obj = pickle.loads(obj)
+    if isinstance(obj, dict) and obj.get("__updater_format__") == 2:
+        return obj["states"], obj["index_update_count"], obj["num_update"]
+    return obj, None, None
+
+
+def pack_updater_states(states, optimizer=None):
+    """The v2 envelope for a host-layout ``{index: state}`` dict,
+    carrying ``optimizer``'s update counters when given."""
+    return pickle.dumps({
+        "__updater_format__": 2,
+        "states": states,
+        "index_update_count":
+            dict(optimizer._index_update_count) if optimizer else {},
+        "num_update": optimizer.num_update if optimizer else 0,
+    })
 
 
 def _state_to_host(v):

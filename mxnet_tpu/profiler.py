@@ -36,7 +36,12 @@ class Profiler:
         dispatch, cached_op.py), "backward" (tape replay), "rpc_retry" /
         "rpc_reconnect" (dist-kvstore fault-tolerance events,
         kvstore_dist.py — the backoff sleeps and redials taken when a
-        parameter server misses its RPC deadline)."""
+        parameter server misses its RPC deadline), "kvstore_push" /
+        "kvstore_pull" (one wire batch of the async data-plane pipeline,
+        kvstore_pipeline.py; coalesced bucket RPCs show their extra key
+        count in the name) and "comm_overlap" (one submit->flush window
+        of that pipeline — its span against the op spans inside it is
+        the visual evidence of compute/comm overlap)."""
         with self._lock:
             self.records.append((name, start_ns, end_ns,
                                  threading.get_ident(), cat))

@@ -17,6 +17,13 @@ Scenario (ISSUE 2 acceptance; docs/architecture/fault_tolerance.md):
 
 The same script serves every role: scheduler/server processes block and
 exit inside ``create_kvstore`` (kvstore_server role hijack).
+
+``TEST_KVSTORE_GRAD_COMPRESS=1`` runs the same scenario with the fast
+data plane fully enabled — 2-bit gradient compression (each push of
+ones delivers exactly +threshold with the rest carried in the
+error-feedback residual), fusion bucketing and the async pipeline — so
+the recovery guarantees are exercised against compressed, bucketed,
+pipelined traffic too.
 """
 import os
 import sys
@@ -37,6 +44,8 @@ KEY = 7
 def main():
     kv = mx.create_kvstore("dist_async")
     print("RANK", kv.rank, flush=True)
+    if os.environ.get("TEST_KVSTORE_GRAD_COMPRESS") == "1":
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
     kv.init(KEY, mx.nd.zeros(SHAPE))
     for _ in range(N_PUSH):
         kv.push(KEY, mx.nd.ones(SHAPE))

@@ -59,6 +59,47 @@ def main():
     assert np.allclose(out2b.asnumpy(), expected2, atol=1e-6), \
         (rank, out2b.asnumpy().ravel()[0], expected2)
 
+    # --- 2-bit compressed pushes (code-domain sync merge) ----------------
+    # every worker's push of ones*rate delivers exactly +threshold (the
+    # rest stays in its error-feedback residual), the server merges the
+    # contributions exactly in the integer code domain, and the
+    # installed SGD updater applies the merged gradient once:
+    # w = 1 - lr * threshold * nw.  The big key is range-sharded, so
+    # this also covers compressed shard slicing across servers.
+    threshold = 0.5
+    kv.set_gradient_compression({"type": "2bit", "threshold": threshold})
+    kv.init(5, mx.nd.ones(shape))
+    kv.init(97, mx.nd.ones(big_shape))
+    kv.push(5, mx.nd.ones(shape) * rate)
+    kv.push(97, mx.nd.ones(big_shape) * rate)
+    expected3 = 1.0 - lr * threshold * nw
+    out3 = mx.nd.zeros(shape)
+    kv.pull(5, out3)
+    assert np.allclose(out3.asnumpy(), expected3, atol=1e-6), \
+        (rank, out3.asnumpy().ravel()[0], expected3)
+    out3b = mx.nd.zeros(big_shape)
+    kv.pull(97, out3b)
+    assert np.allclose(out3b.asnumpy(), expected3, atol=1e-6), \
+        (rank, out3b.asnumpy().ravel()[0], expected3)
+
+    # --- batched multi-key push (fusion buckets under dist_sync) ---------
+    # four bucket-mates pushed in ONE call: the async pipeline may
+    # coalesce them differently on each worker (one push_multi here,
+    # two there) — the server's per-key merge rounds and per-RPC
+    # aggregated acks must still release everyone with the same result
+    bkeys = [20, 21, 22, 23]
+    kv.init(bkeys, [mx.nd.ones(shape)] * len(bkeys))
+    kv.push(bkeys, [mx.nd.ones(shape) * rate] * len(bkeys),
+            priority=[-k for k in bkeys])
+    outs = [mx.nd.zeros(shape) for _ in bkeys]
+    kv.pull(bkeys, outs, priority=[-k for k in bkeys])
+    kv.flush()
+    for o in outs:
+        # compression is still on: each worker's push delivered exactly
+        # +threshold into the code-domain merge, then SGD applied once
+        assert np.allclose(o.asnumpy(), expected3, atol=1e-6), \
+            (rank, o.asnumpy().ravel()[0], expected3)
+
     assert kv.get_num_dead_node(0) == 0
     kv.close()
     print("dist_sync_kvstore OK rank=%d/%d" % (rank, nw))

@@ -1,0 +1,246 @@
+"""KVStore data-plane units (docs/architecture/kvstore_comm.md):
+
+* 2-bit codec: pack/unpack exactness, round-trip error bound vs the
+  threshold, exact wire-size accounting;
+* error feedback: residual stream unbiased — compressed SGD on a
+  quadratic bowl reaches the fp32 loss within tolerance;
+* per-key negotiation: small keys and non-fp32 payloads stay lossless;
+* fusion buckets: deterministic greedy layout (same init sequence =>
+  same layout, the restart/snapshot-compatibility invariant),
+  capacity and standalone rules;
+* local KVStore honors `priority=` as processing order of a multi-key
+  call, and checkpoints its compression residuals with the optimizer
+  states.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore_codec as codec
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# Codec
+# ---------------------------------------------------------------------------
+def test_pack_unpack_codes_exact():
+    rs = np.random.RandomState(0)
+    for n in (1, 3, 4, 5, 16, 1001):
+        codes = rs.randint(-1, 2, n).astype(np.int8)
+        assert (codec.unpack_codes(codec.pack_codes(codes), n)
+                == codes).all()
+
+
+def test_quantize_maps_to_threshold_levels():
+    t = 0.25
+    x = np.array([-1.0, -0.25, -0.1, 0.0, 0.1, 0.25, 3.0], np.float32)
+    got = codec.codes_to_float(codec.quantize_codes(x, t), t)
+    np.testing.assert_array_equal(
+        got, np.array([-t, -t, 0, 0, 0, t, t], np.float32))
+
+
+def test_roundtrip_error_bound_vs_threshold():
+    """For inputs within +/-2t one quantization errs by at most t (and
+    the represented magnitude never exceeds t) — the per-step bound the
+    error-feedback residual carries forward."""
+    rs = np.random.RandomState(1)
+    for t in (0.1, 0.5, 2.0):
+        x = rs.uniform(-2 * t, 2 * t, 4096).astype(np.float32)
+        deq = codec.codes_to_float(codec.quantize_codes(x, t), t)
+        assert np.abs(deq - x).max() <= t + 1e-6
+        assert np.abs(deq).max() <= t
+
+
+def test_exact_size_accounting():
+    for n in (1, 4, 5, 1000, 1001):
+        cg = codec.CompressedGrad(np.zeros(n, np.int8), 0.5)
+        wire = cg.wire()
+        assert codec.wire_nbytes(wire) == codec.compressed_nbytes(n)
+        assert len(wire[1]) == (n + 3) // 4
+    # fp32 payloads count their raw buffer
+    assert codec.wire_nbytes(np.zeros(10, np.float32)) == 40
+    # >= 8x reduction from 256 elements up (4n / (n/4 + 8))
+    assert 4 * 256 / codec.compressed_nbytes(256) > 8
+
+
+def test_compressed_grad_shard_equals_whole():
+    """Range shards cut from the whole-array codes byte-match
+    quantizing the shard — the invariant that lets big keys quantize
+    once and slice per server."""
+    rs = np.random.RandomState(2)
+    x = rs.uniform(-1, 1, 1000).astype(np.float32)
+    gc = codec.GradientCompression({"type": "2bit", "threshold": 0.3})
+    cg = gc.compress("k", x)
+    lo, hi = 123, 789
+    whole = cg.wire(lo, hi)
+    sliced = codec.CompressedGrad(
+        codec.quantize_codes(x[lo:hi], 0.3), 0.3).wire()
+    assert whole == sliced
+
+
+def test_error_feedback_residual_stream():
+    gc = codec.GradientCompression({"type": "2bit", "threshold": 1.0})
+    x = np.full(16, 0.4, np.float32)
+    total = np.zeros(16, np.float32)
+    for _ in range(5):
+        total += gc.compress(7, x).dequantize()
+    # 5 x 0.4 = 2.0 fed in; quantized stream emitted 2.0 exactly (two
+    # +1.0 ticks), residual holds the rest
+    np.testing.assert_allclose(total, 2.0)
+    np.testing.assert_allclose(gc.residuals[7], 0.0, atol=1e-6)
+
+
+def test_gradient_compression_validation_and_negotiation():
+    with pytest.raises(MXNetError, match="unsupported"):
+        codec.GradientCompression({"type": "1bit"})
+    with pytest.raises(MXNetError, match="positive"):
+        codec.GradientCompression({"type": "2bit", "threshold": 0})
+    with pytest.raises(MXNetError, match="unknown"):
+        codec.GradientCompression({"type": "2bit", "bogus": 1})
+    assert not codec.GradientCompression({"type": "none"}).active
+    gc = codec.GradientCompression({"type": "2bit", "threshold": 0.5})
+    assert gc.negotiate(0, np.zeros(16, np.float32))
+    # below the lower bound, or non-fp32 (indices/aux): lossless
+    assert not gc.negotiate(0, np.zeros(15, np.float32))
+    assert not gc.negotiate(0, np.zeros(64, np.int64))
+
+
+# ---------------------------------------------------------------------------
+# Bucket plan
+# ---------------------------------------------------------------------------
+def test_bucket_plan_deterministic_across_rebuilds():
+    """Same (key, size) init sequence => identical layout: what makes
+    every worker agree on bucket->server placement, and what keeps
+    restarts snapshot-compatible (servers store per key, and the
+    rebuilt plan routes each key to the same server)."""
+    seq = [(i, s) for i, s in enumerate([300, 300, 5000, 300, 70000,
+                                         300, 300, 2_000_000, 300])]
+
+    def build():
+        plan = codec.BucketPlan(bucket_bytes=4096, bigarray_bound=10**6)
+        for k, s in seq:
+            plan.add(k, s)
+        return plan
+
+    a, b = build(), build()
+    assert a.layout() == b.layout()
+    for k, _ in seq:
+        assert a.bucket_of(k) == b.bucket_of(k)
+        if a.bucket_of(k) is not None:
+            for ns in (1, 2, 3, 5):
+                assert a.server_of(a.bucket_of(k), ns) \
+                    == b.server_of(b.bucket_of(k), ns)
+
+
+def test_bucket_plan_capacity_and_standalone_rules():
+    plan = codec.BucketPlan(bucket_bytes=4096, bigarray_bound=1000)
+    assert plan.add("big", 1000) is None          # range-shard bound
+    assert plan.add("wide", 999) is not None      # 3996 B: still bucketed
+    plan2 = codec.BucketPlan(bucket_bytes=400, bigarray_bound=10**6)
+    assert plan2.add("exact", 100) is None        # 400 B >= bucket_bytes
+    b0 = plan2.add("a", 50)                       # 200 B
+    assert plan2.add("b", 40) == b0               # 160 B: fits (360)
+    b1 = plan2.add("c", 20)                       # 80 B: would be 440
+    assert b1 is not None and b1 != b0
+    assert plan2.members(b0) == ["a", "b"]
+    assert plan2.add("a", 50) == b0               # idempotent
+
+
+# ---------------------------------------------------------------------------
+# Local KVStore: compression semantics + priority + residual checkpoints
+# ---------------------------------------------------------------------------
+def test_local_kvstore_compressed_push_quantizes():
+    kv = mx.create_kvstore("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(3, mx.nd.zeros((4, 4)))
+    kv.push(3, mx.nd.ones((4, 4)))       # default accumulate updater
+    out = mx.nd.empty((4, 4))
+    kv.pull(3, out=out)
+    # |1.0| >= t: quantized to +t, residual 0.5 carried
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+    kv.push(3, mx.nd.ones((4, 4)))       # 1.0 + residual 0.5 -> +t, ...
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+def test_local_kvstore_small_keys_stay_lossless():
+    kv = mx.create_kvstore("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(1, mx.nd.zeros((2, 2)))      # 4 elems < lower bound
+    kv.push(1, mx.nd.ones((2, 2)) * 0.8)
+    out = mx.nd.empty((2, 2))
+    kv.pull(1, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.8, rtol=1e-6)
+
+
+def test_error_feedback_sgd_converges_on_quadratic_bowl():
+    """min ||w - w*||^2 by SGD through the kvstore: the compressed run
+    (2-bit + error feedback) must reach the fp32 run's loss within
+    tolerance — the convergence claim of the codec."""
+    target = np.linspace(-1.0, 1.0, 32).astype(np.float32)
+
+    def run(compression):
+        kv = mx.create_kvstore("local")
+        if compression:
+            kv.set_gradient_compression(compression)
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, wd=0.0,
+                                          rescale_grad=1.0))
+        kv.init(0, mx.nd.zeros((32,)))
+        w = mx.nd.zeros((32,))
+        # threshold on the gradient's own scale (the regime the codec is
+        # run at in practice): a step moves at most lr*t per coordinate,
+        # so give the run |w*|/(lr*t) = 20+ steps plus settle time
+        for _ in range(200):
+            grad = mx.nd.array(w.asnumpy() - target)  # d/dw 0.5||w-w*||^2
+            kv.push(0, grad)
+            kv.pull(0, out=w)
+        return 0.5 * float(((w.asnumpy() - target) ** 2).sum())
+
+    loss_fp32 = run(None)
+    loss_2bit = run({"type": "2bit", "threshold": 0.5})
+    assert loss_fp32 < 1e-6
+    assert abs(loss_2bit - loss_fp32) < 2e-2, (loss_2bit, loss_fp32)
+
+
+def test_local_priority_orders_multi_key_processing():
+    kv = mx.create_kvstore("local")
+    keys = [0, 1, 2]
+    for k in keys:
+        kv.init(k, mx.nd.zeros((2,)))
+    seen = []
+    kv.set_updater(lambda k, g, w: seen.append(k))
+    # priorities -0, -1, -2: key 0 is most urgent regardless of issue
+    # order — the same contract the dist pipeline schedules by
+    kv.push([2, 1, 0], [mx.nd.ones((2,))] * 3, priority=[-2, -1, 0])
+    assert seen == [0, 1, 2]
+    with pytest.raises(MXNetError, match="priorities"):
+        kv.push([0, 1], [mx.nd.ones((2,))] * 2, priority=[0])
+
+
+def test_residuals_checkpoint_with_optimizer_states(tmp_path):
+    kv = mx.create_kvstore("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.init(0, mx.nd.zeros((16,)))
+    kv.push(0, mx.nd.ones((16,)) * 0.7)   # leaves residual 0.2
+    fname = str(tmp_path / "states")
+    kv.save_optimizer_states(fname)
+    kv2 = mx.create_kvstore("local")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv2.init(0, mx.nd.zeros((16,)))
+    kv2.load_optimizer_states(fname)
+    np.testing.assert_allclose(kv2._gc.residuals[0],
+                               kv._gc.residuals[0])
+    # updater update counts resumed too (v2 envelope)
+    assert kv2._updater.optimizer.num_update == \
+        kv._updater.optimizer.num_update
+    # reverse order — load BEFORE enabling compression — must not drop
+    # the checkpointed residuals: they are stashed and handed over when
+    # set_gradient_compression runs
+    kv3 = mx.create_kvstore("local")
+    kv3.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv3.load_optimizer_states(fname)
+    kv3.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    np.testing.assert_allclose(kv3._gc.residuals[0],
+                               kv._gc.residuals[0])

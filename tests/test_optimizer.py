@@ -144,6 +144,24 @@ def test_updater_states_pickle_roundtrip():
                         atol=1e-6)
 
 
+def test_optimizer_pickles_without_symbol():
+    """The dist kvstore ships the optimizer to PS servers via command 0;
+    an optimizer constructed with sym= (how Module.init_optimizer builds
+    it, to harvest lr/wd mult attrs) must still pickle — the symbol's
+    closures don't, so __getstate__ drops it after the mults are
+    baked."""
+    import pickle
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc", attr={"__lr_mult__": "2.0"})
+    opt = mx.optimizer.create("sgd", sym=net, learning_rate=0.1,
+                              param_idx2name={0: "fc_weight"})
+    clone = pickle.loads(pickle.dumps(opt))
+    assert clone.sym is None
+    assert clone.lr_mult == opt.lr_mult      # mults survived the drop
+    assert clone._get_lr(0) == opt._get_lr(0)
+    assert opt.sym is net                     # original untouched
+
+
 def test_create_registry():
     for name in ("sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
                  "nag", "sgld", "dcasgd"):
