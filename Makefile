@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke ci-quick ci-full docs bench hygiene
+.PHONY: test quick build dist convergence dist-smoke step-profile ci-quick ci-full docs bench hygiene
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -31,6 +31,13 @@ dist-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu \
 		$(PY) -m pytest tests/test_fault_tolerance.py -q \
 		-k "seeded or wire_bytes"
+
+# smoke fit under the profiler -> per-step phase breakdown
+# (data_wait/h2d_stage/compute/metric_fetch) from the dumped trace, so
+# the report format tools/step_profile.py emits cannot rot
+step-profile:
+	timeout -k 10 180 env JAX_PLATFORMS=cpu \
+		$(PY) tools/step_profile.py --delay-ms 5
 
 convergence:
 	$(PY) -m pytest tests/ -m convergence -q

@@ -38,8 +38,8 @@ def score(network, dev, batch_size, num_batches, num_layers=None,
                           .astype(dtype))], label=[])
     # warmup (compile); fetch-forced syncs bracket the clock — over a
     # remote PJRT device wait_to_read can return at enqueue-ack
-    # (docs/perf.md, measuring honestly; shared primitive in bench.py)
-    from bench import _fetch_sync
+    # (docs/perf.md, measuring honestly)
+    from mxnet_tpu.test_utils import fetch_sync as _fetch_sync
     for _ in range(2):
         mod.forward(batch, is_train=False)
     for o in mod.get_outputs():

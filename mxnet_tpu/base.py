@@ -178,6 +178,23 @@ register_env("MXNET_KVSTORE_COMPRESS_LOWER_BOUND", int, 16,
              "Minimum elements before an enabled gradient compression "
              "applies to a key's pushes; smaller keys (and any non-fp32 "
              "payload: indices, aux state) stay lossless.")
+register_env("MXNET_IO_STAGE", bool, True,
+             "Overlapped device input staging: Module.fit stages batch "
+             "t+1 onto the device (host->device upload on a background "
+             "thread, double-buffered) while step t computes "
+             "(io/stager.py).  '0' restores the per-step blocking "
+             "upload bit-for-bit.")
+register_env("MXNET_IO_STAGE_DEPTH", int, 2,
+             "Bound on batches staged ahead of compute by the device "
+             "input stager (the double-buffer depth).  Each slot pins "
+             "one batch of device memory; 2 is classic double "
+             "buffering.")
+register_env("MXNET_EXEC_DONATE", bool, True,
+             "Donate dead auxiliary-state buffers (BatchNorm moving "
+             "stats) into the symbolic Executor's jitted train "
+             "programs so XLA updates them in place in HBM.  Applies "
+             "off-CPU only (CPU PJRT has no donation), never when the "
+             "graph holds Custom host callbacks.  '0' disables.")
 register_env("MXNET_FAULT_INJECT", str, "",
              "Deterministic fault-injection schedule for the dist "
              "kvstore: inline JSON or a path to a JSON file (see "

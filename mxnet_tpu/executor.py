@@ -70,9 +70,22 @@ class _Segment:
 
 class Executor:
     def __init__(self, symbol, ctx, args, grads, reqs, aux, group2ctx=None,
-                 shared_exec=None):
+                 shared_exec=None, compute_dtype=None, keep_dtype=()):
+        """``compute_dtype='bfloat16'`` (TPU extension) runs the traced
+        compute in bf16 while the bound arg/grad/aux arrays stay in
+        their master dtype (fp32): inputs cast on entry to the jitted
+        programs, gradients emerge fp32 through the cast's vjp, aux
+        updates cast back before the write-back — the same mixed-
+        precision policy as ``parallel/dp.py``, now on the classic
+        symbolic path.  ``keep_dtype`` names args never cast (labels:
+        class ids >= 256 are not representable in bf16's significand).
+        Ignored under ctx_group staged execution (model-parallel
+        segments stay master-dtype)."""
         self._symbol = symbol
         self._ctx = ctx
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype else None)
+        self._keep_dtype = frozenset(keep_dtype)
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
@@ -587,8 +600,54 @@ class Executor:
         trace = self._trace
         diff_idx = tuple(self._diff_idx)
 
+        # mixed precision (compute_dtype): cast floating args/aux to the
+        # compute dtype INSIDE the jitted programs — the vjp of the cast
+        # returns master-dtype gradients, and aux updates (BatchNorm
+        # moving stats) cast back to their master dtype before the
+        # write-back, mirroring parallel/dp.py's policy
+        cdt = self._compute_dtype if self._stage_plan is None else None
+        keep = self._keep_dtype
+        castable = tuple(n not in keep for n in self._arg_names)
+
+        def _cast_args(vals):
+            if cdt is None:
+                return tuple(vals)
+            return tuple(
+                v.astype(cdt) if ok and v.dtype != cdt and
+                jnp.issubdtype(v.dtype, jnp.floating) else v
+                for v, ok in zip(vals, castable))
+
+        def _cast_aux(vals):
+            if cdt is None:
+                return tuple(vals)
+            return tuple(v.astype(cdt) if v.dtype != cdt and
+                         jnp.issubdtype(v.dtype, jnp.floating) else v
+                         for v in vals)
+
+        def _uncast_aux(new_aux, aux_vals):
+            if cdt is None:
+                return tuple(new_aux)
+            return tuple(u.astype(a.dtype) for u, a in zip(new_aux,
+                                                           aux_vals))
+
+        # aux-buffer donation: train programs consume the old moving
+        # stats and return the new ones, so the old buffers are dead the
+        # moment the program runs — donate them and XLA updates in place
+        # in HBM.  Guards mirror dp.py/cached_op.py: never with Custom
+        # host callbacks (donated input + blocking callback deadlocks),
+        # never on CPU (PJRT:CPU has no donation — only warns), and
+        # MXNET_EXEC_DONATE=0 is the escape hatch.
+        self._donate_aux = bool(
+            get_env("MXNET_EXEC_DONATE") and self.aux_arrays and
+            self._stage_plan is None and
+            not self._symbol.has_custom_ops() and
+            jax.default_backend() not in ("cpu",))
+        donate = (1,) if self._donate_aux else ()
+
         def fwd(arg_vals, aux_vals, rng, is_train):
-            return trace(arg_vals, aux_vals, is_train, rng)
+            outs, new_aux = trace(_cast_args(arg_vals),
+                                  _cast_aux(aux_vals), is_train, rng)
+            return outs, _uncast_aux(new_aux, aux_vals)
 
         self._jit_fwd = jax.jit(fwd, static_argnums=(3,))
 
@@ -608,14 +667,15 @@ class Executor:
                 full = list(arg_vals)
                 for i, v in zip(diff_idx, diff_vals):
                     full[i] = v
-                outs, new_aux = trace(tuple(full), aux_vals, True, rng)
-                return outs, new_aux
+                outs, new_aux = trace(_cast_args(full),
+                                      _cast_aux(aux_vals), True, rng)
+                return outs, _uncast_aux(new_aux, aux_vals)
 
             diff_vals = tuple(arg_vals[i] for i in diff_idx)
             outs, vjp, new_aux = jax.vjp(f, diff_vals, has_aux=True)
             return outs, new_aux, vjp
 
-        self._jit_fwd_res = jax.jit(fwd_res)
+        self._jit_fwd_res = jax.jit(fwd_res, donate_argnums=donate)
 
         def bwd_from_res(vjp, outs, ograds):
             cots = tuple(jnp.ones_like(o) if g is None else g
@@ -631,8 +691,9 @@ class Executor:
                 full = list(arg_vals)
                 for i, v in zip(diff_idx, diff_vals):
                     full[i] = v
-                outs, new_aux = trace(tuple(full), aux_vals, True, rng)
-                return outs, new_aux
+                outs, new_aux = trace(_cast_args(full),
+                                      _cast_aux(aux_vals), True, rng)
+                return outs, _uncast_aux(new_aux, aux_vals)
 
             diff_vals = tuple(arg_vals[i] for i in diff_idx)
             outs, vjp, new_aux = jax.vjp(f, diff_vals, has_aux=True)
@@ -641,7 +702,14 @@ class Executor:
             grads = vjp(cots)[0]
             return outs, new_aux, grads
 
-        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+        self._jit_fwd_bwd = jax.jit(fwd_bwd, donate_argnums=donate)
+        # non-donating variant for backward() re-runs from a POST-step
+        # aux stash (only reachable when donation consumed the pre-step
+        # aux); jitted lazily — the path is exercised only by repeated
+        # backward() calls without an intervening forward
+        self._fwd_bwd_fn = fwd_bwd
+        self._jit_fwd_bwd_nodonate = None
+        self._stash_advanced = False
 
     # ------------------------------------------------------------------
     def _gather(self):
@@ -670,6 +738,9 @@ class Executor:
             if rng is None:
                 rng = self._eval_rng = _random.next_key()
         self._last_res = None
+        stash_aux = aux_vals
+        if is_train:
+            self._stash_advanced = False
         if self._monitor_cb is not None:
             outs, new_aux = self._forward_monitored(arg_vals, aux_vals,
                                                     is_train, rng)
@@ -689,6 +760,13 @@ class Executor:
                 "executor_forward_train", self._jit_fwd_res, arg_vals,
                 aux_vals, rng)
             self._last_res = (outs, vjp)
+            if self._donate_aux:
+                # the dispatch above consumed aux_vals: stash the live
+                # post-step aux so a later fused-fallback backward never
+                # touches a donated buffer (monitored/staged forwards
+                # run eagerly and keep the pre-step stash)
+                stash_aux = tuple(new_aux)
+                self._stash_advanced = True
         else:
             outs, new_aux = _engine.get().dispatch(
                 "executor_forward", self._jit_fwd, arg_vals, aux_vals,
@@ -698,7 +776,7 @@ class Executor:
         if is_train:
             for a_nd, a in zip(self.aux_arrays, new_aux):
                 a_nd._data = a
-            self._last_state = (arg_vals, aux_vals, rng)
+            self._last_state = (arg_vals, stash_aux, rng)
         return self.outputs
 
     def _forward_monitored(self, arg_vals, aux_vals, is_train, rng):
@@ -755,13 +833,35 @@ class Executor:
             grads = _engine.get().dispatch(
                 "executor_backward", self._jit_bwd_res, vjp, outs, ograds)
         else:
+            rerun = self._donate_aux and self._stash_advanced
+            if rerun:
+                # re-running from a POST-step aux stash (donation
+                # consumed the pre-step aux): a donating dispatch would
+                # kill the live aux buffers AND advance the moving
+                # stats a second time for the same batch, diverging
+                # from MXNET_EXEC_DONATE=0.  Use a non-donating
+                # executable and keep the once-advanced aux (train-mode
+                # BN reads batch stats, not the moving stats, so the
+                # recomputed grads are unaffected).
+                if self._jit_fwd_bwd_nodonate is None:
+                    self._jit_fwd_bwd_nodonate = jax.jit(self._fwd_bwd_fn)
+                fn = self._jit_fwd_bwd_nodonate
+            else:
+                fn = self._jit_fwd_bwd
             outs, new_aux, grads = _engine.get().dispatch(
-                "executor_forward_backward", self._jit_fwd_bwd, arg_vals,
+                "executor_forward_backward", fn, arg_vals,
                 aux_vals, rng, ograds)
             for o_nd, o in zip(self.outputs, outs):
                 o_nd._data = o
-            for a_nd, a in zip(self.aux_arrays, new_aux):
-                a_nd._data = a
+            if not rerun:
+                for a_nd, a in zip(self.aux_arrays, new_aux):
+                    a_nd._data = a
+                if self._donate_aux:
+                    # the dispatched program consumed aux_vals: refresh
+                    # the stash so a repeated backward() reads live
+                    # buffers
+                    self._last_state = (arg_vals, tuple(new_aux), rng)
+                    self._stash_advanced = True
         for i, g in zip(self._diff_idx, grads):
             name = self._arg_names[i]
             req = self.grad_req.get(name, "write")
@@ -815,6 +915,7 @@ class Executor:
         arg_vals, aux_vals = self._gather()
         rng = _random.next_key()
         self._last_state = (arg_vals, aux_vals, rng)
+        self._stash_advanced = False   # freshly gathered pre-step aux
         self._last_res = None  # one-shot fused program, no stash
         return self.backward(out_grads)
 
@@ -896,7 +997,9 @@ class Executor:
             else:
                 new_aux.append(nd.zeros(ns, self._ctx, dtype=str(cur.dtype)))
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self.grad_req, new_aux, group2ctx=self._group2ctx)
+                        self.grad_req, new_aux, group2ctx=self._group2ctx,
+                        compute_dtype=self._compute_dtype,
+                        keep_dtype=self._keep_dtype)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor_cb = callback

@@ -7,8 +7,9 @@ BatchLoader/PrefetcherIter decorators) + ``python/mxnet/io.py``
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
                  MNISTIter, PrefetchingIter, ResizeIter, ImageRecordIter)
 from .detection import ImageDetRecordIter
+from .stager import DeviceStager
 from . import recordio
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "MNISTIter", "PrefetchingIter", "ResizeIter", "ImageRecordIter",
-           "ImageDetRecordIter", "recordio"]
+           "ImageDetRecordIter", "DeviceStager", "recordio"]

@@ -13,6 +13,7 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import profiler
 from ..base import MXNetError
 from ..io.io import DataBatch
 
@@ -172,16 +173,58 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # overlapped device input staging (io/stager.py): batch t+1
+        # uploads while step t computes.  Wrapped AFTER init_optimizer
+        # so the module knows its target placement (fused-trainer
+        # sharding vs executor device); identity when MXNET_IO_STAGE=0
+        # or the module has no staging target.
+        source_data, train_data = train_data, \
+            self._stage_train_data(train_data)
+        try:
+            self._fit_epochs(train_data, eval_data, eval_metric,
+                             validation_metric, epoch_end_callback,
+                             batch_end_callback, eval_end_callback,
+                             eval_batch_end_callback, monitor,
+                             begin_epoch, num_epoch)
+        finally:
+            if train_data is not source_data:
+                train_data.close()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, monitor, begin_epoch,
+                    num_epoch):
+        """The fit epoch/batch loop (split out so ``fit`` can scope the
+        input stager's lifetime around it)."""
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
+            nbatch = 0
+            data_iter = iter(train_data)
+            while True:
+                # step-phase attribution (profiler.record_phase is a
+                # two-lookup no-op unless a collector/trace is on):
+                # data_wait = blocked on the iterator (the stager hides
+                # source latency here), compute = step dispatch,
+                # metric_fetch = metric update incl. any host fetch.
+                t_ns = time.perf_counter_ns()
+                try:
+                    data_batch = next(data_iter)
+                except StopIteration:
+                    break
+                profiler.record_phase("data_wait", t_ns)
                 if monitor is not None:
                     monitor.tic()
+                t_ns = time.perf_counter_ns()
                 self.prepare(data_batch)
                 self.forward_backward(data_batch)
                 self.update()
+                profiler.record_phase("compute", t_ns)
+                t_ns = time.perf_counter_ns()
                 self.update_metric(eval_metric, data_batch.label)
+                profiler.record_phase("metric_fetch", t_ns)
+                profiler.mark_step()
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -190,6 +233,7 @@ class BaseModule:
                         locals=locals())
                     for callback in _as_list(batch_end_callback):
                         callback(batch_end_params)
+                nbatch += 1
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -337,6 +381,13 @@ class BaseModule:
         """Per-batch preparation hook, called by the fit loop before
         ``forward_backward`` (reference base_module.py:719; a no-op for
         dense modules — BucketingModule binds the batch's bucket here)."""
+
+    def _stage_train_data(self, train_data):
+        """Hook for overlapped device input staging: return an iterator
+        whose batches are already placed on device (``io.DeviceStager``)
+        or ``train_data`` unchanged.  Base modules have no placement
+        target, so the default is the identity."""
+        return train_data
 
     def _epoch_end_param_sync(self):
         """Epoch-end device->host sync + device write-back (reference

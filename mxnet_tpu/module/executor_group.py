@@ -89,9 +89,13 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", state_names=None):
+                 grad_req="write", state_names=None, compute_dtype=None):
+        """``compute_dtype='bfloat16'`` threads the mixed-precision
+        policy into each bound Executor (fp32 master weights, compute-
+        dtype MXU math); labels are pinned to their master dtype."""
         self.symbol = symbol
         self.contexts = contexts
+        self.compute_dtype = compute_dtype
         self.workload = workload or [1] * len(contexts)
         self.param_names = param_names
         self.for_training = for_training
@@ -155,8 +159,10 @@ class DataParallelExecutorGroup:
                     shapes[l.name] = ((n_i,) + tuple(l.shape[1:])
                                       if _batched0(l, batch_size)
                                       else tuple(l.shape))
+            keep = tuple(l.name for l in (label_shapes or []))
             ex = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
-                                         **shapes)
+                                         compute_dtype=self.compute_dtype,
+                                         keep_dtype=keep, **shapes)
             if shared_group is not None and i < len(shared_group.execs):
                 # Share parameter/aux NDArray handles with the shared group
                 # (reference: shared memory pool in InitDataEntryMemory;

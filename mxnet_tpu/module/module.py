@@ -40,11 +40,13 @@ class Module(BaseModule):
                  context=None, work_load_list=None,
                  fixed_param_names=None, state_names=None,
                  compute_dtype=None):
-        """``compute_dtype='bfloat16'`` (TPU extension) runs the fused
-        fast-path step in mixed precision: fp32 master weights and
-        optimizer state, bf16 MXU compute — the role the reference's
-        ``*_fp16`` symbol variants play on GPU.  Ignored on the
-        executor-group fallback path."""
+        """``compute_dtype='bfloat16'`` (TPU extension) trains in mixed
+        precision: fp32 master weights and optimizer state, bf16 MXU
+        compute — the role the reference's ``*_fp16`` symbol variants
+        play on GPU.  Applied on BOTH the fused fast path
+        (``parallel/dp.py``) and the executor-group fallback (the
+        policy threads through ``Executor.bind``), so checkpoints stay
+        fp32 either way."""
         super().__init__(logger=logger)
         self._compute_dtype = compute_dtype
         if context is None:
@@ -301,14 +303,18 @@ class Module(BaseModule):
                 for name in self._aux_names}
 
     def _epoch_end_param_sync(self):
-        """Fused fast path: the step is ONE compiled program over the
-        mesh — parameters and aux state are replicated arrays that cannot
-        diverge per device, so the reference's epoch-end write-back would
-        re-upload every parameter unchanged (two full parameter-set
-        transfers per epoch over a remote PJRT device).  Sync down only.
-        The executor-group path (and single-device, where the upload is
-        an identical no-op with nothing to reconverge) keeps the
-        reference write-back for per-device BN-stat reconvergence."""
+        """Epoch-end write-back policy (pinned by
+        tests/test_module.py::test_epoch_end_param_sync_routing): the
+        fused fast path AND single-device executor groups skip the
+        device re-upload — fused state is one replicated program that
+        cannot diverge per device, and a single device has nothing to
+        reconverge, so the reference's set_params would re-upload every
+        parameter unchanged (two full parameter-set transfers per epoch
+        over a remote PJRT device).  Both sync down only.  Only
+        MULTI-device executor groups keep the reference
+        get_params/set_params pair — the host-averaged write-back is
+        what reconverges per-device BatchNorm moving stats each
+        epoch."""
         if self._fused is not None or len(self._context) == 1:
             return self.get_params()
         return super()._epoch_end_param_sync()
@@ -359,7 +365,8 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=grad_req, state_names=self._state_names)
+            grad_req=grad_req, state_names=self._state_names,
+            compute_dtype=self._compute_dtype)
 
         if shared_module is not None:
             self.params_initialized = True
@@ -369,6 +376,9 @@ class Module(BaseModule):
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def _reset_bind(self):
+        if self._fused is not None:
+            # cached input placements pin ~a batch of HBM per name
+            self._fused.clear_placement_cache()
         self.binded = False
         self._exec_group = None
         self._data_shapes = None
@@ -395,6 +405,7 @@ class Module(BaseModule):
             # longer qualify (e.g. batch not divisible across contexts),
             # fall back to full executor-group semantics
             old = self._fused
+            old.clear_placement_cache()
             trainer = None
             batch = self._exec_group.batch_size
             if batch % len(self._context) == 0:
@@ -612,6 +623,7 @@ class Module(BaseModule):
         re-fuse without recompiling; permanent causes (monitor install)
         disable the fast path for good."""
         trainer = self._fused
+        trainer.clear_placement_cache()
         self._fused = None
         self._fused_disabled = True
         # re-fuse only outside bucketing coordination (buckets defuse as a
@@ -696,6 +708,34 @@ class Module(BaseModule):
         self._updater = None
         self.logger.info("re-entering fused fast path")
         return True
+
+    def _stage_train_data(self, train_data):
+        """Overlapped device input staging for the fit loop: wrap the
+        iterator in a ``DeviceStager`` uploading toward this module's
+        placement — the fused trainer's batch sharding, or the executor
+        group's device.  Identity when MXNET_IO_STAGE=0 (bit-exact
+        pre-stager behavior), under multi-process jax (the trainer
+        shards from HOST buffers there), or when a monitor wants eager
+        per-op access anyway."""
+        import jax
+        from ..io.stager import DeviceStager, staging_enabled
+        if not staging_enabled() or self._monitor is not None:
+            return train_data
+        if self._fused is not None:
+            if jax.process_count() > 1:
+                return train_data
+            target = self._fused._batched
+        else:
+            try:
+                target = self._context[0].jax_device()
+            except Exception:
+                return train_data
+
+        def place(arr):
+            # device_put canonicalizes host dtypes (float64 -> float32)
+            # exactly like nd.array would on the blocking path
+            return jax.device_put(arr, target)
+        return DeviceStager(train_data, place)
 
     def _sync_from_trainer(self, trainer):
         args, aux = trainer.get_params()

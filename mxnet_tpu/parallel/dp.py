@@ -361,9 +361,23 @@ class DataParallelTrainer:
                     out[k] = self._place_cached(k, arr)
                 else:
                     # mutable host source (plain numpy): placement must
-                    # not be cached — in-place edits would be served stale
+                    # not be cached — in-place edits would be served
+                    # stale.  Also drop any stale cache entry for this
+                    # name: an iterator that switched from a steady
+                    # device buffer to host batches would otherwise pin
+                    # a dead batch of HBM for the trainer's lifetime
+                    cache = getattr(self, "_placement_cache", None)
+                    if cache is not None:
+                        cache.pop(k, None)
                     out[k] = jax.device_put(arr, self._batched)
         return out
+
+    def clear_placement_cache(self):
+        """Drop all cached input placements (each entry pins ~a batch of
+        HBM per input name).  Module calls this on unbind/rebind and
+        when it leaves the fused fast path, so a retired trainer never
+        holds batch buffers alive."""
+        self._placement_cache = {}
 
     def _place_cached(self, name, arr):
         """device_put with a per-input placement cache.

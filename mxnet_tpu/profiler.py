@@ -20,7 +20,15 @@ from . import engine as _engine
 from .base import get_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "Profiler"]
+           "Profiler", "record_phase", "mark_step", "start_step_profile",
+           "stop_step_profile", "aggregate_phase_trace", "PHASES"]
+
+# The per-step wall-time attribution phases of one Module.fit batch
+# (tools/step_profile.py renders them; docs/perf.md explains the
+# methodology).  ``h2d_stage`` is recorded by the DeviceStager's
+# background thread, so it OVERLAPS compute rather than adding to the
+# step — the report calls that out.
+PHASES = ("data_wait", "h2d_stage", "compute", "metric_fetch")
 
 
 class Profiler:
@@ -64,6 +72,127 @@ class Profiler:
 
 
 _state = {"profiler": None, "filename": "profile.json", "jax_logdir": None}
+
+
+# ---------------------------------------------------------------------------
+# Step-phase attribution.
+#
+# Two consumers share the ``record_phase`` seam:
+# * the Chrome-trace profiler above (spans land with cat="step_phase",
+#   so a full trace shows the phases against the op spans inside them);
+# * a lightweight ``StepPhaseCollector`` that only sums durations — it
+#   never blocks dispatch (unlike the engine-seam profiler, which
+#   synchronizes every dispatched program to time execution), so
+#   bench.py can keep it on DURING a timed window without perturbing
+#   the async pipeline.
+# ---------------------------------------------------------------------------
+class StepPhaseCollector:
+    """Accumulates per-phase wall time across fit steps."""
+
+    def __init__(self):
+        self.totals = {}    # phase -> ns
+        self.counts = {}    # phase -> spans
+        self.steps = 0
+        self._lock = threading.Lock()
+
+    def record(self, name, dur_ns):
+        with self._lock:
+            self.totals[name] = self.totals.get(name, 0) + dur_ns
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def mark_step(self):
+        with self._lock:
+            self.steps += 1
+
+    def report(self):
+        """Per-step phase breakdown: {phase: {total_ms, mean_ms,
+        per_step_ms, pct}} plus step count.  ``pct`` is each phase's
+        share of the summed NON-overlapped phases (h2d_stage runs on
+        the stager thread concurrently with compute and is excluded
+        from the denominator)."""
+        with self._lock:
+            totals = dict(self.totals)
+            counts = dict(self.counts)
+            steps = self.steps
+        denom = sum(v for k, v in totals.items() if k != "h2d_stage")
+        phases = {}
+        for name in sorted(totals, key=lambda n: -totals[n]):
+            t = totals[name]
+            phases[name] = {
+                "total_ms": round(t / 1e6, 3),
+                "mean_ms": round(t / 1e6 / max(1, counts[name]), 3),
+                "per_step_ms": round(t / 1e6 / max(1, steps), 3),
+                "pct": round(100.0 * t / denom, 1) if denom and
+                name != "h2d_stage" else None,
+                "spans": counts[name],
+            }
+        return {"steps": steps, "phases": phases,
+                "overlapped": ["h2d_stage"]}
+
+
+_phase_state = {"collector": None}
+
+
+def start_step_profile():
+    """Install a fresh step-phase collector (cheap: a few dict updates
+    per fit batch; safe inside timed benchmark windows).  Returns it."""
+    col = StepPhaseCollector()
+    _phase_state["collector"] = col
+    return col
+
+
+def stop_step_profile():
+    """Uninstall the collector and return its ``report()`` (None when
+    none was running)."""
+    col = _phase_state["collector"]
+    _phase_state["collector"] = None
+    return col.report() if col is not None else None
+
+
+def record_phase(name, start_ns, end_ns=None):
+    """Report one step-phase span to whichever sinks are active (the
+    step collector and/or the Chrome-trace profiler).  A no-op costing
+    two dict lookups when neither is on — callers may invoke it
+    unconditionally from hot loops."""
+    col = _phase_state["collector"]
+    prof = _state["profiler"]
+    if col is None and prof is None:
+        return
+    if end_ns is None:
+        end_ns = time.perf_counter_ns()
+    if col is not None:
+        col.record(name, end_ns - start_ns)
+    if prof is not None:
+        prof.record(name, start_ns, end_ns, cat="step_phase")
+
+
+def mark_step():
+    """Count one completed fit step (phase ``pct`` normalizes by it)."""
+    col = _phase_state["collector"]
+    if col is not None:
+        col.mark_step()
+
+
+def aggregate_phase_trace(filename):
+    """Per-step phase breakdown from a dumped Chrome trace: pairs the
+    cat="step_phase" B/E events (per name+tid stack) and aggregates
+    them exactly like ``StepPhaseCollector.report``."""
+    with open(filename) as f:
+        trace = json.load(f)
+    col = StepPhaseCollector()
+    open_spans = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("cat") != "step_phase":
+            continue
+        key = (ev["name"], ev.get("tid"))
+        if ev["ph"] == "B":
+            open_spans.setdefault(key, []).append(ev["ts"])
+        elif ev["ph"] == "E" and open_spans.get(key):
+            t0 = open_spans[key].pop()
+            col.record(ev["name"], int((ev["ts"] - t0) * 1000))
+            if ev["name"] == "compute":
+                col.mark_step()
+    return col.report()
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):

@@ -355,9 +355,13 @@ class Symbol:
 
     # -- binding ------------------------------------------------------------
     def simple_bind(self, ctx, grad_req="write", type_dict=None,
-                    group2ctx=None, shared_exec=None, **kwargs):
+                    group2ctx=None, shared_exec=None, compute_dtype=None,
+                    keep_dtype=(), **kwargs):
         """Infer shapes from the given input shapes, allocate all
-        argument/gradient/aux arrays, and return the bound Executor."""
+        argument/gradient/aux arrays, and return the bound Executor.
+        ``compute_dtype``/``keep_dtype`` thread the mixed-precision
+        policy through to the Executor (args named in ``keep_dtype`` —
+        labels — are never cast)."""
         from . import executor as _executor
         from . import ndarray as nd
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
@@ -381,10 +385,13 @@ class Symbol:
                  if reqs.get(n, "null") != "null"}
         return _executor.Executor(self, ctx, args, grads, reqs, aux,
                                   group2ctx=group2ctx,
-                                  shared_exec=shared_exec)
+                                  shared_exec=shared_exec,
+                                  compute_dtype=compute_dtype,
+                                  keep_dtype=keep_dtype)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
-             aux_states=None, group2ctx=None, shared_exec=None):
+             aux_states=None, group2ctx=None, shared_exec=None,
+             compute_dtype=None, keep_dtype=()):
         """Bind with caller-provided argument arrays (list in
         ``list_arguments`` order or dict by name) and return the
         Executor; the executor's fused forward/backward is one compiled
@@ -413,7 +420,9 @@ class Symbol:
             aux = list(aux_states or [])
         return _executor.Executor(self, ctx, list(args), grads, reqs, aux,
                                   group2ctx=group2ctx,
-                                  shared_exec=shared_exec)
+                                  shared_exec=shared_exec,
+                                  compute_dtype=compute_dtype,
+                                  keep_dtype=keep_dtype)
 
     def eval(self, ctx=None, **kwargs):
         """One-shot evaluation: bind with the given named NDArrays and

@@ -58,6 +58,67 @@ def reldiff(a, b):
     return diff / norm
 
 
+def fetch_sync(outs):
+    """Force TRUE device completion by fetching dependent bytes to host.
+
+    ``jax.block_until_ready`` over the experimental remote-PJRT tunnel
+    can return at enqueue-acknowledge rather than compute completion,
+    which inflates a dispatch-rate measurement into an impossible
+    throughput (bench round-5 first pass: resnet-50 "MFU 2.2" — 220% of
+    chip peak).  A host fetch of bytes that data-depend on the
+    computation cannot return early; every timed benchmark window
+    starts and stops on one (bench.py, benchmark_score.py, docs/perf.md
+    "measuring honestly")."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(outs)
+    for leaf in leaves[:1]:
+        data = getattr(leaf, "_data", leaf)  # NDArray or jax array
+        np.asarray(data)
+
+
+def smoke_mlp(num_hidden=64, num_classes=10):
+    """Tiny 2-layer softmax MLP shared by the smoke harnesses
+    (tools/step_profile.py, bench.py's io.input_staging row,
+    tests/test_input_staging.py) so the smoke protocol can't drift
+    between the bench, CI, and test call sites."""
+    from . import symbol as sym
+    data = sym.Variable("data")
+    h = sym.Activation(
+        sym.FullyConnected(data, num_hidden=num_hidden, name="fc1"),
+        act_type="relu")
+    return sym.SoftmaxOutput(
+        sym.FullyConnected(h, num_hidden=num_classes, name="fc2"),
+        name="softmax")
+
+
+class DelayedIter:
+    """DataIter wrapper injecting a fixed per-batch host latency into
+    ``next()`` — the faultinject-delay pattern applied to the input
+    pipeline, standing in for slow decode/augmentation so input-staging
+    overlap is measurable on one CPU host (tests/test_input_staging.py,
+    bench.py ``io.input_staging`` row, tools/step_profile.py)."""
+
+    def __init__(self, source, delay=0.02):
+        self._source = source
+        self.delay = float(delay)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._source)   # raises StopIteration at epoch end
+        time.sleep(self.delay)
+        return batch
+
+    next = __next__
+
+    def reset(self):
+        self._source.reset()
+
+    def __getattr__(self, name):
+        return getattr(self._source, name)
+
+
 def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
     a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
     b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)

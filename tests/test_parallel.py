@@ -370,9 +370,15 @@ def test_batch_placement_cache_semantics():
     tr.step(d2, lab)
     assert tr._placement_cache["data"][1] is not placed, "stale cache hit"
 
-    cached_src = tr._placement_cache["data"][0]
     host = rs.randn(8, 6).astype("float32")
     tr.step(host, lab)
     tr.step(host, lab)
-    assert tr._placement_cache["data"][0] is cached_src, \
-        "mutable numpy batch entered the placement cache"
+    # a mutable numpy source is never cached AND evicts the stale jax
+    # entry for its name — otherwise the retired device batch would pin
+    # ~a batch of HBM for the trainer's lifetime (ADVICE r5)
+    assert "data" not in tr._placement_cache, \
+        "numpy-path step must evict the placement-cache entry"
+    tr.step(d2, lab)
+    assert "data" in tr._placement_cache, "jax source re-caches"
+    tr.clear_placement_cache()
+    assert tr._placement_cache == {}, "unbind/rebind clears the cache"
