@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke step-profile ci-quick ci-full docs bench hygiene
+.PHONY: test quick build dist convergence dist-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -11,6 +11,22 @@ hygiene:
 		echo "tracked binary/scratch artifacts (git rm them):"; \
 		echo "$$bad"; exit 1; \
 	fi; echo "hygiene: clean"
+
+# project-specific static analysis (env-knob registry sync, donation
+# safety, host-sync-in-hot-path, thread discipline, profiler-span
+# coverage); rule catalog + suppression syntax in
+# docs/architecture/static_analysis.md.  Zero-violation gate.
+lint:
+	$(PY) tools/lint.py mxnet_tpu tools bench.py
+
+# dynamic lock-order race detector (analysis/lockcheck.py) armed over
+# the suites that exercise all three thread pools: the device input
+# stager and the kvstore data-plane pipeline.  A lock-order cycle or an
+# unlocked seam mutation fails the run at acquisition time.
+lockcheck:
+	timeout -k 10 300 env MXNET_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/test_input_staging.py \
+		tests/test_kvstore_codec.py -q
 
 quick:
 	$(PY) -m pytest tests/ -m quick -q
@@ -27,8 +43,10 @@ dist:
 # assertion (2bit pushes <= 1/8 of fp32 payload on the same schedule),
 # under a hard timeout so a kvstore robustness regression fails fast
 # instead of hanging CI
+# MXNET_LOCK_CHECK=1: the recovery scenarios double as the lock-order
+# audit of the kvstore pipeline + conn-pool under retry/reconnect load
 dist-smoke:
-	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+	timeout -k 10 420 env JAX_PLATFORMS=cpu MXNET_LOCK_CHECK=1 \
 		$(PY) -m pytest tests/test_fault_tolerance.py -q \
 		-k "seeded or wire_bytes"
 
@@ -55,7 +73,7 @@ docs-check:
 	$(PY) tools/docgen_python.py --check
 	$(PY) tools/gen_cpp_ops.py --check
 
-ci-quick: hygiene quick docs-check
+ci-quick: hygiene lint quick docs-check
 
 ci-full: build dist convergence quick docs-check
 	JAX_PLATFORMS=cpu \

@@ -713,6 +713,7 @@ def bench_input_staging(chip, smoke=False):
     def fit_sps(stage, delay):
         """Steps/sec of the drain-bounded steady-state window (same
         protocol as bench_fit)."""
+        # graft-lint: disable=env-knob — raw save/restore of the toggle
         saved = os.environ.get("MXNET_IO_STAGE")
         os.environ["MXNET_IO_STAGE"] = stage
         try:

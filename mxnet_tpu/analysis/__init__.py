@@ -1,0 +1,43 @@
+"""Project-specific static analysis + dynamic lock-discipline checking.
+
+Four PRs of hand-enforced invariants live in this tree: every ``MXNET_*``
+knob registered in ``base.py`` and documented in ``docs/env_vars.md``, no
+donated buffer read after dispatch, no host sync inside the step loop,
+every thread daemonized or join-bounded, every lock held via ``with``.
+This package makes them mechanical:
+
+* ``graft_lint`` / ``checkers`` — the AST lint framework and its five
+  project rules (``tools/lint.py`` is the CLI; ``make lint`` the CI
+  entry).  Rule catalog: docs/architecture/static_analysis.md.
+* ``manifest`` — the hot-path and profiler-span entry-point manifests
+  the rules consult.
+* ``lockcheck`` — a lightweight dynamic lock-order race detector wired
+  into the engine/kvstore/stager lock allocation seams, active under
+  ``MXNET_LOCK_CHECK=1``.
+
+The static-analysis modules are stdlib-only so ``tools/lint.py`` can
+load them without importing the package (and therefore without jax);
+keep parent-relative imports (``from ..base import ...``) out of them
+and out of this ``__init__`` — ``lockcheck`` is the only module allowed
+to touch the runtime, which is why everything here is re-exported
+lazily.
+"""
+
+_LAZY = {
+    "graft_lint": ".graft_lint",
+    "checkers": ".checkers",
+    "manifest": ".manifest",
+    "lockcheck": ".lockcheck",
+}
+
+__all__ = ["hot_path"] + sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name == "hot_path":
+        from ..base import hot_path
+        return hot_path
+    if name in _LAZY:
+        import importlib
+        return importlib.import_module(_LAZY[name], __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,670 @@
+"""The five graft-lint rules (docs/architecture/static_analysis.md).
+
+Each checker is a class with a ``rule`` name and a
+``check(ctx, relpath, tree, lines)`` generator yielding ``Violation``s.
+All analysis is per-file AST work; the only cross-file facts (the env
+registry, the doc rows, the manifests) arrive pre-parsed on ``ctx``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from . import manifest as _m
+
+__all__ = ["ALL_CHECKERS", "RULES"]
+
+_LOCKISH = re.compile(_m.LOCKISH_NAME_RE)
+
+
+def _V(rule, relpath, node_or_line, msg):
+    from .graft_lint import Violation
+    line = node_or_line if isinstance(node_or_line, int) \
+        else getattr(node_or_line, "lineno", 1)
+    return Violation(rule, relpath, line, msg)
+
+
+def _dotted(node):
+    """'self.a.b' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(func):
+    """Last component of a call target: f() -> 'f', a.b.c() -> 'c'."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _functions_with_qualnames(tree):
+    """Yield (qualname, FunctionDef) for every def, 'Cls.meth' style."""
+    def walk(body, prefix):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = prefix + node.name
+                yield q, node
+                yield from walk(node.body, q + ".")
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, prefix + node.name + ".")
+    yield from walk(tree.body, "")
+
+
+# ---------------------------------------------------------------------------
+# Rule 1: env-knob
+# ---------------------------------------------------------------------------
+class EnvKnobChecker:
+    """MXNET_* env vars are read only through base.py's typed registry."""
+
+    rule = "env-knob"
+
+    def check(self, ctx, relpath, tree, lines):
+        if relpath == ctx.base_relpath:
+            return  # the registry itself owns the raw reads
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                yield from self._call(ctx, relpath, node)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                if _dotted(node.value) in ("os.environ", "environ"):
+                    key = _const_str(node.slice)
+                    if key and key.startswith("MXNET_"):
+                        yield _V(self.rule, relpath, node,
+                                 "os.environ[%r] bypasses the base.py "
+                                 "registry; use base.get_env" % key)
+
+    def _call(self, ctx, relpath, node):
+        func = node.func
+        key = _const_str(node.args[0]) if node.args else None
+        if key is None or not key.startswith("MXNET_"):
+            return
+        raw = False
+        if isinstance(func, ast.Attribute) and func.attr == "get" and \
+                _dotted(func.value) in ("os.environ", "environ"):
+            raw = True
+        elif _terminal(func) == "getenv" and (
+                isinstance(func, ast.Name) or
+                _dotted(func) in ("os.getenv",)):
+            raw = True
+        elif _terminal(func) == "_env":
+            # the project's raw-read wrapper idiom (kvstore_dist._env for
+            # DMLC_* vars); an MXNET_* literal through it is still a
+            # registry bypass
+            raw = True
+        if raw:
+            yield _V(self.rule, relpath, node,
+                     "raw environment read of %r outside base.py's "
+                     "registry; register it and use base.get_env" % key)
+            return
+        if _terminal(func) == "get_env" and key not in ctx.registry:
+            yield _V(self.rule, relpath, node,
+                     "get_env(%r) reads a knob that is not registered "
+                     "in base.py (register_env gives it a type, default "
+                     "and doc row)" % key)
+
+
+# ---------------------------------------------------------------------------
+# Rule 2: donation-safety
+# ---------------------------------------------------------------------------
+class DonationChecker:
+    """No read of an array after it was passed in a donated position."""
+
+    rule = "donation-safety"
+
+    def check(self, ctx, relpath, tree, lines):
+        donating = self._collect_donating(tree)
+        if not donating:
+            return
+        for _q, fn in _functions_with_qualnames(tree):
+            yield from self._check_fn(relpath, fn, dict(donating))
+
+    # -- collection ------------------------------------------------------
+    def _collect_donating(self, tree):
+        """dotted assign target -> frozenset(donated positions) for
+        every ``jax.jit(..., donate_argnums=...)`` in the module —
+        module-level ``step = jax.jit(...)`` idioms included, not just
+        assignments inside functions."""
+        out = {}
+        scopes = [tree]
+        scopes += [fn for _q, fn in _functions_with_qualnames(tree)]
+        for scope in scopes:
+            local = self._literal_tuples(scope)
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Assign):
+                    continue
+                pos = self._jit_donations(node.value, local)
+                if not pos:
+                    continue
+                for tgt in node.targets:
+                    d = _dotted(tgt)
+                    if d:
+                        out[d] = out.get(d, frozenset()) | pos
+        return out
+
+    def _literal_tuples(self, fn):
+        """name -> positions for simple local ``donate = (0, 1)`` /
+        conditional-literal assigns (union over IfExp branches)."""
+        local = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                pos = self._positions(node.value)
+                if pos is not None:
+                    local[node.targets[0].id] = pos
+        return local
+
+    def _positions(self, node, local=None):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return frozenset([node.value])
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = frozenset()
+            for el in node.elts:
+                p = self._positions(el, local)
+                if p is None:
+                    return None
+                out |= p
+            return out
+        if isinstance(node, ast.IfExp):
+            a = self._positions(node.body, local)
+            b = self._positions(node.orelse, local)
+            if a is None and b is None:
+                return None
+            return (a or frozenset()) | (b or frozenset())
+        if isinstance(node, ast.Name) and local is not None:
+            return local.get(node.id)
+        return None
+
+    def _jit_donations(self, value, local):
+        """Donated positions of a ``jax.jit`` call expr, or None."""
+        if not isinstance(value, ast.Call):
+            return None
+        if _dotted(value.func) != "jax.jit" and not (
+                isinstance(value.func, ast.Attribute)
+                and value.func.attr == "jit"
+                and _dotted(value.func.value) == "jax"):
+            return None
+        for kw in value.keywords:
+            if kw.arg == "donate_argnums":
+                pos = self._positions(kw.value, local)
+                return pos or None
+        return None
+
+    # -- per-function dataflow -------------------------------------------
+    def _check_fn(self, relpath, fn, donating):
+        """Abstract-interpret ``fn``'s statements in execution order,
+        tracking ``dead``: dotted-expr -> (donation line, callee).
+        Exclusive branches (if/elif/else, try/except) run on copies and
+        re-merge as the union of their kills (a buffer donated in either
+        arm is conservatively dead after the join)."""
+        out = []       # collected Violations
+        reported = set()  # (lineno, key): dedup across loop re-passes
+
+        def report(node, key, msg):
+            if (node.lineno, key) not in reported:
+                reported.add((node.lineno, key))
+                out.append(_V(self.rule, relpath, node, msg))
+
+        def kill(dead, key, node, target):
+            if key in dead:
+                # donating an already-donated buffer is itself the bug —
+                # this is how the loop-carried case (donate each
+                # iteration, forget to re-stash the output) surfaces on
+                # the second abstract pass over the loop body
+                line, prev = dead[key]
+                report(node,
+                       key, "'%s' is donated to %s but was already "
+                       "donated to %s on line %d (no reassignment in "
+                       "between) — in a loop this hands XLA a consumed "
+                       "buffer every iteration" % (key, target, prev,
+                                                   line))
+            dead[key] = (node.lineno, target)
+
+        def resurrect(dead, key):
+            dead.pop(key, None)
+            for k in [k for k in dead if k.startswith(key + ".")]:
+                dead.pop(k)
+
+        def read(dead, key, node):
+            # a read of x.shape / self.state.mean() reads the donated
+            # buffer just as surely as a read of x — match the dotted
+            # expr's component-wise prefixes against the dead set
+            parts = key.split(".")
+            for n in range(len(parts), 0, -1):
+                prefix = ".".join(parts[:n])
+                if prefix in dead:
+                    line, target = dead[prefix]
+                    report(node,
+                           prefix, "'%s' is read (as '%s') after being "
+                           "donated to %s on line %d; its device buffer "
+                           "may already be reused — re-stash the "
+                           "program's output (or a copy) before reading"
+                           % (prefix, key, target, line))
+                    dead.pop(prefix)  # one report per donation
+                    return
+
+        def expr(node, dead):
+            """Walk one expression in evaluation order: reads check the
+            dead set; donating calls kill their donated args."""
+            if node is None:
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # closures run later; out of intra-function scope
+            if isinstance(node, ast.Call):
+                target, donated_idx = self._donated_call(node, donating)
+                expr(node.func, dead)
+                for i, a in enumerate(node.args):
+                    d = _dotted(a)
+                    if i in donated_idx and d:
+                        kill(dead, d, a, target)
+                    else:
+                        expr(a, dead)
+                for kw in node.keywords:
+                    expr(kw.value, dead)
+                return
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                d = _dotted(node)
+                if d and isinstance(getattr(node, "ctx", ast.Load()),
+                                    ast.Load):
+                    read(dead, d, node)
+                    return
+            for child in ast.iter_child_nodes(node):
+                expr(child, dead)
+
+        def store(node, dead):
+            d = _dotted(node)
+            if d:
+                resurrect(dead, d)
+                return
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.Name, ast.Attribute, ast.Tuple,
+                                      ast.List, ast.Starred)):
+                    store(child, dead)
+
+        def branches(dead, *bodies):
+            """Run each body on a copy of ``dead``; merge the union of
+            the surviving kills back in."""
+            merged = {}
+            for body in bodies:
+                local = dict(dead)
+                stmts(body, local)
+                merged.update(local)
+            dead.clear()
+            dead.update(merged)
+
+        def loop(dead, body, orelse):
+            """A loop body runs 0, 1 or many times: interpret it twice
+            (the second pass starts from the first pass's kills, so a
+            donate-without-reassign becomes visible as the next
+            iteration would see it; ``reported`` dedups the re-walk)."""
+            once = dict(dead)
+            stmts(body, once)
+            twice = dict(once)
+            stmts(body, twice)
+            after_else = dict(dead)
+            stmts(orelse, after_else)
+            dead.clear()
+            dead.update(after_else)
+            dead.update(once)
+            dead.update(twice)
+
+        def stmts(body, dead):
+            for st in body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                elif isinstance(st, ast.Assign):
+                    expr(st.value, dead)
+                    # alias: x = <donating callable>
+                    d = _dotted(st.value)
+                    if d in donating and len(st.targets) == 1:
+                        t = _dotted(st.targets[0])
+                        if t:
+                            donating[t] = donating[d]
+                    for t in st.targets:
+                        store(t, dead)
+                elif isinstance(st, ast.AugAssign):
+                    expr(st.target, dead)
+                    expr(st.value, dead)
+                    store(st.target, dead)
+                elif isinstance(st, ast.AnnAssign):
+                    expr(st.value, dead)
+                    if st.value is not None:
+                        store(st.target, dead)
+                elif isinstance(st, (ast.Expr, ast.Return)):
+                    expr(st.value, dead)
+                elif isinstance(st, ast.For):
+                    expr(st.iter, dead)
+                    store(st.target, dead)
+                    loop(dead, st.body, st.orelse)
+                elif isinstance(st, ast.While):
+                    expr(st.test, dead)
+                    loop(dead, st.body, st.orelse)
+                elif isinstance(st, ast.If):
+                    expr(st.test, dead)
+                    branches(dead, st.body, st.orelse)
+                elif isinstance(st, ast.With):
+                    for item in st.items:
+                        expr(item.context_expr, dead)
+                        if item.optional_vars is not None:
+                            store(item.optional_vars, dead)
+                    stmts(st.body, dead)
+                elif isinstance(st, ast.Try):
+                    branches(dead, st.body,
+                             *[h.body for h in st.handlers])
+                    stmts(st.orelse, dead)
+                    stmts(st.finalbody, dead)
+                else:
+                    expr(st, dead)
+
+        stmts(fn.body, {})
+        yield from out
+
+    def _donated_call(self, node, donating):
+        """(callable name, set of donated ARG indexes) for this call."""
+        d = _dotted(node.func)
+        if d in donating:
+            return d, donating[d]
+        # engine-seam idiom: engine.dispatch("name", donating_fn, *args)
+        if _terminal(node.func) == "dispatch" and len(node.args) >= 2:
+            fn_d = _dotted(node.args[1])
+            if fn_d in donating:
+                return fn_d, {p + 2 for p in donating[fn_d]}
+        return None, frozenset()
+
+
+# ---------------------------------------------------------------------------
+# Rule 3: host-sync
+# ---------------------------------------------------------------------------
+class HostSyncChecker:
+    """No host synchronization inside @hot_path / manifest functions."""
+
+    rule = "host-sync"
+
+    def check(self, ctx, relpath, tree, lines):
+        manifest_fns = {q for p, q in ctx.hot_paths if p == relpath}
+        found = set()
+        for q, fn in _functions_with_qualnames(tree):
+            hot = q in manifest_fns or self._decorated(fn)
+            if q in manifest_fns:
+                found.add(q)
+            if hot:
+                yield from self._check_fn(relpath, fn)
+        for q in sorted(manifest_fns - found):
+            yield _V(self.rule, relpath, 1,
+                     "manifest.HOT_PATHS names %s::%s but no such "
+                     "function exists (update the manifest)"
+                     % (relpath, q))
+
+    def _decorated(self, fn):
+        return any(_terminal(d) == "hot_path" or (
+            isinstance(d, ast.Call) and _terminal(d.func) == "hot_path")
+            for d in fn.decorator_list)
+
+    def _check_fn(self, relpath, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            term = _terminal(node.func)
+            if term in _m.HOST_SYNC_CALLS:
+                yield _V(self.rule, relpath, node,
+                         "%s() synchronizes the host inside hot-path "
+                         "function %s(); move it off the step loop or "
+                         "suppress with a reason" % (term, fn.name))
+            elif isinstance(node.func, ast.Attribute) and \
+                    term in _m.HOST_SYNC_NP_FUNCS and \
+                    _dotted(node.func.value) in ("np", "numpy", "onp"):
+                yield _V(self.rule, relpath, node,
+                         "np.%s() forces a device->host copy inside "
+                         "hot-path function %s()" % (term, fn.name))
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id == "float" and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                yield _V(self.rule, relpath, node,
+                         "float(...) on a non-constant inside hot-path "
+                         "function %s() blocks on the device value"
+                         % fn.name)
+
+
+# ---------------------------------------------------------------------------
+# Rule 4: thread-discipline
+# ---------------------------------------------------------------------------
+class ThreadChecker:
+    """Threads are daemonized or join-bounded; locks are held via
+    ``with`` (or acquire directly guarded by try/finally); no
+    ``time.sleep`` while holding a lock."""
+
+    rule = "thread-discipline"
+
+    def check(self, ctx, relpath, tree, lines):
+        scopes = [("<module>", tree)]
+        scopes += list(_functions_with_qualnames(tree))
+        for q, scope in scopes:
+            body = scope.body
+            has_join = any(
+                isinstance(n, ast.Call) and self._is_thread_join(n)
+                for n in self._own_nodes(scope))
+            for node in self._own_nodes(scope):
+                if isinstance(node, ast.Call) and self._is_thread(node):
+                    if not self._daemon_true(node) and not has_join:
+                        yield _V(self.rule, relpath, node,
+                                 "threading.Thread in %s without "
+                                 "daemon=True and without a join in the "
+                                 "same scope; give it a stop-event + "
+                                 "join, daemonize it, or suppress with "
+                                 "a reason" % q)
+            yield from self._acquires(relpath, q, body)
+            yield from self._sleeps(relpath, q, body, in_lock=False)
+
+    def _own_nodes(self, scope):
+        """Nodes of this scope, not of nested function scopes."""
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from walk(child)
+        yield from walk(scope)
+
+    def _is_thread(self, call):
+        return _dotted(call.func) == "threading.Thread" or (
+            isinstance(call.func, ast.Name) and call.func.id == "Thread")
+
+    def _is_thread_join(self, call):
+        """A thread-shaped .join(): named receiver, zero positional args
+        (``t.join()`` / ``t.join(timeout=5)``) or one numeric timeout —
+        NOT ``", ".join(parts)`` / ``sep.join(names)``."""
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "join"):
+            return False
+        if _dotted(f.value) is None:   # string literal / call result
+            return False
+        if not call.args:
+            return True
+        return (len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, (int, float))
+                and not isinstance(call.args[0].value, bool))
+
+    def _daemon_true(self, call):
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                return isinstance(kw.value, ast.Constant) and \
+                    bool(kw.value.value)
+        return False
+
+    def _lockish(self, node):
+        d = _dotted(node)
+        if not d:
+            return False
+        return bool(_LOCKISH.search(d.rsplit(".", 1)[-1]))
+
+    # -- bare .acquire() --------------------------------------------------
+    def _acquires(self, relpath, q, body, owner_try=None):
+        """Flag lockish ``.acquire()`` not paired with try/finally
+        release (``with`` blocks never produce a bare acquire Expr).
+        ``owner_try`` is the Try whose body ``body`` is, so
+        acquire-as-first-statement-inside-try is recognized."""
+        for i, st in enumerate(body):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested scopes are checked as their own scope
+            # recurse into compound statements' bodies
+            if isinstance(st, ast.Try):
+                yield from self._acquires(relpath, q, st.body,
+                                          owner_try=st)
+                for h in st.handlers:
+                    yield from self._acquires(relpath, q, h.body)
+                yield from self._acquires(relpath, q, st.orelse)
+                yield from self._acquires(relpath, q, st.finalbody)
+            else:
+                for sub in self._sub_bodies(st):
+                    yield from self._acquires(relpath, q, sub)
+            call = self._bare_acquire(st)
+            if call is None:
+                continue
+            recv = _dotted(call.func.value)
+            if self._guarded(body, i, recv, owner_try):
+                continue
+            yield _V(self.rule, relpath, call,
+                     "%s.acquire() in %s without a with-block or an "
+                     "immediate try/finally release; an exception here "
+                     "leaks the lock" % (recv, q))
+
+    def _sub_bodies(self, st):
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(st, attr, None)
+            if sub:
+                yield sub
+        for h in getattr(st, "handlers", ()):
+            yield h.body
+
+    def _bare_acquire(self, st):
+        if not (isinstance(st, ast.Expr) and isinstance(st.value, ast.Call)):
+            return None
+        call = st.value
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "acquire"
+                and len(call.args) + len(call.keywords) <= 2
+                and self._lockish(call.func.value)):
+            return None
+        return call
+
+    def _guarded(self, body, i, recv, owner_try=None):
+        """acquire at body[i] is OK if the NEXT statement is a Try whose
+        finally releases ``recv``, or it is the FIRST statement inside a
+        Try whose finally releases ``recv``."""
+        nxt = body[i + 1] if i + 1 < len(body) else None
+        if isinstance(nxt, ast.Try) and self._releases(nxt.finalbody, recv):
+            return True
+        if owner_try is not None and i == 0 and \
+                self._releases(owner_try.finalbody, recv):
+            return True
+        return False
+
+    def _releases(self, stmts, recv):
+        for n in stmts:
+            for node in ast.walk(n):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "release"
+                        and _dotted(node.func.value) == recv):
+                    return True
+        return False
+
+    # -- time.sleep under a lock -----------------------------------------
+    def _sleeps(self, relpath, q, body, in_lock):
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            held = in_lock
+            if isinstance(st, ast.With) and any(
+                    self._lockish(item.context_expr) for item in st.items):
+                held = True
+            subs = list(self._sub_bodies(st))
+            if subs:
+                for sub in subs:
+                    yield from self._sleeps(relpath, q, sub, held)
+            elif held:
+                for node in ast.walk(st):
+                    if isinstance(node, ast.Call) and (
+                            _dotted(node.func) == "time.sleep" or
+                            (isinstance(node.func, ast.Name)
+                             and node.func.id == "sleep")):
+                        yield _V(self.rule, relpath, node,
+                                 "time.sleep while holding a lock in %s "
+                                 "stalls every thread contending for it; "
+                                 "sleep outside the critical section "
+                                 "(or use Condition.wait)" % q)
+
+
+# ---------------------------------------------------------------------------
+# Rule 5: span-coverage
+# ---------------------------------------------------------------------------
+class SpanChecker:
+    """Manifest entry points must emit a profiler span (<= one hop)."""
+
+    rule = "span-coverage"
+
+    def check(self, ctx, relpath, tree, lines):
+        entries = [q for p, q in ctx.span_entry_points if p == relpath]
+        if not entries:
+            return
+        funcs = dict(_functions_with_qualnames(tree))
+        direct = {q: self._emits(fn) for q, fn in funcs.items()}
+        for q in entries:
+            fn = funcs.get(q)
+            if fn is None:
+                yield _V(self.rule, relpath, 1,
+                         "manifest.SPAN_ENTRY_POINTS names %s::%s but no "
+                         "such function exists (update the manifest)"
+                         % (relpath, q))
+                continue
+            if direct.get(q):
+                continue
+            if self._one_hop(q, fn, direct):
+                continue
+            yield _V(self.rule, relpath, fn,
+                     "entry point %s() emits no profiler span (%s) — "
+                     "overlap and retry behavior becomes invisible in "
+                     "traces" % (q, "/".join(sorted(_m.SPAN_EMITTERS))))
+
+    def _emits(self, fn):
+        return any(isinstance(n, ast.Call)
+                   and _terminal(n.func) in _m.SPAN_EMITTERS
+                   for n in ast.walk(fn))
+
+    def _one_hop(self, q, fn, direct):
+        cls = q.rsplit(".", 1)[0] + "." if "." in q else ""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            term = _terminal(node.func)
+            if term is None:
+                continue
+            for cand in (term, cls + term):
+                if direct.get(cand):
+                    return True
+        return False
+
+
+ALL_CHECKERS = (EnvKnobChecker, DonationChecker, HostSyncChecker,
+                ThreadChecker, SpanChecker)
+RULES = tuple(c.rule for c in ALL_CHECKERS) + ("bad-suppression",)

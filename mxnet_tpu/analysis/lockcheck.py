@@ -1,0 +1,234 @@
+"""Dynamic lock-discipline checking (``MXNET_LOCK_CHECK=1``).
+
+The static ``thread-discipline`` rule catches *lexical* misuse; this
+module catches *order* bugs a lint cannot see: two threads taking the
+same pair of locks in opposite orders (the classic ABBA deadlock, which
+only hangs under exact interleavings) and shared state mutated without
+its guarding lock held.
+
+Integration is at the lock **allocation seams**: the engine, cached-op
+cache, profiler, kvstore pipeline/worker and conn-pool create their
+locks through :func:`make_lock` (and condition variables through
+``threading.Condition(make_lock(...))``).  With the knob off (the
+default) ``make_lock`` returns plain ``threading.Lock``/``RLock`` —
+zero overhead, nothing wrapped.  With ``MXNET_LOCK_CHECK=1`` it returns
+a :class:`CheckedLock` that
+
+* records, per thread, the set of checked locks held at every blocking
+  ``acquire`` and adds a ``held -> acquiring`` edge (with the acquiring
+  stack) to a global lock-order graph;
+* raises :class:`LockOrderError` **at acquisition time** — naming both
+  locks and showing both acquisition stacks — the moment an edge would
+  close a cycle in that graph, i.e. before the interleaving that
+  actually deadlocks ever needs to happen;
+* backs :func:`check_owned`, which registered seams call before
+  mutating lock-guarded state (:class:`LockDisciplineError` if the
+  calling thread does not hold the lock).
+
+Run the existing stager / kvstore-pipeline suites under the knob (CI's
+``lockcheck`` stage, ``make lockcheck``) to regression-test every lock
+order those subsystems exercise.  See
+docs/architecture/static_analysis.md.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import traceback
+
+from ..base import MXNetError, get_env
+
+__all__ = ["enabled", "make_lock", "CheckedLock", "check_owned",
+           "LockOrderError", "LockDisciplineError", "reset"]
+
+
+class LockOrderError(MXNetError):
+    """Two locks were taken in opposite orders by different call paths
+    (potential ABBA deadlock)."""
+
+
+class LockDisciplineError(MXNetError):
+    """Lock-guarded state was mutated without holding its lock."""
+
+
+def enabled():
+    """Is dynamic lock checking on (``MXNET_LOCK_CHECK``)?"""
+    return bool(get_env("MXNET_LOCK_CHECK"))
+
+
+# ---------------------------------------------------------------------------
+# Global lock-order graph.  Nodes are CheckedLock indices; an edge
+# A -> B ("B acquired while holding A") stores the stack that first
+# created it.  All graph state is guarded by _meta (a RAW lock — it is
+# never itself checked, so the checker cannot deadlock on itself).
+# ---------------------------------------------------------------------------
+_meta = threading.Lock()
+_adj = {}      # idx -> set(idx)
+_edges = {}    # (idx_a, idx_b) -> (name_a, name_b, stack_str)
+_tls = threading.local()
+
+
+def reset():
+    """Drop all recorded lock-order edges (test isolation)."""
+    with _meta:
+        _adj.clear()
+        _edges.clear()
+
+
+def _held_stack():
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _stack():
+    # drop the two lockcheck-internal frames at the tail
+    return "".join(traceback.format_stack()[:-2])
+
+
+def _find_path(src, dst):
+    """DFS path src -> dst in _adj (caller holds _meta)."""
+    stack, seen = [(src, (src,))], {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + (nxt,)))
+    return None
+
+
+def _cycle_error(lock, h, path, me):
+    """Build the LockOrderError for acquiring ``lock`` while holding
+    ``h`` when recorded orderings already lead ``lock -> ... -> h``:
+    the full cycle chain, this acquisition's stack, and the recorded
+    stack of EVERY edge on the path (a 3+-lock cycle names every pair
+    involved, not a pair that was never directly inverted)."""
+    edges = [(_edges[(path[i], path[i + 1])])
+             for i in range(len(path) - 1)]
+    chain = " -> ".join([h.name, lock.name] +
+                        [e[1] for e in edges])
+    parts = [
+        "lock-order cycle: acquiring %r while holding %r closes the "
+        "cycle %s (potential ABBA deadlock)." % (lock.name, h.name,
+                                                 chain),
+        "--- this acquisition (%r after %r) ---\n%s"
+        % (lock.name, h.name, me),
+    ]
+    for name_a, name_b, stack in edges:
+        parts.append("--- earlier acquisition (%r after %r) ---\n%s"
+                     % (name_b, name_a, stack))
+    return LockOrderError("\n".join(parts))
+
+
+def _note_order(lock):
+    """Record held->lock edges; raise on a cycle."""
+    held = _held_stack()
+    if not held:
+        return
+    me = None  # stack formatted lazily: steady state records no edges
+    with _meta:
+        for h in held:
+            if h is lock:
+                continue
+            key = (h._idx, lock._idx)
+            if key in _edges:
+                continue
+            if me is None:
+                me = _stack()
+            # would this edge close a cycle?  i.e. can we already reach
+            # h from lock through recorded orderings?
+            path = _find_path(lock._idx, h._idx)
+            if path is not None:
+                raise _cycle_error(lock, h, path, me)
+            _edges[key] = (h.name, lock.name, me)
+            _adj.setdefault(h._idx, set()).add(lock._idx)
+
+
+class CheckedLock:
+    """A ``threading.Lock``/``RLock`` that feeds the order graph and
+    tracks ownership.  Duck-compatible with ``threading.Condition``
+    (it adopts ``_is_owned``)."""
+
+    _counter = itertools.count()
+
+    def __init__(self, name, rlock=False):
+        self._inner = threading.RLock() if rlock else threading.Lock()
+        self._rlock = rlock
+        self.name = name
+        self._idx = next(CheckedLock._counter)
+        self._owners = {}  # thread ident -> recursion count
+
+    def acquire(self, blocking=True, timeout=-1):
+        me = threading.get_ident()
+        reentrant = self._owners.get(me, 0) > 0
+        if blocking and not reentrant:
+            _note_order(self)
+        if timeout is None or timeout < 0:
+            ok = self._inner.acquire(blocking)
+        else:
+            ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._owners[me] = self._owners.get(me, 0) + 1
+            if not reentrant:
+                _held_stack().append(self)
+        return ok
+
+    def release(self):
+        me = threading.get_ident()
+        n = self._owners.get(me, 0)
+        if n <= 1:
+            self._owners.pop(me, None)
+            held = _held_stack()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    break
+        else:
+            self._owners[me] = n - 1
+        self._inner.release()
+
+    def _is_owned(self):
+        # threading.Condition picks this up and uses it for its
+        # owner-thread assertions
+        return self._owners.get(threading.get_ident(), 0) > 0
+
+    def locked(self):
+        if self._rlock:
+            return bool(self._owners)
+        return self._inner.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return "<CheckedLock %r>" % (self.name,)
+
+
+def make_lock(name, rlock=False):
+    """Allocate a lock at a checked seam: a plain
+    ``threading.Lock``/``RLock`` normally, a :class:`CheckedLock` under
+    ``MXNET_LOCK_CHECK=1``.  ``name`` appears in detector reports."""
+    if not enabled():
+        return threading.RLock() if rlock else threading.Lock()
+    return CheckedLock(name, rlock=rlock)
+
+
+def check_owned(lock, what):
+    """Registered-seam guard: raise :class:`LockDisciplineError` when
+    ``what`` is about to be mutated without ``lock`` held.  ``lock`` may
+    be a :class:`CheckedLock` or a ``threading.Condition`` wrapping one;
+    a no-op (one isinstance check) for plain locks, so seams may call
+    it unconditionally."""
+    inner = getattr(lock, "_lock", lock)  # Condition -> its lock
+    if not isinstance(inner, CheckedLock):
+        return
+    if not inner._is_owned():
+        raise LockDisciplineError(
+            "unlocked mutation of %s: thread %r does not hold lock %r"
+            % (what, threading.current_thread().name, inner.name))

@@ -1,0 +1,65 @@
+"""Manifests the graft-lint rules consult.
+
+Paths are repo-root-relative POSIX paths; functions are dotted
+qualnames (``Class.method`` or a bare module-level name).  Keep these
+lists sorted so diffs stay reviewable.
+
+Entries here are load-bearing: a manifest path/qualname that no longer
+resolves in its file is itself reported as a violation (rule
+``span-coverage`` / ``host-sync``), so a refactor cannot silently
+retire a guarded entry point.
+"""
+
+# ---------------------------------------------------------------------------
+# host-sync rule: functions that are hot-path by fiat (in addition to
+# anything carrying the @hot_path decorator).  These are the per-step
+# loops where one stray block_until_ready / np.asarray / .item() turns
+# the async engine back into a synchronous one.
+# ---------------------------------------------------------------------------
+HOT_PATHS = (
+    ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline.submit"),
+    ("mxnet_tpu/module/base_module.py", "BaseModule._fit_epochs"),
+)
+
+# Calls forbidden inside a hot-path function.  Terminal attribute /
+# callable names; `float(x)` is flagged only for non-constant x.
+HOST_SYNC_CALLS = frozenset([
+    "block_until_ready",   # jax.block_until_ready / arr.block_until_ready
+    "asnumpy",             # NDArray host fetch
+    "asscalar",
+    "wait_to_read",
+    "waitall",
+    "item",
+])
+HOST_SYNC_NP_FUNCS = frozenset(["asarray", "array"])  # np./numpy./onp.
+
+# ---------------------------------------------------------------------------
+# span-coverage rule: public engine / kvstore / stager entry points that
+# must emit a profiler span (directly, or through a helper defined in
+# the same module — one hop).
+# ---------------------------------------------------------------------------
+SPAN_ENTRY_POINTS = (
+    ("mxnet_tpu/cached_op.py", "_run"),
+    ("mxnet_tpu/engine.py", "Engine.dispatch"),
+    ("mxnet_tpu/io/stager.py", "DeviceStager._stage_batch"),
+    ("mxnet_tpu/kvstore_dist.py", "WorkerClient._rpc_locked"),
+    ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline._worker"),
+    ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline.flush"),
+    ("mxnet_tpu/module/base_module.py", "BaseModule._fit_epochs"),
+)
+
+# Terminal callable names that count as "emits a span".
+SPAN_EMITTERS = frozenset([
+    "record",          # Profiler.record / StepPhaseCollector.record
+    "record_phase",    # profiler.record_phase step-phase seam
+    "mark_step",
+    "_recorder",       # CommPipeline's injected recorder callback
+    "_prof_record",    # kvstore_dist module-level helper
+])
+
+# ---------------------------------------------------------------------------
+# thread-discipline rule: receivers whose .acquire()/.release() and
+# with-blocks are treated as lock operations (last attribute/name
+# component, case-insensitive regex).
+# ---------------------------------------------------------------------------
+LOCKISH_NAME_RE = r"(?i)(^|_)(lock|locked|mutex|sem|sema|cv|cond|condition)s?$"

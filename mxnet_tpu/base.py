@@ -21,6 +21,7 @@ __all__ = [
     "register_env",
     "get_env",
     "atomic_write",
+    "hot_path",
     "string_types",
     "numeric_types",
 ]
@@ -200,6 +201,52 @@ register_env("MXNET_FAULT_INJECT", str, "",
              "kvstore: inline JSON or a path to a JSON file (see "
              "mxnet_tpu/faultinject.py).  Unset = all fault hooks are "
              "no-ops.")
+register_env("MXNET_MIRROR_SEGMENT", int, 0,
+             "Ops per jax.checkpoint segment when "
+             "MXNET_BACKWARD_DO_MIRROR=1 (the rematerialization chunk "
+             "size).  0 = the sqrt(op_count) heuristic.")
+register_env("MXNET_MODULE_FUSED", bool, True,
+             "Fused Module.fit fast path (forward+backward+psum+update "
+             "as one XLA program).  '0' falls back to full "
+             "executor-group semantics.")
+register_env("MXNET_USE_NATIVE_IO", bool, True,
+             "Use the C++ RecordIO reader/prefetcher when the native "
+             "toolchain is available.  '0' forces the pure-python "
+             "fallback backend.")
+register_env("MXNET_ASYNC_CHECKPOINT", bool, True,
+             "Queue nd.save checkpoint writes onto the native host "
+             "engine (serialized per destination) instead of blocking "
+             "the caller.  '0' writes synchronously.")
+register_env("MXNET_CPU_WORKER_NTHREADS", int, os.cpu_count() or 4,
+             "Worker threads of the native host-task engine (IO, "
+             "decode, async checkpoint writes).")
+register_env("MXNET_PROFILER_JAX_LOGDIR", str, "",
+             "When set, profiler_set_state('run') also starts a "
+             "jax.profiler trace into this directory (real XLA/TPU "
+             "kernel timelines beside the Chrome trace).")
+register_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 1.0,
+             "Seconds between liveness beats a dist-kvstore node sends "
+             "the scheduler on its dedicated heartbeat connection "
+             "(feeds get_num_dead_node).")
+register_env("MXNET_LOCK_CHECK", bool, False,
+             "Dynamic lock-discipline checking (analysis/lockcheck.py): "
+             "locks created at the engine/kvstore/stager seams record "
+             "per-thread acquisition orders and raise on a lock-order "
+             "cycle (potential deadlock) or on guarded shared state "
+             "mutated without its lock held.  Debug/CI aid; off by "
+             "default.")
+
+
+def hot_path(fn):
+    """Mark ``fn`` as part of a latency-critical loop (the fit step loop,
+    cached-op dispatch, pipeline submit).  Purely declarative at runtime;
+    ``tools/lint.py``'s ``host-sync`` rule rejects host-synchronizing
+    calls (``block_until_ready``, ``np.asarray``, ``.item()``, ...)
+    inside any function carrying this decorator
+    (docs/architecture/static_analysis.md).
+    """
+    fn.__hot_path__ = True
+    return fn
 
 
 _ATOMIC_WRITE_SEQ = itertools.count()

@@ -50,7 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import engine as _engine
-from .base import get_env
+from .analysis.lockcheck import make_lock
+from .base import get_env, hot_path
 
 __all__ = ["invoke_op", "eager_call", "setitem", "copy_value",
            "stats", "reset", "configure", "enabled"]
@@ -95,7 +96,7 @@ class _Cache:
         self._entries = OrderedDict()
         self._seen = OrderedDict()  # pre-threshold sighting counts
         self._stats = {}  # op_name -> [hits, misses, evictions]
-        self.lock = threading.Lock()
+        self.lock = make_lock("cached_op.lru")
 
     def _stat(self, op_name):
         s = self._stats.get(op_name)
@@ -146,7 +147,7 @@ class _Cache:
 
 
 _cache = None
-_cache_lock = threading.Lock()
+_cache_lock = make_lock("cached_op.singleton")
 
 
 def _env_max_size():
@@ -267,6 +268,7 @@ def _donation_ok():
 # ---------------------------------------------------------------------------
 # Engine-seam execution: profiler events + NaiveEngine sync contract
 # ---------------------------------------------------------------------------
+@hot_path
 def _run(name, entry, args, hit):
     eng = _engine.get()
     prof = eng._profiler
@@ -276,6 +278,7 @@ def _run(name, entry, args, hit):
     out = entry.fn(*args)
     # NaiveEngine preserves its synchronous-debugging contract through the
     # cache; profiling measures execution, not async dispatch (engine.py)
+    # graft-lint: disable=host-sync — profiler/naive mode only
     jax.block_until_ready(out)
     if prof is not None:
         prof.record(name, t0, time.perf_counter_ns(),
@@ -303,6 +306,7 @@ class _CachedPullback:
 # ---------------------------------------------------------------------------
 # Registry-op entry (imperative_invoke / OpDef.apply_cached)
 # ---------------------------------------------------------------------------
+@hot_path
 def invoke_op(op, attrs, in_arrs, aux_arrs, is_train, rng, recording):
     """Cached-JIT execution of a registered op.
 

@@ -23,7 +23,8 @@ import time
 
 import jax
 
-from .base import get_env
+from .analysis.lockcheck import make_lock
+from .base import get_env, hot_path
 
 __all__ = ["Engine", "get", "is_naive", "waitall"]
 
@@ -32,7 +33,7 @@ class Engine:
     """Singleton engine facade."""
 
     _inst = None
-    _lock = threading.Lock()
+    _lock = make_lock("engine.singleton")
 
     def __init__(self):
         self._naive = get_env("MXNET_ENGINE_TYPE") == "NaiveEngine"
@@ -85,6 +86,7 @@ class Engine:
         cached_op.reset()
 
     # -- dispatch seam ------------------------------------------------------
+    @hot_path
     def dispatch(self, name, fn, *args, **kwargs):
         """Run ``fn`` through the engine seam: profiling + naive-mode sync.
 
@@ -100,6 +102,7 @@ class Engine:
             # profiling measures EXECUTION, not async dispatch: block like
             # the reference's per-op recording (which requires disabling
             # bulk-exec and likewise perturbs scheduling)
+            # graft-lint: disable=host-sync — profiler/naive mode only
             jax.block_until_ready(out)
         if prof is not None:
             prof.record(name, t0, time.perf_counter_ns())

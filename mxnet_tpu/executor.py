@@ -213,7 +213,7 @@ class Executor:
         import math
         nodes = self._nodes
         op_count = sum(1 for n in nodes if not n.is_variable)
-        seg = int(get_env("MXNET_MIRROR_SEGMENT") or 0) or \
+        seg = int(get_env("MXNET_MIRROR_SEGMENT")) or \
             max(1, int(math.ceil(math.sqrt(op_count))))
         chunks = []
         cur, n_ops = [], 0

@@ -65,6 +65,8 @@ import os
 import threading
 import time
 
+from .base import get_env
+
 __all__ = ["hook", "install", "active", "seed", "FaultPlan",
            "InjectedError"]
 
@@ -150,7 +152,7 @@ def _load():
     with _plan_lock:
         if _plan is not _UNSET:
             return _plan
-        spec = os.environ.get("MXNET_FAULT_INJECT")
+        spec = get_env("MXNET_FAULT_INJECT")
         if not spec:
             _plan = None
         else:

@@ -15,7 +15,7 @@ from collections import namedtuple
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
@@ -26,7 +26,7 @@ _LENGTH_MASK = (1 << _LFLAG_BITS) - 1
 
 
 def _use_native():
-    if os.environ.get("MXNET_USE_NATIVE_IO", "1") == "0":
+    if not get_env("MXNET_USE_NATIVE_IO"):
         return False
     from .. import native
     return native.available()

@@ -36,7 +36,7 @@ import time
 import jax
 
 from .. import profiler as _profiler
-from ..base import MXNetError, get_env
+from ..base import MXNetError, get_env, hot_path
 from ..ndarray import NDArray
 
 __all__ = ["DeviceStager", "staging_enabled"]
@@ -141,6 +141,7 @@ class DeviceStager:
     def __iter__(self):
         return self
 
+    @hot_path
     def __next__(self):
         if self._producer is None:
             # lazy start: staging begins at the first consumer read, so

@@ -71,6 +71,7 @@ import numpy as np
 
 from . import faultinject
 from . import kvstore_codec as codec
+from .analysis import lockcheck
 from .base import MXNetError, atomic_write, get_env
 
 _AUTHKEY = b"mxnet_tpu_ps"
@@ -224,7 +225,7 @@ def _start_heartbeat(role, rank, stop_event=None):
     (barriers block the main scheduler connection for minutes; heartbeats
     must keep flowing — ps-lite likewise runs them on the van's own
     thread).  Interval: MXNET_KVSTORE_HEARTBEAT_INTERVAL seconds."""
-    interval = float(_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "1.0"))
+    interval = float(get_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL"))
 
     def beat():
         try:
@@ -920,7 +921,11 @@ class WorkerClient:
                         for a in self.server_addrs]
         self._free_slots = [list(range(self._pool_size))
                             for _ in self.servers]
-        self._pool_cv = threading.Condition()
+        # conn-pool lock through the lockcheck seam: its ordering against
+        # the pipeline/profiler locks is exactly what MXNET_LOCK_CHECK
+        # audits in CI
+        self._pool_cv = threading.Condition(
+            lockcheck.make_lock("kvstore.conn_pool.cv"))
         self.policy = RetryPolicy()
         self.breakers = [CircuitBreaker() for _ in self.servers]
         # fusion-bucket layout (set by KVStoreDist at init; None for
@@ -942,7 +947,7 @@ class WorkerClient:
         # process lifetime: a recovery replacement restarting its
         # counter is never matched against its predecessor's watermarks
         self._push_seq = {}
-        self._push_seq_lock = threading.Lock()
+        self._push_seq_lock = lockcheck.make_lock("kvstore.push_seq")
         self._incarnation = "%d-%08x" % (os.getpid(),
                                          random.getrandbits(32))
         self._hb_stop = threading.Event()

@@ -34,7 +34,8 @@ import itertools
 import threading
 import time
 
-from .base import MXNetError, get_env
+from .analysis import lockcheck
+from .base import MXNetError, get_env, hot_path
 
 __all__ = ["CommOp", "CommPipeline"]
 
@@ -72,7 +73,11 @@ class CommPipeline:
         window = int(get_env("MXNET_KVSTORE_INFLIGHT")) \
             if window is None else int(window)
         self._window = max(1, window)
-        self._cv = threading.Condition()
+        # lock allocated through the lockcheck seam: under
+        # MXNET_LOCK_CHECK=1 every acquisition order through this
+        # Condition feeds the lock-order race detector
+        self._cv = threading.Condition(
+            lockcheck.make_lock("kvstore.pipeline.cv"))
         self._heap = []             # (-priority, order, op)
         self._chains = {}           # key -> last submitted, unfinished op
         self._outstanding = 0
@@ -89,6 +94,7 @@ class CommPipeline:
             self._threads.append(t)
 
     # -- submission ---------------------------------------------------------
+    @hot_path
     def submit(self, op):
         """Enqueue; returns the op (its ``done`` event is the
         completion handle)."""
@@ -179,6 +185,9 @@ class CommPipeline:
             self._cv.notify_all()
 
     def _finish_locked(self, op, err, record=True):
+        # registered lockcheck seam: this mutates _outstanding/_chains
+        # and must only ever run under _cv (no-op when checking is off)
+        lockcheck.check_owned(self._cv, "CommPipeline completion state")
         if err is not None and record:
             self._errors.append(err)
         op.error = err

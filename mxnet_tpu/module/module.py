@@ -19,12 +19,11 @@ keeps or falls back to full executor-group reference semantics.
 from __future__ import annotations
 
 import logging
-import os
 import pickle
 
 from .. import ndarray as nd
 from .. import optimizer as opt
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from ..context import cpu, current_context
 from ..initializer import InitDesc, Uniform
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
@@ -514,8 +513,7 @@ class Module(BaseModule):
         layouts, and an optimizer with an exact in-graph equivalent
         (parallel.ingraph_opt)."""
         from ..parallel.ingraph_opt import supports_ingraph
-        if os.environ.get("MXNET_MODULE_FUSED", "1").lower() in \
-                ("0", "false"):
+        if not get_env("MXNET_MODULE_FUSED"):
             return None
         if (self._fused_disabled or self._monitor is not None or
                 self._state_names or self.inputs_need_grad or

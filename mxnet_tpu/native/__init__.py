@@ -145,8 +145,8 @@ class NativeEngine:
         if l is None:
             raise RuntimeError("native runtime unavailable")
         if num_threads is None:
-            num_threads = int(os.environ.get(
-                "MXNET_CPU_WORKER_NTHREADS", os.cpu_count() or 4))
+            from ..base import get_env
+            num_threads = int(get_env("MXNET_CPU_WORKER_NTHREADS"))
         self._lib = l
         self._h = l.mxt_engine_create(num_threads)
         self._cbs = {}

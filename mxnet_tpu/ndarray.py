@@ -549,7 +549,7 @@ def _async_save(path, write_fn):
     check_async_write_errors()
     eng = None
     if not _engine.is_naive() and \
-            get_env("MXNET_ASYNC_CHECKPOINT") != "0":
+            get_env("MXNET_ASYNC_CHECKPOINT"):
         eng = _engine.get().host
     if eng is None:
         write_fn()

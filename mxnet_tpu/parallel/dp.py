@@ -18,7 +18,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import ndarray as nd
-from ..base import MXNetError
+from ..base import MXNetError, hot_path
 from ..initializer import InitDesc, Uniform
 from ..ndarray import NDArray
 from .mesh import local_mesh
@@ -399,6 +399,7 @@ class DataParallelTrainer:
         cache[name] = (arr, placed)
         return placed
 
+    @hot_path
     def step(self, data, label=None, rng=None):
         """Run one fused training step; returns output jax arrays."""
         batch = dict(data) if isinstance(data, dict) else \

@@ -17,6 +17,7 @@ import threading
 import time
 
 from . import engine as _engine
+from .analysis.lockcheck import make_lock
 from .base import get_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
@@ -35,7 +36,7 @@ class Profiler:
     def __init__(self, filename="profile.json"):
         self.filename = filename
         self.records = []  # (name, start_ns, end_ns, thread_id, category)
-        self._lock = threading.Lock()
+        self._lock = make_lock("profiler.records")
         self._t0 = time.perf_counter_ns()
 
     def record(self, name, start_ns, end_ns, cat="operator"):
@@ -93,7 +94,7 @@ class StepPhaseCollector:
         self.totals = {}    # phase -> ns
         self.counts = {}    # phase -> spans
         self.steps = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("profiler.phase_collector")
 
     def record(self, name, dur_ns):
         with self._lock:

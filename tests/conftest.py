@@ -18,8 +18,40 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import numpy as _np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _no_leaked_nondaemon_threads():
+    """Runtime face of graft-lint's thread-discipline rule: at session
+    teardown every non-daemon worker thread must have been joined.  A
+    leaked one would hang interpreter exit in production (atexit waits
+    on it), so fail the whole run and NAME the leaker."""
+    yield
+
+    def offenders():
+        main = threading.main_thread()
+        return [t for t in threading.enumerate()
+                if t.is_alive() and not t.daemon and t is not main]
+
+    deadline = time.time() + 3.0   # grace for joins racing teardown
+    while offenders() and time.time() < deadline:
+        time.sleep(0.05)
+    bad = offenders()
+    if bad:
+        names = ", ".join(
+            "%r (target=%s)" % (t.name,
+                                getattr(getattr(t, "_target", None),
+                                        "__qualname__", "?"))
+            for t in bad)
+        pytest.fail(
+            "non-daemon thread(s) leaked past session teardown: %s — "
+            "give each worker a stop-event + join or daemon=True "
+            "(docs/architecture/static_analysis.md)" % names)
 
 
 @pytest.fixture(autouse=True)
