@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke serve-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -49,6 +49,16 @@ dist-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu MXNET_LOCK_CHECK=1 \
 		$(PY) -m pytest tests/test_fault_tolerance.py -q \
 		-k "seeded or wire_bytes"
+
+# serving-plane smoke gate: the continuous batcher (AOT bucket programs
+# + latency-budget scheduler) vs a per-request Predictor deployment
+# under the SAME seeded open-loop arrival schedule (serving/loadgen.py).
+# Gates: batcher achieved QPS >= 3x the per-request deployment's, p99
+# no worse, zero dropped requests.  Deterministic seed; the ratio is
+# host-relative so the gate holds on any machine.
+serve-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+		$(PY) tools/serve_smoke.py --seed 11 --qps-floor 3.0
 
 # smoke fit under the profiler -> per-step phase breakdown
 # (data_wait/h2d_stage/compute/metric_fetch) from the dumped trace, so

@@ -691,6 +691,47 @@ def bench_kvstore_push_pull(mode, chip, smoke=False):
     return row
 
 
+def bench_serving_latency(mode, chip, smoke=False):
+    """Serving-plane p50/p99 + QPS: the continuous batcher
+    (serving/scheduler.py over AOT bucket programs) vs a per-request
+    ``Predictor.forward`` deployment, both driven by the SAME seeded
+    open-loop arrival schedule at a multiple of the per-request
+    capacity (serving/loadgen.py latency_protocol — the protocol
+    ``make serve-smoke`` gates on).  CPU-deterministic: the schedule
+    and request contents derive from the seed; batching economics
+    (one bucket dispatch amortizes per-forward overhead across
+    requests) reproduce without an accelerator."""
+    from mxnet_tpu.serving.loadgen import latency_protocol
+
+    r = latency_protocol(mode=mode, smoke=smoke)
+    so, b = r["serial_open"], r["batch"]
+    eng = b.pop("engine", {})
+    row = {"metric": "serving.latency.%s" % mode,
+           "value": b["qps_achieved"], "unit": "qps",
+           "vs_baseline": None,
+           "p50_ms": b["p50_ms"], "p99_ms": b["p99_ms"],
+           "per_request_qps": so["qps_achieved"],
+           "per_request_p50_ms": so["p50_ms"],
+           "per_request_p99_ms": so["p99_ms"],
+           "qps_vs_per_request": r["qps_vs_per_request"],
+           "p99_vs_per_request": r["p99_vs_per_request"],
+           "closed_loop_qps": r["serial_closed"]["qps"],
+           "offered_mult": r["offered_mult"],
+           "max_delay_ms": r["max_delay_ms"],
+           "max_batch": r["max_batch"],
+           "n_requests": b["n"],
+           "dropped": b["timeouts"] + b["errors"] + b["cancelled"],
+           "batches": eng.get("batches"),
+           "padded_rows": eng.get("padded_rows"),
+           "seed": r["seed"]}
+    if mode == "bf16":
+        row["note"] = ("bf16 serving weights (half the resident memory); "
+                       "fp32 serving stays bit-equal to the classic "
+                       "Predictor — the accuracy row is "
+                       "tests/test_serving.py's bit-equality pin")
+    return row
+
+
 def bench_input_staging(chip, smoke=False):
     """Overlapped device input staging through the real ``Module.fit``
     loop: steps/sec with the DeviceStager on vs ``MXNET_IO_STAGE=0``,
@@ -1102,6 +1143,11 @@ def main():
     guard("kvstore.push_pull.2bit", bench_kvstore_push_pull, "2bit", chip,
           smoke)
     guard("io.input_staging", bench_input_staging, chip, smoke)
+    # CPU-deterministic serving-plane rows (seeded open-loop protocol)
+    guard("serving.latency.fp32", bench_serving_latency, "fp32", chip,
+          smoke)
+    guard("serving.latency.bf16", bench_serving_latency, "bf16", chip,
+          smoke)
     guard("train.resnet-50.trainer_direct", bench_trainer_direct, iters,
           warmup, chip, smoke)
     if not smoke:  # smoke pins batch 8 — a duplicate row, skip
@@ -1177,6 +1223,19 @@ def _assemble_out(rows, chip, smoke, t0):
                      "unit": "unavailable", "vs_baseline": None,
                      "reason": reasons})
 
+    # serving-plane summary: the continuous batcher's QPS multiple over
+    # the per-request deployment at the same offered load (the >= 3x
+    # acceptance figure), surfaced per serving dtype when the rows ran
+    serving = {}
+    for mode in ("fp32", "bf16"):
+        r = by_metric.get("serving.latency.%s" % mode)
+        if r and r.get("unit") not in ("error", "skipped"):
+            serving[mode] = {
+                "qps": r["value"],
+                "qps_vs_per_request": r.get("qps_vs_per_request"),
+                "p99_ms": r.get("p99_ms"),
+            }
+
     out = {
         "metric": "resnet50_train_images_per_sec",
         "value": headline["value"] if headline else 0.0,
@@ -1190,6 +1249,8 @@ def _assemble_out(rows, chip, smoke, t0):
         "total_seconds": round(time.time() - t0, 1),
         "rows": rows,
     }
+    if serving:
+        out["serving"] = serving
     if fit_vs_direct_reason is not None:
         out["fit_vs_direct_reason"] = fit_vs_direct_reason
     if smoke and fit_vs_direct is not None:

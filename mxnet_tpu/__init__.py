@@ -68,6 +68,7 @@ from . import rtc  # noqa: E402
 from . import predictor  # noqa: E402
 from .predictor import Predictor  # noqa: E402
 from . import deploy  # noqa: E402
+from . import serving  # noqa: E402  (AOT program store + continuous batcher)
 from . import executor_manager  # noqa: E402
 from . import pallas_ops  # noqa: E402
 from . import test_utils  # noqa: E402

@@ -46,6 +46,7 @@ SPAN_ENTRY_POINTS = (
     ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline._worker"),
     ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline.flush"),
     ("mxnet_tpu/module/base_module.py", "BaseModule._fit_epochs"),
+    ("mxnet_tpu/serving/scheduler.py", "ServingEngine._dispatch_once"),
 )
 
 # Terminal callable names that count as "emits a span".

@@ -235,6 +235,36 @@ register_env("MXNET_LOCK_CHECK", bool, False,
              "cycle (potential deadlock) or on guarded shared state "
              "mutated without its lock held.  Debug/CI aid; off by "
              "default.")
+register_env("MXNET_SERVE_BUCKETS", str, "1,2,4,8,16,32",
+             "Comma-separated batch-size bucket edges of the serving "
+             "program store (serving/program_store.py): a request of n "
+             "rows is padded up to the smallest edge >= n and runs the "
+             "AOT-compiled program for that bucket, so arbitrary "
+             "request sizes hit a small fixed set of compiled "
+             "programs.")
+register_env("MXNET_SERVE_MAX_DELAY_MS", float, 5.0,
+             "Per-request latency budget (milliseconds) of the "
+             "continuous batching scheduler: a batch is flushed no "
+             "later than this long after its OLDEST member was "
+             "submitted, even if the largest bucket has not filled.  "
+             "0 dispatches every request immediately (no batching "
+             "delay).")
+register_env("MXNET_SERVE_MAX_BATCH", int, 32,
+             "Upper bound on rows the continuous batcher coalesces "
+             "into one serving dispatch (further capped by the "
+             "largest configured shape bucket).")
+register_env("MXNET_SERVE_PROGRAM_CACHE", int, 32,
+             "Max AOT-compiled serving programs held per model by the "
+             "program store's LRU (one per shape bucket signature); "
+             "least-recently-used executables are dropped beyond it "
+             "and recompile on next use (stats count the evictions).")
+register_env("MXNET_SERVE_DTYPE", str, "",
+             "Default serving compute dtype for models registered "
+             "without an explicit compute_dtype ('bfloat16' halves "
+             "weight memory and feeds the MXU; outputs are returned "
+             "as float32 either way).  Empty keeps the checkpoint "
+             "dtype (fp32 serving, bit-equal to the classic "
+             "Predictor).")
 
 
 def hot_path(fn):

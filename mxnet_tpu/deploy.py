@@ -31,11 +31,17 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["export_model", "export_checkpoint", "load_model",
-           "DeployedModel"]
+           "DeployedModel", "to_serving", "to_serving_checkpoint",
+           "read_serving_artifact"]
 
 _META_NAME = "meta.json"
 _EXPORT_NAME = "exported.bin"
 _FORMAT_VERSION = 1
+
+_SERVE_META = "serving.json"
+_SERVE_SYMBOL = "symbol.json"
+_SERVE_PARAMS = "params.npz"
+_SERVE_FORMAT_VERSION = 1
 
 
 def export_model(symbol, arg_params, aux_params, input_shapes, path,
@@ -206,3 +212,85 @@ class DeployedModel:
 def load_model(path):
     """Load a ``.mxtpkg`` deploy artifact."""
     return DeployedModel(path)
+
+
+# ---------------------------------------------------------------------------
+# Serving artifacts (.mxsrv): the registry-loadable deploy unit.
+#
+# ``export_model`` bakes weights into StableHLO for a standalone embedded
+# consumer; a serving *tenant* is different — the registry wants the raw
+# (symbol-json, params, shape-buckets) triple so it can cast weights to
+# the serving dtype, share one device-resident copy across all bucket
+# programs, and AOT-compile per bucket on its own terms
+# (serving/program_store.py).
+# ---------------------------------------------------------------------------
+def to_serving(symbol, arg_params, aux_params, input_shapes, path,
+               bucket_edges=None, compute_dtype=None, input_dtypes=None):
+    """Export a ``(symbol-json, params, shape-buckets)`` serving artifact
+    that :meth:`serving.ModelRegistry.load_artifact` loads directly.
+
+    ``bucket_edges`` defaults to the current ``MXNET_SERVE_BUCKETS``
+    resolution and is RECORDED in the artifact, so the serving process
+    compiles the same program set the exporter validated.  Returns
+    ``path``.
+    """
+    from .serving.program_store import bucket_edges as _resolve
+
+    input_names = list(input_shapes)
+    input_dtypes = dict(input_dtypes or {})
+    meta = {
+        "format_version": _SERVE_FORMAT_VERSION,
+        "input_shapes": {n: list(input_shapes[n]) for n in input_names},
+        "input_dtypes": {n: str(np.dtype(input_dtypes.get(n, "float32")))
+                         for n in input_names},
+        "bucket_edges": list(_resolve(bucket_edges)),
+        "compute_dtype": compute_dtype,
+        "output_names": symbol.list_outputs(),
+    }
+
+    def host(v):
+        return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+    payload = {"arg:%s" % k: host(v) for k, v in arg_params.items()}
+    payload.update({"aux:%s" % k: host(v)
+                    for k, v in (aux_params or {}).items()})
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr(_SERVE_META, json.dumps(meta, indent=1))
+        z.writestr(_SERVE_SYMBOL, symbol.tojson())
+        z.writestr(_SERVE_PARAMS, buf.getvalue())
+    return path
+
+
+def to_serving_checkpoint(prefix, epoch, input_shapes, path, **kwargs):
+    """``to_serving`` from a ``save_checkpoint`` prefix/epoch pair."""
+    from .model import load_checkpoint
+    sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+    return to_serving(sym, arg_params, aux_params, input_shapes, path,
+                      **kwargs)
+
+
+def read_serving_artifact(path_or_bytes):
+    """Load a ``to_serving`` artifact.  Returns
+    ``(symbol, arg_params, aux_params, meta)`` with numpy param values
+    (the registry's program store places them on device once)."""
+    from . import symbol as sym_mod
+
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        path_or_bytes = io.BytesIO(path_or_bytes)
+    with zipfile.ZipFile(path_or_bytes) as z:
+        meta = json.loads(z.read(_SERVE_META))
+        if meta.get("format_version", 0) > _SERVE_FORMAT_VERSION:
+            raise MXNetError(
+                "serving artifact format v%s is newer than this "
+                "loader (v%s)" % (meta.get("format_version"),
+                                  _SERVE_FORMAT_VERSION))
+        symbol = sym_mod.load_json(z.read(_SERVE_SYMBOL).decode())
+        data = np.load(io.BytesIO(z.read(_SERVE_PARAMS)),
+                       allow_pickle=False)
+        arg_params, aux_params = {}, {}
+        for k in data.files:
+            kind, name = k.split(":", 1)
+            (arg_params if kind == "arg" else aux_params)[name] = data[k]
+    return symbol, arg_params, aux_params, meta

@@ -12,6 +12,12 @@ forward, and exposes the same verbs.
     pred.set_input("data", x)      # or pred.forward(data=x)
     pred.forward()
     y = pred.get_output(0)
+
+``serving=True`` swaps the classic bound Executor for the AOT serving
+program store (``serving/program_store.py``): the forward is compiled
+ahead of time per shape bucket, so requests of ANY bucketable batch size
+run without rebinding or retracing — the production fast path the
+``ServingEngine`` batches over (docs/architecture/serving.md).
 """
 from __future__ import annotations
 
@@ -34,12 +40,25 @@ def load_ndarray_file(nd_bytes):
     return {k: np.asarray(v) for k, v in data.items()}
 
 
+def _as_ctx_array(value, ctx):
+    """Param value -> NDArray on ``ctx`` WITHOUT a host round-trip when
+    it is already device-resident (an NDArray from load_checkpoint): the
+    underlying jax buffer is device_put directly, never ``.asnumpy()``'d
+    back to host."""
+    from . import ndarray as nd
+    if isinstance(value, nd.NDArray):
+        import jax
+        return nd.NDArray(jax.device_put(value._data, ctx.jax_device()))
+    return nd.array(value, ctx)
+
+
 class Predictor:
     """Inference-only executor over a symbol-JSON + params checkpoint
     (reference MXPredCreate, c_predict_api.h:59)."""
 
     def __init__(self, symbol_json_str, param_raw_bytes, input_shapes,
-                 dev_type="cpu", dev_id=0):
+                 dev_type="cpu", dev_id=0, serving=False,
+                 compute_dtype=None, buckets=None):
         from . import context, symbol as sym_mod
         from . import ndarray as nd
 
@@ -62,6 +81,29 @@ class Predictor:
                 arg_params[k] = v
 
         self._input_names = list(input_shapes)
+        self._store = None
+        self._exec = None
+        self._outputs = None
+        if serving:
+            # serving fast path: AOT bucketed programs instead of a
+            # bound Executor — accepts any bucketable request size and
+            # never retraces at dispatch (warmed here, at load)
+            from .serving import ProgramStore
+            self._store = ProgramStore(
+                self._symbol, arg_params, aux_params, input_shapes,
+                name="predictor", compute_dtype=compute_dtype,
+                buckets=buckets, device=self._ctx.jax_device())
+            self._store.warmup()
+            self._np_inputs = {
+                n: np.zeros(tuple(input_shapes[n]), np.float32)
+                for n in self._input_names}
+            shapes = {n: tuple(input_shapes[n])
+                      for n in self._input_names}
+            _, out_shapes, _ = self._symbol.infer_shape_partial(**shapes)
+            self._declared_out_shapes = [tuple(s) if s else None
+                                         for s in out_shapes]
+            return
+
         arg_names = self._symbol.list_arguments()
         aux_names = self._symbol.list_auxiliary_states()
         shapes = dict(input_shapes)
@@ -74,7 +116,7 @@ class Predictor:
                 a = nd.zeros(tuple(input_shapes[name]), self._ctx)
                 self._inputs[name] = a
             elif name in arg_params:
-                a = nd.array(arg_params[name], self._ctx)
+                a = _as_ctx_array(arg_params[name], self._ctx)
             elif shape is not None:
                 # non-parameter aux inputs (labels) get zeros — inference
                 # never reads them
@@ -87,7 +129,7 @@ class Predictor:
         aux = []
         for name, shape in zip(aux_names, aux_shapes):
             if name in aux_params:
-                aux.append(nd.array(aux_params[name], self._ctx))
+                aux.append(_as_ctx_array(aux_params[name], self._ctx))
             elif shape is not None:
                 aux.append(nd.zeros(tuple(shape), self._ctx))
             else:
@@ -99,10 +141,17 @@ class Predictor:
                                        grad_req="null",
                                        aux_states=dict(zip(aux_names,
                                                            aux)))
-        self._outputs = None
 
     def set_input(self, name, data):
         """MXPredSetInput (c_predict_api.h:125)."""
+        if self._store is not None:
+            if name not in self._np_inputs:
+                raise MXNetError("unknown input %r (have %s)"
+                                 % (name, self._input_names))
+            # serving accepts any bucketable batch size; dtype and
+            # trailing dims are validated at forward (canon_inputs)
+            self._np_inputs[name] = np.asarray(data)
+            return
         if name not in self._inputs:
             raise MXNetError("unknown input %r (have %s)"
                              % (name, self._input_names))
@@ -110,8 +159,15 @@ class Predictor:
 
     def forward(self, **inputs):
         """MXPredForward; kwargs are a convenience for set_input."""
+        from . import ndarray as nd
         for k, v in inputs.items():
             self.set_input(k, v)
+        if self._store is not None:
+            feed, n = self._store.canon_inputs(
+                {k: self._np_inputs[k] for k in self._input_names})
+            outs, _bucket, _bm = self._store.run(feed, n=n)
+            self._outputs = [nd.NDArray(o) for o in outs]
+            return self._outputs
         self._outputs = self._exec.forward(is_train=False)
         return self._outputs
 
@@ -123,19 +179,34 @@ class Predictor:
 
     def get_output_shape(self, index):
         """Static output shape from executor metadata — no device transfer
-        (reference MXPredGetOutputShape)."""
+        (reference MXPredGetOutputShape).  On the serving path the shape
+        reflects the last forward's batch rows (declared template shape
+        before any forward)."""
+        if self._store is not None:
+            if self._outputs is not None:
+                return tuple(self._outputs[index].shape)
+            return self._declared_out_shapes[index]
         return tuple(self._exec.outputs[index].shape)
+
+    def serving_stats(self):
+        """Compile-cache stats of the serving program store (None on the
+        classic executor path)."""
+        return None if self._store is None else self._store.stats()
 
     @staticmethod
     def from_checkpoint(prefix, epoch, input_shapes, dev_type="cpu",
-                        dev_id=0):
+                        dev_id=0, **kwargs):
         """Build from a `prefix-symbol.json` + `prefix-NNNN.params` pair
-        (model.save_checkpoint layout)."""
+        (model.save_checkpoint layout).  Params are loaded ONCE and the
+        device-resident arrays handed straight to the predictor — no
+        ``.asnumpy()`` round-trip through host memory.  Extra kwargs
+        (``serving=True``, ``compute_dtype``, ``buckets``) pass
+        through."""
         with open("%s-symbol.json" % prefix) as f:
             sym_json = f.read()
         from .model import load_checkpoint
         _, arg_params, aux_params = load_checkpoint(prefix, epoch)
-        params = {"arg:%s" % k: v.asnumpy() for k, v in arg_params.items()}
-        params.update({"aux:%s" % k: v.asnumpy()
-                       for k, v in aux_params.items()})
-        return Predictor(sym_json, params, input_shapes, dev_type, dev_id)
+        params = {"arg:%s" % k: v for k, v in arg_params.items()}
+        params.update({"aux:%s" % k: v for k, v in aux_params.items()})
+        return Predictor(sym_json, params, input_shapes, dev_type, dev_id,
+                         **kwargs)

@@ -22,7 +22,8 @@ from .base import get_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "Profiler", "record_phase", "mark_step", "start_step_profile",
-           "stop_step_profile", "aggregate_phase_trace", "PHASES"]
+           "stop_step_profile", "aggregate_phase_trace", "PHASES",
+           "SERVE_PHASES"]
 
 # The per-step wall-time attribution phases of one Module.fit batch
 # (tools/step_profile.py renders them; docs/perf.md explains the
@@ -30,6 +31,15 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
 # background thread, so it OVERLAPS compute rather than adding to the
 # step — the report calls that out.
 PHASES = ("data_wait", "h2d_stage", "compute", "metric_fetch")
+
+# The serving engine's scheduler-cycle phases (serving/scheduler.py):
+# ``serve_wait`` (engine blocked on the request queue), ``serve_batch``
+# (continuous-batch forming — the latency-budget window) and
+# ``serve_compute`` (bucketed program dispatch + future resolution).
+# They ride the same record_phase seam, so a Chrome trace shows the
+# batcher's duty cycle and the step collector can aggregate a serving
+# window exactly like a fit window.
+SERVE_PHASES = ("serve_wait", "serve_batch", "serve_compute")
 
 
 class Profiler:

@@ -1,0 +1,34 @@
+"""Production serving plane: AOT-compiled inference with continuous batching.
+
+Three layers (docs/architecture/serving.md):
+
+* :mod:`program_store` — per ``(model, shape-bucket, dtype)`` signature the
+  inference program is lowered and compiled **ahead of time**
+  (``jax.jit(...).lower(...).compile()``) into a bounded LRU keyed like
+  ``cached_op.py``'s; arbitrary request sizes are padded up to a small set
+  of configured bucket edges and the pad rows sliced back off the outputs.
+* :mod:`scheduler` — :class:`ServingEngine`, a continuous-batching request
+  scheduler: one engine thread drains a request queue into the largest
+  bucket that fits within a per-request latency budget
+  (``MXNET_SERVE_MAX_DELAY_MS`` / ``MXNET_SERVE_MAX_BATCH``), with
+  per-request futures, timeout/cancellation, and graceful shutdown that
+  drains in-flight work.
+* :mod:`registry` — :class:`ModelRegistry`, multi-model tenancy: N models
+  served from one process, each with its own program store and optional
+  serving weight dtype (bf16).
+
+:mod:`loadgen` provides the seeded open-loop load generator (deterministic
+arrival schedule, ``faultinject``-style) driving the p50/p99 + QPS bench
+rows on CPU in CI.
+"""
+from .program_store import ProgramStore, bucket_edges, bucket_for
+from .registry import ModelRegistry
+from .scheduler import ServeClosed, ServeRequest, ServeTimeout, ServingEngine
+from .loadgen import OpenLoopSchedule, latency_protocol, run_loadgen
+
+__all__ = [
+    "ProgramStore", "bucket_edges", "bucket_for",
+    "ModelRegistry",
+    "ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
+    "OpenLoopSchedule", "run_loadgen", "latency_protocol",
+]

@@ -1,0 +1,337 @@
+"""Seeded open-loop load generator for the serving plane.
+
+The "millions of users" scenario is open-loop: requests arrive on their
+own schedule whether or not the server keeps up (closed-loop harnesses
+hide queueing collapse — a saturated server just slows its own clients).
+Real arrival processes are not reproducible in CI, so — exactly like
+``faultinject.py`` turns real failures into a seeded schedule — the
+generator draws the whole arrival process (exponential inter-arrival
+gaps + request sizes) ONCE from a seed into a concrete
+:class:`OpenLoopSchedule`; the same seed replays the same offered load
+byte-for-byte, making the p50/p99/QPS bench rows CPU-deterministic up to
+host timing noise.
+
+:func:`run_loadgen` drives any ``submit(i, n) -> Future`` target on the
+schedule and reports per-request latency percentiles and achieved QPS;
+completion timestamps are taken AFTER a dependent-byte host fetch
+(``test_utils.fetch_sync`` — the honest-timing discipline of bench.py)
+on a waiter thread, never on the engine thread.
+
+:func:`latency_protocol` is the full bench protocol shared by
+``bench.py``'s ``serving.latency.{fp32,bf16}`` rows, ``make serve-smoke``
+and the tests: measure per-request ``Predictor.forward`` closed-loop
+(service latency + capacity), then drive BOTH a per-request server and
+the continuous batcher under the same seeded open-loop schedule at a
+multiple of that capacity.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["OpenLoopSchedule", "run_loadgen", "latency_protocol"]
+
+
+class OpenLoopSchedule:
+    """Deterministic seeded arrival schedule.
+
+    ``arrivals[i]`` — seconds after t0 request ``i`` is offered (cumsum
+    of exponential gaps at ``qps``); ``sizes[i]`` — its row count, drawn
+    from ``sizes``/``size_weights``.  Same seed => identical schedule.
+    """
+
+    def __init__(self, seed=0, n_requests=100, qps=100.0, sizes=(1,),
+                 size_weights=None):
+        if qps <= 0 or n_requests < 1:
+            raise MXNetError("schedule needs qps > 0 and n_requests >= 1")
+        rs = np.random.RandomState(int(seed))
+        self.arrivals = np.cumsum(
+            rs.exponential(1.0 / float(qps), int(n_requests)))
+        p = None
+        if size_weights is not None:
+            p = np.asarray(size_weights, np.float64)
+            p = p / p.sum()
+        self.sizes = rs.choice(np.asarray(sizes, np.int64),
+                               int(n_requests), p=p)
+        self.seed = int(seed)
+        self.qps = float(qps)
+        self.n = int(n_requests)
+
+
+def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
+    """Drive ``submit(i, n_rows) -> Future`` on an open-loop schedule.
+
+    Returns a summary dict: latency percentiles over successful
+    requests (submit -> result fetched to host), achieved vs offered
+    QPS, and failure counters.  Submission stays open-loop: a request
+    is offered at its scheduled time even when earlier ones are still
+    in flight; ``max_submit_slip_ms`` reports how far the submitting
+    thread itself fell behind the schedule (pacing credibility).
+    """
+    from ..test_utils import fetch_sync
+
+    n = schedule.n
+    done_q = queue.Queue()
+    records = [None] * n   # (status, latency_s) — waiter thread writes
+    t_last_done = [0.0]
+
+    def waiter():
+        got = 0
+        while got < n:
+            i, t_sub, fut = done_q.get()
+            try:
+                res = fut.result()
+                if fetch and res:
+                    fetch_sync(res[0])
+                records[i] = ("ok", time.perf_counter() - t_sub)
+            except Exception as e:  # noqa: BLE001 — tallied by class
+                from .scheduler import ServeTimeout
+                if fut.cancelled():
+                    status = "cancelled"
+                elif isinstance(e, ServeTimeout):
+                    status = "timeout"
+                else:
+                    status = "error"
+                records[i] = (status, time.perf_counter() - t_sub)
+            t_last_done[0] = time.perf_counter()
+            got += 1
+
+    w = threading.Thread(target=waiter, name="mxt-loadgen-wait",
+                         daemon=True)
+    w.start()
+    slip = 0.0
+    t0 = time.perf_counter()
+    for i in range(n):
+        due = schedule.arrivals[i]
+        now = time.perf_counter() - t0
+        if due > now:
+            time.sleep(due - now)
+        else:
+            slip = max(slip, now - due)
+        t_sub = time.perf_counter()
+        try:
+            fut = submit(i, int(schedule.sizes[i]))
+        except Exception:  # noqa: BLE001 — submission refusals count too
+            records[i] = ("error", 0.0)
+            done_q.put((i, t_sub, _failed_future()))
+            continue
+        fut.add_done_callback(
+            lambda f, i=i, t=t_sub: done_q.put((i, t, f)))
+    w.join(settle_s)
+    if w.is_alive():
+        raise MXNetError("loadgen waiter did not drain within %.0fs "
+                         "(requests lost?)" % settle_s)
+    lats = np.asarray([r[1] for r in records if r and r[0] == "ok"])
+    counts = {}
+    for r in records:
+        counts[r[0] if r else "lost"] = counts.get(
+            r[0] if r else "lost", 0) + 1
+    ok = counts.get("ok", 0)
+    span = max(t_last_done[0] - t0, 1e-9)
+    return {
+        "n": n,
+        "ok": ok,
+        "timeouts": counts.get("timeout", 0),
+        "cancelled": counts.get("cancelled", 0),
+        "errors": counts.get("error", 0) + counts.get("lost", 0),
+        "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3)
+        if ok else None,
+        "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3)
+        if ok else None,
+        "mean_ms": round(float(lats.mean()) * 1e3, 3) if ok else None,
+        "max_ms": round(float(lats.max()) * 1e3, 3) if ok else None,
+        "qps_offered": round(schedule.qps, 2),
+        "qps_achieved": round(ok / span, 2),
+        "rows": int(schedule.sizes.sum()),
+        "duration_s": round(span, 3),
+        "max_submit_slip_ms": round(slip * 1e3, 3),
+        "seed": schedule.seed,
+    }
+
+
+def _failed_future():
+    from concurrent.futures import Future
+    f = Future()
+    f.set_exception(MXNetError("submit refused"))
+    return f
+
+
+class _PerRequestServer:
+    """The per-request baseline under open-loop load: one worker thread
+    services a FIFO queue by calling ``Predictor.forward`` for every
+    request individually (no batching, no buckets) — exactly what a
+    naive deployment of ``predictor.py`` does.  Same Future interface
+    as the ServingEngine so :func:`run_loadgen` drives both."""
+
+    def __init__(self, predictor, input_name="data"):
+        self._pred = predictor
+        self._input = input_name
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._work,
+                                        name="mxt-serial-serve",
+                                        daemon=True)
+        self._thread.start()
+
+    def submit(self, x):
+        from concurrent.futures import Future
+        fut = Future()
+        self._q.put((x, fut))
+        return fut
+
+    def _work(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            x, fut = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                outs = self._pred.forward(**{self._input: x})
+                # resolve with the device array; the loadgen waiter
+                # fetch-syncs it, the same completion clock the
+                # batcher's futures get
+                fut.set_result([outs[0]._data])
+            except BaseException as e:  # noqa: BLE001 — to the future
+                fut.set_exception(e)
+
+    def close(self):
+        self._q.put(None)
+        self._thread.join(30)
+
+
+def _smoke_model(feat, hidden, seed):
+    """Deterministic tiny-MLP symbol + params (shared smoke protocol
+    model, test_utils.smoke_mlp shape family)."""
+    from ..test_utils import smoke_mlp
+    sym = smoke_mlp(num_hidden=hidden)
+    shapes, _, _ = sym.infer_shape(data=(1, feat), softmax_label=(1,))
+    rs = np.random.RandomState(seed)
+    args = {}
+    for name, shape in zip(sym.list_arguments(), shapes):
+        if name not in ("data", "softmax_label"):
+            args[name] = np.asarray(
+                rs.uniform(-0.3, 0.3, shape), np.float32)
+    return sym, args
+
+
+def latency_protocol(mode="fp32", smoke=False, seed=11, offered_mult=6.0,
+                     max_delay_ms=2.0, max_batch=32):
+    """The serving bench protocol (CPU-deterministic).
+
+    1. **Per-request baseline, closed loop**: ``Predictor.forward`` +
+       output fetch back-to-back over deterministic inputs — service
+       latency and the per-request capacity ``C`` (QPS ceiling of the
+       no-batching deployment).
+    2. **Per-request baseline, open loop**: the same Predictor behind a
+       FIFO worker, driven by the seeded schedule at
+       ``offered_mult x C`` — shows queueing collapse (p99 explodes,
+       achieved QPS saturates at ~C).
+    3. **Continuous batcher**: registry + ServingEngine (same weights,
+       ``mode`` = 'fp32' or 'bf16' serving dtype) under the SAME
+       schedule — achieved QPS tracks the offered load with p99 far
+       below the saturated baseline.
+
+    Returns ``{"serial_closed", "serial_open", "batch", ...}`` with
+    ``qps_vs_per_request`` = batcher achieved QPS / open-loop baseline
+    achieved QPS (the >= 3x acceptance figure).
+    """
+    import mxnet_tpu as mx
+    from .registry import ModelRegistry
+    from .scheduler import ServingEngine
+
+    if mode not in ("fp32", "bf16"):
+        raise MXNetError("mode must be fp32 or bf16, got %r" % mode)
+    # the model must be COMPUTE-dominated for the row to mean anything:
+    # at this size a batch-32 forward costs about the same wall time as
+    # batch-1 on CPU (the matmuls stream the weights; extra rows ride
+    # the vector units), so batching converts per-request service time
+    # into pure capacity — the same economics as a TPU serving stack.
+    # A faster model would also push the open-loop offered rate past
+    # what the submitting thread can pace on a small CPU host.
+    feat, hidden = 512, 2048
+    n_serial = 40 if smoke else 120
+    n_load = 120 if smoke else 400
+    sym, args = _smoke_model(feat, hidden, seed)
+    rs = np.random.RandomState(seed + 1)
+    pool = [np.asarray(rs.uniform(-1, 1, (1, feat)), np.float32)
+            for _ in range(16)]
+
+    pred = mx.Predictor(sym.tojson(),
+                        {"arg:%s" % k: v for k, v in args.items()},
+                        {"data": (1, feat)})
+    # closed-loop service measurement (warm first: bind-time compile)
+    for i in range(5):
+        pred.forward(data=pool[i % len(pool)])
+        pred.get_output(0)
+    lats = np.empty(n_serial)
+    tic = time.perf_counter()
+    for i in range(n_serial):
+        t = time.perf_counter()
+        pred.forward(data=pool[i % len(pool)])
+        pred.get_output(0)          # host fetch: the client-visible value
+        lats[i] = time.perf_counter() - t
+    serial_qps = n_serial / (time.perf_counter() - tic)
+    serial_closed = {
+        "qps": round(serial_qps, 2),
+        "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+        "n": n_serial,
+    }
+
+    offered = serial_qps * float(offered_mult)
+    schedule = OpenLoopSchedule(seed, n_load, offered, sizes=(1,))
+
+    # open-loop per-request baseline (fresh schedule replay, same seed)
+    serial_srv = _PerRequestServer(pred)
+    try:
+        serial_open = run_loadgen(
+            lambda i, n: serial_srv.submit(pool[i % len(pool)]),
+            schedule, fetch=True)
+    finally:
+        serial_srv.close()
+
+    # continuous batcher on the same seeded schedule
+    registry = ModelRegistry()
+    registry.add_model(
+        "m", sym, args, {}, input_shapes={"data": (1, feat)},
+        compute_dtype="bfloat16" if mode == "bf16" else None,
+        warmup=True)
+    engine = ServingEngine(registry, max_delay_ms=max_delay_ms,
+                           max_batch=max_batch)
+    try:
+        # warm the batched dispatch path (first multi-request batch pays
+        # one-time executable/runtime init that warmup-at-load's
+        # compiles don't cover), mirroring the baseline's warmup
+        for _ in range(3):
+            for f in [engine.submit("m", data=pool[i % len(pool)])
+                      for i in range(max_batch)]:
+                f.result(60)
+        batch = run_loadgen(
+            lambda i, n: engine.submit("m", data=pool[i % len(pool)]),
+            schedule, fetch=True)
+        batch["engine"] = engine.stats()
+    finally:
+        engine.close()
+    ratio = (batch["qps_achieved"] / serial_open["qps_achieved"]
+             if serial_open["qps_achieved"] else None)
+    return {
+        "mode": mode,
+        "seed": seed,
+        "model": {"feat": feat, "hidden": hidden},
+        "serial_closed": serial_closed,
+        "serial_open": serial_open,
+        "batch": batch,
+        "offered_mult": float(offered_mult),
+        "max_delay_ms": float(max_delay_ms),
+        "max_batch": int(max_batch),
+        "qps_vs_per_request": round(ratio, 3) if ratio else None,
+        "p99_vs_per_request": (
+            round(batch["p99_ms"] / serial_open["p99_ms"], 4)
+            if batch["p99_ms"] and serial_open["p99_ms"] else None),
+    }

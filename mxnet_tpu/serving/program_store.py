@@ -1,0 +1,465 @@
+"""AOT program store: compiled-ahead-of-time inference per shape bucket.
+
+The training side compiles lazily (``cached_op.py``'s tiered LRU,
+``executor.py``'s bind-time jit) because training shapes are stable after
+step one.  A serving process is the opposite regime: request sizes vary
+per call and the first request of a new shape must NOT pay a multi-second
+XLA compile.  So the store
+
+* quantizes request batch sizes into configured **bucket edges**
+  (``MXNET_SERVE_BUCKETS``): a request of ``n`` rows is zero-padded up to
+  the smallest edge ``>= n``, runs the bucket's program, and the pad rows
+  are sliced back off every batch-major output.  Inference graphs are
+  row-independent (``is_train=False`` — BatchNorm reads running stats,
+  softmax is per-row), so the pad rows cannot perturb the real rows and
+  fp32 bucketed outputs are **bit-equal** to an unbatched forward
+  (pinned by ``tests/test_serving.py``);
+* compiles each bucket's program **ahead of time** —
+  ``jax.jit(fwd).lower(specs...).compile()`` — normally at model load
+  (:meth:`ProgramStore.warmup`), so steady-state dispatch never traces;
+* holds the executables in a bounded LRU keyed like ``cached_op.py``'s
+  (``(model, bucket, input avals, dtype)``), ``MXNET_SERVE_PROGRAM_CACHE``
+  entries, with hit/compile/eviction stats.
+
+Parameters are **arguments** of the compiled programs (not baked
+constants like ``deploy.py``'s export), so all buckets share one
+device-resident copy of the weights and a model upgrade swaps arrays
+without recompiling.  ``compute_dtype='bfloat16'`` casts the floating
+weights once at load (half the serving memory) and casts inputs inside
+the program; outputs always come back float32.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.lockcheck import make_lock
+from ..base import MXNetError, get_env, hot_path
+
+__all__ = ["ProgramStore", "bucket_edges", "bucket_for"]
+
+log = logging.getLogger(__name__)
+
+
+def bucket_edges(edges=None):
+    """Resolve bucket edges: an explicit iterable, or the
+    ``MXNET_SERVE_BUCKETS`` comma list; returned sorted, deduplicated,
+    all positive."""
+    if edges is None:
+        raw = get_env("MXNET_SERVE_BUCKETS")
+        edges = [int(tok) for tok in str(raw).split(",") if tok.strip()]
+    out = sorted({int(e) for e in edges})
+    if not out or out[0] < 1:
+        raise MXNetError("serving bucket edges must be positive ints, "
+                         "got %r" % (edges,))
+    return tuple(out)
+
+
+def bucket_for(n, edges):
+    """Smallest edge >= n, or None when n exceeds the largest edge."""
+    for e in edges:
+        if n <= e:
+            return e
+    return None
+
+
+def _as_device_array(v):
+    """Model parameter -> jax array WITHOUT a host round-trip when the
+    value is already device-resident (NDArray / jax.Array)."""
+    data = getattr(v, "_data", v)  # NDArray unwraps; numpy/jax pass through
+    return data if isinstance(data, jax.Array) else jnp.asarray(data)
+
+
+class _Program:
+    __slots__ = ("fn", "bucket", "out_batch_major", "compile_ms")
+
+    def __init__(self, fn, bucket, out_batch_major, compile_ms):
+        self.fn = fn
+        self.bucket = bucket
+        self.out_batch_major = out_batch_major
+        self.compile_ms = compile_ms
+
+
+class ProgramStore:
+    """Bucketed AOT-compiled inference programs for one model.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The inference graph.
+    arg_params, aux_params : dict
+        name -> array (NDArray / jax / numpy).  Non-input arguments
+        missing from ``arg_params`` whose shape is inferable are baked
+        as zeros (unused loss-head labels, same policy as ``deploy.py``).
+    input_shapes : dict
+        name -> full shape; axis 0 of every input is the batch axis the
+        store buckets on (the leading dim given here is only a shape
+        template — requests of any bucketable size are accepted).
+    name : str
+        Cache-key / diagnostics tag.
+    compute_dtype : str, optional
+        ``'bfloat16'`` casts floating weights once at load and inputs
+        inside the program; outputs return float32.  None = master
+        dtype (fp32 bit-equal serving).
+    buckets : iterable of int, optional
+        Bucket edges; overrides ``MXNET_SERVE_BUCKETS``.
+    max_programs : int, optional
+        LRU bound; overrides ``MXNET_SERVE_PROGRAM_CACHE``.
+    input_dtypes : dict, optional
+        name -> numpy dtype of the wire inputs (default float32).
+    device : jax.Device, optional
+        Pin weights (and hence the compiled programs, which follow
+        their committed arguments) to this device; default leaves
+        placement to jax's default device.
+    """
+
+    def __init__(self, symbol, arg_params, aux_params, input_shapes,
+                 name="model", compute_dtype=None, buckets=None,
+                 max_programs=None, input_dtypes=None, device=None):
+        self._symbol = symbol
+        self.name = name
+        self._edges = bucket_edges(buckets)
+        self._cdt = jnp.dtype(compute_dtype) if compute_dtype else None
+        self._input_names = list(input_shapes)
+        if not self._input_names:
+            raise MXNetError("serving needs at least one input")
+        self._input_tails = {n: tuple(input_shapes[n])[1:]
+                             for n in self._input_names}
+        self._input_dtypes = {
+            n: np.dtype((input_dtypes or {}).get(n, "float32"))
+            for n in self._input_names}
+        self._device = device
+        # bucketing correctness requires every output to carry a leading
+        # batch axis: pad rows are sliced off outputs, and the batcher
+        # hands each request its row range — an output computed over the
+        # WHOLE batch (a mean/sum head) would mix pad rows and, under
+        # continuous batching, other requests' rows into every result.
+        # Probe the symbol at two distinct batch sizes: batch-major
+        # outputs track the batch, anything else is rejected at load.
+        out_names = symbol.list_outputs()
+        probes = []
+        for b in (self._edges[-1], self._edges[-1] + 1):
+            probe = {n: (b,) + self._input_tails[n]
+                     for n in self._input_names}
+            _, out_shapes, _ = symbol.infer_shape_partial(**probe)
+            probes.append(out_shapes)
+        for i, oname in enumerate(out_names):
+            s1, s2 = probes[0][i], probes[1][i]
+            if s1 is None or s2 is None or not len(s1) or not len(s2) \
+                    or s1[0] != self._edges[-1] \
+                    or s2[0] != self._edges[-1] + 1:
+                raise MXNetError(
+                    "output %r of serving model %r is not batch-major "
+                    "(shape %s at batch size %d): bucket padding and "
+                    "continuous batching require row-independent "
+                    "batch-major outputs — serve this model with the "
+                    "classic Predictor instead"
+                    % (oname, name, s1, self._edges[-1]))
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        aux_params = aux_params or {}
+        self._param_names = [n for n in arg_names
+                             if n not in input_shapes and n in arg_params]
+        self._zero_args = [n for n in arg_names
+                           if n not in input_shapes
+                           and n not in arg_params]
+
+        def load(v):
+            a = _as_device_array(v)
+            if self._cdt is not None and a.dtype != self._cdt and \
+                    jnp.issubdtype(a.dtype, jnp.floating):
+                a = a.astype(self._cdt)
+            if device is not None:
+                # committed params pin the compiled programs' placement
+                # (uncommitted request inputs follow them)
+                a = jax.device_put(a, device)
+            return a
+
+        self._params = {n: load(arg_params[n]) for n in self._param_names}
+        aux = []
+        # aux states missing from the checkpoint keep predictor.py's
+        # policy: zero-filled at their inferred shape
+        shapes = {n: tuple(input_shapes[n]) for n in self._input_names}
+        _, _, aux_shapes = symbol.infer_shape_partial(**shapes)
+        for n, shape in zip(aux_names, aux_shapes):
+            if n in aux_params:
+                aux.append(load(aux_params[n]))
+            elif shape is not None:
+                z = jnp.zeros(tuple(shape), self._cdt or jnp.float32)
+                aux.append(z if device is None
+                           else jax.device_put(z, device))
+            else:
+                raise MXNetError("auxiliary state %r is not in the params "
+                                 "and its shape cannot be inferred" % n)
+        self._aux = tuple(aux)
+
+        if max_programs is None:
+            max_programs = int(get_env("MXNET_SERVE_PROGRAM_CACHE"))
+        self.max_programs = max(1, int(max_programs))
+        if self.max_programs < len(self._edges):
+            # warmup can't keep every bucket resident: the LRU evicts
+            # early buckets before traffic, and the first request for
+            # one pays a compile AT DISPATCH — the stall AOT exists to
+            # prevent.  Legal (eviction tests rely on it) but worth a
+            # loud heads-up in a serving process.
+            log.warning(
+                "serving model %r: program cache (%d) is smaller than "
+                "the bucket count (%d); warmed buckets will be evicted "
+                "and recompile inside served requests — raise "
+                "MXNET_SERVE_PROGRAM_CACHE or trim MXNET_SERVE_BUCKETS",
+                name, self.max_programs, len(self._edges))
+        self._programs = OrderedDict()   # key -> _Program
+        self._lock = make_lock("serving.program_store")
+        self._stats = {"hits": 0, "compiles": 0, "evictions": 0,
+                       "compile_ms_total": 0.0}
+
+    # -- geometry ------------------------------------------------------
+    @property
+    def edges(self):
+        return self._edges
+
+    def max_bucket(self):
+        return self._edges[-1]
+
+    @property
+    def input_names(self):
+        return list(self._input_names)
+
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    def canon_inputs(self, inputs):
+        """Validate + canonicalize one request's inputs (client-thread
+        work: np conversion, dtype cast, shape checks).  Returns
+        ``(dict name -> np.ndarray, n_rows)``."""
+        got, want = set(inputs), set(self._input_names)
+        if got != want:
+            raise MXNetError("serving inputs mismatch for %r: got %s, "
+                             "want %s" % (self.name, sorted(got),
+                                          sorted(want)))
+        out = {}
+        n = None
+        for name in self._input_names:
+            a = np.asarray(inputs[name], dtype=self._input_dtypes[name])
+            tail = self._input_tails[name]
+            if a.ndim != len(tail) + 1 or tuple(a.shape[1:]) != tail:
+                raise MXNetError(
+                    "input %r has shape %s; want (n,%s)"
+                    % (name, a.shape, ",".join(map(str, tail))))
+            if n is None:
+                n = int(a.shape[0])
+            elif int(a.shape[0]) != n:
+                raise MXNetError("inputs disagree on batch rows: %d vs %d"
+                                 % (n, a.shape[0]))
+            out[name] = a
+        if n < 1:
+            raise MXNetError("empty request (0 rows)")
+        if bucket_for(n, self._edges) is None:
+            raise MXNetError(
+                "request of %d rows exceeds the largest serving bucket "
+                "(%d); raise MXNET_SERVE_BUCKETS or split the request"
+                % (n, self._edges[-1]))
+        return out, n
+
+    # -- compilation ---------------------------------------------------
+    def _key(self, bucket):
+        sig = tuple((n, (bucket,) + self._input_tails[n],
+                     str(self._input_dtypes[n]))
+                    for n in self._input_names)
+        return ("serve", self.name, bucket, sig,
+                str(self._cdt) if self._cdt is not None else None)
+
+    def _build_forward(self, bucket):
+        """Pure ``fwd(params, aux, inputs)`` for one bucket: the
+        ``deploy.py`` DAG walk, with params/aux as *arguments* instead
+        of baked constants."""
+        symbol = self._symbol
+        nodes = symbol._nodes()
+        head = [(id(n), oi) for n, oi in symbol._outputs]
+        aux_names = symbol.list_auxiliary_states()
+        aux_set = set(aux_names)
+        aux_order = {n: i for i, n in enumerate(aux_names)}
+        shapes = {n: (bucket,) + self._input_tails[n]
+                  for n in self._input_names}
+        arg_shapes, _, _ = symbol.infer_shape_partial(**shapes)
+        zero_shapes = {}
+        for n, s in zip(symbol.list_arguments(), arg_shapes):
+            if n in self._zero_args:
+                if s is None:
+                    raise MXNetError(
+                        "argument %r is neither an input nor in the "
+                        "params and its shape cannot be inferred" % n)
+                zero_shapes[n] = tuple(s)
+        from ..executor import shape_overrides
+        known = dict(shapes)
+        known.update({n: tuple(a.shape) for n, a in self._params.items()})
+        overrides = shape_overrides(symbol, known)
+        cdt = self._cdt
+        input_set = set(self._input_names)
+
+        def fwd(params, aux, inputs):
+            vals = {}
+            for node in nodes:
+                if node.is_variable:
+                    nm = node.name
+                    if nm in aux_set:
+                        v = aux[aux_order[nm]]
+                    elif nm in input_set:
+                        v = inputs[nm]
+                        if cdt is not None and v.dtype != cdt and \
+                                jnp.issubdtype(v.dtype, jnp.floating):
+                            v = v.astype(cdt)
+                    elif nm in zero_shapes:
+                        v = jnp.zeros(zero_shapes[nm],
+                                      cdt or jnp.float32)
+                    else:
+                        v = params[nm]
+                    vals[(id(node), 0)] = v
+                    continue
+                ins = [vals[(id(s), oi)] for s, oi in node.arg_inputs()]
+                aux_in = tuple(vals[(id(s), oi)]
+                               for s, oi in node.aux_inputs())
+                outs, _ = node.op.apply(
+                    overrides.get(id(node), node.attrs), ins, aux_in,
+                    False, None)
+                for oi, o in enumerate(outs):
+                    vals[(id(node), oi)] = o
+            outs = tuple(vals[k] for k in head)
+            if cdt is not None:
+                outs = tuple(
+                    o.astype(jnp.float32)
+                    if jnp.issubdtype(o.dtype, jnp.floating)
+                    and o.dtype != jnp.float32 else o
+                    for o in outs)
+            return outs
+
+        return fwd
+
+    def _compile(self, bucket):
+        tic = time.perf_counter()
+        fwd = self._build_forward(bucket)
+        # AOT specs carry the placement: without it the executable
+        # compiles for the default device and rejects device-pinned
+        # params at call time
+        sh = (jax.sharding.SingleDeviceSharding(self._device)
+              if self._device is not None else None)
+        spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+            (self._params, self._aux))
+        in_spec = {n: jax.ShapeDtypeStruct(
+            (bucket,) + self._input_tails[n],
+            jnp.dtype(self._input_dtypes[n]), sharding=sh)
+            for n in self._input_names}
+        compiled = jax.jit(fwd).lower(spec[0], spec[1], in_spec).compile()
+        out_avals = jax.eval_shape(fwd, spec[0], spec[1], in_spec)
+        flags = tuple(len(o.shape) > 0 and o.shape[0] == bucket
+                      for o in out_avals)
+        ms = (time.perf_counter() - tic) * 1e3
+        return _Program(compiled, bucket, flags, ms)
+
+    def _acquire(self, bucket):
+        """LRU lookup/compile for one bucket (cached_op.acquire shape:
+        compile outside the lock, re-check for a race on insert)."""
+        key = self._key(bucket)
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._programs.move_to_end(key)
+                self._stats["hits"] += 1
+                return prog
+        prog = self._compile(bucket)
+        with self._lock:
+            raced = self._programs.get(key)
+            if raced is not None:
+                self._stats["hits"] += 1
+                return raced
+            self._stats["compiles"] += 1
+            self._stats["compile_ms_total"] += prog.compile_ms
+            while len(self._programs) >= self.max_programs:
+                self._programs.popitem(last=False)
+                self._stats["evictions"] += 1
+            self._programs[key] = prog
+            return prog
+
+    def warmup(self, execute=True):
+        """Compile — and by default EXECUTE once on zeros — every
+        configured bucket ahead of traffic (warmup-at-load).  The
+        execution matters: a freshly compiled XLA executable pays
+        tens of ms of one-time setup (buffer/thread-pool init) on its
+        first run, which must not land inside a served request.
+        Returns the per-bucket compile times (ms)."""
+        out = {}
+        for b in self._edges:
+            prog = self._acquire(b)
+            out[b] = prog.compile_ms
+            if execute:
+                feed = {n: np.zeros((b,) + self._input_tails[n],
+                                    self._input_dtypes[n])
+                        for n in self._input_names}
+                jax.block_until_ready(
+                    prog.fn(self._params, self._aux, feed))
+        return out
+
+    # -- execution -----------------------------------------------------
+    @hot_path
+    def run(self, inputs, n=None, slice_outputs=True):
+        """Run ``n`` rows of canonicalized inputs through the bucketed
+        program.  Returns ``(outputs, bucket, batch_major)``:
+        batch-major outputs come sliced back to ``n`` rows (device-side
+        lazy slice, no host sync); ``batch_major`` flags which outputs
+        carry a leading batch axis.  ``slice_outputs=False`` returns
+        the raw bucket-shaped outputs (pad rows included) — the
+        scheduler uses it because it re-slices per request anyway, and
+        the intermediate ``[:n]`` would compile one XLA slice program
+        per distinct row count.  Called from the serving engine's
+        dispatch loop — everything here is enqueue-only device work
+        plus cheap host padding."""
+        if n is None:
+            n = int(inputs[self._input_names[0]].shape[0])
+        bucket = bucket_for(n, self._edges)
+        if bucket is None:
+            raise MXNetError(
+                "request of %d rows exceeds the largest serving bucket "
+                "(%d)" % (n, self._edges[-1]))
+        prog = self._acquire(bucket)
+        feed = {}
+        for name in self._input_names:
+            v = inputs[name]
+            if v.shape[0] != bucket:
+                pad = np.zeros((bucket,) + tuple(v.shape[1:]), v.dtype)
+                pad[:n] = v
+                v = pad
+            feed[name] = v
+        outs = prog.fn(self._params, self._aux, feed)
+        if slice_outputs:
+            outs = [o[:n] if bm and n != bucket else o
+                    for o, bm in zip(outs, prog.out_batch_major)]
+        else:
+            outs = list(outs)
+        return outs, bucket, prog.out_batch_major
+
+    # -- introspection -------------------------------------------------
+    def stats(self):
+        """Compile-cache stats: hits/compiles/evictions/size plus the
+        currently-resident buckets."""
+        with self._lock:
+            out = dict(self._stats)
+            out["size"] = len(self._programs)
+            out["max_programs"] = self.max_programs
+            out["buckets_resident"] = sorted(
+                p.bucket for p in self._programs.values())
+        out["edges"] = list(self._edges)
+        out["compute_dtype"] = str(self._cdt) if self._cdt else None
+        return out
+
+    def reset_stats(self):
+        with self._lock:
+            for k in ("hits", "compiles", "evictions"):
+                self._stats[k] = 0
+            self._stats["compile_ms_total"] = 0.0

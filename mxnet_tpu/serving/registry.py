@@ -1,0 +1,120 @@
+"""Multi-model tenancy: N models served from one process.
+
+Each registered model gets its own :class:`~.program_store.ProgramStore`
+(its own bucket programs, weights and compile-cache stats); the
+continuous batcher (:class:`~.scheduler.ServingEngine`) schedules across
+all of them, never mixing models in one batch.  Models can be added from
+live arrays, a ``save_checkpoint`` prefix/epoch pair, or a
+``deploy.to_serving`` artifact, and removed at runtime (in-flight
+requests for a removed model fail cleanly at dispatch).
+
+Serving weight dtype: ``compute_dtype='bfloat16'`` (or the
+``MXNET_SERVE_DTYPE`` default) casts floating weights once at load —
+half the resident memory per tenant, the PR-4 ``compute_dtype`` policy
+applied to the serving plane.
+"""
+from __future__ import annotations
+
+from ..analysis.lockcheck import make_lock
+from ..base import MXNetError, get_env
+from .program_store import ProgramStore
+
+__all__ = ["ModelRegistry"]
+
+
+class ModelRegistry:
+    """name -> :class:`ProgramStore` with thread-safe add/remove."""
+
+    def __init__(self):
+        self._stores = {}
+        self._lock = make_lock("serving.registry")
+
+    def add_model(self, name, symbol, arg_params, aux_params=None,
+                  input_shapes=None, compute_dtype=None, buckets=None,
+                  max_programs=None, input_dtypes=None, device=None,
+                  warmup=True):
+        """Register a model; compiles every bucket ahead of traffic
+        unless ``warmup=False``.  Returns the model's ProgramStore."""
+        if input_shapes is None:
+            raise MXNetError("add_model needs input_shapes "
+                             "(name -> (batch, ...) template)")
+        if compute_dtype is None:
+            compute_dtype = get_env("MXNET_SERVE_DTYPE") or None
+        store = ProgramStore(symbol, arg_params, aux_params or {},
+                             input_shapes, name=name,
+                             compute_dtype=compute_dtype, buckets=buckets,
+                             max_programs=max_programs,
+                             input_dtypes=input_dtypes, device=device)
+        with self._lock:
+            if name in self._stores:
+                raise MXNetError("model %r is already registered" % name)
+            self._stores[name] = store
+        if warmup:
+            try:
+                store.warmup()
+            except BaseException:
+                # a model whose programs don't compile must not stay
+                # registered (serveable-but-broken, and blocking the
+                # name for a corrected retry)
+                with self._lock:
+                    self._stores.pop(name, None)
+                raise
+        return store
+
+    def load_checkpoint(self, name, prefix, epoch, input_shapes, **kwargs):
+        """Register from a ``prefix-symbol.json`` + ``prefix-NNNN.params``
+        pair (``model.save_checkpoint`` layout); params are loaded once
+        and stay device-resident."""
+        from ..model import load_checkpoint
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return self.add_model(name, sym, arg_params, aux_params,
+                              input_shapes, **kwargs)
+
+    def load_artifact(self, name, path, **overrides):
+        """Register from a ``deploy.to_serving`` artifact (symbol-json +
+        params + shape-bucket metadata in one zip); keyword overrides
+        win over the artifact's recorded settings."""
+        from ..deploy import read_serving_artifact
+        sym, arg_params, aux_params, meta = read_serving_artifact(path)
+        kwargs = {
+            "input_shapes": {k: tuple(v)
+                             for k, v in meta["input_shapes"].items()},
+            "input_dtypes": meta.get("input_dtypes"),
+            "buckets": meta.get("bucket_edges"),
+            "compute_dtype": meta.get("compute_dtype"),
+        }
+        kwargs.update(overrides)
+        return self.add_model(name, sym, arg_params, aux_params, **kwargs)
+
+    def store(self, name):
+        """The model's ProgramStore; raises MXNetError when unknown."""
+        with self._lock:
+            store = self._stores.get(name)
+            known = sorted(self._stores) if store is None else None
+        if store is None:
+            raise MXNetError("unknown serving model %r (registered: %s)"
+                             % (name, known))
+        return store
+
+    def remove_model(self, name):
+        with self._lock:
+            if self._stores.pop(name, None) is None:
+                raise MXNetError("unknown serving model %r" % name)
+
+    def models(self):
+        with self._lock:
+            return sorted(self._stores)
+
+    def stats(self):
+        """Per-model program-store stats (compile cache, buckets)."""
+        with self._lock:
+            stores = dict(self._stores)
+        return {name: s.stats() for name, s in stores.items()}
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._stores
+
+    def __len__(self):
+        with self._lock:
+            return len(self._stores)

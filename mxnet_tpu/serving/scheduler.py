@@ -1,0 +1,437 @@
+"""Continuous batching scheduler: the serving engine thread.
+
+One engine thread drains a request queue into serving dispatches:
+
+* the queue head opens a batch and starts its **latency budget** clock
+  (``MXNET_SERVE_MAX_DELAY_MS``, measured from the head's submit time —
+  a request is never delayed longer than the budget for the sake of a
+  fuller batch);
+* while the budget lasts, later requests for the *same model* join until
+  the batch reaches ``MXNET_SERVE_MAX_BATCH`` rows (or the model's
+  largest shape bucket, whichever is smaller); requests for other models
+  park in a pending deque, keeping per-model FIFO order;
+* the batch is concatenated, padded to its bucket by the program store,
+  and dispatched through the AOT-compiled program; per-request row
+  slices resolve each request's Future.  Everything on the engine thread
+  is enqueue-only device work (``@hot_path`` — graft-lint rejects host
+  syncs here); clients fetch results on their own threads.
+
+Requests carry optional deadlines (``timeout=``): one that expires while
+queued gets :class:`ServeTimeout` instead of compute.  ``Future.cancel()``
+on a queued request is honored at batch-forming time.  ``close()``
+drains: everything already submitted still runs, then the thread joins;
+later submits raise :class:`ServeClosed`.
+
+Profiler: each cycle emits ``serve_wait`` (blocked on the queue),
+``serve_batch`` (batch forming, the latency-budget wait) and
+``serve_compute`` (dispatch + future resolution) spans through the
+step-phase seam (``profiler.record_phase``), so a Chrome trace shows the
+batcher's duty cycle against the op spans inside it.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+import jax
+import numpy as np
+
+from .. import profiler as _profiler
+from ..analysis.lockcheck import make_lock
+from ..base import MXNetError, get_env, hot_path
+
+__all__ = ["ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed"]
+
+_STOP = object()
+
+# Per-request rows are cut out of the batch output with a jitted
+# dynamic slice whose OFFSET is a traced argument: a static ``o[a:b]``
+# would compile one XLA slice program per distinct offset (dozens on
+# the first full batch, each a multi-ms stall of the dispatch loop),
+# while here jax caches one executable per (rows, output aval).
+_SLICERS = {}
+
+
+def _row_slice(arr, ofs, n):
+    fn = _SLICERS.get(n)
+    if fn is None:
+        def f(x, i, _n=n):
+            return jax.lax.dynamic_slice_in_dim(x, i, _n, 0)
+        fn = _SLICERS.setdefault(n, jax.jit(f))
+    return fn(arr, ofs)
+
+
+class ServeTimeout(MXNetError):
+    """The request's deadline expired while it waited for dispatch."""
+
+
+class ServeClosed(MXNetError):
+    """The engine is shut down (or shutting down without drain)."""
+
+
+class ServeRequest:
+    """One queued inference request (internal; clients hold the Future)."""
+
+    __slots__ = ("model", "inputs", "n", "future", "deadline", "t_submit")
+
+    def __init__(self, model, inputs, n, future, deadline, t_submit):
+        self.model = model
+        self.inputs = inputs      # dict name -> np.ndarray (canonical)
+        self.n = n                # rows
+        self.future = future
+        self.deadline = deadline  # monotonic seconds, or None
+        self.t_submit = t_submit
+
+
+class ServingEngine:
+    """Continuous batcher over a :class:`~.registry.ModelRegistry`.
+
+    ``submit(model, timeout=None, **inputs)`` returns a
+    ``concurrent.futures.Future`` resolving to the list of output arrays
+    for exactly the submitted rows (device arrays — fetch on the caller's
+    thread).  One engine serves every model in the registry; batches
+    never mix models.
+    """
+
+    def __init__(self, registry, max_delay_ms=None, max_batch=None):
+        self._registry = registry
+        if max_delay_ms is None:
+            max_delay_ms = float(get_env("MXNET_SERVE_MAX_DELAY_MS"))
+        self._max_delay = max(0.0, float(max_delay_ms)) / 1e3
+        if max_batch is None:
+            max_batch = int(get_env("MXNET_SERVE_MAX_BATCH"))
+        self._max_batch = max(1, int(max_batch))
+        self._queue = queue.Queue()
+        self._pending = collections.deque()
+        self._closed = False
+        self._submit_lock = make_lock("serving.submit")
+        self._stats_lock = make_lock("serving.stats")
+        self._stats = {"requests": 0, "batches": 0, "rows": 0,
+                       "padded_rows": 0, "timeouts": 0, "cancelled": 0,
+                       "errors": 0, "max_rows_in_batch": 0}
+        # test seam (faultinject spirit): called with (model, live_reqs)
+        # right before each dispatch; tests install sleeps/recorders here
+        self._dispatch_hook = None
+        # future resolution happens on a dedicated completer thread:
+        # set_result runs client done-callbacks and wakes every thread
+        # blocked in Future.result(), and each wake costs the resolving
+        # thread a GIL handoff (up to the 5ms switch interval) — a
+        # 32-request batch resolved on the dispatch thread stalled it
+        # ~50ms, 40x the actual compute.  The dispatch loop only
+        # enqueues (fut, result) pairs here.
+        self._done_q = queue.Queue()
+        self._completer = threading.Thread(target=self._complete_loop,
+                                           name="mxt-serve-done",
+                                           daemon=True)
+        self._completer.start()
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="mxt-serve", daemon=True)
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------
+    def submit(self, model, timeout=None, **inputs):
+        """Enqueue one request; returns its Future.
+
+        ``timeout`` (seconds) bounds time-in-queue: an expired request
+        fails with :class:`ServeTimeout` instead of computing.  Input
+        validation/canonicalization (np conversion, dtype, shapes)
+        happens here on the caller's thread."""
+        store = self._registry.store(model)
+        canon, n = store.canon_inputs(inputs)
+        fut = Future()
+        now = time.monotonic()
+        req = ServeRequest(model, canon, n, fut,
+                           now + timeout if timeout is not None else None,
+                           now)
+        with self._submit_lock:
+            if self._closed:
+                raise ServeClosed("serving engine is closed")
+            self._queue.put(req)
+        with self._stats_lock:
+            self._stats["requests"] += 1
+        return fut
+
+    def stats(self):
+        """Scheduler counters plus each model's program-store stats."""
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["models"] = self._registry.stats()
+        return out
+
+    def close(self, drain=True, timeout=60.0):
+        """Stop the engine.  ``drain=True`` (default) completes every
+        request already submitted before the thread exits;
+        ``drain=False`` fails queued requests with :class:`ServeClosed`.
+        Idempotent; joins the engine thread."""
+        with self._submit_lock:
+            if not self._closed:
+                self._closed = True
+                self._drain_on_stop = bool(drain)
+                self._queue.put(_STOP)
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise MXNetError("serving engine thread failed to stop "
+                             "within %.0fs" % timeout)
+        # every resolution the drain enqueued precedes the sentinel
+        self._done_q.put(_STOP)
+        self._completer.join(timeout)
+        if self._completer.is_alive():
+            raise MXNetError("serving completer thread failed to stop "
+                             "within %.0fs" % timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- completer thread ----------------------------------------------
+    def _complete_loop(self):
+        while True:
+            item = self._done_q.get()
+            if item is _STOP:
+                return
+            fut, result, exc = item
+            try:
+                if exc is not None:
+                    fut.set_exception(exc)
+                else:
+                    fut.set_result(result)
+            except InvalidStateError:
+                # a client cancel() can land at any point before the
+                # set (exception resolutions target still-PENDING
+                # futures): the cancel wins, the resolution is dropped
+                pass
+
+    def _resolve(self, fut, result=None, exc=None):
+        self._done_q.put((fut, result, exc))
+
+    # -- engine thread -------------------------------------------------
+    def _serve_loop(self):
+        while self._dispatch_once():
+            pass
+
+    @hot_path
+    def _dispatch_once(self):
+        """One scheduler cycle: wait for a head request, form the batch
+        within the head's latency budget, dispatch it.  Returns False
+        when the engine should exit (after draining)."""
+        t0 = time.perf_counter_ns()
+        head = self._take()
+        _profiler.record_phase("serve_wait", t0)
+        if head is _STOP:
+            self._shutdown()
+            return False
+        if self._closed and not getattr(self, "_drain_on_stop", True):
+            # close(drain=False): queued work ahead of the STOP
+            # sentinel fails fast instead of being served out
+            self._resolve(head.future, exc=ServeClosed(
+                "serving engine closed before dispatch"))
+            return True
+        t1 = time.perf_counter_ns()
+        reqs, rows, stop = self._collect(head)
+        _profiler.record_phase("serve_batch", t1)
+        self._dispatch_batch(head.model, reqs, rows)
+        if stop:
+            self._shutdown()
+            return False
+        return True
+
+    def _take(self):
+        """Next request: pending deque first (oldest parked), else block
+        on the queue (close() unblocks via the _STOP sentinel)."""
+        if self._pending:
+            return self._pending.popleft()
+        return self._queue.get()
+
+    def _collect(self, head):
+        """Grow ``head``'s batch to the largest bucket that fits within
+        its latency budget.  Returns ``(reqs, rows, stop_seen)``."""
+        try:
+            cap = min(self._max_batch,
+                      self._registry.store(head.model).max_bucket())
+        except MXNetError as e:  # model removed after submit
+            self._resolve(head.future, exc=e)
+            return [], 0, False
+        reqs = [head]
+        rows = head.n
+        # same-model requests already parked keep their arrival order;
+        # once one doesn't fit, NOTHING younger of that model may join
+        # past it (everything later in pending — and everything still in
+        # the queue — is younger), or batches would reorder the
+        # per-model FIFO
+        keep = collections.deque()
+        blocked = False
+        while self._pending:
+            r = self._pending.popleft()
+            if r.model == head.model and not blocked \
+                    and rows + r.n <= cap and rows < cap:
+                reqs.append(r)
+                rows += r.n
+            else:
+                keep.append(r)
+                if r.model == head.model:
+                    blocked = True
+        self._pending = keep
+        if blocked:
+            # the batch cannot legally grow (any same-model arrival is
+            # younger than the parked one) — waiting out the latency
+            # budget could only add overtakers, so flush now
+            return reqs, rows, False
+        deadline = head.t_submit + self._max_delay
+        stop = False
+        while rows < cap:
+            # the budget bounds WAITING, never taking: a backlogged
+            # queue still fills the bucket via non-blocking gets even
+            # when the head is already past its delay budget (otherwise
+            # a backlog degenerates into one-request batches — the
+            # exact regime continuous batching exists for)
+            remaining = deadline - time.monotonic()
+            try:
+                item = self._queue.get(timeout=remaining) \
+                    if remaining > 0 else self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                stop = True
+                break
+            if item.model == head.model and rows + item.n <= cap:
+                reqs.append(item)
+                rows += item.n
+            else:
+                self._pending.append(item)
+                if item.model == head.model:
+                    break  # same model but over cap: flush now
+        return reqs, rows, stop
+
+    @hot_path
+    def _dispatch_batch(self, model, reqs, rows):
+        """Concatenate live requests, run the bucketed program, resolve
+        per-request futures with row slices (lazy device slices — no
+        host sync on this thread)."""
+        if not reqs:
+            return
+        t2 = time.perf_counter_ns()
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                self._resolve(r.future, exc=ServeTimeout(
+                    "request for %r timed out after %.1f ms in queue"
+                    % (r.model, (now - r.t_submit) * 1e3)))
+                with self._stats_lock:
+                    self._stats["timeouts"] += 1
+            elif r.future.set_running_or_notify_cancel():
+                live.append(r)
+            else:
+                with self._stats_lock:
+                    self._stats["cancelled"] += 1
+        if not live:
+            return
+        if self._dispatch_hook is not None:
+            self._dispatch_hook(model, live)
+        rows = sum(r.n for r in live)
+        if len(live) == 1:
+            inputs = live[0].inputs
+        else:
+            names = live[0].inputs.keys()
+            inputs = {k: np.concatenate([r.inputs[k] for r in live])
+                      for k in names}
+        try:
+            store = self._registry.store(model)
+            outs, bucket, batch_major = store.run(inputs, n=rows,
+                                                  slice_outputs=False)
+        except BaseException as e:  # noqa: BLE001 — forwarded to futures
+            exc = e if isinstance(e, MXNetError) \
+                else MXNetError("serving dispatch failed: %r" % (e,))
+            for r in live:
+                self._resolve(r.future, exc=exc)
+            with self._stats_lock:
+                self._stats["errors"] += len(live)
+            return
+        # outs are bucket-shaped (pad rows still on); every request gets
+        # its rows via the shared traced-offset slicer, so no per-batch
+        # or per-offset slice program ever compiles on this thread
+        ofs = 0
+        for r in live:
+            res = []
+            for o, bm in zip(outs, batch_major):
+                if bm and r.n != bucket:
+                    o = _row_slice(o, ofs, r.n)
+                res.append(o)
+            self._resolve(r.future, res)
+            ofs += r.n
+        _profiler.record_phase("serve_compute", t2)
+        with self._stats_lock:
+            self._stats["batches"] += 1
+            self._stats["rows"] += rows
+            self._stats["padded_rows"] += bucket - rows
+            if rows > self._stats["max_rows_in_batch"]:
+                self._stats["max_rows_in_batch"] = rows
+
+    def _shutdown(self):
+        """Drain everything already submitted (or fail it when
+        ``close(drain=False)``), then let the loop exit."""
+        drain = getattr(self, "_drain_on_stop", True)
+        while True:
+            if self._pending:
+                head = self._pending.popleft()
+            else:
+                try:
+                    head = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+            if head is _STOP:
+                continue
+            if not drain:
+                self._resolve(head.future, exc=ServeClosed(
+                    "serving engine closed before dispatch"))
+                continue
+            reqs, rows, _ = self._collect_ready(head)
+            self._dispatch_batch(head.model, reqs, rows)
+
+    def _collect_ready(self, head):
+        """Shutdown-time batch forming: same-model coalescing, but only
+        over requests already queued — no latency-budget waiting."""
+        try:
+            cap = min(self._max_batch,
+                      self._registry.store(head.model).max_bucket())
+        except MXNetError as e:
+            self._resolve(head.future, exc=e)
+            return [], 0, False
+        reqs = [head]
+        rows = head.n
+        keep = collections.deque()
+        # same FIFO discipline as _collect: a same-model request that
+        # didn't fit blocks every younger one from joining this batch
+        blocked = False
+        while self._pending:
+            r = self._pending.popleft()
+            if r.model == head.model and not blocked \
+                    and rows + r.n <= cap:
+                reqs.append(r)
+                rows += r.n
+            else:
+                keep.append(r)
+                if r.model == head.model:
+                    blocked = True
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                continue
+            if item.model == head.model and not blocked \
+                    and rows + item.n <= cap:
+                reqs.append(item)
+                rows += item.n
+            else:
+                keep.append(item)
+                if item.model == head.model:
+                    blocked = True
+        self._pending = keep
+        return reqs, rows, False

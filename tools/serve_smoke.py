@@ -1,0 +1,112 @@
+"""Serving-plane smoke gate: seeded loadgen p50/p99 + QPS floor.
+
+Runs the shared serving latency protocol
+(``mxnet_tpu.serving.loadgen.latency_protocol``) in smoke mode on CPU:
+
+1. per-request ``Predictor.forward`` closed-loop (service baseline),
+2. the same Predictor behind a FIFO worker under the seeded open-loop
+   schedule (the no-batching deployment under overload),
+3. the continuous batcher under the SAME schedule.
+
+Gates (exit 1 on failure):
+
+* the batcher's achieved QPS >= ``--qps-floor`` (default 3.0) times the
+  per-request deployment's achieved QPS — the ratio is host-relative, so
+  the gate holds on any machine;
+* the batcher's p99 is no worse than the per-request deployment's p99
+  under the same offered load ("equal p99" comparison);
+* zero timeouts/errors/lost requests on either side.
+
+Deterministic: the arrival schedule and request contents derive from
+``--seed`` (faultinject-style); residual wall-clock noise moves the
+measured numbers, not the schedule.
+
+Usage::
+
+    python tools/serve_smoke.py [--seed 11] [--qps-floor 3.0] [--full]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--qps-floor", type=float, default=3.0,
+                    help="min batcher/per-request achieved-QPS ratio")
+    ap.add_argument("--full", action="store_true",
+                    help="full-size protocol (bench row scale)")
+    ap.add_argument("--mode", default="fp32", choices=("fp32", "bf16"))
+    ap.add_argument("--json", action="store_true",
+                    help="dump the full protocol result as JSON")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.serving.loadgen import latency_protocol
+    r = latency_protocol(mode=args.mode, smoke=not args.full,
+                         seed=args.seed)
+    if args.json:
+        print(json.dumps(r, indent=1))
+
+    sc, so, b = r["serial_closed"], r["serial_open"], r["batch"]
+
+    def f(v, spec="%.2f"):
+        # a side with zero successful requests reports None percentiles
+        # — the gate below turns that into a FAIL, not a TypeError
+        return ("n/a" if v is None else spec % v).rjust(10)
+
+    print("serve-smoke (%s, seed %d, offered %.0fx capacity)"
+          % (args.mode, args.seed, r["offered_mult"]))
+    print("  %-28s %10s %10s %10s" % ("", "qps", "p50 ms", "p99 ms"))
+    print("  %-28s %s %s %s"
+          % ("per-request closed-loop", f(sc["qps"], "%.1f"),
+             f(sc["p50_ms"]), f(sc["p99_ms"])))
+    print("  %-28s %s %s %s"
+          % ("per-request under load", f(so["qps_achieved"], "%.1f"),
+             f(so["p50_ms"]), f(so["p99_ms"])))
+    print("  %-28s %s %s %s"
+          % ("continuous batcher", f(b["qps_achieved"], "%.1f"),
+             f(b["p50_ms"]), f(b["p99_ms"])))
+    print("  batcher QPS vs per-request: %s (floor %.1fx); "
+          "p99 ratio: %s" % (f(r["qps_vs_per_request"]).strip(),
+                             args.qps_floor,
+                             f(r["p99_vs_per_request"], "%.3f").strip()))
+
+    failures = []
+    for tag, side in (("per-request", so), ("batcher", b)):
+        bad = side["timeouts"] + side["errors"] + side["cancelled"]
+        if bad:
+            failures.append("%s side dropped %d of %d requests"
+                            % (tag, bad, side["n"]))
+    if r["qps_vs_per_request"] is None:
+        failures.append("QPS ratio unavailable (a side had zero "
+                        "successful requests)")
+    elif r["qps_vs_per_request"] < args.qps_floor:
+        failures.append("QPS ratio %.2f below the %.1fx floor"
+                        % (r["qps_vs_per_request"], args.qps_floor))
+    if b["p99_ms"] is not None and so["p99_ms"] is not None \
+            and b["p99_ms"] > so["p99_ms"]:
+        failures.append("batcher p99 %.1fms worse than per-request "
+                        "%.1fms at the same offered load"
+                        % (b["p99_ms"], so["p99_ms"]))
+    if failures:
+        for msg in failures:
+            print("FAIL: %s" % msg)
+        return 1
+    print("serve-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
