@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke serve-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke serve-smoke spmd-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -59,6 +59,16 @@ dist-smoke:
 serve-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 		$(PY) tools/serve_smoke.py --seed 11 --qps-floor 3.0
+
+# one-SPMD-step-program gate under 8 fake host devices: numerical
+# equivalence (dp8 vs single device, dp2xmp2 vs dp4, closed-form SGD),
+# the shared-program-cache pin across frontends, the MXNET_SPMD=0
+# escape hatch, and the banked + live bench ratios (sharded step
+# >= 1.5x the classic executor-group path on the smoke MLP)
+spmd-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m pytest tests/test_spmd_step.py -q
 
 # smoke fit under the profiler -> per-step phase breakdown
 # (data_wait/h2d_stage/compute/metric_fetch) from the dumped trace, so

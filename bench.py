@@ -801,6 +801,148 @@ def bench_input_staging(chip, smoke=False):
             "batch_size": batch}
 
 
+def _spmd_exec_group_rate(n_ctx, spmd, steps, warmup, batch_per_dev=16,
+                          feat=64):
+    """Steps/sec of multi-device ``Module`` training driven through the
+    executor-group frontend on the smoke MLP: ``spmd=True`` routes the
+    ONE sharded step program (parallel/spmd.py — XLA all-reduce inside
+    the step, in-graph optimizer update, device-resident params),
+    ``spmd=False`` pins the classic path (per-device executor
+    replication + host gradient aggregation + host ``Updater`` round
+    trip) via the MXNET_SPMD=0 escape hatch.  Same module protocol,
+    same contexts, same batch — only the dispatch plane differs."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.test_utils import fetch_sync, smoke_mlp
+
+    managed = {"MXNET_MODULE_FUSED": "0",
+               "MXNET_SPMD": "1" if spmd else "0"}
+    saved = {k: os.environ.pop(k, None) for k in managed}
+    os.environ.update(managed)
+    try:
+        batch = batch_per_dev * n_ctx
+        sym = smoke_mlp(num_hidden=feat)
+        rs = np.random.RandomState(0)
+        X = rs.uniform(-1, 1, (batch, feat)).astype("float32")
+        y = rs.randint(0, 10, (batch,)).astype("float32")
+        it = mx.io.NDArrayIter(X, y, batch_size=batch)
+        mx.random.seed(0)
+        mod = mx.Module(sym, context=[mx.cpu(i) for i in range(n_ctx)])
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(kvstore="device", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        assert mod._exec_group.spmd_active == spmd
+        b0 = next(iter(it))
+
+        def sync():
+            # force the whole in-flight chain: the last step's outputs
+            # depend on its forward, whose params depend on every prior
+            # update (per-exec form works on both dispatch planes)
+            fetch_sync(mod.get_outputs(merge_multi_context=False)[0][0])
+
+        for _ in range(warmup):
+            mod.forward_backward(b0)
+            mod.update()
+        sync()
+        tic = time.perf_counter()
+        for _ in range(steps):
+            mod.forward_backward(b0)
+            mod.update()
+        sync()
+        return steps / (time.perf_counter() - tic)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _spmd_trainer_rate(mesh_axes, rules, steps, warmup, batch=64, feat=64):
+    """Steps/sec of the fused-trainer frontend over an arbitrary mesh
+    (the dp×mp row the Module frontend cannot express)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import (DataParallelTrainer, MeshTrainer,
+                                    make_mesh)
+    from mxnet_tpu.test_utils import fetch_sync, smoke_mlp
+
+    n = 1
+    for v in mesh_axes.values():
+        n *= v
+    mesh = make_mesh(dict(mesh_axes), jax.devices()[:n])
+    sym = smoke_mlp(num_hidden=feat)
+    kw = dict(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    if rules is not None:
+        tr = MeshTrainer(sym, {"data": (batch, feat)},
+                         {"softmax_label": (batch,)}, mesh=mesh,
+                         rules=rules, **kw)
+    else:
+        tr = DataParallelTrainer(sym, {"data": (batch, feat)},
+                                 {"softmax_label": (batch,)}, mesh=mesh,
+                                 **kw)
+    rs = np.random.RandomState(0)
+    X = rs.uniform(-1, 1, (batch, feat)).astype("float32")
+    y = rs.randint(0, 10, (batch,)).astype("float32")
+    for _ in range(warmup):
+        out = tr.step(X, y)
+    fetch_sync(out[0])
+    tic = time.perf_counter()
+    for _ in range(steps):
+        out = tr.step(X, y)
+    fetch_sync(out[0])
+    return steps / (time.perf_counter() - tic)
+
+
+def bench_spmd_step(config, chip, smoke=False):
+    """One-SPMD-step-program rows: the sharded fused step over a global
+    mesh vs the classic ``DataParallelExecutorGroup`` replication path,
+    same smoke-MLP ``Module`` protocol (``config`` = dp2/dp4/dp8), plus
+    the dp2xmp2 mesh through the fused-trainer frontend (model-parallel
+    rules the Module frontend cannot express).  CPU-deterministic under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` — the win is
+    deleting the per-device Python dispatch loop + host updater round
+    trip, which needs no accelerator to reproduce."""
+    import jax
+    from mxnet_tpu.parallel import ShardingRules
+    from jax.sharding import PartitionSpec as P
+
+    steps, warmup = (10, 2) if smoke else (40, 5)
+    need = {"dp2": 2, "dp4": 4, "dp8": 8, "dp2xmp2": 4}[config]
+    if jax.device_count() < need:
+        return {"metric": "spmd.step.%s" % config, "value": 0.0,
+                "unit": "skipped", "vs_baseline": None,
+                "reason": "%d devices visible, %d needed (run under "
+                          "XLA_FLAGS=--xla_force_host_platform_device_"
+                          "count=8 on CPU)" % (jax.device_count(), need)}
+    if config == "dp2xmp2":
+        rules = ShardingRules([
+            (r"fc1_weight", P("tp", None)), (r"fc1_bias", P("tp")),
+            (r"fc2_weight", P(None, "tp")),
+        ])
+        sharded = _spmd_trainer_rate({"dp": 2, "tp": 2}, rules, steps,
+                                     warmup)
+        classic = _spmd_exec_group_rate(4, False, steps, warmup)
+        note = ("dp2×mp2 mesh through the fused-trainer frontend "
+                "(megatron-style tp rules); classic reference is the "
+                "4-device replication path at the same global batch")
+    else:
+        sharded = _spmd_exec_group_rate(need, True, steps, warmup)
+        classic = _spmd_exec_group_rate(need, False, steps, warmup)
+        note = ("identical Module/executor-group protocol; only the "
+                "dispatch plane differs (one sharded program vs "
+                "per-device replication + host updater)")
+    return {"metric": "spmd.step.%s" % config,
+            "value": round(sharded, 2), "unit": "steps/sec",
+            "vs_baseline": None,
+            "classic_steps_per_sec": round(classic, 2),
+            "speedup_vs_classic": round(sharded / classic, 3)
+            if classic else None,
+            "n_devices": need, "batch_per_device": 16,
+            "steps": steps, "note": note}
+
+
 def bench_host_transfer(chip, smoke=False):
     """Host<->device transfer: upload/download bandwidth and small-fetch
     round-trip latency.  On a remote-PJRT (tunneled) device these
@@ -1143,6 +1285,11 @@ def main():
     guard("kvstore.push_pull.2bit", bench_kvstore_push_pull, "2bit", chip,
           smoke)
     guard("io.input_staging", bench_input_staging, chip, smoke)
+    # CPU-deterministic one-SPMD-step-program rows (need >=8 visible
+    # devices: XLA_FLAGS=--xla_force_host_platform_device_count=8 on
+    # CPU, or a real multi-chip slice; skipped rows otherwise)
+    for cfg in ("dp2", "dp4", "dp8", "dp2xmp2"):
+        guard("spmd.step.%s" % cfg, bench_spmd_step, cfg, chip, smoke)
     # CPU-deterministic serving-plane rows (seeded open-loop protocol)
     guard("serving.latency.fp32", bench_serving_latency, "fp32", chip,
           smoke)

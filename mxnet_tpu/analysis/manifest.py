@@ -19,6 +19,9 @@ retire a guarded entry point.
 HOT_PATHS = (
     ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline.submit"),
     ("mxnet_tpu/module/base_module.py", "BaseModule._fit_epochs"),
+    ("mxnet_tpu/module/executor_group.py",
+     "DataParallelExecutorGroup.spmd_step"),
+    ("mxnet_tpu/parallel/dp.py", "DataParallelTrainer.step"),
 )
 
 # Calls forbidden inside a hot-path function.  Terminal attribute /
@@ -46,6 +49,7 @@ SPAN_ENTRY_POINTS = (
     ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline._worker"),
     ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline.flush"),
     ("mxnet_tpu/module/base_module.py", "BaseModule._fit_epochs"),
+    ("mxnet_tpu/parallel/dp.py", "DataParallelTrainer.step"),
     ("mxnet_tpu/serving/scheduler.py", "ServingEngine._dispatch_once"),
 )
 

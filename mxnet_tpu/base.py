@@ -205,6 +205,21 @@ register_env("MXNET_MIRROR_SEGMENT", int, 0,
              "Ops per jax.checkpoint segment when "
              "MXNET_BACKWARD_DO_MIRROR=1 (the rematerialization chunk "
              "size).  0 = the sqrt(op_count) heuristic.")
+register_env("MXNET_SPMD", bool, True,
+             "Route multi-device training through the ONE shared SPMD "
+             "step program (parallel/spmd.py): forward+backward+in-graph "
+             "optimizer update compiled once over a jax.sharding.Mesh, "
+             "batch sharded on the dp axis, gradient reduction as an XLA "
+             "all-reduce inside the step.  '0' restores the classic "
+             "per-device executor replication path (host gradient "
+             "aggregation + host updater) bit-for-bit and makes trainers "
+             "compile privately instead of sharing the program cache.")
+register_env("MXNET_SPMD_PROGRAM_CACHE", int, 64,
+             "Max compiled SPMD step programs held by the shared "
+             "program LRU (one per (symbol, mesh, shapes, dtype, "
+             "optimizer statics, sharding rules) key); least-recently-"
+             "used programs are dropped beyond it and recompile on "
+             "next use.")
 register_env("MXNET_MODULE_FUSED", bool, True,
              "Fused Module.fit fast path (forward+backward+psum+update "
              "as one XLA program).  '0' falls back to full "

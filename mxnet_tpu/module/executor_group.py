@@ -6,10 +6,17 @@ Reference: ``python/mxnet/module/executor_group.py:77-648`` —
 for KVStore reduction.
 
 TPU note: with a single TPU context this degenerates to one fused-XLA
-executor; the multi-device *sharded* fast path (in-graph psum over a mesh)
-lives in ``mxnet_tpu.parallel`` and is selected by Module when possible.
-This class keeps full reference semantics (works over cpu/tpu context lists,
-as the reference test suite does with cpu stand-ins).
+executor.  For multi-device training the group is a thin frontend over
+the ONE shared SPMD step program (``parallel/spmd.py``): when Module
+enables it (``enable_spmd``), forward_backward+update run as a single
+jitted fwd+bwd+in-graph-update program over the contexts' mesh —
+gradient reduction is an XLA all-reduce inside the step and parameters
+stay device-resident — instead of the per-device replication loop +
+host updater below.  ``MXNET_SPMD=0`` (or any setup the single program
+cannot express: monitor, explicit backward, grad_req!='write', states,
+input grads, dist kvstore) keeps full reference replication semantics
+(works over cpu/tpu context lists, as the reference test suite does
+with cpu stand-ins).
 """
 from __future__ import annotations
 
@@ -18,7 +25,7 @@ import logging
 import numpy as np
 
 from .. import ndarray as nd
-from ..base import MXNetError
+from ..base import MXNetError, hot_path
 from ..io.io import DataDesc
 
 
@@ -85,6 +92,42 @@ def _load_general(data, targets):
                 target[:] = np_src[slice_idx]
 
 
+def _pack_global_batch(data_batch, data_descs, label_descs, label_names,
+                       arg_shapes=None, fill_missing_labels=False):
+    """{name: array} dict of one GLOBAL (unsliced) batch for the fused /
+    SPMD step programs.
+
+    batch.data follows the ITERATOR's provide_data order, which is what
+    the module was bound with — not necessarily the constructor's
+    data_names order (NDArrayIter sorts dict inputs).  Zipping
+    constructor order against iterator order silently swaps same-shaped
+    inputs (e.g. user/item in matrix factorization)."""
+    def _names(descs):
+        # descriptors may be DataDesc or classic (name, shape) tuples
+        return [d.name if hasattr(d, "name") else d[0] for d in descs]
+
+    provide = getattr(data_batch, "provide_data", None)
+    dnames = _names(provide if provide else data_descs)
+    batch = {}
+    for name, arr in zip(dnames, data_batch.data):
+        batch[name] = arr
+    labels = getattr(data_batch, "label", None) or []
+    provide_l = getattr(data_batch, "provide_label", None)
+    lnames = (_names(provide_l) if provide_l
+              else _names(label_descs or []) or list(label_names))
+    for name, arr in zip(lnames, labels):
+        batch[name] = arr
+    if fill_missing_labels:
+        # forward-only consumers (score/predict through a training
+        # symbol) may omit labels the traced program still takes as
+        # arguments; zeros keep the avals stable without affecting
+        # outputs at is_train=False
+        for name in label_names:
+            if name not in batch and arg_shapes and name in arg_shapes:
+                batch[name] = nd.zeros(arg_shapes[name])
+    return batch
+
+
 class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
@@ -93,6 +136,18 @@ class DataParallelExecutorGroup:
         """``compute_dtype='bfloat16'`` threads the mixed-precision
         policy into each bound Executor (fp32 master weights, compute-
         dtype MXU math); labels are pinned to their master dtype."""
+        # SPMD frontend state (``enable_spmd``): the embedded trainer
+        # holding device-resident params/opt-state over the contexts'
+        # mesh, the packed global batch a forward_backward stashed for
+        # the next ``spmd_step``, and that step's outputs.  While the
+        # trainer is live the per-exec arrays below are STALE mirrors;
+        # ``disable_spmd`` reconverges them.
+        self._spmd = None
+        self._spmd_batch = None
+        self._spmd_outputs = None
+        # Module hook: rebuild the host kvstore/updater (with optimizer
+        # state carried over) when the group has to leave SPMD mode
+        self.on_spmd_disable = None
         self.symbol = symbol
         self.contexts = contexts
         self.compute_dtype = compute_dtype
@@ -224,10 +279,144 @@ class DataParallelExecutorGroup:
         if data_shapes == self.data_shapes and \
                 label_shapes == self.label_shapes:
             return
+        if self._spmd is not None:
+            # recompile at the new shapes over the SAME device-resident
+            # state (share_state_with: the program cache makes this one
+            # lookup when the shape was seen before); shapes the single
+            # program cannot express fall back to replication
+            batch0 = data_shapes[0].shape[
+                DataDesc.get_batch_axis(getattr(data_shapes[0], "layout",
+                                                "NCHW"))]
+            new = None
+            if batch0 % len(self.contexts) == 0:
+                try:
+                    new = self._build_spmd_trainer(
+                        data_shapes, label_shapes, self._spmd.optimizer,
+                        share_state_with=self._spmd)
+                except Exception as e:
+                    self.logger.info("SPMD reshape recompile failed "
+                                     "(%s)", e)
+            if new is not None:
+                self._spmd.clear_placement_cache()
+                self._spmd = new
+                self._spmd_batch = None
+                self._spmd_outputs = None
+            else:
+                self.disable_spmd("reshape to an inexpressible shape")
         self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    # -- SPMD frontend -------------------------------------------------
+    # One shared step program (parallel/spmd.py) instead of the
+    # per-device replication loop: train dispatch becomes ONE jitted
+    # fwd+bwd+in-graph-update over the contexts' mesh, gradients reduce
+    # as an XLA all-reduce inside the step, and parameters/optimizer
+    # state stay device-resident across the run.  Module enables this
+    # for qualifying multi-device setups; anything the one program
+    # cannot express hands back to full replication semantics via
+    # ``disable_spmd``.
+    @property
+    def spmd_active(self):
+        """Is train dispatch currently routed through the shared SPMD
+        step program?"""
+        return self._spmd is not None
+
+    @property
+    def spmd_trainer(self):
+        """The embedded state-holding trainer while SPMD is active
+        (optimizer-state interop: Updater.states layout via its
+        ``get/set_updater_states``), else None."""
+        return self._spmd
+
+    def _build_spmd_trainer(self, data_shapes, label_shapes, optimizer,
+                            share_state_with=None):
+        """Embedded ``DataParallelTrainer`` over this group's contexts —
+        the state holder whose compiled step comes from the shared
+        program cache (so the fused-Module frontend and this group
+        frontend run the SAME executable for the same setup)."""
+        from ..parallel.dp import DataParallelTrainer
+        from ..parallel.mesh import mesh_for_contexts
+        mesh = (share_state_with.mesh if share_state_with is not None
+                else mesh_for_contexts(self.contexts))
+        data_map = {d.name: tuple(d.shape) for d in data_shapes}
+        label_map = {d.name: tuple(d.shape)
+                     for d in (label_shapes or [])}
+        return DataParallelTrainer(
+            self.symbol, data_map, label_map or None, mesh=mesh,
+            optimizer=optimizer, compute_dtype=self.compute_dtype,
+            fixed_params=tuple(self.fixed_param_names),
+            share_state_with=share_state_with)
+
+    def enable_spmd(self, optimizer, arg_params, aux_params):
+        """Route this group's training through the one SPMD step
+        program, seeding the device-resident state from the given host
+        params.  Returns True on success; False leaves the classic
+        replication machinery untouched (caller keeps the host-updater
+        path)."""
+        try:
+            trainer = self._build_spmd_trainer(
+                self.data_shapes, self.label_shapes, optimizer)
+        except Exception as e:
+            self.logger.info("SPMD step program unavailable (%s); "
+                             "keeping per-device replication", e)
+            return False
+        if self._spmd is not None:
+            # force re-init: retire the previous trainer's pinned
+            # input-placement buffers before swapping it out
+            self._spmd.clear_placement_cache()
+        trainer.set_params(arg_params, aux_params)
+        self._spmd = trainer
+        self._spmd_batch = None
+        self._spmd_outputs = None
+        return True
+
+    def disable_spmd(self, reason):
+        """Leave the SPMD step program: reload the per-exec param/aux
+        arrays from the trainer's device state and notify Module (the
+        ``on_spmd_disable`` hook rebuilds the host kvstore/updater with
+        optimizer state carried over), so training continues under full
+        replication semantics."""
+        trainer = self._spmd
+        if trainer is None:
+            return
+        self._spmd = None
+        self._spmd_batch = None
+        self._spmd_outputs = None
+        trainer.clear_placement_cache()
+        self.logger.info("leaving SPMD step program (%s)", reason)
+        args, aux = trainer.get_params()
+        self.set_params(args, aux)
+        if self.on_spmd_disable is not None:
+            self.on_spmd_disable(trainer, reason)
+
+    @hot_path
+    def spmd_step(self):
+        """Run the one compiled train step (fwd+bwd+all-reduce+update)
+        on the batch the last ``forward_backward`` stashed; Module's
+        ``update`` dispatches here instead of the host updater."""
+        batch = self._spmd_batch
+        assert batch is not None, "call forward_backward before update"
+        outs = self._spmd.step(batch)
+        self._spmd_outputs = [nd.NDArray(o) for o in outs]
+        self._spmd_batch = None
+        return self._spmd_outputs
+
+    def _spmd_get_outputs(self):
+        if self._spmd_outputs is None:
+            assert self._spmd_batch is not None, "no forward has been run"
+            # update() not called yet: forward-only outputs for the
+            # stashed batch (params unchanged, so the later step still
+            # computes the same gradients)
+            outs = self._spmd.predict(self._spmd_batch)
+            self._spmd_outputs = [nd.NDArray(o) for o in outs]
+        return self._spmd_outputs
 
     # ------------------------------------------------------------------
     def set_params(self, arg_params, aux_params):
+        if self._spmd is not None:
+            # the trainer owns the live state; execs reconverge on
+            # disable_spmd
+            self._spmd.set_params(arg_params, aux_params)
+            return
         for ex in self.execs:
             ex.copy_params_from(arg_params, aux_params,
                                 allow_extra_params=True)
@@ -235,6 +424,13 @@ class DataParallelExecutorGroup:
     def get_params(self, arg_params, aux_params):
         """Average params over devices into the given dicts (reference
         sync_params_from_devices path)."""
+        if self._spmd is not None:
+            args, aux = self._spmd.get_params()
+            for name, v in args.items():
+                arg_params[name] = v
+            for name, v in aux.items():
+                aux_params[name] = v
+            return
         for name, block in zip(self.param_names, self.param_arrays):
             weight = sum(w.asnumpy() for w in block) / len(block)
             arg_params[name] = nd.array(weight)
@@ -252,6 +448,21 @@ class DataParallelExecutorGroup:
     def forward(self, data_batch, is_train=None):
         if is_train is None:
             is_train = self.for_training
+        if self._spmd is not None:
+            if is_train:
+                # explicit per-op training access is outside the one-
+                # program contract; hand back to replication
+                self.disable_spmd("explicit forward(is_train=True)")
+            else:
+                batch = _pack_global_batch(
+                    data_batch, self.data_shapes, self.label_shapes,
+                    self.label_names, arg_shapes=self._spmd._arg_shapes,
+                    fill_missing_labels=True)
+                outs = self._spmd.predict(batch)
+                self._spmd_outputs = [nd.NDArray(o) for o in outs]
+                # a pending forward_backward stash stays valid: update()
+                # recomputes from it with unchanged params
+                return
         self._load_batch(data_batch)
         if self.pre_forward_sync is not None:
             self.pre_forward_sync()
@@ -265,6 +476,8 @@ class DataParallelExecutorGroup:
         if not self.for_training:
             raise MXNetError("re-bind with for_training=True to run "
                              "backward")
+        if self._spmd is not None:
+            self.disable_spmd("explicit backward()")
         for i, ex in enumerate(self.execs):
             if out_grads is None:
                 ex.backward()
@@ -275,6 +488,16 @@ class DataParallelExecutorGroup:
 
     def forward_backward(self, data_batch):
         """Fused train step: one XLA program per device (forward+backward)."""
+        if self._spmd is not None:
+            # stash the GLOBAL batch; the whole fwd+bwd+all-reduce+update
+            # runs as one program at ``spmd_step`` (Module.update), so
+            # weights still change only at update — skip-step patterns
+            # (NaN guards) keep reference semantics
+            self._spmd_batch = _pack_global_batch(
+                data_batch, self.data_shapes, self.label_shapes,
+                self.label_names)
+            self._spmd_outputs = None
+            return
         self._load_batch(data_batch)
         if self.pre_forward_sync is not None:
             self.pre_forward_sync()
@@ -285,11 +508,26 @@ class DataParallelExecutorGroup:
     @staticmethod
     def _merge_multi_context(groups):
         """Per-name lists of per-executor arrays -> batch-concatenated
-        arrays (the kvstore-free merge every getter shares)."""
-        return [nd.concatenate(parts, axis=0) if len(parts) > 1
-                else parts[0] for parts in groups]
+        arrays (the kvstore-free merge every getter shares).
+
+        Per-exec arrays are committed to DIFFERENT devices; an eager
+        concatenate over mixed devices is a jax error, so parts are
+        gathered onto the first exec's device before merging."""
+        import jax
+
+        def _gather(parts):
+            dev = next(iter(parts[0]._data.devices()))
+            datas = [p._data if p._data.devices() == {dev}
+                     else jax.device_put(p._data, dev) for p in parts]
+            return nd.NDArray(jax.numpy.concatenate(datas, axis=0))
+
+        return [_gather(parts) if len(parts) > 1 else parts[0]
+                for parts in groups]
 
     def get_outputs(self, merge_multi_context=True):
+        if self._spmd is not None:
+            outs = self._spmd_get_outputs()
+            return outs if merge_multi_context else [[o] for o in outs]
         outputs = [[ex.outputs[i] for ex in self.execs]
                    for i in range(len(self.execs[0].outputs))]
         if merge_multi_context:
@@ -332,6 +570,14 @@ class DataParallelExecutorGroup:
                     ex.arg_dict[name][:] = value
 
     def update_metric(self, eval_metric, labels):
+        if self._spmd is not None:
+            outs = self._spmd_get_outputs()
+            # one global output set, not per-exec slices; device-side
+            # accumulation keeps the hot loop free of host syncs (the
+            # fused frontend's policy), host update as fallback
+            if not eval_metric.update_device(labels, outs):
+                eval_metric.update(labels, outs)
+            return
         for i, ex in enumerate(self.execs):
             islice = self.slices[i]
             labels_slice = [label.slice(islice.start, islice.stop)
@@ -340,5 +586,8 @@ class DataParallelExecutorGroup:
             eval_metric.update(labels_slice, ex.outputs)
 
     def install_monitor(self, mon):
+        if self._spmd is not None:
+            # per-op intermediate access needs real executors
+            self.disable_spmd("monitor installed")
         for ex in self.execs:
             mon.install(ex)

@@ -172,17 +172,19 @@ class Module(BaseModule):
         corrupts the previous states file."""
         from ..base import atomic_write
         assert self.optimizer_initialized
-        if self._fused is not None:
+        trainer = self._one_program_trainer()
+        if trainer is not None:
             # Updater.states layout keyed by plain param index — the
-            # update_on_kvstore layout, which the fused path semantically
-            # is (one shared update per parameter).  Like the reference,
-            # files are not portable to the update_on_kvstore=False
-            # multi-device host-updater layout (index*num_device+k).
-            # Written as the v2 envelope so the optimizer's update
-            # counters (Adam bias-correction schedule) resume too.
+            # update_on_kvstore layout, which the one-program paths
+            # semantically are (one shared update per parameter).  Like
+            # the reference, files are not portable to the
+            # update_on_kvstore=False multi-device host-updater layout
+            # (index*num_device+k).  Written as the v2 envelope so the
+            # optimizer's update counters (Adam bias-correction
+            # schedule) resume too.
             from ..optimizer import _state_to_host, pack_updater_states
             states = {i: _state_to_host(v) for i, v in
-                      self._fused.get_updater_states().items()}
+                      trainer.get_updater_states().items()}
             with atomic_write(fname, "wb") as fout:
                 fout.write(pack_updater_states(states, self._optimizer))
         elif self._update_on_kvstore:
@@ -195,12 +197,13 @@ class Module(BaseModule):
         """Restore optimizer state written by
         ``save_optimizer_states``."""
         assert self.optimizer_initialized
-        if self._fused is not None:
+        trainer = self._one_program_trainer()
+        if trainer is not None:
             from ..optimizer import unpack_updater_states
             with open(fname, "rb") as f:
                 states, counts, num_update = \
                     unpack_updater_states(f.read())
-            self._fused.set_updater_states(states)
+            trainer.set_updater_states(states)
             if counts is not None:
                 self._optimizer._index_update_count = dict(counts)
                 self._optimizer.num_update = num_update
@@ -314,7 +317,11 @@ class Module(BaseModule):
         get_params/set_params pair — the host-averaged write-back is
         what reconverges per-device BatchNorm moving stats each
         epoch."""
-        if self._fused is not None or len(self._context) == 1:
+        if (self._fused is not None or len(self._context) == 1 or
+                (self._exec_group is not None and
+                 self._exec_group.spmd_active)):
+            # the SPMD step program keeps ONE sharded/replicated state —
+            # nothing can diverge per device, so sync down only
             return self.get_params()
         return super()._epoch_end_param_sync()
 
@@ -378,6 +385,9 @@ class Module(BaseModule):
         if self._fused is not None:
             # cached input placements pin ~a batch of HBM per name
             self._fused.clear_placement_cache()
+        if self._exec_group is not None and \
+                self._exec_group.spmd_trainer is not None:
+            self._exec_group.spmd_trainer.clear_placement_cache()
         self.binded = False
         self._exec_group = None
         self._data_shapes = None
@@ -390,7 +400,7 @@ class Module(BaseModule):
         """Re-bind to new input shapes keeping the current parameters
         (new shapes trigger one fresh XLA compile, then cache)."""
         assert self.binded
-        if self._fused is not None:
+        if self._fused is not None or self._exec_group.spmd_active:
             self._sync_params_from_devices()
         self._data_shapes = [x if hasattr(x, "name") else _as_data_desc(x)
                              for x in data_shapes]
@@ -429,7 +439,8 @@ class Module(BaseModule):
             return
 
         self._kvstore_arg = kvstore
-        if self._fused is not None and self._params_dirty:
+        if ((self._fused is not None or self._exec_group.spmd_active)
+                and self._params_dirty):
             # force_init re-init: pull current device params back before
             # the trainer (and its optimizer state) is rebuilt
             self._sync_params_from_devices()
@@ -448,6 +459,29 @@ class Module(BaseModule):
                     self.load_optimizer_states(self._preload_opt_states)
                     self._preload_opt_states = None
                 return
+
+        # executor-group frontend over the ONE shared SPMD step program
+        # (parallel/spmd.py): when the fused fast path is off
+        # (MXNET_MODULE_FUSED=0) but the multi-device setup is still
+        # expressible as a single program, training dispatches through
+        # exec_group.spmd_step — XLA all-reduce inside the step, params
+        # device-resident — instead of the per-device replication loop +
+        # host updater below.  MXNET_SPMD=0 restores the classic path
+        # bit-for-bit.
+        spmd_opt = self._spmd_optimizer(kvstore, optimizer,
+                                        optimizer_params)
+        if spmd_opt is not None and self._exec_group.enable_spmd(
+                spmd_opt, self._arg_params, self._aux_params):
+            self._exec_group.on_spmd_disable = self._on_spmd_disable
+            self._optimizer = spmd_opt
+            self._kvstore = None
+            self._update_on_kvstore = False
+            self._updater = None
+            self.optimizer_initialized = True
+            if self._preload_opt_states is not None:
+                self.load_optimizer_states(self._preload_opt_states)
+                self._preload_opt_states = None
+            return
 
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
@@ -504,8 +538,39 @@ class Module(BaseModule):
 
     # -- fused fast path ---------------------------------------------------
     def _fusible_optimizer(self, kvstore, optimizer, optimizer_params):
-        """If the training setup qualifies for the fused in-graph step,
-        return the (possibly constructed) Optimizer instance; else None.
+        """If the training setup qualifies for the fused in-graph
+        fast path (``MXNET_MODULE_FUSED``), return the (possibly
+        constructed) Optimizer instance; else None."""
+        if not get_env("MXNET_MODULE_FUSED") or self._fused_disabled:
+            return None
+        return self._one_program_optimizer(kvstore, optimizer,
+                                           optimizer_params)
+
+    def _spmd_optimizer(self, kvstore, optimizer, optimizer_params):
+        """Like ``_fusible_optimizer`` but for the executor-group SPMD
+        frontend: multi-device only (a single device has no replication
+        loop to delete), never under a shared bind (bucketing shares
+        executor memory, not trainer state), and never with Custom host
+        callbacks (they deadlock inside one donated program, same as the
+        fused path)."""
+        from ..parallel.spmd import spmd_enabled
+        if not spmd_enabled() or len(self._context) == 1:
+            return None
+        # _fused_disabled is the module-level "keep reference executor
+        # semantics" latch (shared binds, permanent defuse, tests
+        # pinning the classic path) — it covers this frontend too
+        if self._fused_disabled or self._exec_group.shared_group is not None:
+            return None
+        if self._symbol.has_custom_ops():
+            return None
+        return self._one_program_optimizer(kvstore, optimizer,
+                                           optimizer_params)
+
+    def _one_program_optimizer(self, kvstore, optimizer, optimizer_params):
+        """If the training setup is expressible as ONE compiled step
+        program, return the (possibly constructed) Optimizer instance;
+        else None.  Shared qualification for the fused fast path and the
+        executor-group SPMD frontend.
 
         Qualifying = local/device kvstore semantics (single process),
         grad_req='write', no monitor / input grads / states / shared bind,
@@ -513,9 +578,7 @@ class Module(BaseModule):
         layouts, and an optimizer with an exact in-graph equivalent
         (parallel.ingraph_opt)."""
         from ..parallel.ingraph_opt import supports_ingraph
-        if not get_env("MXNET_MODULE_FUSED"):
-            return None
-        if (self._fused_disabled or self._monitor is not None or
+        if (self._monitor is not None or
                 self._state_names or self.inputs_need_grad or
                 not self.for_training or self._grad_req != "write"):
             return None
@@ -553,14 +616,13 @@ class Module(BaseModule):
         new trainer a shape variant over another trainer's state (bucketing:
         reference bucketing_module.py:302-330 shares executor memory the
         same way)."""
-        import numpy as np
-        from jax.sharding import Mesh
         from ..parallel.dp import DataParallelTrainer
+        from ..parallel.mesh import mesh_for_contexts
         try:
-            devices = [ctx.jax_device() for ctx in self._context]
+            # THE mesh factory (parallel/mesh.py): one place constructs
+            # every module-level mesh, one place grows multi-host axes
+            mesh = mesh_for_contexts(self._context)
         except Exception:
-            return None
-        if len(set(devices)) != len(devices):
             return None
         if self._symbol.has_custom_ops():
             # CustomOp callbacks inside the single fused program deadlock
@@ -576,7 +638,6 @@ class Module(BaseModule):
         data_shapes = {d.name: tuple(d.shape) for d in self._data_shapes}
         label_shapes = {d.name: tuple(d.shape)
                         for d in (self._label_shapes or [])}
-        mesh = Mesh(np.asarray(devices), ("dp",))
         try:
             trainer = DataParallelTrainer(
                 self._symbol, data_shapes, label_shapes or None, mesh=mesh,
@@ -633,6 +694,15 @@ class Module(BaseModule):
         self._exec_group.set_params(self._arg_params, self._aux_params)
         if not self.optimizer_initialized:
             return
+        self._rebuild_host_update_path(trainer)
+        if self._on_defuse is not None:
+            self._on_defuse(self)
+
+    def _rebuild_host_update_path(self, trainer):
+        """Rebuild the classic kvstore/host-updater machinery after
+        leaving a one-program path (fused fast path or the exec-group
+        SPMD frontend), carrying the trainer's optimizer state over into
+        the host updater's per-device layout."""
         (kvstore, _) = _create_kvstore(
             self._kvstore_arg, len(self._context), self._arg_params)
         self._kvstore = kvstore
@@ -665,8 +735,26 @@ class Module(BaseModule):
                 # allocates next to its weight
                 self._updater.states[i * num_device + k] = \
                     _place_state(_clone_state(state), self._context[k])
-        if self._on_defuse is not None:
-            self._on_defuse(self)
+
+    def _one_program_trainer(self):
+        """The state-holding trainer when training runs as one compiled
+        step program — the fused fast path's, or the executor-group SPMD
+        frontend's — else None."""
+        if self._fused is not None:
+            return self._fused
+        if self._exec_group is not None:
+            return self._exec_group.spmd_trainer
+        return None
+
+    def _on_spmd_disable(self, trainer, reason):
+        """exec_group.disable_spmd hook: the group already reconverged
+        its per-exec arrays from the trainer; re-sync the host param
+        copies and rebuild the kvstore/updater so training continues
+        under full replication semantics with optimizer state carried
+        over."""
+        self._sync_from_trainer(trainer)
+        if self.optimizer_initialized:
+            self._rebuild_host_update_path(trainer)
 
     def _maybe_refuse(self):
         """Return to the fused fast path after a transient defuse: the
@@ -719,10 +807,13 @@ class Module(BaseModule):
         from ..io.stager import DeviceStager, staging_enabled
         if not staging_enabled() or self._monitor is not None:
             return train_data
-        if self._fused is not None:
+        spmd = self._exec_group.spmd_trainer if self._exec_group else None
+        if self._fused is not None or spmd is not None:
             if jax.process_count() > 1:
                 return train_data
-            target = self._fused._batched
+            # staged arrays land pre-sharded on the batch axis, hitting
+            # _shard_batch's already-placed fast path
+            target = (self._fused or spmd)._batched
         else:
             try:
                 target = self._context[0].jax_device()
@@ -744,33 +835,15 @@ class Module(BaseModule):
         self._params_dirty = False
 
     def _fused_pack_batch(self, data_batch, fill_missing_labels=False):
-        # batch.data follows the ITERATOR's provide_data order, which is
-        # what the module was bound with — not necessarily the
-        # constructor's data_names order (NDArrayIter sorts dict inputs).
-        # Zipping constructor order against iterator order silently swaps
-        # same-shaped inputs (e.g. user/item in matrix factorization).
-        def _names(descs):
-            # descriptors may be DataDesc or classic (name, shape) tuples
-            return [d.name if hasattr(d, "name") else d[0] for d in descs]
-
-        provide = getattr(data_batch, "provide_data", None)
-        dnames = _names(provide if provide else self._data_shapes)
-        batch = {}
-        for name, arr in zip(dnames, data_batch.data):
-            batch[name] = arr
-        labels = getattr(data_batch, "label", None) or []
-        provide_l = getattr(data_batch, "provide_label", None)
-        lnames = (_names(provide_l) if provide_l
-                  else _names(self._label_shapes or [])
-                  or self._label_names)
-        for name, arr in zip(lnames, labels):
-            batch[name] = arr
-        if fill_missing_labels:
-            for name in self._label_names:
-                if name not in batch:
-                    batch[name] = nd.zeros(
-                        self._fused._arg_shapes[name])
-        return batch
+        """One global {name: array} dict for the fused step — the
+        shared order-sensitive packing (iterator provide_data order,
+        NOT constructor order) lives in
+        ``executor_group._pack_global_batch``."""
+        from .executor_group import _pack_global_batch
+        return _pack_global_batch(
+            data_batch, self._data_shapes, self._label_shapes,
+            self._label_names, arg_shapes=self._fused._arg_shapes,
+            fill_missing_labels=fill_missing_labels)
 
     def _fused_get_outputs(self):
         if self._fused_outputs is None:
@@ -826,6 +899,12 @@ class Module(BaseModule):
             outs = self._fused.step(self._fused_batch)
             self._fused_outputs = [nd.NDArray(o) for o in outs]
             self._fused_batch = None
+            return
+        if self._exec_group.spmd_active:
+            # the whole step (fwd+bwd+all-reduce+in-graph update) runs
+            # here as the one compiled program, on the batch
+            # forward_backward stashed
+            self._exec_group.spmd_step()
             return
         if self._update_on_kvstore:
             # pushes and pulls are submitted asynchronously (dist

@@ -7,16 +7,21 @@ become XLA collectives (psum / all_gather / ppermute) over a
 annotations (tp rules / pipeline stages); and beyond-reference sequence
 parallelism (ring attention) and expert parallelism live here too.
 """
-from .mesh import make_mesh, data_parallel_sharding, local_mesh
-from .dp import DataParallelTrainer
+from .mesh import make_mesh, data_parallel_sharding, local_mesh, \
+    mesh_for_contexts
+from .dp import DataParallelTrainer, FusedDPTrainer
 from .tp import ShardingRules, MeshTrainer, megatron_rules_for_mlp
 from .sp import ring_attention, ring_self_attention, blockwise_attention
 from .pp import spmd_pipeline, pipelined, stack_stage_params
 from .ep import moe_ffn, top1_dispatch, init_moe_params
+from .spmd import get_step_program, program_cache_stats, \
+    reset_program_cache, spmd_enabled
 
 __all__ = ["make_mesh", "data_parallel_sharding", "local_mesh",
-           "DataParallelTrainer", "ShardingRules", "MeshTrainer",
+           "mesh_for_contexts", "DataParallelTrainer", "FusedDPTrainer",
+           "ShardingRules", "MeshTrainer",
            "megatron_rules_for_mlp", "ring_attention",
            "ring_self_attention", "blockwise_attention", "spmd_pipeline",
            "pipelined", "stack_stage_params", "moe_ffn", "top1_dispatch",
-           "init_moe_params"]
+           "init_moe_params", "get_step_program", "program_cache_stats",
+           "reset_program_cache", "spmd_enabled"]

@@ -11,6 +11,8 @@ meet the sharded batch (the ``psum`` that subsumes kvstore push+pull).
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -18,12 +20,13 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import ndarray as nd
+from .. import profiler
 from ..base import MXNetError, hot_path
 from ..initializer import InitDesc, Uniform
 from ..ndarray import NDArray
 from .mesh import local_mesh
 
-__all__ = ["DataParallelTrainer"]
+__all__ = ["DataParallelTrainer", "FusedDPTrainer"]
 
 
 from .ingraph_opt import InGraphOptimizer
@@ -79,6 +82,10 @@ class DataParallelTrainer:
         shapes = dict(data_shapes)
         if label_shapes:
             shapes.update(label_shapes)
+        self._data_shapes_map = {k: tuple(v) for k, v in
+                                 data_shapes.items()}
+        self._label_shapes_map = {k: tuple(v) for k, v in
+                                  (label_shapes or {}).items()}
         self.data_names = list(data_shapes)
         self.label_names = list(label_shapes or {})
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
@@ -229,113 +236,25 @@ class DataParallelTrainer:
         self.aux = aux
 
     def _compile(self):
-        from ..executor import shape_overrides
-        symbol = self.symbol
-        nodes = symbol._nodes()
-        aux_set = set(self.aux_names)
-        head = [(id(n), oi) for n, oi in symbol._outputs]
-        # sampling ops draw at inference too: predict() must not reuse a
-        # cached key for such graphs
-        self._rng_at_eval = any(not n.is_variable and
-                                getattr(n.op, "rng_at_eval", False)
-                                for n in nodes)
-        param_names = self.param_names
-        data_names = self.data_names + self.label_names
-        overrides = shape_overrides(symbol, self._arg_shapes)
-
-        def trace(args_map, aux_map, rng, is_train):
-            vals = {}
-            new_aux = dict(aux_map)
-            for idx, node in enumerate(nodes):
-                if node.is_variable:
-                    vals[(id(node), 0)] = (aux_map[node.name]
-                                           if node.name in aux_set
-                                           else args_map[node.name])
-                    continue
-                ins = [vals[(id(n), oi)] for n, oi in node.arg_inputs()]
-                aux_in = tuple(vals[(id(n), oi)]
-                               for n, oi in node.aux_inputs())
-                r = jax.random.fold_in(rng, idx) \
-                    if (node.op.needs_rng or node.op.stateful) else None
-                outs, upd = node.op.apply(
-                    overrides.get(id(node), node.attrs), ins, aux_in,
-                    is_train, r)
-                for oi, o in enumerate(outs):
-                    vals[(id(node), oi)] = o
-                for (an, _), u in zip(node.aux_inputs(), upd):
-                    new_aux[an.name] = u
-            return tuple(vals[k] for k in head), new_aux
-
-        opt_update = self._opt_update
-        fixed = self._fixed
-        cdt = self._compute_dtype
-        label_set = set(self.label_names)
-        # ZeRO-1: the per-shard update would propagate a dp-sharded
-        # layout onto the weights (silent retrace + broken replication
-        # contract); pin updated weights back to their own sharding so
-        # XLA inserts the all-gather inside the step
-        param_shardings = ({n: self._sharding_for(n)
-                            for n in param_names}
-                           if self._zero1 else None)
-
-        def _cast(tree):
-            if cdt is None:
-                return tree
-            # labels stay in their master dtype: class ids >= 256 are not
-            # representable in bf16's 8-bit significand
-            return {k: (v.astype(cdt) if jnp.issubdtype(v.dtype,
-                                                        jnp.floating)
-                        and k not in label_set
-                        else v) for k, v in tree.items()}
-
-        def train_step(params, opt_state, aux, batch, lrs, wds, rng):
-            # split INSIDE the graph and carry the successor key out: the
-            # host never runs an eager split per step (23 ms over a TPU
-            # tunnel) and never re-uploads a key
-            rng, rng_next = jax.random.split(rng)
-
-            def f(ps):
-                args = _cast(dict(batch))
-                args.update(_cast(ps))
-                outs, new_aux = trace(args, _cast(aux), rng, True)
-                # moving stats stay in their master dtype across steps
-                new_aux = {k: v.astype(aux[k].dtype)
-                           for k, v in new_aux.items()}
-                return outs, new_aux
-
-            outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
-            cots = tuple(jnp.ones_like(o) for o in outs)
-            grads = vjp(cots)[0]
-            new_params, new_opt = {}, {}
-            for idx, name in enumerate(param_names):
-                if name in fixed or grads.get(name) is None:
-                    new_params[name] = params[name]
-                    new_opt[name] = opt_state[name]
-                else:
-                    w, s = opt_update(params[name], grads[name],
-                                      opt_state[name], lrs[idx], wds[idx],
-                                      jax.random.fold_in(rng, (1 << 20) +
-                                                         idx))
-                    if param_shardings is not None:
-                        w = jax.lax.with_sharding_constraint(
-                            w, param_shardings[name])
-                    new_params[name] = w
-                    new_opt[name] = s
-            return new_params, new_opt, new_aux, outs, rng_next
-
-        def predict_step(params, aux, batch, rng):
-            args = _cast(dict(batch))
-            args.update(_cast(params))
-            outs, _ = trace(args, _cast(aux), rng, False)
-            return outs
-
-        # pure_callback (Custom op) + donated buffers deadlock: the
-        # callback can block forever materializing an input whose buffer
-        # was donated to the next step already in flight.  Trade the
-        # in-place param update for correctness only when callbacks exist.
-        donate = () if symbol.has_custom_ops() else (0, 1, 2)
-        self._train_step = jax.jit(train_step, donate_argnums=donate)
-        self._predict_step = jax.jit(predict_step)
+        """Fetch (or compile) the shared SPMD step program for this
+        trainer's (symbol, mesh, shapes, dtype, optimizer, rules) — the
+        trainer holds state and placement; the program is owned by
+        ``parallel/spmd.py``'s cache and shared with every other
+        frontend keyed the same."""
+        from . import spmd
+        shardings = {n: self._sharding_for(n) for n in self.param_names}
+        self._program = spmd.get_step_program(
+            self.symbol, self.mesh,
+            data_shapes=self._data_shapes_map,
+            label_shapes=self._label_shapes_map or None,
+            dtype=self._dtype, compute_dtype=self._compute_dtype,
+            optimizer=self.optimizer,
+            fixed_params=tuple(sorted(self._fixed)),
+            shard_optimizer_state=self._zero1,
+            param_shardings=shardings)
+        self._rng_at_eval = self._program.rng_at_eval
+        self._train_step = self._program.train_step
+        self._predict_step = self._program.predict_step
 
     # ------------------------------------------------------------------
     def _shard_batch(self, batch):
@@ -414,10 +333,14 @@ class DataParallelTrainer:
             rng = self._carry_rng()
         lrs, wds = self._host_hyper()
         from .. import engine as _engine
+        t_ns = time.perf_counter_ns()
         self.params, self.opt_state, self.aux, outs, rng_next = \
             _engine.get().dispatch(
                 "fused_train_step", self._train_step, self.params,
                 self.opt_state, self.aux, batch, lrs, wds, rng)
+        # spmd_step attributes the sharded-program dispatch inside the
+        # fit loop's "compute" phase (nested span; excluded from pct)
+        profiler.record_phase("spmd_step", t_ns)
         self._rng_dev = rng_next
         return outs
 
@@ -507,9 +430,21 @@ class DataParallelTrainer:
     def set_updater_states(self, states):
         for i, name in enumerate(self.param_names):
             if i in states and name not in self._fixed:
+                if states[i] is None:
+                    # a stateless entry (momentum=0 sgd serializes its
+                    # state as None): keep this trainer's freshly
+                    # initialized state — feeding None through
+                    # state_from_host would materialize a NaN scalar
+                    # (jnp.asarray(None)) that poisons the first update
+                    continue
                 arrs = [jnp.asarray(s._data if isinstance(s, NDArray)
                                     else s)
                         for s in self._ingraph.state_from_host(states[i])]
                 self.opt_state[name] = tuple(
                     self._place(a, self._opt_sharding_for(name, a.shape))
                     for a in arrs)
+
+
+# The name the SPMD step-program design docs use for the fused-trainer
+# frontend (docs/architecture/spmd_step.md): same class, clearer role.
+FusedDPTrainer = DataParallelTrainer

@@ -28,7 +28,7 @@ from .. import ndarray as nd
 from ..base import MXNetError
 from ..ndarray import NDArray
 
-__all__ = ["InGraphOptimizer", "supports_ingraph"]
+__all__ = ["InGraphOptimizer", "supports_ingraph", "ingraph_fingerprint"]
 
 
 def _static_clip(g, clip):
@@ -324,9 +324,47 @@ _ENTRIES = {
 }
 
 
+# per-entry hyperparameters that are BAKED into the traced update (lr/wd
+# stay dynamic args); together with the class name and the common statics
+# they fully determine the compiled update math — the optimizer half of
+# the shared SPMD step-program cache key (parallel/spmd.py)
+_STATIC_ATTRS = {
+    "sgd": ("momentum",),
+    "ccsgd": ("momentum",),
+    "nag": ("momentum",),
+    "sgld": (),
+    "dcasgd": ("momentum", "lamda"),
+    "adam": ("beta1", "beta2", "epsilon"),
+    "adagrad": ("float_stable_eps",),
+    "rmsprop": ("gamma1", "gamma2", "epsilon", "centered", "clip_weights"),
+    "adadelta": ("rho", "epsilon"),
+    "ftrl": ("lamda1", "beta"),
+    "test": (),
+}
+
+
 def supports_ingraph(optimizer):
     """True if this Optimizer instance has an exact in-graph equivalent."""
     return type(optimizer).__name__.lower() in _ENTRIES
+
+
+def ingraph_fingerprint(optimizer):
+    """Hashable identity of the compiled update math for ``optimizer``.
+
+    Two Optimizer instances with the same fingerprint trace bit-identical
+    in-graph updates (host-side bookkeeping — schedulers, idx2name,
+    update counts — rides in the dynamic lr/wd arguments and never
+    affects the program), so they may share one compiled step."""
+    key = type(optimizer).__name__.lower()
+    if key not in _ENTRIES:
+        raise MXNetError(
+            "no in-graph update for optimizer %r (have %s)"
+            % (type(optimizer).__name__, sorted(_ENTRIES)))
+    statics = tuple((a, getattr(optimizer, a, None))
+                    for a in _STATIC_ATTRS[key])
+    clip = optimizer.clip_gradient
+    return (key, float(optimizer.rescale_grad),
+            float(clip) if clip else None) + statics
 
 
 class InGraphOptimizer:

@@ -13,7 +13,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "local_mesh", "data_parallel_sharding", "P",
-           "NamedSharding", "axis_size"]
+           "NamedSharding", "axis_size", "mesh_for_contexts"]
 
 
 def axis_size(axis_name):
@@ -54,6 +54,29 @@ def local_mesh(axis_name="dp", devices=None):
     if devices is None:
         devices = jax.devices()
     return make_mesh({axis_name: len(devices)}, devices)
+
+
+def mesh_for_contexts(contexts, axes=None, batch_axis="dp"):
+    """THE mesh factory for module-level training: a Mesh over the jax
+    devices of a Context list.
+
+    ``axes`` is a ``make_mesh``-style {axis_name: size} dict (sizes may
+    use -1; they must cover ``len(contexts)`` devices); the default is a
+    one-axis data-parallel mesh.  Every mesh a Module builds goes
+    through here, so multi-host axes have a single place to land later.
+
+    Raises MXNetError when contexts resolve to duplicate devices — a
+    mesh must enumerate distinct chips.
+    """
+    from ..base import MXNetError
+    devices = [ctx.jax_device() for ctx in contexts]
+    if len(set(devices)) != len(devices):
+        raise MXNetError("contexts %s resolve to duplicate jax devices; "
+                         "a mesh needs one distinct device per context"
+                         % (list(map(str, contexts)),))
+    if axes is None:
+        axes = {batch_axis: len(devices)}
+    return make_mesh(dict(axes), devices)
 
 
 def data_parallel_sharding(mesh, batch_axis="dp"):

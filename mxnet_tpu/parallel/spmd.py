@@ -1,0 +1,392 @@
+"""One SPMD step program: sharded fused training over a global mesh.
+
+PAPER.md's layer-6 headline is that the *same* training script scales from
+one device to multi-machine; the TPU-native analog is ONE jitted step
+program — forward + backward + in-graph optimizer update — compiled once
+against a ``jax.sharding.Mesh`` and partitioned by XLA:
+
+* data parallelism  = batch inputs carry a ``P(batch_axis)`` NamedSharding,
+  so the gradient reduction is an ICI all-reduce *inside* the step (the
+  ``psum`` that subsumes kvstore push+pull);
+* model parallelism = parameter arrays carry ``parallel/tp.py`` rule
+  shardings, so tp-sharded weights' gradients are born sharded
+  (reduce-scatter, not all-reduce) and optimizer state lives sharded too;
+* the optimizer update runs in-graph (``parallel/ingraph_opt.py``), so the
+  host never round-trips gradients or weights.
+
+This module owns the *program*; frontends own *state*.  Both training
+frontends are thin adapters over it:
+
+* ``parallel.dp.DataParallelTrainer`` (alias ``FusedDPTrainer``) — the
+  fused trainer driven by ``Module.fit``'s fast path;
+* ``module.Module``'s executor-group path — multi-device training with
+  ``kvstore=None``/``'local'``/``'device'`` routes here instead of
+  per-device executor replication (``MXNET_SPMD=0`` restores the classic
+  ``DataParallelExecutorGroup`` replication machinery bit-for-bit).
+
+Programs are cached in a bounded LRU keyed like ``cached_op.py`` — on
+(symbol fingerprint, mesh fingerprint, input shapes, dtypes, optimizer
+statics, sharding rules, donation) — so any number of frontends, modules
+and shape-sharing buckets referencing the same training setup share ONE
+compiled executable per key (``MXNET_SPMD_PROGRAM_CACHE`` bounds it).
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..analysis.lockcheck import make_lock
+from ..base import get_env
+from .ingraph_opt import InGraphOptimizer, ingraph_fingerprint
+
+__all__ = ["StepProgram", "get_step_program", "spmd_enabled",
+           "program_cache_stats", "reset_program_cache", "_cache_size"]
+
+
+def spmd_enabled():
+    """Is the shared SPMD step-program path on?  (``MXNET_SPMD=0`` is the
+    escape hatch: frontends compile privately and Module's multi-device
+    training falls back to classic per-device executor replication.)"""
+    return bool(get_env("MXNET_SPMD"))
+
+
+# ---------------------------------------------------------------------------
+# Cache keys
+# ---------------------------------------------------------------------------
+def _symbol_fingerprint(symbol):
+    """Content fingerprint of a Symbol graph (computed at program-fetch
+    time, never per step).
+
+    Two symbol objects with identical serialized graphs share programs;
+    graphs that cannot serialize (e.g. holding Custom python callbacks)
+    fall back to identity — still correct, just never shared across
+    objects."""
+    try:
+        return ("sha1", hashlib.sha1(symbol.tojson().encode()).hexdigest())
+    except Exception:
+        return ("id", id(symbol))
+
+
+def mesh_fingerprint(mesh):
+    """Hashable identity of a Mesh: axis names, axis sizes and the exact
+    device assignment (device ids in mesh order)."""
+    return (tuple(mesh.axis_names),
+            tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+def _shapes_key(shapes):
+    if not shapes:
+        return ()
+    return tuple(sorted((k, tuple(int(x) for x in v))
+                        for k, v in shapes.items()))
+
+
+def _shardings_key(param_shardings):
+    """Only non-replicated rules contribute to the key (a replicated map
+    and an empty map compile the same program)."""
+    if not param_shardings:
+        return ()
+    out = []
+    for name, sh in sorted(param_shardings.items()):
+        spec = tuple(sh.spec)
+        if spec:
+            out.append((name, spec))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# The compiled program
+# ---------------------------------------------------------------------------
+class StepProgram:
+    """One compiled SPMD training step (plus its predict twin).
+
+    ``train_step(params, opt_state, aux, batch, lrs, wds, rng)`` returns
+    ``(new_params, new_opt_state, new_aux, outputs, rng_next)``; the
+    param/opt-state/aux input buffers are donated (in-place update in
+    HBM) unless the graph holds Custom host callbacks.
+    ``predict_step(params, aux, batch, rng)`` returns the outputs only.
+    """
+
+    __slots__ = ("key", "symbol", "train_step", "predict_step",
+                 "rng_at_eval", "param_names", "aux_names", "arg_shapes",
+                 "aux_shapes", "data_names", "label_names", "donated",
+                 "trace_counts")
+
+    def __init__(self, key, symbol, train_step, predict_step, rng_at_eval,
+                 param_names, aux_names, arg_shapes, aux_shapes,
+                 data_names, label_names, donated, trace_counts):
+        self.key = key
+        # strong reference: identity-keyed entries (graphs that cannot
+        # serialize fall back to ("id", id(symbol)) in the cache key)
+        # must keep the symbol alive for the entry's lifetime, or a
+        # GC'd symbol's address could be reused by a DIFFERENT graph
+        # that then hits this program
+        self.symbol = symbol
+        self.train_step = train_step
+        self.predict_step = predict_step
+        self.rng_at_eval = rng_at_eval
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.arg_shapes = arg_shapes
+        self.aux_shapes = aux_shapes
+        self.data_names = data_names
+        self.label_names = label_names
+        self.donated = donated
+        # {"train": n, "predict": n} — incremented each time jax
+        # re-traces the step body; the no-retrace tests pin these at 1
+        # (the executable-cache entry count is polluted by fastpath
+        # bookkeeping and can exceed the true trace count)
+        self.trace_counts = trace_counts
+
+
+def _build_program(key, symbol, mesh, data_shapes, label_shapes, dtype,
+                   compute_dtype, optimizer, fixed_params, zero1,
+                   param_shardings):
+    """Trace + jit the fused step for one cache key (the program body
+    formerly private to ``DataParallelTrainer._compile``)."""
+    from ..executor import shape_overrides
+
+    shapes = dict(data_shapes)
+    if label_shapes:
+        shapes.update(label_shapes)
+    data_names = list(data_shapes)
+    label_names = list(label_shapes or {})
+    arg_shape_list, _, aux_shape_list = symbol.infer_shape(**shapes)
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    param_names = [n for n in arg_names if n not in shapes]
+    arg_shapes = dict(zip(arg_names, arg_shape_list))
+    aux_shapes = dict(zip(aux_names, aux_shape_list))
+
+    nodes = symbol._nodes()
+    aux_set = set(aux_names)
+    head = [(id(n), oi) for n, oi in symbol._outputs]
+    # sampling ops draw at inference too: predict() must not reuse a
+    # cached key for such graphs
+    rng_at_eval = any(not n.is_variable and
+                      getattr(n.op, "rng_at_eval", False) for n in nodes)
+    overrides = shape_overrides(symbol, arg_shapes)
+
+    def trace(args_map, aux_map, rng, is_train):
+        vals = {}
+        new_aux = dict(aux_map)
+        for idx, node in enumerate(nodes):
+            if node.is_variable:
+                vals[(id(node), 0)] = (aux_map[node.name]
+                                       if node.name in aux_set
+                                       else args_map[node.name])
+                continue
+            ins = [vals[(id(n), oi)] for n, oi in node.arg_inputs()]
+            aux_in = tuple(vals[(id(n), oi)]
+                           for n, oi in node.aux_inputs())
+            r = jax.random.fold_in(rng, idx) \
+                if (node.op.needs_rng or node.op.stateful) else None
+            outs, upd = node.op.apply(
+                overrides.get(id(node), node.attrs), ins, aux_in,
+                is_train, r)
+            for oi, o in enumerate(outs):
+                vals[(id(node), oi)] = o
+            for (an, _), u in zip(node.aux_inputs(), upd):
+                new_aux[an.name] = u
+        return tuple(vals[k] for k in head), new_aux
+
+    opt_update = InGraphOptimizer(optimizer).update
+    fixed = set(fixed_params)
+    cdt = jnp.dtype(compute_dtype) if compute_dtype else None
+    label_set = set(label_names)
+    # ZeRO-1: the per-shard update would propagate a dp-sharded layout
+    # onto the weights (silent retrace + broken replication contract);
+    # pin updated weights back to their own sharding so XLA inserts the
+    # all-gather inside the step
+    pin_shardings = dict(param_shardings) if zero1 else None
+
+    def _cast(tree):
+        if cdt is None:
+            return tree
+        # labels stay in their master dtype: class ids >= 256 are not
+        # representable in bf16's 8-bit significand
+        return {k: (v.astype(cdt) if jnp.issubdtype(v.dtype, jnp.floating)
+                    and k not in label_set
+                    else v) for k, v in tree.items()}
+
+    trace_counts = {"train": 0, "predict": 0}
+
+    def train_step(params, opt_state, aux, batch, lrs, wds, rng):
+        # runs at trace time only: a steady-state training loop must
+        # never re-enter this body
+        trace_counts["train"] += 1
+        # split INSIDE the graph and carry the successor key out: the
+        # host never runs an eager split per step and never re-uploads
+        # a key
+        rng, rng_next = jax.random.split(rng)
+
+        def f(ps):
+            args = _cast(dict(batch))
+            args.update(_cast(ps))
+            outs, new_aux = trace(args, _cast(aux), rng, True)
+            # moving stats stay in their master dtype across steps
+            new_aux = {k: v.astype(aux[k].dtype)
+                       for k, v in new_aux.items()}
+            return outs, new_aux
+
+        outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
+        cots = tuple(jnp.ones_like(o) for o in outs)
+        grads = vjp(cots)[0]
+        new_params, new_opt = {}, {}
+        for idx, name in enumerate(param_names):
+            if name in fixed or grads.get(name) is None:
+                new_params[name] = params[name]
+                new_opt[name] = opt_state[name]
+            else:
+                w, s = opt_update(params[name], grads[name],
+                                  opt_state[name], lrs[idx], wds[idx],
+                                  jax.random.fold_in(rng, (1 << 20) + idx))
+                if pin_shardings is not None:
+                    w = jax.lax.with_sharding_constraint(
+                        w, pin_shardings[name])
+                new_params[name] = w
+                new_opt[name] = s
+        return new_params, new_opt, new_aux, outs, rng_next
+
+    def predict_step(params, aux, batch, rng):
+        trace_counts["predict"] += 1
+        args = _cast(dict(batch))
+        args.update(_cast(params))
+        outs, _ = trace(args, _cast(aux), rng, False)
+        return outs
+
+    # pure_callback (Custom op) + donated buffers deadlock: the callback
+    # can block forever materializing an input whose buffer was donated
+    # to the next step already in flight.  Trade the in-place param
+    # update for correctness only when callbacks exist.
+    donate = () if symbol.has_custom_ops() else (0, 1, 2)
+    return StepProgram(
+        key=key,
+        symbol=symbol,
+        train_step=jax.jit(train_step, donate_argnums=donate),
+        predict_step=jax.jit(predict_step),
+        rng_at_eval=rng_at_eval,
+        param_names=param_names, aux_names=aux_names,
+        arg_shapes=arg_shapes, aux_shapes=aux_shapes,
+        data_names=data_names, label_names=label_names,
+        donated=bool(donate), trace_counts=trace_counts)
+
+
+# ---------------------------------------------------------------------------
+# The bounded program LRU (cached_op.py's shape, one entry = one
+# compiled StepProgram shared by every frontend with the same key)
+# ---------------------------------------------------------------------------
+class _ProgramCache:
+    def __init__(self, max_size):
+        self.max_size = max(1, int(max_size))
+        self._entries = OrderedDict()
+        self._stats = [0, 0, 0]  # hits, misses, evictions
+        self.lock = make_lock("spmd.programs")
+
+    def acquire(self, key, builder):
+        with self.lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self._stats[0] += 1
+                return entry
+            self._stats[1] += 1
+        # compile outside the lock; re-check for a racing insert
+        entry = builder()
+        with self.lock:
+            raced = self._entries.get(key)
+            if raced is not None:
+                return raced
+            while len(self._entries) >= self.max_size:
+                self._entries.popitem(last=False)
+                self._stats[2] += 1
+            self._entries[key] = entry
+            return entry
+
+    def snapshot(self):
+        with self.lock:
+            return {"hits": self._stats[0], "misses": self._stats[1],
+                    "evictions": self._stats[2],
+                    "size": len(self._entries),
+                    "max_size": self.max_size}
+
+    def size(self):
+        with self.lock:
+            return len(self._entries)
+
+
+_cache = None
+_cache_lock = make_lock("spmd.programs.singleton")
+
+
+def _get_cache():
+    global _cache
+    if _cache is None:
+        with _cache_lock:
+            if _cache is None:
+                _cache = _ProgramCache(
+                    int(get_env("MXNET_SPMD_PROGRAM_CACHE") or 64))
+    return _cache
+
+
+def program_cache_stats():
+    """Hit/miss/eviction counters + current size of the program LRU."""
+    return _get_cache().snapshot()
+
+
+def reset_program_cache(max_size=None):
+    """Drop all cached step programs (tests / reconfiguration)."""
+    global _cache
+    with _cache_lock:
+        _cache = _ProgramCache(
+            int(max_size) if max_size is not None
+            else int(get_env("MXNET_SPMD_PROGRAM_CACHE") or 64))
+
+
+def _cache_size():
+    """Number of compiled step programs currently cached."""
+    return _get_cache().size()
+
+
+def get_step_program(symbol, mesh, data_shapes, label_shapes=None,
+                     dtype="float32", compute_dtype=None, optimizer=None,
+                     fixed_params=(), shard_optimizer_state=False,
+                     param_shardings=None):
+    """The one SPMD step program for this training setup.
+
+    Returns the cached :class:`StepProgram` for (symbol, mesh, shapes,
+    dtype, optimizer statics, sharding rules), compiling it on first
+    use.  ``param_shardings`` maps parameter names to NamedShardings
+    (tensor-parallel rules); omitted names are replicated.  With
+    ``MXNET_SPMD=0`` the program is built privately (never cached or
+    shared) — the pre-sharing behavior.
+    """
+    if optimizer is None:
+        raise ValueError("get_step_program requires an optimizer with an "
+                         "in-graph equivalent (parallel/ingraph_opt.py)")
+    if param_shardings is None:
+        replicated = NamedSharding(mesh, P())
+        param_shardings = {n: replicated
+                           for n in symbol.list_arguments()}
+    fixed = tuple(sorted(fixed_params))
+    key = ("spmd_step", _symbol_fingerprint(symbol), mesh_fingerprint(mesh),
+           _shapes_key(data_shapes), _shapes_key(label_shapes),
+           str(dtype), str(compute_dtype) if compute_dtype else None,
+           ingraph_fingerprint(optimizer), fixed,
+           bool(shard_optimizer_state), _shardings_key(param_shardings),
+           bool(symbol.has_custom_ops()))
+
+    def build():
+        return _build_program(key, symbol, mesh, data_shapes, label_shapes,
+                              dtype, compute_dtype, optimizer, fixed,
+                              bool(shard_optimizer_state), param_shardings)
+
+    if not spmd_enabled():
+        return build()
+    return _get_cache().acquire(key, build)

@@ -29,8 +29,18 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
 # (tools/step_profile.py renders them; docs/perf.md explains the
 # methodology).  ``h2d_stage`` is recorded by the DeviceStager's
 # background thread, so it OVERLAPS compute rather than adding to the
-# step — the report calls that out.
-PHASES = ("data_wait", "h2d_stage", "compute", "metric_fetch")
+# step — the report calls that out.  ``spmd_step`` is the sharded
+# step-program dispatch (parallel/spmd.py) recorded INSIDE the fit
+# loop's ``compute`` phase: its span against compute shows how much of
+# compute is the one-program dispatch vs frontend packing/metric glue.
+PHASES = ("data_wait", "h2d_stage", "compute", "metric_fetch",
+          "spmd_step")
+
+# Phases that overlap (h2d_stage: stager thread concurrent with
+# compute) or nest inside (spmd_step: within compute) another phase —
+# reported, but excluded from the step-percentage denominator so the
+# breakdown still sums to 100%.
+_NON_ADDITIVE_PHASES = frozenset(["h2d_stage", "spmd_step"])
 
 # The serving engine's scheduler-cycle phases (serving/scheduler.py):
 # ``serve_wait`` (engine blocked on the request queue), ``serve_batch``
@@ -118,14 +128,16 @@ class StepPhaseCollector:
     def report(self):
         """Per-step phase breakdown: {phase: {total_ms, mean_ms,
         per_step_ms, pct}} plus step count.  ``pct`` is each phase's
-        share of the summed NON-overlapped phases (h2d_stage runs on
-        the stager thread concurrently with compute and is excluded
-        from the denominator)."""
+        share of the summed NON-overlapped top-level phases (h2d_stage
+        runs on the stager thread concurrently with compute, spmd_step
+        nests inside compute — both are excluded from the
+        denominator)."""
         with self._lock:
             totals = dict(self.totals)
             counts = dict(self.counts)
             steps = self.steps
-        denom = sum(v for k, v in totals.items() if k != "h2d_stage")
+        denom = sum(v for k, v in totals.items()
+                    if k not in _NON_ADDITIVE_PHASES)
         phases = {}
         for name in sorted(totals, key=lambda n: -totals[n]):
             t = totals[name]
@@ -134,11 +146,12 @@ class StepPhaseCollector:
                 "mean_ms": round(t / 1e6 / max(1, counts[name]), 3),
                 "per_step_ms": round(t / 1e6 / max(1, steps), 3),
                 "pct": round(100.0 * t / denom, 1) if denom and
-                name != "h2d_stage" else None,
+                name not in _NON_ADDITIVE_PHASES else None,
                 "spans": counts[name],
             }
         return {"steps": steps, "phases": phases,
-                "overlapped": ["h2d_stage"]}
+                "overlapped": sorted(_NON_ADDITIVE_PHASES
+                                     & set(totals) | {"h2d_stage"})}
 
 
 _phase_state = {"collector": None}

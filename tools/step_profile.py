@@ -8,7 +8,12 @@ recorded by the step-phase profiler seam (``mxnet_tpu/profiler.py``):
 * ``h2d_stage``    — host->device upload on the stager thread (OVERLAPS
   compute; reported but excluded from the step percentage base);
 * ``compute``      — step dispatch + execution (forward/backward/update);
-* ``metric_fetch`` — metric accumulation incl. any host fetch.
+* ``metric_fetch`` — metric accumulation incl. any host fetch;
+* ``spmd_step``    — the one-SPMD-step-program dispatch
+  (``parallel/spmd.py``), NESTED inside ``compute``: its share of
+  compute shows how much of the step is the sharded program vs frontend
+  packing/metric glue (absent when training runs the classic
+  executor-group replication path).
 
 This is the diagnostic for an MFU gap: a healthy saturated chip shows
 ``compute`` ~100% of the step; a fat ``data_wait`` means the input
@@ -84,8 +89,10 @@ def render(report):
         lines.append("%-14s %8d %9.2f %12.3f %7s" % (
             name, row["spans"], row["total_ms"], row["per_step_ms"], pct))
     if report.get("overlapped"):
-        lines.append("(%s overlaps compute on the stager thread; excluded "
-                     "from pct)" % ", ".join(report["overlapped"]))
+        lines.append("(%s excluded from pct: h2d_stage overlaps compute "
+                     "on the stager thread, spmd_step nests inside "
+                     "compute as the sharded-program dispatch)"
+                     % ", ".join(report["overlapped"]))
     return "\n".join(lines)
 
 
