@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke serve-smoke spmd-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke serve-smoke spmd-smoke kernels-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -69,6 +69,18 @@ spmd-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m pytest tests/test_spmd_step.py -q
+
+# Pallas kernel plane + remat policy gate, deterministic on CPU: every
+# kernel's REAL body runs in interpret mode (fused softmax/xent, RMSNorm,
+# LayerNorm, flash attention) pinned against the plain XLA lowering —
+# forward AND gradients — plus the MXNET_PALLAS=0 bit-for-bit escape
+# hatch, the dispatch-fingerprint cache keys, the remat policies'
+# residual-memory reduction at pinned numerics, and the banked
+# BENCH_transformer_cpu.json artifact pins
+kernels-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/test_pallas_kernels.py \
+		tests/test_remat_policy.py -q
 
 # smoke fit under the profiler -> per-step phase breakdown
 # (data_wait/h2d_stage/compute/metric_fetch) from the dumped trace, so

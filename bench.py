@@ -27,6 +27,7 @@ BASELINE.md); full sweep under "rows", chip info under "chip".
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -51,31 +52,24 @@ ALLREDUCE_BASELINE_GBS = 11.1  # device kvstore, 2 GPUs (tools/bandwidth)
 FWD_GFLOPS = {"alexnet": 1.43, "vgg": 31.0, "inception-bn": 4.1,
               "inception-v3": 11.4, "resnet-50": 8.2, "resnet-152": 23.1}
 
-# Peak dense bf16 FLOP/s per JAX device, keyed by device_kind substring.
-PEAK_FLOPS = [("v6e", 918e12), ("v6", 918e12), ("v5p", 459e12),
-              ("v5litepod", 197e12), ("v5 lite", 197e12), ("v5e", 197e12),
-              ("v4", 275e12), ("v3", 61.4e12), ("v2", 22.5e12)]
-
-
 def _chip_info():
     import jax
+    # single source for the peak table: mxnet_tpu/flops.py (the MFU-proxy
+    # columns and tools/step_profile.py read the same one)
+    from mxnet_tpu.flops import peak_bf16_flops
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", str(dev.platform))
-    peak = None
-    k = kind.lower().replace("_", " ")
-    for key, val in PEAK_FLOPS:
-        if key in k:
-            peak = val
-            break
+    peak = peak_bf16_flops(kind)
     info = {"device_kind": kind, "platform": dev.platform,
             "n_devices": len(jax.devices()),
             "peak_bf16_flops_per_device": peak}
     if peak is None and dev.platform == "tpu":
         # an unlisted TPU generation must not silently drop the MFU
         # column — that is the diagnostic the judge needs most
-        info["mfu_warning"] = ("device_kind %r not in PEAK_FLOPS table; "
-                               "mfu columns will be null — add its peak "
-                               "bf16 FLOP/s to bench.py" % kind)
+        info["mfu_warning"] = ("device_kind %r not in the peak-FLOPs "
+                               "table; mfu columns will be null — add "
+                               "its peak bf16 FLOP/s to "
+                               "mxnet_tpu/flops.py" % kind)
         print("# WARNING: %s" % info["mfu_warning"], flush=True)
     return info
 
@@ -86,6 +80,49 @@ def _mfu(flops_per_item, items_per_sec, chip):
         return None
     return round(flops_per_item * items_per_sec /
                  (peak * chip["n_devices"]), 4)
+
+
+def _cost_columns(cost, steps_per_sec, chip):
+    """Measured-FLOPs columns for a train row: model FLOPs per step from
+    the COMPILED program's cost_analysis() (not the hand table) and the
+    MFU proxy against table peak.  ``cost`` may be None (backend
+    declined) — the columns then report null, never fail the row."""
+    from mxnet_tpu.flops import mfu_proxy
+    flops = (cost or {}).get("flops")
+    cols = {
+        "model_gflops_per_step":
+            round(flops / 1e9, 3) if flops else None,
+        "mfu_proxy": mfu_proxy(flops, steps_per_sec,
+                               chip["peak_bf16_flops_per_device"],
+                               chip["n_devices"]),
+    }
+    if cost and cost.get("temp_bytes") is not None:
+        cols["program_temp_mb"] = round(cost["temp_bytes"] / 2 ** 20, 2)
+    return cols
+
+
+@contextlib.contextmanager
+def _managed_env(set_vars, clear=()):
+    """Pop every key in ``set_vars`` | ``clear`` from the environment,
+    apply ``set_vars``, restore all of them on exit.  THE way a bench
+    row controls trace-time knobs: listing a var in ``clear`` makes
+    "baseline = this knob absent" explicit, so an ambient setting (e.g.
+    MXNET_REMAT_POLICY exported in the measuring shell) can never leak
+    into a row that claims to measure without it."""
+    keys = set(set_vars) | set(clear)
+    saved = {k: os.environ.pop(k, None) for k in keys}
+    os.environ.update(set_vars)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+_REMAT_VARS = ("MXNET_REMAT_POLICY", "MXNET_BACKWARD_DO_MIRROR")
 
 
 def _fetch_sync(outs):
@@ -264,12 +301,22 @@ def bench_fit(name, per_dev_batch, iters, warmup, chip, smoke=False):
     gflops = FWD_GFLOPS.get(name)
     phases = {k: v["per_step_ms"]
               for k, v in (phase_report or {}).get("phases", {}).items()}
-    return {"metric": "train.%s.module_fit" % name,
-            "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": round(ips / (TRAIN_BASELINE[name] * n_dev), 3),
-            "batch_size": batch,
-            "phase_ms_per_step": phases,
-            "mfu": _mfu(3 * gflops * 1e9 if gflops else None, ips, chip)}
+    # measured-FLOPs MFU proxy from the compiled fused step (the fit fast
+    # path's trainer); None on the executor-group fallback
+    cost = None
+    trainer = mod._one_program_trainer()
+    if trainer is not None:
+        train.reset()
+        b0 = next(iter(train))
+        cost = trainer.step_cost_analysis(b0.data[0], b0.label[0])
+    row = {"metric": "train.%s.module_fit" % name,
+           "value": round(ips, 2), "unit": "images/sec",
+           "vs_baseline": round(ips / (TRAIN_BASELINE[name] * n_dev), 3),
+           "batch_size": batch,
+           "phase_ms_per_step": phases,
+           "mfu": _mfu(3 * gflops * 1e9 if gflops else None, ips, chip)}
+    row.update(_cost_columns(cost, ips / batch, chip))
+    return row
 
 
 def bench_trainer_direct(iters, warmup, chip, smoke=False,
@@ -314,14 +361,17 @@ def bench_trainer_direct(iters, warmup, chip, smoke=False,
     ips = batch * iters / (time.perf_counter() - tic)
     tag = "train.resnet-50.trainer_direct" + (
         "" if per_dev_batch == 32 else "_b%d" % per_dev_batch)
-    return {"metric": tag,
-            "value": round(ips, 2), "unit": "images/sec",
-            # the P100 anchor is a batch-32 protocol; larger-batch rows
-            # report throughput/MFU only
-            "vs_baseline": round(ips / (TRAIN_BASELINE["resnet-50"] * n_dev),
-                                 3) if per_dev_batch == 32 else None,
-            "batch_size": batch,
-            "mfu": _mfu(3 * FWD_GFLOPS["resnet-50"] * 1e9, ips, chip)}
+    row = {"metric": tag,
+           "value": round(ips, 2), "unit": "images/sec",
+           # the P100 anchor is a batch-32 protocol; larger-batch rows
+           # report throughput/MFU only
+           "vs_baseline": round(ips / (TRAIN_BASELINE["resnet-50"] * n_dev),
+                                3) if per_dev_batch == 32 else None,
+           "batch_size": batch,
+           "mfu": _mfu(3 * FWD_GFLOPS["resnet-50"] * 1e9, ips, chip)}
+    row.update(_cost_columns(trainer.step_cost_analysis(data, label),
+                             ips / batch, chip))
+    return row
 
 
 def bench_inference(name, iters, chip, smoke=False):
@@ -754,10 +804,7 @@ def bench_input_staging(chip, smoke=False):
     def fit_sps(stage, delay):
         """Steps/sec of the drain-bounded steady-state window (same
         protocol as bench_fit)."""
-        # graft-lint: disable=env-knob — raw save/restore of the toggle
-        saved = os.environ.get("MXNET_IO_STAGE")
-        os.environ["MXNET_IO_STAGE"] = stage
-        try:
+        with _managed_env({"MXNET_IO_STAGE": stage}):
             mx.random.seed(0)
             it = mx.io.NDArrayIter(X, y, batch_size=batch)
             if delay > 0:
@@ -779,11 +826,6 @@ def bench_input_staging(chip, smoke=False):
                     batch_end_callback=cb)
             assert None not in (t0[0], t1[0])
             return (batches - warmup) / (t1[0] - t0[0])
-        finally:
-            if saved is None:
-                os.environ.pop("MXNET_IO_STAGE", None)
-            else:
-                os.environ["MXNET_IO_STAGE"] = saved
 
     # calibrate the injected latency to the measured per-step compute
     compute_s = 1.0 / fit_sps("0", 0.0)
@@ -941,6 +983,217 @@ def bench_spmd_step(config, chip, smoke=False):
             if classic else None,
             "n_devices": need, "batch_per_device": 16,
             "steps": steps, "note": note}
+
+
+def _transformer_shapes(chip, smoke):
+    """(batch, seq_len, layers, hidden, heads, vocab, iters, warmup).
+    Off-TPU the Pallas path runs in interpret mode — a correctness
+    vehicle, so shapes stay tiny; on chip the row uses MXU-feeding
+    dims."""
+    if chip["platform"] == "tpu" and not smoke:
+        return (16 * chip["n_devices"], 256, 4, 512, 8, 8192, 20, 3)
+    return (8, 32, 2, 64, 4, 256, 6, 2)
+
+
+_TRANSFORMER_CACHE = {}
+
+
+def _transformer_fit_rate(mode, chip, smoke):
+    """samples/sec of the transformer LM through the real Module.fit
+    loop (drain-bounded clock, bench_fit protocol), with the Pallas
+    kernel plane on ('pallas': compiled Mosaic on TPU, forced interpret
+    mode elsewhere) or off ('xla': MXNET_PALLAS=0, the plain lowering).
+    Returns (sps, kernels_routed, cost) and caches per (mode, shapes)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.pallas_ops import dispatch
+
+    shapes = _transformer_shapes(chip, smoke)
+    ck = (mode, shapes)
+    if ck in _TRANSFORMER_CACHE:
+        return _TRANSFORMER_CACHE[ck]
+    batch, seq_len, layers, hidden, heads, vocab, iters, warmup = shapes
+    if mode == "pallas":
+        pallas = "1" if chip["platform"] == "tpu" else "2"
+    else:
+        pallas = "0"
+    # remat knobs cleared too: the banked transformer headline measures
+    # the kernel plane alone, never an ambient remat setting
+    with _managed_env({"MXNET_PALLAS": pallas}, clear=_REMAT_VARS):
+        sym = mx.models.transformer_lm(
+            seq_len=seq_len, num_layers=layers, num_hidden=hidden,
+            num_heads=heads, vocab_size=vocab)
+        rs = np.random.RandomState(0)
+        n = batch * (warmup + iters)
+        X = rs.randint(0, vocab, (n, seq_len)).astype("float32")
+        y = np.roll(X, -1, axis=1)
+        mx.random.seed(0)
+        it = mx.io.NDArrayIter(X, y, batch_size=batch)
+        devs = [mx.tpu(i) for i in range(chip["n_devices"])] \
+            if chip["platform"] == "tpu" else [mx.current_context()]
+        mod = mx.Module(sym, context=devs)
+        seen, t0, t1 = [0], [None], [None]
+
+        def cb(param):
+            seen[0] += 1
+            if seen[0] in (warmup, warmup + iters):
+                mx.nd.waitall()
+                _fetch_sync(mod.get_outputs()[0])
+                (t0 if seen[0] == warmup else t1)[0] = time.perf_counter()
+
+        dispatch.reset_dispatch_stats()
+        mod.fit(it, num_epoch=1,
+                eval_metric=mx.metric.Perplexity(ignore_label=None),
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05,
+                                  "momentum": 0.9},
+                initializer=mx.initializer.Xavier(),
+                kvstore="device", batch_end_callback=cb)
+        routed = dispatch.dispatch_stats()
+        assert seen[0] == warmup + iters and None not in (t0[0], t1[0])
+        sps = batch * iters / (t1[0] - t0[0])
+        cost = None
+        trainer = mod._one_program_trainer()
+        if trainer is not None:
+            it.reset()
+            b0 = next(iter(it))
+            cost = trainer.step_cost_analysis(b0.data[0], b0.label[0])
+        _TRANSFORMER_CACHE[ck] = (sps, routed, cost)
+        return _TRANSFORMER_CACHE[ck]
+
+
+def bench_transformer_train(mode, chip, smoke=False):
+    """Transformer-LM train rows: the MFU headline workload next to
+    ResNet (ROADMAP item 2).  'pallas' runs flash attention + the fused
+    RMSNorm/LayerNorm/SoftmaxOutput kernels end-to-end through
+    Module.fit (the banked ``kernels_routed`` counters are the proof);
+    'xla' is the same protocol with MXNET_PALLAS=0.  Off-TPU the kernel
+    path runs in Pallas INTERPRET mode — a correctness/protocol row
+    whose throughput is expected to trail XLA; on chip the compiled
+    Mosaic kernels compete for real and the row carries the
+    measured-FLOPs MFU proxy the next TPU run is judged against."""
+    batch, seq_len, layers, hidden, heads, vocab, iters, warmup = \
+        _transformer_shapes(chip, smoke)
+    sps, routed, cost = _transformer_fit_rate(mode, chip, smoke)
+    row = {"metric": "transformer.train.%s" % mode,
+           "value": round(sps, 2), "unit": "samples/sec",
+           "vs_baseline": None,
+           "tokens_per_sec": round(sps * seq_len, 1),
+           "batch_size": batch, "seq_len": seq_len,
+           "num_layers": layers, "hidden": hidden, "heads": heads,
+           "vocab": vocab,
+           "kernels_routed": routed}
+    row.update(_cost_columns(cost, sps / batch, chip))
+    if mode == "pallas":
+        x_sps, _, _ = _transformer_fit_rate("xla", chip, smoke)
+        row["xla_samples_per_sec"] = round(x_sps, 2)
+        row["speedup_vs_xla"] = round(sps / x_sps, 3) if x_sps else None
+        if chip["platform"] != "tpu":
+            row["note"] = ("off-TPU the kernels run in Pallas interpret "
+                           "mode (correctness vehicle, slower than XLA "
+                           "by design); the compiled-Mosaic comparison "
+                           "needs the chip")
+    return row
+
+
+def bench_remat_batch_scaling(chip, smoke=False):
+    """Remat batch scaling: MXNET_REMAT_POLICY on the classic Executor
+    (bf16 compute, the PR 4 recipe) shrinks the residual stash the
+    split train forward keeps alive for backward — measured via
+    ``compiled.memory_analysis()`` on the SAME bound shapes, at pinned
+    loss parity over real update steps.  The residual stash scales
+    ~linearly with batch, so its reduction ratio is the batch headroom
+    the policy buys at fixed activation HBM."""
+    import mxnet_tpu as mx
+
+    _, seq_len, layers, hidden, heads, vocab, _, _ = \
+        _transformer_shapes(chip, smoke)
+    seq_len = max(seq_len, 32)
+    batches = (8, 16) if (smoke or chip["platform"] != "tpu") else (32, 64)
+    policies = ("nothing_saveable", "dots_with_no_batch_dims_saveable")
+    sym = mx.models.transformer_lm(
+        seq_len=seq_len, num_layers=layers, num_hidden=hidden,
+        num_heads=heads, vocab_size=vocab)
+
+    def bind(policy, batch):
+        # policy=None is the remat-OFF baseline: BOTH remat knobs must
+        # be absent during bind (remat config is captured there), or an
+        # ambient MXNET_REMAT_POLICY in the measuring shell would remat
+        # the baseline too and collapse the banked reduction toward 1x
+        managed = {} if policy is None else {"MXNET_REMAT_POLICY": policy}
+        with _managed_env(managed, clear=_REMAT_VARS):
+            ex = sym.simple_bind(mx.current_context(),
+                                 data=(batch, seq_len),
+                                 softmax_label=(batch, seq_len),
+                                 compute_dtype="bfloat16",
+                                 keep_dtype=("softmax_label",))
+        rs = np.random.RandomState(7)
+        for name, arr in ex.arg_dict.items():
+            if name not in ("data", "softmax_label"):
+                arr[:] = mx.nd.array(rs.uniform(-0.1, 0.1, arr.shape)
+                                     .astype("float32"))
+        return ex
+
+    def losses(ex, batch, steps=3, lr=0.1):
+        """Mean NLL per step over `steps` real SGD updates."""
+        rs = np.random.RandomState(11)
+        out = []
+        for _ in range(steps):
+            d = rs.randint(0, vocab, (batch, seq_len)).astype("float32")
+            lbl = np.roll(d, -1, axis=1)
+            ex.forward(is_train=True, data=mx.nd.array(d),
+                       softmax_label=mx.nd.array(lbl))
+            probs = ex.outputs[0].asnumpy()
+            flat = lbl.reshape(-1).astype(int)
+            nll = -np.log(np.maximum(
+                probs[np.arange(flat.size), flat], 1e-9)).mean()
+            out.append(float(nll))
+            ex.backward()
+            for name, g in ex.grad_dict.items():
+                if name not in ("data", "softmax_label"):
+                    ex.arg_dict[name][:] = \
+                        ex.arg_dict[name] - lr * g
+        return out
+
+    # the remat-off baseline is policy-independent: bind/cost/train it
+    # once per batch, not once per (policy, batch) — on TPU shapes that
+    # is several multi-second XLA compiles saved per bench run
+    base = {}
+    for batch in batches:
+        ex_off = bind(None, batch)
+        base[batch] = (ex_off.program_cost("fwd_res"),
+                       losses(ex_off, batch))
+    sweep = []
+    for policy in policies:
+        for batch in batches:
+            c_off, l_off = base[batch]
+            ex_on = bind(policy, batch)
+            c_on = ex_on.program_cost("fwd_res")
+            l_on = losses(ex_on, batch)
+            diff = max(abs(a - b) for a, b in zip(l_off, l_on))
+            sweep.append({
+                "policy": policy, "batch": batch,
+                "residual_bytes_off": c_off["output_bytes"],
+                "residual_bytes_on": c_on["output_bytes"],
+                "residual_reduction":
+                    round(c_off["output_bytes"] / c_on["output_bytes"],
+                          3),
+                "loss_max_abs_diff": round(diff, 6),
+                "loss_per_step_off": [round(x, 5) for x in l_off],
+            })
+    best = max(sweep, key=lambda c: c["residual_reduction"])
+    return {"metric": "transformer.remat_batch_scaling",
+            "value": best["residual_reduction"],
+            "unit": "x residual memory", "vs_baseline": None,
+            "best_policy": best["policy"],
+            "batch_headroom_note":
+                "the residual stash scales ~linearly with batch: a %.2fx "
+                "reduction at fixed activation HBM is ~%.2fx batch "
+                "headroom at pinned loss parity" % (
+                    best["residual_reduction"],
+                    best["residual_reduction"]),
+            "compute_dtype": "bfloat16",
+            "seq_len": seq_len, "num_layers": layers, "hidden": hidden,
+            "sweep": sweep}
 
 
 def bench_host_transfer(chip, smoke=False):
@@ -1295,6 +1548,15 @@ def main():
           smoke)
     guard("serving.latency.bf16", bench_serving_latency, "bf16", chip,
           smoke)
+    # transformer MFU headline (flash attention + the fused Pallas
+    # kernels end-to-end through Module.fit) + the remat batch-scaling
+    # row; CPU-deterministic protocol, banked as BENCH_transformer_cpu
+    guard("transformer.train.pallas", bench_transformer_train, "pallas",
+          chip, smoke)
+    guard("transformer.train.xla", bench_transformer_train, "xla", chip,
+          smoke)
+    guard("transformer.remat_batch_scaling", bench_remat_batch_scaling,
+          chip, smoke)
     guard("train.resnet-50.trainer_direct", bench_trainer_direct, iters,
           warmup, chip, smoke)
     if not smoke:  # smoke pins batch 8 — a duplicate row, skip

@@ -273,6 +273,35 @@ register_env("MXNET_SERVE_PROGRAM_CACHE", int, 32,
              "program store's LRU (one per shape bucket signature); "
              "least-recently-used executables are dropped beyond it "
              "and recompile on next use (stats count the evictions).")
+register_env("MXNET_PALLAS", str, "1",
+             "Pallas kernel dispatch at the op-lowering seam "
+             "(pallas_ops/dispatch.py): '1' (default) routes eligible "
+             "patterns (SoftmaxOutput-style loss heads, LayerNorm/"
+             "RMSNorm, DotProductAttention) to the hand-blocked Mosaic "
+             "kernels when the backend is a TPU; '0' is the escape "
+             "hatch (plain XLA lowering everywhere, bit-for-bit); '2' "
+             "forces interpret-mode kernels even off-TPU (parity tests "
+             "and make kernels-smoke).")
+register_env("MXNET_PALLAS_BLOCK_ROWS", int, 8,
+             "Row-block bound of the row-wise Pallas kernels (fused "
+             "softmax/cross-entropy, RMSNorm, LayerNorm): rows per VMEM "
+             "tile, clamped to a divisor of the row count and to the "
+             "VMEM tile budget.")
+register_env("MXNET_PALLAS_BLOCK_SEQ", int, 128,
+             "Sequence-block bound of the Pallas flash-attention "
+             "kernel (block_q/block_k); sequence lengths must tile "
+             "exactly by the clamped block for the kernel route to "
+             "qualify.")
+register_env("MXNET_REMAT_POLICY", str, "",
+             "Named jax.checkpoint rematerialization policy for train "
+             "programs (mxnet_tpu/remat.py): one of nothing_saveable, "
+             "everything_saveable, dots_saveable, "
+             "dots_with_no_batch_dims_saveable.  On the classic "
+             "Executor it selects the policy of the chunked "
+             "MXNET_BACKWARD_DO_MIRROR remat path (and activates it); "
+             "on the SPMD step program it wraps the loss under "
+             "jax.checkpoint(policy=...) and is part of the program-"
+             "cache key.  Empty disables.")
 register_env("MXNET_SERVE_DTYPE", str, "",
              "Default serving compute dtype for models registered "
              "without an explicit compute_dtype ('bfloat16' halves "

@@ -52,6 +52,7 @@ import numpy as np
 from . import engine as _engine
 from .analysis.lockcheck import make_lock
 from .base import get_env, hot_path
+from .pallas_ops import dispatch as _pallas_dispatch
 
 __all__ = ["invoke_op", "eager_call", "setitem", "copy_value",
            "stats", "reset", "configure", "enabled"]
@@ -321,9 +322,13 @@ def invoke_op(op, attrs, in_arrs, aux_arrs, is_train, rng, recording):
     # never be hit from a call where donation would be unsafe
     donate = bool(op.mutate) and not recording and _donation_ok()
     try:
+        # the Pallas dispatch fingerprint rides in the key: fcompute may
+        # LOWER differently per MXNET_PALLAS mode/blocks, and this LRU
+        # outlives env flips — a flipped knob must miss, not hit a
+        # stale lowering
         key = ("op", op.name, _attrs_key(attrs), _avals(in_arrs),
                _avals(aux_arrs), bool(is_train), rng is not None,
-               bool(recording), donate)
+               bool(recording), donate, _pallas_dispatch.fingerprint())
         hash(key)
     except (_Bypass, TypeError):
         return None

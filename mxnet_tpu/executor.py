@@ -24,8 +24,10 @@ import numpy as np
 
 from . import engine as _engine
 from . import random as _random
+from . import remat as _remat
 from .base import MXNetError, get_env
 from .ndarray import NDArray
+from .pallas_ops import dispatch as _pallas_dispatch
 
 __all__ = ["Executor"]
 
@@ -117,9 +119,15 @@ class Executor:
         # graph_executor.cc:242-331): ops whose __ctx_group__ maps to
         # distinct devices run as per-device compiled segments with
         # explicit device_put transfers at cut edges
+        # Pallas routing captured at bind, like _remat_config below: jit
+        # traces lazily, and the routing this executor lowers with must
+        # be what the env said when it was BOUND, not at first call
+        # (_eval_node re-applies it around every op lowering)
+        self._pallas_fp = _pallas_dispatch.fingerprint()
         self._stage_plan = self._build_stage_plan()
         if self._stage_plan is not None:
             self._place_arrays()
+        self._remat = self._remat_config()
         self._compile()
 
         # placeholder outputs carry the inferred shapes so output_shapes is
@@ -174,14 +182,31 @@ class Executor:
         r = jax.random.fold_in(rng, idx) if (need_rng and
                                              rng is not None) else None
         attrs = self._attr_overrides.get(id(node), node.attrs)
-        outs, upd = node.op.apply(attrs, ins, aux_in, is_train, r)
+        with _pallas_dispatch.overriding(self._pallas_fp):
+            outs, upd = node.op.apply(attrs, ins, aux_in, is_train, r)
         return outs, upd
+
+    def _remat_config(self):
+        """(active, policy) for train-mode tracing: the chunked remat
+        path runs under MXNET_BACKWARD_DO_MIRROR=1 (plain checkpoint,
+        the reference mirroring) OR whenever MXNET_REMAT_POLICY names a
+        jax.checkpoint policy — the policy then decides what each chunk
+        saves vs replays (mxnet_tpu/remat.py).  Captured at BIND time
+        (jit traces lazily; reading env at trace time would tie the
+        program to whenever the first call happens, like _donate_aux
+        this is a property of the bound executor)."""
+        policy = _remat.env_policy()
+        if policy is not None:
+            return True, policy
+        return bool(get_env("MXNET_BACKWARD_DO_MIRROR")), None
 
     def _trace(self, arg_vals, aux_vals, is_train, rng, tap=None):
         """Pure traced evaluation of the DAG."""
-        if is_train and tap is None and \
-                get_env("MXNET_BACKWARD_DO_MIRROR"):
-            return self._trace_remat(arg_vals, aux_vals, rng)
+        if is_train and tap is None:
+            remat_on, remat_policy = self._remat
+            if remat_on:
+                return self._trace_remat(arg_vals, aux_vals, rng,
+                                         policy=remat_policy)
         vals = {}
         new_aux = list(aux_vals)
         for idx, node in enumerate(self._nodes):
@@ -200,7 +225,7 @@ class Executor:
         outputs = tuple(vals[k] for k in self._head)
         return outputs, tuple(new_aux)
 
-    def _trace_remat(self, arg_vals, aux_vals, rng):
+    def _trace_remat(self, arg_vals, aux_vals, rng, policy=None):
         """Mirroring (memonger): evaluate the DAG in ~sqrt(N)-op segments,
         each wrapped in ``jax.checkpoint``, so backward stores only
         segment-boundary values and recomputes segment interiors.
@@ -209,7 +234,12 @@ class Executor:
         (graph_executor.cc:210-223, MXNET_BACKWARD_DO_MIRROR); on TPU the
         equivalent memory/compute trade is sqrt-chunked rematerialization
         — XLA frees interior activations and the backward pass replays
-        each chunk from its inputs (params are residuals either way)."""
+        each chunk from its inputs (params are residuals either way).
+
+        ``policy`` (MXNET_REMAT_POLICY, mxnet_tpu/remat.py) refines what
+        each chunk may additionally save: None is the plain mirror
+        (boundaries only); e.g. ``dots_saveable`` keeps matmul outputs
+        so only elementwise work replays."""
         import math
         nodes = self._nodes
         op_count = sum(1 for n in nodes if not n.is_variable)
@@ -309,7 +339,11 @@ class Executor:
                     upds.extend(upd)
                 return (tuple(vals[key] for key in outs_list),
                         tuple(upds))
-            return fn if has_callback else jax.checkpoint(fn)
+            if has_callback:
+                return fn
+            if policy is not None:
+                return jax.checkpoint(fn, policy=policy)
+            return jax.checkpoint(fn)
 
         live = {}
         new_aux = list(aux_vals)
@@ -918,6 +952,34 @@ class Executor:
         self._stash_advanced = False   # freshly gathered pre-step aux
         self._last_res = None  # one-shot fused program, no stash
         return self.backward(out_grads)
+
+    def program_cost(self, kind="fwd_bwd"):
+        """Compiled cost/memory analysis of one of this executor's train
+        programs at the bound shapes (``mxnet_tpu.flops.compiled_cost``).
+
+        ``kind='fwd_bwd'`` — the fused forward+backward program;
+        ``kind='fwd_res'`` — the split train forward whose OUTPUTS are
+        the vjp residual stash, so its ``output_bytes`` is the
+        activation memory held between forward and backward — the
+        number the remat policies (MXNET_REMAT_POLICY /
+        MXNET_BACKWARD_DO_MIRROR) exist to shrink.  Staged (ctx_group)
+        executors have no single program to analyze — returns None."""
+        from .flops import compiled_cost
+        if self._stage_plan is not None:
+            return None
+        arg_vals, aux_vals = self._gather()
+        rng = getattr(self, "_eval_rng", None)
+        if rng is None:
+            rng = self._eval_rng = _random.next_key()
+        if kind == "fwd_res":
+            return compiled_cost(self._jit_fwd_res, arg_vals, aux_vals,
+                                 rng)
+        if kind == "fwd_bwd":
+            ograds = tuple(None for _ in self.outputs)
+            return compiled_cost(self._jit_fwd_bwd, arg_vals, aux_vals,
+                                 rng, ograds)
+        raise MXNetError("program_cost kind must be 'fwd_bwd' or "
+                         "'fwd_res', got %r" % kind)
 
     def forward_prepare(self, **kwargs):
         for k, v in kwargs.items():

@@ -12,7 +12,8 @@ from .resnext import get_symbol as resnext
 from .inception_resnet_v2 import get_symbol as inception_resnet_v2
 from .lstm_lm import get_symbol as lstm_lm
 from .ssd import get_symbol as ssd, get_symbol_train as ssd_train
+from .transformer_lm import get_symbol as transformer_lm
 
 __all__ = ["mlp", "lenet", "resnet", "alexnet", "vgg", "inception_bn",
            "googlenet", "inception_v3", "resnext", "inception_resnet_v2",
-           "lstm_lm", "ssd", "ssd_train"]
+           "lstm_lm", "ssd", "ssd_train", "transformer_lm"]

@@ -1,0 +1,78 @@
+"""Decoder-only transformer LM as a SYMBOL graph — the train-tier
+headline for the Pallas kernel plane.
+
+The reference model zoo stops at LSTMs (its attention era hadn't
+happened); this is the workload that exercises every hot-op kernel
+end-to-end through the classic ``Module``/``DataParallelTrainer``
+machinery: causal ``DotProductAttention`` (the flash kernel), ``RMSNorm``
+on both block norms, ``LayerNorm`` on the final norm, and a
+``SoftmaxOutput`` loss head — each routed through the Pallas dispatch
+seam when eligible (``MXNET_PALLAS``), each falling back to the plain
+XLA lowering bit-for-bit when not (docs/architecture/pallas_kernels.md).
+
+Pre-norm blocks, learned projections without biases on q/k/v/proj (the
+standard decoder recipe), ReLU FFN at 4x width.  ``data`` is a
+``(batch, seq_len)`` integer token grid, ``softmax_label`` its
+next-token targets of the same shape.
+"""
+from .. import symbol as sym
+
+__all__ = ["get_symbol"]
+
+
+def _attention_block(x, seq_len, num_hidden, num_heads, name):
+    """Pre-norm causal self-attention with residual. x: (B, L, D)."""
+    head_dim = num_hidden // num_heads
+    a = sym.RMSNorm(x, name=name + "_ln1")
+    a2 = sym.Reshape(a, shape=(-1, num_hidden))
+
+    def heads(t, tag):
+        proj = sym.FullyConnected(t, num_hidden=num_hidden, no_bias=True,
+                                  name="%s_%s" % (name, tag))
+        h = sym.Reshape(proj, shape=(-1, seq_len, num_heads, head_dim))
+        return sym.transpose(h, axes=(0, 2, 1, 3))   # (B, H, L, dh)
+
+    att = sym.DotProductAttention(heads(a2, "q"), heads(a2, "k"),
+                                  heads(a2, "v"), causal=True,
+                                  name=name + "_attn")
+    att = sym.Reshape(sym.transpose(att, axes=(0, 2, 1, 3)),
+                      shape=(-1, num_hidden))
+    proj = sym.FullyConnected(att, num_hidden=num_hidden, no_bias=True,
+                              name=name + "_proj")
+    return x + sym.Reshape(proj, shape=(-1, seq_len, num_hidden))
+
+
+def _ffn_block(x, seq_len, num_hidden, name):
+    """Pre-norm ReLU FFN (4x) with residual."""
+    f = sym.RMSNorm(x, name=name + "_ln2")
+    f = sym.Reshape(f, shape=(-1, num_hidden))
+    f = sym.FullyConnected(f, num_hidden=4 * num_hidden,
+                           name=name + "_ffn1")
+    f = sym.Activation(f, act_type="relu")
+    f = sym.FullyConnected(f, num_hidden=num_hidden, name=name + "_ffn2")
+    return x + sym.Reshape(f, shape=(-1, seq_len, num_hidden))
+
+
+def get_symbol(seq_len, num_layers=2, num_hidden=64, num_heads=4,
+               vocab_size=256, **kwargs):
+    """Causal transformer LM symbol for one sequence length.
+
+    data: (batch, seq_len) token ids; softmax_label: (batch, seq_len)
+    next-token ids.  Loss head: SoftmaxOutput over the flattened
+    (batch*seq_len, vocab) logits."""
+    if num_hidden % num_heads:
+        raise ValueError("num_hidden %d must divide into num_heads %d"
+                         % (num_hidden, num_heads))
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=num_hidden,
+                      name="embed")
+    for i in range(num_layers):
+        name = "blk%d" % i
+        x = _attention_block(x, seq_len, num_hidden, num_heads, name)
+        x = _ffn_block(x, seq_len, num_hidden, name)
+    h = sym.LayerNorm(x, name="final_ln")
+    logits = sym.FullyConnected(sym.Reshape(h, shape=(-1, num_hidden)),
+                                num_hidden=vocab_size, name="pred")
+    return sym.SoftmaxOutput(logits, sym.Reshape(label, shape=(-1,)),
+                             name="softmax")

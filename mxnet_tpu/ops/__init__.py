@@ -11,6 +11,7 @@ from . import reduce        # noqa: F401
 from . import init_ops      # noqa: F401
 from . import indexing      # noqa: F401
 from . import nn            # noqa: F401
+from . import attention     # noqa: F401
 from . import softmax       # noqa: F401
 from . import ordering      # noqa: F401
 from . import sampling      # noqa: F401

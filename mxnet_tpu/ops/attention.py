@@ -1,0 +1,80 @@
+"""Attention operator: the symbol-level door to the flash kernel.
+
+No reference counterpart (its attention era was RNNs): this is the
+TPU-first hot-op surface the framework design promises.  The op lowers
+scaled-dot-product attention over ``[batch, heads, length, head_dim]``
+tensors; eligible shapes route through the Pallas dispatch seam to
+``pallas_ops/flash_attention.py`` (online-softmax, O(block) memory, the
+L×L score matrix never materializes), everything else — and
+``MXNET_PALLAS=0`` — lowers to the dense XLA computation with the SAME
+masking constant, so the two paths are numerically twins.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import Bool, Float, register
+
+_NEG = -1e30  # flash_attention._NEG: shared mask constant for parity
+
+
+def _dense_attention(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = q.shape[2], k.shape[2]
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _attn_fc(attrs, query, key, value):
+    if query.ndim != 4:
+        raise MXNetError("DotProductAttention expects [batch, heads, "
+                         "length, head_dim] inputs, got ndim=%d"
+                         % query.ndim)
+    causal = attrs["causal"]
+    scale = attrs["scale"]
+    if scale <= 0.0:
+        scale = 1.0 / (query.shape[-1] ** 0.5)
+    b, h, lq, d = query.shape
+    lk = key.shape[2]
+    from ..pallas_ops import dispatch as _pd
+    if _pd.use_attention("DotProductAttention", b, h, lq, lk, d,
+                         query.dtype):
+        from ..pallas_ops import flash_attention
+        bs = _pd.block_seq()
+        return flash_attention(query, key, value, causal=causal,
+                               scale=scale, block_q=bs, block_k=bs,
+                               interpret=_pd.interpret_mode())
+    return _dense_attention(query, key, value, causal, scale)
+
+
+def _attn_infer(attrs, in_shapes):
+    qs, ks, vs = in_shapes
+    known = qs or ks or vs
+    if known is not None:
+        for i in range(3):
+            if in_shapes[i] is None:
+                in_shapes[i] = known
+    return in_shapes, [in_shapes[0]], []
+
+
+register("DotProductAttention", fcompute=_attn_fc,
+         arguments=("query", "key", "value"),
+         attrs={"causal": Bool(False, doc="apply a lower-triangular "
+                                          "mask: position q attends "
+                                          "only to keys k <= q"),
+                "scale": Float(0.0, doc="score scale; <= 0 selects "
+                                        "1/sqrt(head_dim)")},
+         infer_shape=_attn_infer,
+         doc="Scaled dot-product attention over [batch, heads, length, "
+             "head_dim]; scale<=0 means 1/sqrt(head_dim).  Eligible "
+             "shapes run the Pallas flash-attention kernel (online "
+             "softmax, no L×L score tensor); others lower to dense "
+             "XLA attention (docs/architecture/pallas_kernels.md).")

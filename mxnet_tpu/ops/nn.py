@@ -419,6 +419,100 @@ register("LRN", fcompute=_lrn_fc,
 
 
 # ---------------------------------------------------------------------------
+# LayerNorm / RMSNorm (transformer-era norms; LayerNorm mirrors the
+# reference's layer_norm.cc signature, RMSNorm is the TPU-native sibling).
+# Both route through the Pallas dispatch seam: last-axis normalization of
+# an eligible shape runs as ONE fused VMEM-blocked kernel forward and
+# backward (pallas_ops/norm.py, custom_vjp); anything else — and
+# MXNET_PALLAS=0 — takes the plain XLA lowering below, which jax
+# autodiff differentiates.
+# ---------------------------------------------------------------------------
+def _norm_axis(attrs, ndim):
+    ax = attrs["axis"]
+    return ax + ndim if ax < 0 else ax
+
+
+def _rows_width(shape):
+    rows = 1
+    for d in shape[:-1]:
+        rows *= d
+    return rows, shape[-1]
+
+
+def _ln_fc(attrs, data, gamma, beta):
+    ax = _norm_axis(attrs, data.ndim)
+    eps = attrs["eps"]
+    if ax == data.ndim - 1:
+        from ..pallas_ops import dispatch as _pd
+        from ..pallas_ops import norm as _pn
+        rows, width = _rows_width(data.shape)
+        if _pd.use_rowwise("LayerNorm", rows, width, data.dtype):
+            out = _pn.layer_norm(
+                data.reshape(rows, width), gamma, beta, eps,
+                _pd.row_block_for(rows, width), _pd.interpret_mode())
+            return out.reshape(data.shape)
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    bshape = [1] * data.ndim
+    bshape[ax] = data.shape[ax]
+    xhat = (data - mean) * jax.lax.rsqrt(var + eps)
+    return xhat * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+def _ln_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    ax = _norm_axis(attrs, len(ds))
+    in_shapes[1] = (ds[ax],)
+    in_shapes[2] = (ds[ax],)
+    return in_shapes, [ds], []
+
+
+register("LayerNorm", fcompute=_ln_fc,
+         arguments=("data", "gamma", "beta"),
+         attrs={"axis": Int(-1), "eps": Float(1e-5)},
+         infer_shape=_ln_infer,
+         doc="Layer normalization over `axis` with affine gamma/beta "
+             "(reference src/operator/nn/layer_norm.cc).  Last-axis "
+             "instances route to the fused Pallas kernel when eligible "
+             "(docs/architecture/pallas_kernels.md).")
+
+
+def _rms_fc(attrs, data, gamma):
+    eps = attrs["eps"]
+    from ..pallas_ops import dispatch as _pd
+    from ..pallas_ops import norm as _pn
+    rows, width = _rows_width(data.shape)
+    if _pd.use_rowwise("RMSNorm", rows, width, data.dtype):
+        out = _pn.rms_norm(data.reshape(rows, width), gamma, eps,
+                           _pd.row_block_for(rows, width),
+                           _pd.interpret_mode())
+        return out.reshape(data.shape)
+    r = jax.lax.rsqrt(jnp.mean(jnp.square(data), axis=-1,
+                               keepdims=True) + eps)
+    return data * r * gamma
+
+
+def _rms_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None], []
+    in_shapes[1] = (ds[-1],)
+    return in_shapes, [ds], []
+
+
+register("RMSNorm", fcompute=_rms_fc,
+         arguments=("data", "gamma"),
+         attrs={"eps": Float(1e-6)},
+         infer_shape=_rms_infer,
+         doc="Root-mean-square normalization over the last axis scaled "
+             "by gamma (no reference counterpart — the transformer-era "
+             "norm).  Routes to the fused Pallas kernel when eligible "
+             "(docs/architecture/pallas_kernels.md).")
+
+
+# ---------------------------------------------------------------------------
 # InstanceNorm / L2Normalization
 # ---------------------------------------------------------------------------
 def _in_fc(attrs, data, gamma, beta):

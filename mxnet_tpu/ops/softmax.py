@@ -67,7 +67,8 @@ def _softmax_output_fwd(cfg, data, label):
 
 
 def _softmax_output_bwd(cfg, res, g):
-    grad_scale, ignore_label, multi_output, use_ignore, _, normalization = cfg
+    (grad_scale, ignore_label, multi_output, use_ignore, _,
+     normalization, out_grad, smooth_alpha) = cfg
     prob, label = res
     if multi_output:
         # data: (n, c, d1...), label: (n, prod(d1...)) or (n, d1...);
@@ -82,7 +83,16 @@ def _softmax_output_bwd(cfg, res, g):
         onehot = jax.nn.one_hot(label.astype(jnp.int32), num_class,
                                 dtype=prob.dtype)
         onehot = onehot.reshape(prob.shape)
+    if smooth_alpha:
+        # label smoothing (reference softmax_output-inl.h): the target
+        # row becomes 1 - alpha, the other k-1 classes alpha / (k - 1)
+        onehot = (onehot * (1.0 - smooth_alpha)
+                  + (1.0 - onehot) * (smooth_alpha / (num_class - 1)))
     grad = prob - onehot
+    if out_grad:
+        # out_grad=True: SoftmaxOutput stops being an implicit-loss head
+        # and scales its gradient by the incoming output cotangent
+        grad = grad * g
     if use_ignore:
         if multi_output:
             mask = (lbl != ignore_label).astype(prob.dtype)
@@ -107,7 +117,31 @@ _softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
 def _softmax_output_fc(attrs, data, label):
     cfg = (attrs["grad_scale"], attrs["ignore_label"], attrs["multi_output"],
            attrs["use_ignore"], attrs["preserve_shape"],
-           attrs["normalization"])
+           attrs["normalization"], attrs["out_grad"],
+           attrs["smooth_alpha"])
+    # Pallas kernel route (pallas_ops/dispatch.py): the plain 2D loss
+    # head — forward softmax and the implicit (p - onehot) * scale
+    # backward each as ONE VMEM-blocked kernel.  The decorated configs
+    # (multi_output / ignore / label smoothing / out_grad) keep the XLA
+    # custom_vjp lowering; MXNET_PALLAS=0 keeps it for everything.
+    from ..pallas_ops import dispatch as _pd
+    from ..pallas_ops import softmax_xent as _px
+    if (data.ndim == 2 and label.ndim == 1
+            and not attrs["multi_output"] and not attrs["use_ignore"]
+            and not attrs["preserve_shape"] and not attrs["out_grad"]
+            and attrs["smooth_alpha"] == 0.0
+            and attrs["normalization"] in ("null", "batch", "valid")
+            and _pd.use_rowwise("SoftmaxOutput", data.shape[0],
+                                data.shape[1], data.dtype)):
+        scale = attrs["grad_scale"]
+        if attrs["normalization"] in ("batch", "valid"):
+            # without use_ignore, valid-normalization divides by
+            # label.size == rows for a 2D head (see _softmax_output_bwd)
+            scale = scale / data.shape[0]
+        return _px.softmax_output_head(
+            data, label, scale,
+            _pd.row_block_for(data.shape[0], data.shape[1]),
+            _pd.interpret_mode())
     return _softmax_output(cfg, data, label)
 
 
@@ -234,6 +268,19 @@ register("SVMOutput",
 # softmax_cross_entropy (reference loss_binary_op.cc)
 # ---------------------------------------------------------------------------
 def _sce_fc(attrs, data, label):
+    # Pallas route: per-row logsumexp(x) - x[label] kernel — the
+    # probability tensor is never materialized in either pass
+    # (pallas_ops/softmax_xent.softmax_xent_loss)
+    from ..pallas_ops import dispatch as _pd
+    from ..pallas_ops import softmax_xent as _px
+    if (data.ndim == 2 and label.ndim == 1
+            and _pd.use_rowwise("softmax_cross_entropy", data.shape[0],
+                                data.shape[1], data.dtype)):
+        loss = _px.softmax_xent_loss(
+            data, label,
+            _pd.row_block_for(data.shape[0], data.shape[1]),
+            _pd.interpret_mode())
+        return jnp.sum(loss).astype(data.dtype).reshape(1)
     logp = jax.nn.log_softmax(data, axis=-1)
     onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1],
                             dtype=data.dtype)

@@ -2,10 +2,25 @@
 
 The XLA compiler fuses the vast majority of what the reference hand-wrote
 in CUDA (SURVEY.md §2.2 TPU mapping note); these kernels cover the cases
-where explicit VMEM blocking beats XLA's default schedule — starting with
-flash attention (the quadratic-memory softmax-attention pattern XLA will
-not re-block on its own).
+where explicit VMEM blocking beats XLA's default schedule:
+
+* ``flash_attention``  — online-softmax attention (the quadratic-memory
+  pattern XLA will not re-block on its own);
+* ``softmax_xent``     — fused softmax / softmax-cross-entropy loss
+  heads (forward never materializes the probability tensor);
+* ``norm``             — fused RMSNorm / LayerNorm, forward and backward
+  each one VMEM trip.
+
+``dispatch`` is the routing seam: eligible op lowerings (the registry
+``fcompute`` layer every execution plane traces through) ask it whether
+to use the kernel or the plain XLA lowering — ``MXNET_PALLAS=0`` is the
+escape hatch (docs/architecture/pallas_kernels.md).
 """
 from .flash_attention import flash_attention
+from .norm import layer_norm, rms_norm
+from .softmax_xent import (fused_softmax, softmax_output_head,
+                           softmax_xent_loss)
+from . import dispatch
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_softmax", "softmax_output_head",
+           "softmax_xent_loss", "rms_norm", "layer_norm", "dispatch"]

@@ -1,0 +1,230 @@
+"""Kernel dispatch seam: route eligible op lowerings to Pallas kernels.
+
+The op registry's ``fcompute`` functions ARE the op-lowering layer — the
+symbolic Executor, the SPMD step program and the imperative cached-op
+path all trace through them — so this one seam covers every execution
+plane.  An eligible op pattern (SoftmaxOutput-style loss heads, norm
+layers, attention) asks :func:`use_rowwise` / :func:`use_attention` at
+trace time; a ``True`` answer routes the lowering to the hand-blocked
+kernel (``softmax_xent.py`` / ``norm.py`` / ``flash_attention.py``),
+``False`` keeps the plain XLA lowering.
+
+``MXNET_PALLAS`` modes:
+
+* ``1`` (default, "auto") — kernels compile via Mosaic when the backend
+  is a TPU; every other backend keeps the plain XLA lowering (interpret
+  mode is orders of magnitude slower than compiled XLA on CPU, so it is
+  never routed to implicitly);
+* ``0`` — escape hatch: plain XLA lowering everywhere, bit-for-bit the
+  pre-kernel-plane behavior (pinned by tests/test_pallas_kernels.py);
+* ``2`` ("force") — route eligible patterns in interpret mode even
+  off-TPU: the parity tests and ``make kernels-smoke`` run the real
+  kernel bodies on CPU this way.
+
+Eligibility is static (shapes/dtypes only), so a routing decision is a
+property of the traced program.  Programs are cached across the
+codebase; every cache that can outlive an env flip carries
+:func:`fingerprint` in its key (cached_op LRU, SPMD program LRU).
+``jax.jit`` traces LAZILY (at first call, not at jit() time), so a
+program built under one env and first called under another would
+silently trace with the wrong routing; long-lived program holders
+(the Executor, the SPMD step) therefore capture :func:`fingerprint`
+when they are CREATED and re-apply it around their traced bodies with
+:func:`overriding` — the routing a caller configured at bind time is
+the routing the program lowers with, whenever tracing happens.
+Rebinding after a flip re-decides.
+
+``dispatch_stats()`` counts routes per op kind at trace time; the bench
+rows bank them so an artifact claiming "kernels end-to-end" carries the
+proof.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..base import get_env
+from .flash_attention import _on_tpu
+
+__all__ = ["mode", "kernels_active", "interpret_mode", "block_rows",
+           "block_seq", "fingerprint", "overriding", "use_rowwise",
+           "use_attention", "eligible_rowwise", "eligible_attention",
+           "dispatch_stats", "reset_dispatch_stats"]
+
+MODE_OFF, MODE_AUTO, MODE_FORCE = 0, 1, 2
+
+# bind-time fingerprint re-applied around a traced body (tracing is
+# synchronous in the calling thread, so a threadlocal carries it)
+_override = threading.local()
+
+
+@contextlib.contextmanager
+def overriding(fp):
+    """Pin routing to a captured ``fingerprint()`` for the duration of
+    the block: ``mode``/``block_rows``/``block_seq`` (and everything
+    built on them) answer from ``fp`` instead of the live environment.
+    Long-lived program holders wrap their traced bodies in this so lazy
+    tracing lowers with the routing captured when the program was
+    created, not whatever the env says at first-call time.  No-op for
+    ``fp=None``."""
+    if fp is None:
+        yield
+        return
+    prev = getattr(_override, "fp", None)
+    _override.fp = fp
+    try:
+        yield
+    finally:
+        _override.fp = prev
+
+# one (block_rows, width) fp32 tile must fit VMEM (~16 MB/core) with
+# headroom for the kernel's other operands and Mosaic's double buffering
+_VMEM_TILE_BUDGET = 4 * 1024 * 1024
+_FLOAT_DTYPES = ("float32", "bfloat16", "float16")
+
+
+def mode():
+    """0 = off (escape hatch), 1 = auto (TPU only), 2 = force-interpret."""
+    fp = getattr(_override, "fp", None)
+    if fp is not None:
+        return fp[0]
+    raw = str(get_env("MXNET_PALLAS")).strip().lower()
+    if raw in ("0", "off", "false"):
+        return MODE_OFF
+    if raw in ("2", "force", "interpret"):
+        return MODE_FORCE
+    return MODE_AUTO
+
+
+def kernels_active():
+    """Would an eligible pattern route to a Pallas kernel right now?"""
+    m = mode()
+    if m == MODE_OFF:
+        return False
+    if m == MODE_FORCE:
+        return True
+    return _on_tpu()
+
+
+def interpret_mode():
+    """Interpret (True) vs compiled Mosaic (False) for a routed kernel —
+    flash_attention's auto rule: compiled on TPU, interpret elsewhere."""
+    return not _on_tpu()
+
+
+def block_rows():
+    """Row-block bound for the row-wise kernels (softmax/xent/norms)."""
+    fp = getattr(_override, "fp", None)
+    if fp is not None:
+        return fp[1]
+    return max(1, int(get_env("MXNET_PALLAS_BLOCK_ROWS") or 8))
+
+
+def block_seq():
+    """Q/K sequence-block bound for the attention kernel."""
+    fp = getattr(_override, "fp", None)
+    if fp is not None:
+        return fp[2]
+    return max(8, int(get_env("MXNET_PALLAS_BLOCK_SEQ") or 128))
+
+
+def row_block_for(rows, width):
+    """Row-block bound for a (rows, width) kernel launch: the configured
+    bound shrunk until one fp32 tile fits the VMEM budget (the kernels
+    further clamp to a divisor of ``rows`` via ``row_block``)."""
+    bound = block_rows()
+    while bound > 1 and bound * int(width) * 4 > _VMEM_TILE_BUDGET:
+        bound //= 2
+    return bound
+
+
+def fingerprint():
+    """Hashable routing identity for program caches that can outlive an
+    env flip: (mode, block overrides).  Two calls tracing under
+    different fingerprints may lower differently and must not share a
+    compiled program."""
+    return (mode(), block_rows(), block_seq())
+
+
+# ---------------------------------------------------------------------------
+# Eligibility (static shape/dtype rules — docs/architecture/pallas_kernels.md)
+# ---------------------------------------------------------------------------
+def eligible_rowwise(rows, width, dtype):
+    """May a (rows, width) row-wise pattern run as a VMEM-blocked kernel?
+
+    * floating dtype the MXU/VPU handles (fp32/bf16/fp16);
+    * width >= 2 (degenerate single-class rows stay with XLA);
+    * one fp32 tile within the VMEM budget at SOME divisor block size
+      (row_block degrades the block, so rows never disqualify);
+    * compiled Mosaic additionally wants the lane dimension aligned:
+      width % 128 == 0 off-interpret (interpret mode takes any width).
+    """
+    if str(dtype) not in _FLOAT_DTYPES:
+        return False
+    rows, width = int(rows), int(width)
+    if rows < 1 or width < 2:
+        return False
+    if width * 4 > _VMEM_TILE_BUDGET:  # even a 1-row tile would not fit
+        return False
+    if not interpret_mode() and width % 128 != 0:
+        return False
+    return True
+
+
+def eligible_attention(b, h, lq, lk, d, dtype):
+    """May a [B, H, L, D] attention pattern run as the flash kernel?
+
+    Sequence lengths must tile exactly by the (clamped) block size —
+    flash_attention asserts divisibility; head dim is kept within one
+    VMEM-friendly tile.
+    """
+    if str(dtype) not in _FLOAT_DTYPES:
+        return False
+    bs = block_seq()
+    for length in (int(lq), int(lk)):
+        if length < 1 or length % min(bs, length) != 0:
+            return False
+    if int(d) < 1 or int(d) > 512:
+        return False
+    return int(b) >= 1 and int(h) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Routing decisions (+ trace-time counters, banked by the bench rows)
+# ---------------------------------------------------------------------------
+_stats_lock = threading.Lock()
+_stats: dict = {}
+
+
+def _note(kind):
+    with _stats_lock:
+        _stats[kind] = _stats.get(kind, 0) + 1
+
+
+def dispatch_stats():
+    """{op kind: times routed to a Pallas kernel at trace time}."""
+    with _stats_lock:
+        return dict(_stats)
+
+
+def reset_dispatch_stats():
+    with _stats_lock:
+        _stats.clear()
+
+
+def use_rowwise(kind, rows, width, dtype):
+    """Route decision for a row-wise pattern; counts a route when taken."""
+    if not kernels_active() or not eligible_rowwise(rows, width, dtype):
+        return False
+    _note(kind)
+    return True
+
+
+def use_attention(kind, b, h, lq, lk, d, dtype):
+    """Route decision for an attention pattern; counts a route when
+    taken."""
+    if not kernels_active() or not eligible_attention(b, h, lq, lk, d,
+                                                      dtype):
+        return False
+    _note(kind)
+    return True

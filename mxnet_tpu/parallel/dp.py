@@ -383,6 +383,28 @@ class DataParallelTrainer:
         self._hyper_cache = (key, dev)
         return dev
 
+    def step_cost_analysis(self, data, label=None):
+        """Compiled cost/memory analysis of THE fused step at this
+        trainer's shapes (``mxnet_tpu.flops.compiled_cost``): model
+        FLOPs per step from XLA's own ``cost_analysis()`` — the honest
+        numerator for an MFU claim — plus the program's temp/argument
+        bytes.  ``lower().compile()`` does not reuse the warmed jit
+        executable: this pays one fresh XLA compile, so call it once
+        per configuration as a diagnostic, never per step."""
+        from ..flops import compiled_cost
+        batch = dict(data) if isinstance(data, dict) else \
+            {self.data_names[0]: data}
+        if label is not None:
+            if isinstance(label, dict):
+                batch.update(label)
+            else:
+                batch[self.label_names[0]] = label
+        batch = self._shard_batch(batch)
+        lrs, wds = self._host_hyper()
+        return compiled_cost(self._train_step, self.params,
+                             self.opt_state, self.aux, batch, lrs, wds,
+                             self._carry_rng())
+
     def predict(self, data, rng=None):
         batch = dict(data) if isinstance(data, dict) else \
             {self.data_names[0]: data}

@@ -42,6 +42,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..analysis.lockcheck import make_lock
 from ..base import get_env
+from .. import remat as _remat
+from ..pallas_ops import dispatch as _pallas_dispatch
 from .ingraph_opt import InGraphOptimizer, ingraph_fingerprint
 
 __all__ = ["StepProgram", "get_step_program", "spmd_enabled",
@@ -147,7 +149,7 @@ class StepProgram:
 
 def _build_program(key, symbol, mesh, data_shapes, label_shapes, dtype,
                    compute_dtype, optimizer, fixed_params, zero1,
-                   param_shardings):
+                   param_shardings, remat_policy=None):
     """Trace + jit the fused step for one cache key (the program body
     formerly private to ``DataParallelTrainer._compile``)."""
     from ..executor import shape_overrides
@@ -173,6 +175,11 @@ def _build_program(key, symbol, mesh, data_shapes, label_shapes, dtype,
                       getattr(n.op, "rng_at_eval", False) for n in nodes)
     overrides = shape_overrides(symbol, arg_shapes)
 
+    # Pallas routing pinned to the fingerprint this program is KEYED on:
+    # jit traces lazily, and a flip between get_step_program and the
+    # first step must not lower the program differently from its key
+    pallas_fp = _pallas_dispatch.fingerprint()
+
     def trace(args_map, aux_map, rng, is_train):
         vals = {}
         new_aux = dict(aux_map)
@@ -187,9 +194,10 @@ def _build_program(key, symbol, mesh, data_shapes, label_shapes, dtype,
                            for n, oi in node.aux_inputs())
             r = jax.random.fold_in(rng, idx) \
                 if (node.op.needs_rng or node.op.stateful) else None
-            outs, upd = node.op.apply(
-                overrides.get(id(node), node.attrs), ins, aux_in,
-                is_train, r)
+            with _pallas_dispatch.overriding(pallas_fp):
+                outs, upd = node.op.apply(
+                    overrides.get(id(node), node.attrs), ins, aux_in,
+                    is_train, r)
             for oi, o in enumerate(outs):
                 vals[(id(node), oi)] = o
             for (an, _), u in zip(node.aux_inputs(), upd):
@@ -235,6 +243,14 @@ def _build_program(key, symbol, mesh, data_shapes, label_shapes, dtype,
                        for k, v in new_aux.items()}
             return outs, new_aux
 
+        if remat_policy is not None:
+            # MXNET_REMAT_POLICY (mxnet_tpu/remat.py): the whole loss
+            # closure runs under jax.checkpoint with the named policy —
+            # the backward replays everything the policy declines to
+            # save, trading step FLOPs for activation HBM so batch (the
+            # other MFU lever) can scale.  The policy name is part of
+            # this program's cache key.
+            f = jax.checkpoint(f, policy=remat_policy)
         outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
         cots = tuple(jnp.ones_like(o) for o in outs)
         grads = vjp(cots)[0]
@@ -375,17 +391,24 @@ def get_step_program(symbol, mesh, data_shapes, label_shapes=None,
         param_shardings = {n: replicated
                            for n in symbol.list_arguments()}
     fixed = tuple(sorted(fixed_params))
+    # trace-time environment that changes what the step LOWERS to must
+    # ride in the key: the remat policy (what the backward saves) and
+    # the Pallas dispatch fingerprint (which op lowerings route to
+    # kernels) — a flipped knob gets its own program, never a stale hit
+    remat_name = _remat.env_policy_name()
     key = ("spmd_step", _symbol_fingerprint(symbol), mesh_fingerprint(mesh),
            _shapes_key(data_shapes), _shapes_key(label_shapes),
            str(dtype), str(compute_dtype) if compute_dtype else None,
            ingraph_fingerprint(optimizer), fixed,
            bool(shard_optimizer_state), _shardings_key(param_shardings),
-           bool(symbol.has_custom_ops()))
+           bool(symbol.has_custom_ops()), remat_name,
+           _pallas_dispatch.fingerprint())
 
     def build():
         return _build_program(key, symbol, mesh, data_shapes, label_shapes,
                               dtype, compute_dtype, optimizer, fixed,
-                              bool(shard_optimizer_state), param_shardings)
+                              bool(shard_optimizer_state), param_shardings,
+                              remat_policy=_remat.resolve(remat_name))
 
     if not spmd_enabled():
         return build()

@@ -1,0 +1,84 @@
+"""Rematerialization policy seam (``MXNET_REMAT_POLICY``).
+
+Batch size is the second MFU lever after kernel quality, and activation
+memory is what caps it.  ``MXNET_BACKWARD_DO_MIRROR`` (the reference's
+memonger, graph_executor.cc:210-223) already trades compute for memory by
+replaying ~sqrt(N)-op chunks under plain ``jax.checkpoint``; this module
+generalizes that seam to JAX's *named* checkpoint policies so the
+save/recompute split is tunable per workload:
+
+* ``nothing_saveable``    — chunk boundaries only (plain mirror);
+* ``everything_saveable`` — remat structurally present but saving all
+  (a no-op baseline for A/B);
+* ``dots_saveable``       — matmul outputs saved, elementwise replayed;
+* ``dots_with_no_batch_dims_saveable`` — only batch-free matmuls
+  (weight-stationary contractions) saved: activations replayed, the
+  policy of choice for batch scaling.
+
+Two consumers:
+
+* the classic :class:`~mxnet_tpu.executor.Executor` — a set policy
+  activates the chunked remat path with ``jax.checkpoint(policy=...)``
+  per chunk (``MXNET_MIRROR_SEGMENT`` still sizes the chunks);
+* the SPMD step program (``parallel/spmd.py``) — the loss closure is
+  wrapped whole under the policy, and the policy name is part of the
+  program-cache key (two policies never share a compiled step).
+
+The policy changes WHAT the backward saves, never what it computes:
+loss trajectories are parity-pinned in tests/test_remat_policy.py, and
+the bench row ``transformer.remat_batch_scaling`` banks the residual
+memory reduction via ``compiled.memory_analysis()``.
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import MXNetError, get_env
+
+__all__ = ["policy_names", "resolve", "env_policy_name", "env_policy"]
+
+_POLICIES = {
+    "nothing_saveable": "nothing_saveable",
+    "everything_saveable": "everything_saveable",
+    "dots_saveable": "dots_saveable",
+    "checkpoint_dots": "dots_saveable",  # jax's historical alias
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+
+def policy_names():
+    """Accepted ``MXNET_REMAT_POLICY`` values."""
+    return sorted(_POLICIES)
+
+
+def resolve(name):
+    """Named policy -> jax.checkpoint_policies callable (None for '')."""
+    if not name:
+        return None
+    key = str(name).strip().lower()
+    attr = _POLICIES.get(key)
+    if attr is None:
+        raise MXNetError(
+            "unknown MXNET_REMAT_POLICY %r; valid: %s"
+            % (name, ", ".join(policy_names())))
+    return getattr(jax.checkpoint_policies, attr)
+
+
+def env_policy_name():
+    """Canonical policy name from MXNET_REMAT_POLICY ('' when unset).
+
+    Canonicalized through the alias table so two spellings of one
+    policy share cached programs."""
+    raw = str(get_env("MXNET_REMAT_POLICY") or "").strip().lower()
+    if not raw:
+        return ""
+    if raw not in _POLICIES:
+        raise MXNetError(
+            "unknown MXNET_REMAT_POLICY %r; valid: %s"
+            % (raw, ", ".join(policy_names())))
+    return _POLICIES[raw]
+
+
+def env_policy():
+    """Resolved policy callable from the environment (None when unset)."""
+    return resolve(env_policy_name())

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, get_env, hot_path
+from ..pallas_ops import dispatch as _pallas_dispatch
 
 __all__ = ["ProgramStore", "bucket_edges", "bucket_for"]
 
@@ -272,8 +273,14 @@ class ProgramStore:
         sig = tuple((n, (bucket,) + self._input_tails[n],
                      str(self._input_dtypes[n]))
                     for n in self._input_names)
+        # the Pallas dispatch fingerprint rides in the key like in the
+        # cached-op and SPMD program caches: bucket forwards trace
+        # through the op-lowering seam, and this LRU outlives an
+        # MXNET_PALLAS flip — the escape hatch must recompile, not
+        # serve the stale lowering
         return ("serve", self.name, bucket, sig,
-                str(self._cdt) if self._cdt is not None else None)
+                str(self._cdt) if self._cdt is not None else None,
+                _pallas_dispatch.fingerprint())
 
     def _build_forward(self, bucket):
         """Pure ``fwd(params, aux, inputs)`` for one bucket: the

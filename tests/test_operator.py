@@ -250,6 +250,37 @@ def test_softmax_output_backward():
         grad_req={"data": "write", "label": "null"})
 
 
+def test_softmax_output_smooth_alpha_backward():
+    # label smoothing (reference softmax_output-inl.h): target row
+    # 1 - alpha, the other k-1 classes alpha / (k - 1)
+    n, c, alpha = 4, 3, 0.2
+    x = np.random.randn(n, c).astype("float32")
+    label = np.array([0, 1, 2, 1], dtype="float32")
+    onehot = _onehot(label, c)
+    smoothed = onehot * (1 - alpha) + (1 - onehot) * (alpha / (c - 1))
+    s = sym.SoftmaxOutput(sym.Variable("data"), sym.Variable("label"),
+                          smooth_alpha=alpha, name="sm")
+    check_symbolic_backward(
+        s, {"data": x, "label": label}, None,
+        {"data": _softmax(x) - smoothed}, rtol=1e-4,
+        grad_req={"data": "write", "label": "null"})
+
+
+def test_softmax_output_out_grad_backward():
+    # out_grad=True drops the implicit-loss contract: the gradient is
+    # scaled elementwise by the incoming output cotangent
+    n, c = 4, 3
+    x = np.random.randn(n, c).astype("float32")
+    label = np.array([0, 1, 2, 1], dtype="float32")
+    og = np.full((n, c), 2.0, dtype="float32")
+    s = sym.SoftmaxOutput(sym.Variable("data"), sym.Variable("label"),
+                          out_grad=True, name="sm")
+    check_symbolic_backward(
+        s, {"data": x, "label": label}, [og],
+        {"data": (_softmax(x) - _onehot(label, c)) * og}, rtol=1e-4,
+        grad_req={"data": "write", "label": "null"})
+
+
 def _softmax(x):
     e = np.exp(x - x.max(axis=-1, keepdims=True))
     return e / e.sum(axis=-1, keepdims=True)

@@ -1,0 +1,222 @@
+"""Remat policy seam (MXNET_REMAT_POLICY, mxnet_tpu/remat.py).
+
+The policy changes WHAT the backward saves, never what it computes:
+numerics are parity-pinned on both planes (classic Executor chunked
+remat, SPMD step program), the residual-memory reduction is measured
+via ``compiled.memory_analysis()``, and the SPMD program cache keys on
+the policy so two policies never share a compiled step."""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import remat
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import DataParallelTrainer, make_mesh, spmd
+from mxnet_tpu.test_utils import fetch_sync, smoke_mlp
+
+
+def _deep_mlp(layers=6, hidden=128, classes=32):
+    h = mx.sym.Variable("data")
+    for i in range(layers):
+        h = mx.sym.Activation(
+            mx.sym.FullyConnected(h, num_hidden=hidden, name="fc%d" % i),
+            act_type="tanh")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=classes, name="head"),
+        name="softmax")
+
+
+def _bind(monkeypatch, policy, sym=None, batch=64, feat=128):
+    if policy is None:
+        monkeypatch.delenv("MXNET_REMAT_POLICY", raising=False)
+    else:
+        monkeypatch.setenv("MXNET_REMAT_POLICY", policy)
+    ex = (sym or _deep_mlp()).simple_bind(
+        mx.cpu(), data=(batch, feat), softmax_label=(batch,))
+    monkeypatch.delenv("MXNET_REMAT_POLICY", raising=False)
+    return ex
+
+
+def _seed_params(ex):
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = mx.nd.array(np.random.RandomState(
+                abs(hash(name)) % 2 ** 31).uniform(
+                    -0.1, 0.1, arr.shape).astype("float32"))
+
+
+def _train_step(ex, seed=0):
+    rs = np.random.RandomState(seed)
+    d = rs.randn(*ex.arg_dict["data"].shape).astype("float32")
+    lbl = rs.randint(0, ex.outputs[0].shape[-1],
+                     ex.arg_dict["softmax_label"].shape).astype("float32")
+    ex.forward(is_train=True, data=mx.nd.array(d),
+               softmax_label=mx.nd.array(lbl))
+    grads = ex.backward()
+    return [ex.outputs[0].asnumpy()] + [g.asnumpy() for g in grads]
+
+
+# ---------------------------------------------------------------------------
+# Resolution
+# ---------------------------------------------------------------------------
+def test_policy_resolution():
+    assert remat.resolve("") is None
+    for name in remat.policy_names():
+        assert remat.resolve(name) is not None
+    # alias canonicalizes (shared program-cache keys across spellings)
+    assert remat.resolve("checkpoint_dots") is \
+        jax.checkpoint_policies.dots_saveable
+    with pytest.raises(MXNetError):
+        remat.resolve("save_the_whales")
+
+
+def test_env_policy_name_canonical(monkeypatch):
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "checkpoint_dots")
+    assert remat.env_policy_name() == "dots_saveable"
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "bogus")
+    with pytest.raises(MXNetError):
+        remat.env_policy_name()
+
+
+# ---------------------------------------------------------------------------
+# Classic Executor: residual shrink + numerics parity
+# ---------------------------------------------------------------------------
+def test_executor_policy_shrinks_residual_stash(monkeypatch):
+    """The split train forward's OUTPUTS are the vjp residual stash;
+    nothing_saveable (chunk boundaries only) must shrink it measurably
+    — this is the memory the policy exists to reclaim."""
+    ex_off = _bind(monkeypatch, None)
+    ex_on = _bind(monkeypatch, "nothing_saveable")
+    c_off = ex_off.program_cost("fwd_res")
+    c_on = ex_on.program_cost("fwd_res")
+    assert c_off and c_on
+    ratio = c_off["output_bytes"] / c_on["output_bytes"]
+    assert ratio > 1.2, (c_off, c_on)
+
+
+@pytest.mark.parametrize("policy", ["nothing_saveable", "dots_saveable",
+                                    "dots_with_no_batch_dims_saveable",
+                                    "everything_saveable"])
+def test_executor_policy_numerics_parity(monkeypatch, policy):
+    ex_ref = _bind(monkeypatch, None)
+    ex_pol = _bind(monkeypatch, policy)
+    _seed_params(ex_ref)
+    _seed_params(ex_pol)
+    ref = _train_step(ex_ref)
+    got = _train_step(ex_pol)
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_policy_composes_with_mirror_segment(monkeypatch):
+    """MXNET_MIRROR_SEGMENT still sizes the chunks when a policy is
+    active; numerics stay pinned."""
+    monkeypatch.setenv("MXNET_MIRROR_SEGMENT", "2")
+    ex_ref = _bind(monkeypatch, None)
+    ex_pol = _bind(monkeypatch, "dots_saveable")
+    _seed_params(ex_ref)
+    _seed_params(ex_pol)
+    for a, b in zip(_train_step(ex_pol), _train_step(ex_ref)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_mirror_without_policy_unchanged(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR=1 alone keeps the plain-checkpoint
+    chunked path (policy None) — the pre-seam behavior."""
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    ex = _deep_mlp().simple_bind(mx.cpu(), data=(64, 128),
+                                 softmax_label=(64,))
+    assert ex._remat == (True, None)
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR")
+    ex2 = _deep_mlp().simple_bind(mx.cpu(), data=(64, 128),
+                                  softmax_label=(64,))
+    assert ex2._remat == (False, None)
+
+
+# ---------------------------------------------------------------------------
+# SPMD step program: cache key + parity
+# ---------------------------------------------------------------------------
+def _trainer(monkeypatch, policy, mesh, sym=None):
+    if policy is None:
+        monkeypatch.delenv("MXNET_REMAT_POLICY", raising=False)
+    else:
+        monkeypatch.setenv("MXNET_REMAT_POLICY", policy)
+    tr = DataParallelTrainer(
+        sym if sym is not None else smoke_mlp(),
+        {"data": (64, 32)}, {"softmax_label": (64,)},
+        mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1})
+    monkeypatch.delenv("MXNET_REMAT_POLICY", raising=False)
+    return tr
+
+
+def test_spmd_policy_in_program_cache_key(monkeypatch):
+    spmd.reset_program_cache()
+    mesh = make_mesh({"dp": 4}, jax.devices()[:4])
+    sym = smoke_mlp()
+    tr_off = _trainer(monkeypatch, None, mesh, sym)
+    tr_on = _trainer(monkeypatch, "dots_saveable", mesh, sym)
+    st = spmd.program_cache_stats()
+    assert st["size"] == 2 and st["misses"] == 2, st
+    assert tr_off._program is not tr_on._program
+    # alias spelling shares the canonical program (cache HIT)
+    tr_alias = _trainer(monkeypatch, "checkpoint_dots", mesh, sym)
+    st = spmd.program_cache_stats()
+    assert st["size"] == 2 and st["hits"] == 1, st
+    assert tr_alias._program is tr_on._program
+
+
+def test_spmd_policy_numerics_parity(monkeypatch):
+    """Same params, same batches: the policy-on trainer walks the same
+    loss trajectory as the policy-off one."""
+    spmd.reset_program_cache()
+    mesh = make_mesh({"dp": 4}, jax.devices()[:4])
+    tr_off = _trainer(monkeypatch, None, mesh)
+    tr_on = _trainer(monkeypatch, "dots_with_no_batch_dims_saveable",
+                     mesh)
+    args, aux = tr_off.get_params()
+    tr_on.set_params(args, aux)
+    rs = np.random.RandomState(0)
+    for step in range(5):
+        X = rs.uniform(-1, 1, (64, 32)).astype("float32")
+        y = rs.randint(0, 10, (64,)).astype("float32")
+        rng = jax.random.key(step)
+        o_off = tr_off.step(X, y, rng=rng)
+        o_on = tr_on.step(X, y, rng=rng)
+    fetch_sync(o_off[0])
+    fetch_sync(o_on[0])
+    np.testing.assert_allclose(np.asarray(o_on[0]), np.asarray(o_off[0]),
+                               rtol=1e-5, atol=1e-6)
+    a_off, _ = tr_off.get_params()
+    a_on, _ = tr_on.get_params()
+    for k in a_off:
+        np.testing.assert_allclose(a_on[k].asnumpy(), a_off[k].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_module_fit_under_policy(monkeypatch):
+    """Module.fit end-to-end with the policy active (the fused fast
+    path fetches its program under the policy key) — converges like
+    the baseline."""
+    rs = np.random.RandomState(2)
+    X = rs.uniform(-1, 1, (256, 32)).astype("float32")
+    y = rs.randint(0, 10, (256,)).astype("float32")
+
+    def fit():
+        mx.random.seed(21)
+        it = mx.io.NDArrayIter(X, y, batch_size=64)
+        mod = mx.Module(smoke_mlp(), context=mx.cpu())
+        mod.fit(it, num_epoch=3, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5},
+                eval_metric="acc")
+        a, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in a.items()}
+
+    ref = fit()
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "dots_saveable")
+    got = fit()
+    monkeypatch.delenv("MXNET_REMAT_POLICY")
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-4, atol=1e-5)

@@ -125,6 +125,22 @@ def test_store_lru_eviction_and_recompile_stats():
     assert st["max_programs"] == 2
 
 
+def test_store_key_carries_pallas_fingerprint(monkeypatch):
+    """The serving program LRU outlives an MXNET_PALLAS flip like the
+    cached-op and SPMD caches do: its key must carry the dispatch
+    fingerprint so the escape hatch recompiles instead of serving the
+    stale lowering."""
+    net, args = _conv_model()
+    store = _mkstore(net, args)
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    k1 = store._key(2)
+    monkeypatch.setenv("MXNET_PALLAS", "0")
+    k0 = store._key(2)
+    assert k1 != k0
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    assert store._key(2) == k1
+
+
 def test_store_rejects_non_batch_major_output():
     """A whole-batch reduction output (no leading batch axis) cannot be
     served through buckets: pad rows and batch-mates would leak into
