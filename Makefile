@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke serve-smoke spmd-smoke kernels-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke spmd-smoke kernels-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -49,6 +49,18 @@ dist-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu MXNET_LOCK_CHECK=1 \
 		$(PY) -m pytest tests/test_fault_tolerance.py -q \
 		-k "seeded or wire_bytes"
+
+# elastic-async PS gate (docs/architecture/elastic_ps.md): the
+# straggler scenario (dist_async s=4 >= 2x dist_sync under one
+# injected straggler + the staleness-bound property + s=0 sync
+# parity), elastic membership (heartbeat death epochs, worker join at
+# the frontier) and live bucket rebalancing under traffic (exactly-
+# once across the migration, capacity add/remove).  MXNET_LOCK_CHECK=1
+# arms the lock-order race detector over the new staleness/membership/
+# migration lock paths; hard timeout like dist-smoke
+elastic-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu MXNET_LOCK_CHECK=1 \
+		$(PY) -m pytest tests/test_elastic_ps.py -q
 
 # serving-plane smoke gate: the continuous batcher (AOT bucket programs
 # + latency-budget scheduler) vs a per-request Predictor deployment

@@ -741,6 +741,132 @@ def bench_kvstore_push_pull(mode, chip, smoke=False):
     return row
 
 
+def _staleness_run(mode, steps, delay_s, sizes):
+    """One 2-worker in-process cluster (worker threads + scheduler +
+    server) where worker 1 is a persistent straggler (the seeded
+    ``straggler`` fault kind sleeps ``delay_s`` on each of its RPCs).
+    ``mode``: 'sync' (dist_sync merge rounds — every round waits for
+    the straggler) or 's<N>' (dist_async under staleness bound N).
+    Returns (fast-worker steps/sec, fast-worker wire stats/step)."""
+    import socket
+    import threading
+
+    from mxnet_tpu import faultinject
+    from mxnet_tpu import kvstore_dist as ksd
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    staleness = -1 if mode == "sync" else int(mode[1:])
+    managed = {
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": "1",
+        "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.1",
+        "MXNET_KVSTORE_MEMBERSHIP_TTL": "0.05",
+        "MXNET_KVSTORE_MAX_STALENESS": str(staleness),
+    }
+    saved = {k: os.environ.get(k) for k in managed}
+    os.environ.update(managed)
+    try:
+        sched = threading.Thread(target=ksd.run_scheduler, daemon=True)
+        sched.start()
+        server = ksd.Server()
+        threading.Thread(target=server.run, daemon=True).start()
+        fast, slow = ksd.WorkerClient(), ksd.WorkerClient()
+        if mode == "sync":
+            server._handle_command("sync_mode", b"")
+            fast.sync_push = slow.sync_push = True
+        else:
+            server._handle_command("async_mode", b"")
+        keys = list(range(len(sizes)))
+        for k, n in zip(keys, sizes):
+            fast.init(k, np.zeros(n, np.float32))
+        grads = [np.ones(n, np.float32) for n in sizes]
+        faultinject.install({"seed": 5, "rules": [
+            {"seam": "worker.send", "rank": 1, "action": "straggler",
+             "seconds": delay_s}]})
+        elapsed = [None]
+        fast.reset_wire_stats()
+
+        def run(client, timer):
+            tic = time.perf_counter()
+            for _ in range(steps):
+                for k, g in zip(keys, grads):
+                    client.push(k, g)
+                for k, n in zip(keys, sizes):
+                    client.pull(k, n)
+            if timer:
+                elapsed[0] = time.perf_counter() - tic
+
+        ts = [threading.Thread(target=run, args=(fast, True), daemon=True),
+              threading.Thread(target=run, args=(slow, False), daemon=True)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=600)
+        faultinject.install(None)
+        stats = fast.wire_stats()
+        fast.finalize(False)
+        slow.finalize(True)
+        return steps / elapsed[0], {k: v / steps for k, v in stats.items()}
+    finally:
+        faultinject.install(None)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+_STALENESS_SYNC_BASELINE = {}
+
+
+def bench_kvstore_async_staleness(mode, chip, smoke=False):
+    """Elastic-async PS throughput under one straggler: the fast
+    worker's steps/sec over a bounded window, dist_sync vs dist_async
+    at staleness bounds s=0 / s=4 on the same seeded schedule
+    (docs/architecture/elastic_ps.md).  The straggler sleeps per RPC
+    (>= 5x slower per step than the fast worker); in sync mode every
+    merge round waits for it, at s=4 the fast worker runs through it up
+    to 4 steps ahead; s=0 reproduces sync pacing through the read gate.
+    CPU-deterministic; wire-stats columns as in kvstore.push_pull."""
+    sizes = [256] * 3 if smoke else [256] * 6
+    steps, delay = 7, 0.03
+    rate, wire = _staleness_run(mode, steps, delay, sizes)
+    cache_key = (tuple(sizes), steps, delay)
+    if cache_key not in _STALENESS_SYNC_BASELINE:
+        if mode == "sync":
+            _STALENESS_SYNC_BASELINE[cache_key] = (rate, wire)
+        else:
+            _STALENESS_SYNC_BASELINE[cache_key] = _staleness_run(
+                "sync", steps, delay, sizes)
+    sync_rate, _ = _STALENESS_SYNC_BASELINE[cache_key]
+    row = {"metric": "kvstore.async_staleness.%s" % mode,
+           "value": round(rate, 2), "unit": "steps/sec",
+           "vs_baseline": None,
+           "staleness_bound": -1 if mode == "sync" else int(mode[1:]),
+           "sync_steps_per_sec": round(sync_rate, 2),
+           "speedup_vs_sync": round(rate / sync_rate, 3)
+           if sync_rate else None,
+           "straggler_rpc_delay_ms": delay * 1e3,
+           "window_steps": steps,
+           "push_bytes_per_step": int(wire["push_bytes"]),
+           "pull_bytes_per_step": int(wire["pull_bytes"]),
+           "push_rpcs_per_step": round(wire["push_rpcs"], 2),
+           "pull_rpcs_per_step": round(wire["pull_rpcs"], 2),
+           "n_params": len(sizes)}
+    if mode == "s4":
+        row["note"] = ("bounded-staleness SSP: the fast worker reads at "
+                       "most 4 steps ahead of the straggler instead of "
+                       "fencing every merge round on it; over the "
+                       "%d-step window that is the elastic claim the "
+                       "elastic-smoke gate pins at >= 2x" % steps)
+    return row
+
+
 def bench_serving_latency(mode, chip, smoke=False):
     """Serving-plane p50/p99 + QPS: the continuous batcher
     (serving/scheduler.py over AOT bucket programs) vs a per-request
@@ -1537,6 +1663,11 @@ def main():
           smoke)
     guard("kvstore.push_pull.2bit", bench_kvstore_push_pull, "2bit", chip,
           smoke)
+    # elastic-async PS rows: sync vs bounded-staleness async under one
+    # injected straggler (CPU-deterministic seeded protocol)
+    for st_mode in ("sync", "s0", "s4"):
+        guard("kvstore.async_staleness.%s" % st_mode,
+              bench_kvstore_async_staleness, st_mode, chip, smoke)
     guard("io.input_staging", bench_input_staging, chip, smoke)
     # CPU-deterministic one-SPMD-step-program rows (need >=8 visible
     # devices: XLA_FLAGS=--xla_force_host_platform_device_count=8 on

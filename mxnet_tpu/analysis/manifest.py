@@ -45,7 +45,11 @@ SPAN_ENTRY_POINTS = (
     ("mxnet_tpu/cached_op.py", "_run"),
     ("mxnet_tpu/engine.py", "Engine.dispatch"),
     ("mxnet_tpu/io/stager.py", "DeviceStager._stage_batch"),
+    ("mxnet_tpu/kvstore_dist.py", "Server._install_bucket"),
+    ("mxnet_tpu/kvstore_dist.py", "Server._migrate_out"),
+    ("mxnet_tpu/kvstore_dist.py", "Server._refresh_membership_locked"),
     ("mxnet_tpu/kvstore_dist.py", "WorkerClient._rpc_locked"),
+    ("mxnet_tpu/kvstore_dist.py", "WorkerClient.migrate_bucket"),
     ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline._worker"),
     ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline.flush"),
     ("mxnet_tpu/module/base_module.py", "BaseModule._fit_epochs"),

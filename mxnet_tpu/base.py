@@ -243,6 +243,23 @@ register_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 1.0,
              "Seconds between liveness beats a dist-kvstore node sends "
              "the scheduler on its dedicated heartbeat connection "
              "(feeds get_num_dead_node).")
+register_env("MXNET_KVSTORE_MAX_STALENESS", int, -1,
+             "Bounded-staleness knob for dist_async (SSP): a worker's "
+             "pull blocks on the server until its own per-key version "
+             "is at most this many update steps ahead of the slowest "
+             "live worker's.  0 degenerates to sync-read semantics; "
+             "negative disables the bound (pure hogwild, the "
+             "pre-elastic dist_async behavior).")
+register_env("MXNET_KVSTORE_DEAD_TIMEOUT", float, 15.0,
+             "Heartbeat silence (seconds) before the scheduler's "
+             "epoched membership view declares a worker dead: the "
+             "epoch bumps, barrier counts shrink, and servers retire "
+             "the dead rank's version-vector entries so it can never "
+             "stall the bounded-staleness frontier.")
+register_env("MXNET_KVSTORE_MEMBERSHIP_TTL", float, 0.5,
+             "Seconds a dist-kvstore server caches the scheduler's "
+             "epoched membership view while gating stale pulls; also "
+             "the re-check tick of a blocked staleness wait.")
 register_env("MXNET_LOCK_CHECK", bool, False,
              "Dynamic lock-discipline checking (analysis/lockcheck.py): "
              "locks created at the engine/kvstore/stager seams record "

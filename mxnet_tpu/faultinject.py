@@ -42,7 +42,13 @@ Rule fields:
     already-received reply is thrown away (the server DID apply the
     message — the worker's resend exercises the exactly-once dedup).
   - ``delay`` — sleep ``seconds`` (default 0.1) then proceed: slow
-    network / GC pause.
+    network / GC pause (transient by default: ``count`` defaults to 1).
+  - ``straggler`` — sleep ``seconds`` (default 0.5) then proceed, on
+    EVERY match from ``nth`` on (``count`` defaults to ``"inf"``): a
+    persistently slow node, as opposed to ``delay``'s transient hiccup.
+    Scoped with ``rank``/``role`` it turns one worker into the
+    straggler the bounded-staleness scenarios run through
+    (docs/architecture/elastic_ps.md).
   - ``error`` — raise ``OSError``: severed connection.
   - ``die``   — ``os._exit(exit_code)`` (default 137, i.e. SIGKILLed):
     the process vanishes without cleanup, exactly like a real crash.
@@ -70,7 +76,7 @@ from .base import get_env
 __all__ = ["hook", "install", "active", "seed", "FaultPlan",
            "InjectedError"]
 
-_ACTIONS = ("drop", "delay", "error", "die")
+_ACTIONS = ("drop", "delay", "straggler", "error", "die")
 
 
 class InjectedError(OSError):
@@ -92,9 +98,12 @@ class _Rule:
         self.sid = spec.get("sid")
         self.role = spec.get("role")
         self.nth = int(spec.get("nth", 1))
-        count = spec.get("count", 1)
+        # a straggler is persistent by definition: every matching event
+        # from nth on is slow unless the schedule bounds it explicitly
+        count = spec.get("count", "inf" if self.action == "straggler" else 1)
         self.count = float("inf") if count == "inf" else int(count)
-        self.seconds = float(spec.get("seconds", 0.1))
+        self.seconds = float(spec.get(
+            "seconds", 0.5 if self.action == "straggler" else 0.1))
         self.exit_code = int(spec.get("exit_code", 137))
         self.hits = 0
 
@@ -127,6 +136,10 @@ class FaultPlan:
         self.seed = int(spec.get("seed", 0))
         self.rules = [_Rule(r) for r in spec.get("rules", [])]
         self._lock = threading.Lock()
+        # fired-event log: one (seam, kind, rank, sid, action) entry per
+        # armed action, in each process's own execution order — the
+        # determinism witness two same-seed runs must produce identically
+        self.log = []
 
     def on_event(self, seam, meta):
         """Advance every matching rule's counter; first armed action wins."""
@@ -139,6 +152,9 @@ class FaultPlan:
                     if a is not None and action is None:
                         action = a
                         rule = r
+            if action is not None:
+                self.log.append((seam, meta.get("kind"), meta.get("rank"),
+                                 meta.get("sid"), action))
         return action, rule
 
 
@@ -209,7 +225,7 @@ def hook(seam, **meta):
     action, rule = plan.on_event(seam, meta)
     if action is None:
         return None
-    if action == "delay":
+    if action in ("delay", "straggler"):
         time.sleep(rule.seconds)
         return None
     if action == "error":

@@ -286,11 +286,18 @@ class KVStoreDist(KVStore):
             self._pipeline = CommPipeline(
                 self._run_batch,
                 recorder=lambda name, t0, cat: ksd._prof_record(
-                    name, t0, cat=cat))
-        # a recovered worker skips startup barriers: the surviving group is
-        # already past them (ps::Postoffice::is_recovery skip-barrier,
-        # kvstore_dist.h:39,77,178)
+                    name, t0, cat=cat),
+                # a bucket-plan redirect mid-flight is a routing event,
+                # not a failure: the pipeline re-enqueues the batch and
+                # the re-run re-shards against the refreshed plan
+                retryable=lambda e: isinstance(e, ksd.PlanMovedError))
+        # recovered workers AND elastic late joiners skip startup
+        # barriers: the surviving/running group is already past them
+        # (ps::Postoffice::is_recovery skip-barrier, kvstore_dist.h:
+        # 39,77,178; docs/architecture/elastic_ps.md for joins)
         self._is_recovery = self._client.is_recovery
+        self._late_join = self._client.late_join
+        self._elastic = self._is_recovery or self._late_join
         # rank0 flips servers to bulk-sync unless async
         # (reference kvstore.cc:34-42)
         if "async" not in kv_type:
@@ -298,10 +305,20 @@ class KVStoreDist(KVStore):
             # they get barrier-scale RPC deadlines (kvstore_dist
             # WorkerClient._deadline_for)
             self._client.sync_push = True
-            if self._rank == 0 and not self._is_recovery:
+            if self._rank == 0 and not self._elastic:
                 self._client.send_command("sync_mode", b"")
-            if not self._is_recovery:
+            if not self._elastic:
                 self._client.barrier()
+        else:
+            # dist_async is REAL now: rank0 arms the servers' elastic
+            # bounded-staleness plane (updater per push + version
+            # vectors + staleness-gated pulls).  No startup barrier —
+            # async workers synchronize through the init barrier only,
+            # which orders every data push after this command
+            self._client.stale_pulls = \
+                int(get_env("MXNET_KVSTORE_MAX_STALENESS")) >= 0
+            if self._rank == 0 and not self._elastic:
+                self._client.send_command("async_mode", b"")
         import atexit
         atexit.register(self.close)
 
@@ -314,15 +331,19 @@ class KVStoreDist(KVStore):
             for d in vv.shape:
                 flat_size *= int(d)
             # bucket layout is keyed once, in init order — identical on
-            # every worker (and every restart) of the same job
+            # every worker (and every restart/join) of the same job
             self._plan.add(k, flat_size)
-            if self._rank == 0 and not self._is_recovery:
+            if self._rank == 0 and not self._elastic:
                 # rank0 pushes initial weights (kvstore_dist.h:62-80); a
                 # recovered rank0 must NOT re-init — the servers hold the
                 # surviving group's trained state
                 self._client.init(k, self._flat(vv))
-        if not self._is_recovery:
+        if not self._elastic:
             self._client.barrier()
+        elif self._late_join:
+            # elastic joiner: pick up any plan deltas issued before the
+            # join so the first pushes already target the right owners
+            self._client._refresh_plan()
 
     def _flat(self, v):
         import numpy as np
@@ -339,27 +360,27 @@ class KVStoreDist(KVStore):
 
     def _run_batch(self, ops):
         """Execute one wire batch (single op, or a coalesced set of
-        bucket-mates of one kind) on the transport client."""
+        bucket-mates of one kind) on the transport client.  Bucketed
+        batches route to the bucket's CURRENT owner (live rebalancing
+        may have moved it) and chase plan redirects."""
         from . import kvstore_codec as codec
         client = self._client
         if ops[0].kind == "push":
             if len(ops) == 1:
                 client.push(ops[0].key, ops[0].payload)
                 return
-            sid = self._plan.server_of(ops[0].group, client.num_servers)
             entries = []
             for op in ops:
                 wire = op.payload.wire() \
                     if isinstance(op.payload, codec.CompressedGrad) \
                     else op.payload
                 entries.append((op.key, wire, client.next_seq(op.key)))
-            client.push_multi(sid, entries)
+            client.push_bucket(ops[0].group, entries)
             return
         if len(ops) == 1:
             ops[0].targets(client.pull(ops[0].key, ops[0].size))
             return
-        sid = self._plan.server_of(ops[0].group, client.num_servers)
-        vals = client.pull_multi(sid, [op.key for op in ops])
+        vals = client.pull_bucket(ops[0].group, [op.key for op in ops])
         import numpy as np
         for op, val in zip(ops, vals):
             op.targets(np.asarray(val, dtype=np.float32))
@@ -439,12 +460,14 @@ class KVStoreDist(KVStore):
 
     def set_optimizer(self, optimizer):
         """Ship the pickled optimizer to the servers (command 0) — the
-        update then runs server-side (python/mxnet/kvstore.py:226-249)."""
+        update then runs server-side (python/mxnet/kvstore.py:226-249).
+        Recovered workers and elastic joiners skip both the command and
+        the barrier: the running group's servers already hold it."""
         self.flush()
         body = pickle.dumps(optimizer)
-        if self._rank == 0 and not self._is_recovery:
+        if self._rank == 0 and not self._elastic:
             self._client.send_command(0, body)
-        if not self._is_recovery:
+        if not self._elastic:
             self._client.barrier()
 
     def barrier(self):
@@ -452,9 +475,22 @@ class KVStoreDist(KVStore):
         self._client.barrier()
 
     def get_num_dead_node(self, node_id=0, timeout=60):
-        """Actual dead-node count from scheduler heartbeat ages
-        (reference kvstore_dist.h:159-168)."""
+        """Actual dead-node count from the scheduler's epoched
+        membership view (reference kvstore_dist.h:159-168)."""
         return self._client.get_num_dead_node(node_id, timeout)
+
+    def membership(self, timeout=None):
+        """The scheduler's epoched live-worker view: ``(epoch,
+        [(rank, late), ...])`` — joins, leaves and heartbeat deaths
+        each bump the epoch (docs/architecture/elastic_ps.md)."""
+        return self._client.membership(timeout)
+
+    def migrate_bucket(self, bucket, target_sid):
+        """Live shard rebalancing: move one fusion bucket (values +
+        dedup watermarks + version vectors + per-key updater state) to
+        server ``target_sid`` under traffic.  Returns the new plan
+        version; other workers retarget via redirect replies."""
+        return self._client.migrate_bucket(bucket, target_sid)
 
     def close(self):
         if not self._closed:
@@ -468,10 +504,19 @@ class KVStoreDist(KVStore):
                 pass
             if self._pipeline is not None:
                 self._pipeline.close()
-            try:
-                self._client.barrier()
-            except Exception:  # noqa: BLE001
-                pass
+            if not ("async" in self.type and self._elastic):
+                # the group drains together before rank 0 may stop the
+                # servers — otherwise a fast rank 0 kills the cluster
+                # under peers still flushing.  Only an ELASTIC async
+                # worker (recovery or late joiner) LEAVING mid-run
+                # skips it: peers keep training, and a departed peer
+                # can't hang the others anyway — the scheduler's
+                # epoched barrier drops it from the target on finalize
+                # or death
+                try:
+                    self._client.barrier()
+                except Exception:  # noqa: BLE001
+                    pass
             try:
                 self._client.finalize(self._rank == 0)
             except Exception:  # noqa: BLE001
@@ -484,7 +529,11 @@ def create(name="local"):
     sync mode are handled by XLA collectives rather than distinct C++
     implementations.  'dist_*' with a ps environment (DMLC_ROLE=worker)
     returns the parameter-server-backed store; without one it degenerates
-    to rank0/size1 local (how the reference behaves with no tracker)."""
+    to rank0/size1 local (how the reference behaves with no tracker).
+    'dist_sync' arms the servers' bulk-synchronous merge; 'dist_async'
+    arms the elastic bounded-staleness async plane (updater per push,
+    version-vector staleness gating, live membership + shard
+    rebalancing — docs/architecture/elastic_ps.md)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "device", "local_allreduce_cpu",

@@ -227,6 +227,11 @@ class BucketPlan:
         self._members = {}      # bucket index -> [key, ...]
         self._open = None       # (bucket index, used bytes)
         self._next = 0
+        # versioned ownership deltas (live shard rebalancing): the
+        # scheduler's plan version and its bucket->server overrides;
+        # see docs/architecture/elastic_ps.md
+        self.version = 0
+        self._overrides = {}
 
     def add(self, key, size):
         """Assign ``key`` (``size`` fp32 elements); idempotent for a
@@ -252,9 +257,27 @@ class BucketPlan:
         return self._assign.get(key)
 
     def server_of(self, bucket, num_servers):
-        """Deterministic server owning a bucket (every member key's
-        whole payload lives there, so one RPC covers the bucket)."""
+        """Deterministic BASE server owning a bucket (every member
+        key's whole payload lives there, so one RPC covers the bucket).
+        ``num_servers`` must be the INITIAL census — live rebalancing
+        moves buckets exclusively through :meth:`apply_delta`
+        overrides, never by reshuffling this hash."""
         return zlib.crc32(("bucket:%d" % bucket).encode()) % num_servers
+
+    def apply_delta(self, version, overrides):
+        """Adopt a newer versioned ownership delta from the scheduler
+        (monotone: an older delta is ignored, so racing refreshes can
+        arrive in any order)."""
+        if version >= self.version:
+            self.version = version
+            self._overrides = dict(overrides)
+        return self.version
+
+    def owner_of(self, bucket, num_servers):
+        """Current owner under the adopted deltas: the override when
+        one exists, else the deterministic base assignment."""
+        sid = self._overrides.get(bucket)
+        return self.server_of(bucket, num_servers) if sid is None else sid
 
     def members(self, bucket):
         return list(self._members.get(bucket, ()))

@@ -13,7 +13,17 @@ Semantics preserved:
   ONCE on the merged gradient, then releases all workers
   (``kvstore_dist_server.h:164-198``).
 * ``dist_async`` — updater per push, replies immediately (hogwild,
-  ``:199-207``).
+  ``:199-207``), extended here into an *elastic bounded-staleness*
+  plane (docs/architecture/elastic_ps.md): per-key version vectors on
+  top of the (rank, incarnation, seq) dedup watermarks, an SSP
+  staleness bound (``MXNET_KVSTORE_MAX_STALENESS``) gating pulls on
+  the server, an epoched live-membership view at the scheduler
+  (worker join/leave/death bump the epoch; barriers and the staleness
+  frontier follow the live group), and live shard rebalancing — whole
+  fusion buckets migrate between servers under traffic via a
+  versioned plan: scheduler ``advance_plan`` delta, source-server
+  freeze+transfer of the bucket's snapshot-envelope slice, worker
+  retargeting through ``redirect`` replies.
 * key→server sharding — small arrays go whole to ``hash(key) % S``; arrays
   bigger than ``MXNET_KVSTORE_BIGARRAY_BOUND`` (default 1e6 elements) are
   range-partitioned across ALL servers (``EncodeKey``,
@@ -108,6 +118,14 @@ class _RPCTimeout(Exception):
 class MXNetConnectError(MXNetError):
     """(Re)connecting to an endpoint failed within its bounded dial
     budget; retryable, unlike a generic MXNetError."""
+
+
+class PlanMovedError(MXNetError):
+    """A server redirected: the bucket plan advanced and the target no
+    longer owns the key.  Raised AFTER the local plan/address tables
+    were refreshed, so the caller just re-shards and re-sends (same
+    seq — the dedup watermarks migrated with the bucket, so a resend
+    that crosses the migration is still exactly-once)."""
 
 
 def backoff_delay(attempt, base, cap, rng=None):
@@ -260,7 +278,18 @@ class Scheduler:
     nodes whose last heartbeat is older than the caller's timeout
     (reference ps-lite heartbeats behind ``get_num_dead_node``,
     kvstore_dist.h:159-168).  A node registering with a recovery rank
-    reuses its slot (``ps::Postoffice::is_recovery`` re-join)."""
+    reuses its slot (``ps::Postoffice::is_recovery`` re-join).
+
+    Elastic membership (docs/architecture/elastic_ps.md): the worker
+    group is an *epoched view* — a join (a worker registering beyond
+    ``DMLC_NUM_WORKER`` is a *late joiner*), a clean leave (finalize) and
+    a heartbeat-timeout death each bump ``epoch``.  Barriers count the
+    CURRENT live group, so a dead or departed peer can no longer hang
+    the survivors; servers poll the view (``membership``) to retire dead
+    ranks from the bounded-staleness frontier.  The scheduler also owns
+    the *versioned bucket plan*: ``advance_plan`` records a bucket->
+    server override and bumps ``plan_version`` (live shard rebalancing);
+    workers refresh via ``query_plan`` on redirect replies."""
 
     def __init__(self):
         self.num_workers = int(_env("DMLC_NUM_WORKER", "1"))
@@ -272,21 +301,98 @@ class Scheduler:
         self.next_worker = 0
         self.barrier_count = 0
         self.barrier_gen = 0
+        self.barrier_ranks = set()   # ranks arrived at the open barrier
         self.last_seen = {}      # (role, rank) -> last heartbeat time
         self.finalized = set()   # nodes that deregistered cleanly
+        # -- epoched elastic membership ------------------------------------
+        self.epoch = 0
+        self.registered = set()  # (role, rank) ever registered
+        self.dead = set()        # (role, rank) declared dead by the sweep
+        self.done = threading.Event()
+        # -- versioned bucket plan (live shard rebalancing) ----------------
+        self.plan_version = 0
+        self.plan_overrides = {}   # bucket index -> owning server rank
 
     def _mark(self, role, rank):
         self.last_seen[(role, rank)] = time.time()
         self.finalized.discard((role, rank))
+        self.registered.add((role, rank))
+        # a recovery replacement (or a revived GC-paused node) un-deads
+        # its slot and re-enters the membership view
+        if (role, rank) in self.dead:
+            self.dead.discard((role, rank))
+            self._bump_epoch(role)
+
+    def _bump_epoch(self, role):
+        """Membership changed; wake barrier waiters so they re-count
+        the live group.  Caller holds the lock."""
+        if role == "worker":
+            self.epoch += 1
+        self.lock.notify_all()
+
+    def _sweep_dead(self, timeout):
+        """Declare every registered, unfinalized node silent for more
+        than ``timeout`` seconds dead (bumping the epoch), so barriers
+        and the staleness frontier stop waiting on it.  Caller holds
+        the lock."""
+        now = time.time()
+        for (role, rank), ts in list(self.last_seen.items()):
+            node = (role, rank)
+            if node in self.finalized or node in self.dead:
+                continue
+            if now - ts > timeout:
+                self.dead.add(node)
+                self._bump_epoch(role)
+        self._check_done()
+
+    def _live_workers(self):
+        """Current live worker group as (rank, late) pairs.  Initial
+        ranks (< DMLC_NUM_WORKER) count as live until declared dead or
+        finalized even before they register — a barrier must not
+        release early just because a peer is still booting.  Late
+        joiners count only while registered and alive.  Caller holds
+        the lock."""
+        live = []
+        for r in range(self.num_workers):
+            node = ("worker", r)
+            if node not in self.dead and node not in self.finalized:
+                live.append((r, False))
+        for role, r in self.registered:
+            if role != "worker" or r < self.num_workers:
+                continue
+            node = ("worker", r)
+            if node not in self.dead and node not in self.finalized:
+                live.append((r, True))
+        return sorted(live)
+
+    def _maybe_release_barrier(self):
+        """Release the pending barrier when every live worker arrived.
+        The target counts live INITIAL ranks unconditionally (they all
+        issue the library barriers) but a late joiner only once it
+        actually arrives — an elastic join racing an open barrier must
+        not deadlock the initial group on a peer that skips barriers.
+        The live target also shrinks when a peer dies or leaves
+        mid-wait.  Caller holds the lock."""
+        target = sum(1 for r, late in self._live_workers()
+                     if not late or r in self.barrier_ranks)
+        if self.barrier_count and self.barrier_count >= target:
+            self.barrier_count = 0
+            self.barrier_ranks = set()
+            self.barrier_gen += 1
+            self.lock.notify_all()
 
     def _count_dead(self, mask, timeout):
         """Dead nodes in the ps-lite group mask (2=servers, 4=workers;
-        0 means all groups)."""
+        0 means all groups).  Counts by heartbeat age against the
+        CALLER's timeout (the pre-elastic per-call semantics — a probe
+        at 60s must not report a node another consumer swept at 15s);
+        the sweep at the same timeout keeps the epoched view moving."""
         if mask == 0:
             mask = 7
-        now = time.time()
         cnt = 0
+        now = time.time()
         with self.lock:
+            self._sweep_dead(timeout)
             for (role, rank), ts in self.last_seen.items():
                 if (role, rank) in self.finalized:
                     continue
@@ -295,12 +401,27 @@ class Scheduler:
                     cnt += 1
         return cnt
 
+    def _check_done(self):
+        """The run loop may exit once the initial group fully registered
+        and every registered node has finalized or been declared dead
+        (crashed nodes are covered by recovery replacements re-using
+        their slot).  Caller holds the lock."""
+        w0 = {r for (role, r) in self.registered
+              if role == "worker" and r < self.num_workers}
+        s0 = {r for (role, r) in self.registered
+              if role == "server" and r < self.num_servers}
+        if len(w0) < self.num_workers or len(s0) < self.num_servers:
+            return
+        for node in self.registered:
+            if node not in self.finalized and node not in self.dead:
+                return
+        self.done.set()
+
     def run(self):
         """Serve until every expected node deregistered cleanly (crashed
         nodes are covered by their recovery replacements; the launcher
         reaps a scheduler outliving its workers)."""
-        done = threading.Event()
-        expected = self.num_workers + self.num_servers
+        done = self.done
 
         def handle(conn):
             try:
@@ -309,82 +430,125 @@ class Scheduler:
                         msg = conn.recv()
                     except (EOFError, OSError):
                         return
-                    kind = msg[0]
-                    if kind == "register_server":
-                        # a restarted server re-joins under its old rank
-                        # and publishes its NEW address; workers pick it
-                        # up via query_servers on reconnect
-                        recover_rank = msg[2] if len(msg) > 2 else None
-                        with self.lock:
-                            if recover_rank is not None:
-                                rank = recover_rank
-                            else:
-                                rank = self.next_server
-                                self.next_server += 1
-                            self.server_addrs[rank] = msg[1]
-                            self._mark("server", rank)
-                            self.lock.notify_all()
-                        conn.send(("assigned", rank))
-                    elif kind == "register_worker":
-                        recover_rank = msg[1] if len(msg) > 1 else None
-                        with self.lock:
-                            if recover_rank is not None:
-                                rank = recover_rank
-                            else:
-                                rank = self.next_worker
-                                self.next_worker += 1
-                            self._mark("worker", rank)
-                            while any(a is None for a in self.server_addrs):
-                                self.lock.wait()
-                        conn.send(("assigned", rank,
-                                   list(self.server_addrs)))
-                    elif kind == "heartbeat":
-                        _, role, rank = msg
-                        with self.lock:
-                            self.last_seen[(role, rank)] = time.time()
-                        # fire-and-forget: no reply
-                    elif kind == "barrier":
-                        with self.lock:
-                            gen = self.barrier_gen
-                            self.barrier_count += 1
-                            if self.barrier_count == self.num_workers:
-                                self.barrier_count = 0
-                                self.barrier_gen += 1
-                                self.lock.notify_all()
-                            else:
-                                while self.barrier_gen == gen:
-                                    self.lock.wait()
-                        conn.send(("barrier_done",))
-                    elif kind == "num_dead":
-                        mask = msg[1] if len(msg) > 1 else 0
-                        timeout = msg[2] if len(msg) > 2 else 60
-                        conn.send(("num_dead",
-                                   self._count_dead(mask, timeout)))
-                    elif kind == "query_servers":
-                        # current address table (recovered servers appear
-                        # here under their old rank with a new address)
-                        with self.lock:
-                            conn.send(("servers", list(self.server_addrs)))
-                    elif kind == "finalize":
-                        if len(msg) > 1:
-                            with self.lock:
-                                self.finalized.add((msg[1], msg[2]))
-                        conn.send(("bye",))
-                        with self.lock:
-                            handle.finalizes += 1
-                            if handle.finalizes >= expected:
-                                done.set()
+                    if self._handle_one(msg, conn):
                         return
             finally:
                 conn.close()
 
-        handle.finalizes = 0
         accept_thread = threading.Thread(target=self._accept,
                                          args=(handle, done),
                                          daemon=True)
         accept_thread.start()
         done.wait()
         self.listener.close()
+
+    def _handle_one(self, msg, conn):
+        """Serve one scheduler request; returns True when this
+        connection's node finalized (connection handler should exit)."""
+        kind = msg[0]
+        if kind == "register_server":
+            # a restarted server re-joins under its old rank and
+            # publishes its NEW address; workers pick it up via
+            # query_servers on reconnect.  A fresh rank beyond
+            # DMLC_NUM_SERVER is a capacity add: the address table
+            # grows and buckets migrate onto it via the versioned plan
+            recover_rank = msg[2] if len(msg) > 2 else None
+            with self.lock:
+                if recover_rank is not None:
+                    rank = recover_rank
+                else:
+                    rank = self.next_server
+                    self.next_server += 1
+                while rank >= len(self.server_addrs):
+                    self.server_addrs.append(None)
+                self.server_addrs[rank] = msg[1]
+                self._mark("server", rank)
+                self.lock.notify_all()
+            conn.send(("assigned", rank))
+        elif kind == "register_worker":
+            recover_rank = msg[1] if len(msg) > 1 else None
+            with self.lock:
+                if recover_rank is not None:
+                    rank = recover_rank
+                else:
+                    rank = self.next_worker
+                    self.next_worker += 1
+                late = rank >= self.num_workers
+                self._mark("worker", rank)
+                self._bump_epoch("worker")
+                # only the INITIAL address table gates registration: a
+                # late capacity-add server may be mid-handshake
+                while any(a is None
+                          for a in self.server_addrs[:self.num_servers]):
+                    self.lock.wait()
+                conn.send(("assigned", rank, list(self.server_addrs),
+                           late))
+        elif kind == "heartbeat":
+            _, role, rank = msg
+            with self.lock:
+                self.last_seen[(role, rank)] = time.time()
+                if (role, rank) in self.dead:
+                    # a presumed-dead node beating again (GC pause, not
+                    # a crash) rejoins the live view
+                    self.dead.discard((role, rank))
+                    self._bump_epoch(role)
+            # fire-and-forget: no reply
+        elif kind == "barrier":
+            dead_after = float(get_env("MXNET_KVSTORE_DEAD_TIMEOUT"))
+            rank = msg[1] if len(msg) > 1 else None
+            with self.lock:
+                gen = self.barrier_gen
+                self.barrier_count += 1
+                if rank is not None:
+                    self.barrier_ranks.add(rank)
+                self._maybe_release_barrier()
+                while self.barrier_gen == gen:
+                    if not self.lock.wait(timeout=0.25):
+                        # periodic re-count: a peer that died while we
+                        # waited must shrink the live target
+                        self._sweep_dead(dead_after)
+                        self._maybe_release_barrier()
+            conn.send(("barrier_done",))
+        elif kind == "num_dead":
+            mask = msg[1] if len(msg) > 1 else 0
+            timeout = msg[2] if len(msg) > 2 else 60
+            conn.send(("num_dead", self._count_dead(mask, timeout)))
+        elif kind == "membership":
+            timeout = msg[1] if len(msg) > 1 \
+                else float(get_env("MXNET_KVSTORE_DEAD_TIMEOUT"))
+            with self.lock:
+                self._sweep_dead(timeout)
+                self._maybe_release_barrier()
+                conn.send(("membership", self.epoch,
+                           self._live_workers()))
+        elif kind == "query_servers":
+            # current address table (recovered servers appear here
+            # under their old rank with a new address; capacity-add
+            # servers extend it)
+            with self.lock:
+                conn.send(("servers", list(self.server_addrs)))
+        elif kind == "query_plan":
+            with self.lock:
+                conn.send(("plan", self.plan_version,
+                           dict(self.plan_overrides)))
+        elif kind == "advance_plan":
+            _, bucket, sid = msg
+            with self.lock:
+                self.plan_version += 1
+                self.plan_overrides[bucket] = sid
+                conn.send(("plan", self.plan_version,
+                           dict(self.plan_overrides)))
+        elif kind == "finalize":
+            if len(msg) > 1:
+                with self.lock:
+                    self.finalized.add((msg[1], msg[2]))
+                    if msg[1] == "worker":
+                        self._bump_epoch("worker")
+                        self._maybe_release_barrier()
+                    self._check_done()
+            conn.send(("bye",))
+            return True
+        return False
 
     def _accept(self, handle, done):
         while not done.is_set():
@@ -461,8 +625,36 @@ class Server:
         self._applied_seq = {}
         # RLock: synchronous snapshots run inside update critical sections
         self.lock = threading.RLock()
+        # staleness/migration waiters park here; pushes, membership
+        # epoch changes and bucket installs notify (same underlying lock)
+        self.cond = threading.Condition(self.lock)
         self.updater = None
         self.sync_mode = False
+        # -- bounded-staleness async plane (docs/architecture/elastic_ps.md)
+        self.async_mode = False
+        self.max_staleness = int(get_env("MXNET_KVSTORE_MAX_STALENESS"))
+        # per-key version vectors: key -> {worker rank: applied pushes}.
+        # Layered on the (rank, incarnation, seq) watermarks: a deduped
+        # resend never bumps a version, so the vector counts exactly the
+        # applied updates
+        self._versions = {}
+        # retired entries of non-live ranks (key -> {rank: count}): a
+        # swept-dead rank that REVIVES (GC pause, not a crash) resumes
+        # its true count instead of re-entering at zero and dragging
+        # the frontier back to the start line
+        self._retired_versions = {}
+        self.stale_log = None    # tests: list collecting (key, rank, my,
+        #                          slowest) per admitted gated pull
+        # cached scheduler membership view (epoched; TTL-refreshed)
+        self._member_epoch = -1
+        self._member_ts = 0.0
+        self._member_live = None    # set of live worker ranks, or None
+        self._member_late = set()   # live ranks that joined late
+        self._member_conn = None
+        # -- live shard rebalancing ----------------------------------------
+        self.plan_version = 0
+        self._moved = {}         # wire key -> plan version it left under
+        self._migrating = set()  # keys frozen by an in-flight transfer
         self.stop_event = threading.Event()
         self.rank = None
         # -- crash durability (docs/architecture/fault_tolerance.md) --
@@ -515,6 +707,17 @@ class Server:
                 # push dedup watermarks: a retried push from before the
                 # crash must not double-apply after restore
                 "applied_seq": dict(self._applied_seq),
+                # elastic-async plane: version vectors, migrated-key
+                # tombstones and the plan version ride the same envelope
+                # so a recovered server resumes staleness accounting and
+                # keeps redirecting traffic for buckets it gave away
+                "async_mode": self.async_mode,
+                "versions": {k: dict(v)
+                             for k, v in self._versions.items()},
+                "retired_versions": {k: dict(v) for k, v
+                                     in self._retired_versions.items()},
+                "moved": dict(self._moved),
+                "plan_version": self.plan_version,
             }
         gen = state["mutations"]
         payload = pickle.dumps(state)   # snapshot copies: lock-free
@@ -545,6 +748,14 @@ class Server:
             self.store = state["store"]
             self.sync_mode = state["sync_mode"]
             self._applied_seq = dict(state.get("applied_seq", {}))
+            self.async_mode = state.get("async_mode", False)
+            self._versions = {k: dict(v)
+                              for k, v in state.get("versions", {}).items()}
+            self._retired_versions = {
+                k: dict(v)
+                for k, v in state.get("retired_versions", {}).items()}
+            self._moved = dict(state.get("moved", {}))
+            self.plan_version = state.get("plan_version", 0)
             if state["optimizer"] is not None:
                 self._install_optimizer(state["optimizer"])
                 if state["updater_states"] is not None:
@@ -594,6 +805,309 @@ class Server:
         else:
             self._default_update(key, recved, stored)
 
+    # -- epoched membership view (server-side cache) ------------------------
+    def _refresh_membership_locked(self):
+        """Refresh the cached scheduler membership view when its TTL
+        lapsed; on an epoch change, retire departed ranks' version
+        entries so a dead or departed worker can never stall the
+        staleness frontier.  Caller holds ``self.lock``; the scheduler
+        RPC is a local round-trip on a dedicated connection."""
+        ttl = float(get_env("MXNET_KVSTORE_MEMBERSHIP_TTL"))
+        now = time.monotonic()
+        if self._member_live is not None and now - self._member_ts < ttl:
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            if self._member_conn is None:
+                self._member_conn = _connect(_root_addr(), retries=5,
+                                             delay=0.05)
+            self._member_conn.send(
+                ("membership", float(get_env("MXNET_KVSTORE_DEAD_TIMEOUT"))))
+            if not self._member_conn.poll(10):
+                raise _RPCTimeout("membership probe timed out")
+            _, epoch, rows = self._member_conn.recv()
+        except (EOFError, OSError, ValueError, MXNetError, _RPCTimeout):
+            # scheduler unreachable: keep serving on the stale view
+            # rather than stalling the data plane; retry next TTL
+            try:
+                if self._member_conn is not None:
+                    self._member_conn.close()
+            except OSError:
+                pass
+            self._member_conn = None
+            self._member_ts = now
+            return
+        self._member_ts = now
+        live = {r for r, _ in rows}
+        self._member_late = {r for r, late in rows if late}
+        if epoch != self._member_epoch:
+            self._member_epoch = epoch
+            # frontier retirement: entries of ranks that left the live
+            # view stop counting toward min/max immediately — but their
+            # counts are stashed so a REVIVED rank resumes where it was
+            for k, vv in self._versions.items():
+                for r in [r for r in vv if r not in live]:
+                    self._retired_versions.setdefault(k, {})[r] = vv.pop(r)
+            self.cond.notify_all()
+        self._member_live = live
+        _prof_record("ps_membership[e%d:%d live]" % (epoch, len(live)),
+                     t0, cat="ps_membership")
+
+    def _live_view_locked(self):
+        """(live ranks, late ranks) for staleness math.  Without a
+        reachable scheduler (bare in-process tests) fall back to the
+        ranks the version vectors have seen."""
+        if self._member_live is not None:
+            return self._member_live, self._member_late
+        seen = set()
+        for vv in self._versions.values():
+            seen.update(vv)
+        return seen, set()
+
+    # -- bounded staleness (SSP) --------------------------------------------
+    def _bump_version_locked(self, key, rank):
+        """Count one applied push toward (key, rank)'s version.  A rank
+        first seen on this key resumes its retired count if it revived
+        (a swept-dead node beating again must not drag the frontier
+        back to zero), enters at the key's current frontier if it
+        joined late (a joiner must never do that either), and at 0 for
+        an initial worker (its missing entry already counted as 0
+        toward the frontier minimum — the sync start line)."""
+        if not self.async_mode or rank is None:
+            return
+        vv = self._versions.setdefault(key, {})
+        if rank not in vv:
+            if self._member_live is None or rank not in self._member_live:
+                # first sighting of a rank the cached view predates
+                # (an elastic joiner's very first push): force a
+                # refresh so its late flag — and therefore its frontier
+                # entry point — is decided on the post-join epoch
+                self._member_ts = 0.0
+            self._refresh_membership_locked()
+            stashed = self._retired_versions.get(key, {}).pop(rank, None)
+            if stashed is not None:
+                vv[rank] = stashed
+            elif rank in self._member_late:
+                vv[rank] = max(vv.values(), default=0)
+            else:
+                vv[rank] = 0
+        vv[rank] += 1
+        self.cond.notify_all()
+
+    def _staleness_gate_locked(self, key, rank):
+        """(ok, my_version, slowest): may ``rank`` read ``key`` now?
+        SSP bound: the reader's own version may lead the slowest live
+        worker's by at most ``max_staleness`` applied steps.  Missing
+        entries count 0 for initial ranks and frontier for late
+        joiners (they enter at the frontier)."""
+        vv = self._versions.get(key) or {}
+        frontier = max(vv.values(), default=0)
+        live, late = self._live_view_locked()
+        retired = self._retired_versions.get(key, {})
+
+        def v(r):
+            if r in vv:
+                return vv[r]
+            if r in retired:     # revived, not yet re-pushed: true count
+                return retired[r]
+            return frontier if r in late else 0
+
+        vals = [v(r) for r in live]
+        slowest = min(vals) if vals else frontier
+        my = v(rank) if (rank in live or rank in vv) else frontier
+        return my - slowest <= self.max_staleness, my, slowest
+
+    def _wait_staleness(self, keys, rank):
+        """Block until ``rank``'s read of every key satisfies the
+        staleness bound (no-op unless async mode with a bound set and
+        an identity-carrying pull).  Returns "redirect" if a key
+        migrated away mid-wait.  Raises after barrier-scale patience —
+        by then the membership sweep has retired any dead peer, so a
+        genuine timeout means a live-but-wedged cluster."""
+        if not self.async_mode or self.max_staleness < 0 or rank is None:
+            return None
+        deadline = time.monotonic() \
+            + float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT"))
+        tick = max(0.01, float(get_env("MXNET_KVSTORE_MEMBERSHIP_TTL")))
+        with self.cond:
+            while True:
+                if any(k in self._moved for k in keys):
+                    return "redirect"
+                self._refresh_membership_locked()
+                pend = None
+                for k in keys:
+                    ok, my, slowest = self._staleness_gate_locked(k, rank)
+                    if not ok:
+                        pend = (k, my, slowest)
+                        break
+                    if self.stale_log is not None:
+                        self.stale_log.append((k, rank, my, slowest))
+                if pend is None:
+                    return None
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        "staleness wait timed out: worker %r reading key "
+                        "%r at version %d, slowest live worker at %d, "
+                        "bound %d" % ((rank,) + pend + (self.max_staleness,)))
+                self.cond.wait(timeout=tick)
+
+    # -- live shard rebalancing ---------------------------------------------
+    def _updater_states_for(self, keys):
+        """Per-key slice of the updater state (momentum buffers, update
+        counters) for a migrating bucket, in host layout."""
+        if self.updater is None:
+            return None
+        from .optimizer import _state_to_host
+        states = {k: _state_to_host(self.updater.states[k])
+                  for k in keys if k in self.updater.states}
+        counts = getattr(self.updater.optimizer, "_index_update_count", {})
+        return {"states": states,
+                "counts": {k: counts[k] for k in keys if k in counts},
+                "num_update": getattr(self.updater.optimizer,
+                                      "num_update", 0)}
+
+    def _merge_updater_states(self, payload):
+        if not payload or self.updater is None:
+            return
+        from .optimizer import _state_from_host
+        for k, v in payload.get("states", {}).items():
+            self.updater.states[k] = _state_from_host(v)
+        opt = self.updater.optimizer
+        if hasattr(opt, "_index_update_count"):
+            opt._index_update_count.update(payload.get("counts", {}))
+        opt.num_update = max(getattr(opt, "num_update", 0),
+                             payload.get("num_update", 0))
+
+    def _await_migration_locked(self, keys):
+        """Park while any of ``keys`` is frozen by an in-flight
+        transfer (caller holds the lock via ``self.cond``).  The freeze
+        window is the envelope-to-install gap — redirecting during it
+        would send workers to a target that has no state yet."""
+        deadline = time.monotonic() \
+            + float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT"))
+        while any(k in self._migrating for k in keys):
+            if time.monotonic() > deadline:
+                raise MXNetError("bucket migration of %r did not resolve "
+                                 "within the barrier timeout" % (keys,))
+            self.cond.wait(timeout=0.05)
+
+    def _migrate_out(self, keys, target_addr, version, conn):
+        """Transfer one bucket's state to the server at ``target_addr``
+        (the rebalance handshake's source half).  The envelope carries
+        everything a fresh capacity-add server needs to continue
+        exactly: values, the (rank, incarnation, seq) dedup watermarks,
+        the version vectors (live + retired), per-key updater state and
+        the optimizer itself — the PR-2 snapshot envelope, sliced per
+        key.  Three phases: capture + freeze under the store lock,
+        transfer with the lock RELEASED (only the migrating keys stay
+        frozen — unrelated traffic flows), then retire + tombstone
+        under the lock on ack (or unfreeze on failure)."""
+        t0 = time.perf_counter_ns()
+        with self.cond:
+            if self.sync_mode:
+                conn.send(("err", "bucket migration requires the async "
+                           "server mode (dist_async)"))
+                return
+            keyset = set(keys)
+            missing = [k for k in keys if k not in self.store]
+            if missing:
+                conn.send(("err", "cannot migrate uninitialized keys %r"
+                           % (missing,)))
+                return
+            envelope = {
+                "store": {k: self.store[k].copy() for k in keys},
+                "applied_seq": {kr: v for kr, v in self._applied_seq.items()
+                                if kr[0] in keyset},
+                "versions": {k: dict(self._versions.get(k, {}))
+                             for k in keys},
+                "retired_versions": {
+                    k: dict(self._retired_versions.get(k, {}))
+                    for k in keys},
+                "updater_states": self._updater_states_for(keys),
+                "optimizer": self._optimizer_bytes,
+                "async_mode": self.async_mode,
+            }
+            # freeze: writes/reads of these keys park in
+            # _await_migration_locked until phase 3 resolves; the
+            # captured envelope is therefore exact
+            self._migrating.update(keyset)
+        ok, errmsg = False, None
+        try:
+            try:
+                tconn = _connect(tuple(target_addr), retries=50, delay=0.05)
+            except MXNetError as exc:
+                errmsg = "cannot reach migration target %r: %s" \
+                    % (target_addr, exc)
+            else:
+                try:
+                    tconn.send(("install_bucket", version, envelope))
+                    if not tconn.poll(60):
+                        raise _RPCTimeout("bucket install not acknowledged")
+                    reply = tconn.recv()
+                    if reply[0] == "ok":
+                        ok = True
+                    else:
+                        errmsg = "target rejected bucket: %r" % (reply,)
+                except (EOFError, OSError, _RPCTimeout) as exc:
+                    errmsg = "bucket transfer failed: %r" % (exc,)
+                finally:
+                    try:
+                        tconn.close()
+                    except OSError:
+                        pass
+        finally:
+            with self.cond:
+                if ok:
+                    # acknowledged by the target: retire locally, leave
+                    # redirect tombstones, free the capacity
+                    for k in keys:
+                        self.store.pop(k, None)
+                        self._versions.pop(k, None)
+                        self._retired_versions.pop(k, None)
+                        if self.updater is not None:
+                            self.updater.states.pop(k, None)
+                        self._moved[k] = version
+                    for kr in [kr for kr in self._applied_seq
+                               if kr[0] in keyset]:
+                        self._applied_seq.pop(kr)
+                    self.plan_version = max(self.plan_version, version)
+                    self._mutated()
+                self._migrating.difference_update(keyset)
+                self.cond.notify_all()
+        if ok:
+            conn.send(("ok",))
+            _prof_record("ps_rebalance[out:%d keys->v%d]"
+                         % (len(keys), version), t0, cat="ps_rebalance")
+        else:
+            conn.send(("err", errmsg))
+
+    def _install_bucket(self, version, envelope):
+        """Target half of the rebalance handshake: install the migrated
+        bucket's state.  Idempotent per key; a key migrating back clears
+        its tombstone."""
+        t0 = time.perf_counter_ns()
+        with self.cond:
+            for k, v in envelope["store"].items():
+                self.store[k] = np.array(v, dtype=np.float32)
+                self._moved.pop(k, None)
+            self._applied_seq.update(envelope.get("applied_seq", {}))
+            for k, vv in envelope.get("versions", {}).items():
+                self._versions[k] = dict(vv)
+            for k, vv in envelope.get("retired_versions", {}).items():
+                if vv:
+                    self._retired_versions[k] = dict(vv)
+            if envelope.get("optimizer") is not None and self.updater is None:
+                self._install_optimizer(envelope["optimizer"])
+            self._merge_updater_states(envelope.get("updater_states"))
+            if envelope.get("async_mode"):
+                self.async_mode = True
+            self.plan_version = max(self.plan_version, version)
+            self._mutated()
+            self.cond.notify_all()
+        _prof_record("ps_rebalance[in:%d keys@v%d]"
+                     % (len(envelope["store"]), version), t0,
+                     cat="ps_rebalance")
+
     def run(self):
         # register with scheduler; a restarted server re-claims its old
         # rank (DMLC_PS_RECOVERY_RANK) so workers can re-resolve it
@@ -633,6 +1147,13 @@ class Server:
         except Exception:  # noqa: BLE001 — shutdown must still finalize
             pass
         self.listener.close()
+        with self.lock:
+            if self._member_conn is not None:
+                try:
+                    self._member_conn.close()
+                except OSError:
+                    pass
+                self._member_conn = None
         sched.send(("finalize", "server", self.rank))
         try:
             sched.recv()
@@ -704,62 +1225,71 @@ class Server:
             rank = msg[3] if len(msg) > 3 else None
             seq = msg[4] if len(msg) > 4 else None
             inc = msg[5] if len(msg) > 5 else None
-            with self.lock:
-                known = key in self.store
-            if not known:
-                conn.send(("err", "key %r has not been initialized"
-                           % (key,)))
-            else:
-                self._handle_push(key, arr, conn, rank, seq, inc)
+            # await + moved-recheck and the push apply share ONE lock
+            # hold (RLock; _handle_push re-enters), so a migration can
+            # never capture its envelope between our check and the
+            # apply — a racing push is either in the envelope or
+            # redirected, never silently lost or hard-errored
+            with self.cond:
+                self._await_migration_locked([key])
+                if key in self._moved:
+                    conn.send(("redirect", self.plan_version))
+                    return False
+                if key not in self.store:
+                    conn.send(("err", "key %r has not been initialized"
+                               % (key,)))
+                else:
+                    self._handle_push(key, arr, conn, rank, seq, inc)
         elif kind == "push_multi":
             # one fusion bucket per RPC: (push_multi, [(key, payload,
             # seq), ...], rank, inc).  Each key runs the ordinary push
             # path (same dedup watermarks, same sync-mode merge rounds);
             # the single wire reply waits for every key via _MultiAck
             _, entries, rank, inc = msg
-            with self.lock:
-                missing = [k for k, _, _ in entries if k not in self.store]
-            if missing:
-                conn.send(("err", "keys %r have not been initialized"
-                           % (missing,)))
-            else:
-                # +1: the loop below contributes a final barrier ack
-                # AFTER the batched snapshot, so in synchronous-snapshot
-                # mode one RPC costs ONE store snapshot (not one per
-                # key) while 'acked' still implies 'persisted'
-                ack = _MultiAck(conn, len(entries) + 1)
-                for key, payload, seq in entries:
-                    self._handle_push(key, payload, ack, rank, seq, inc,
-                                      snap=False)
-                if self.snapshot_dir is not None \
-                        and self.snapshot_interval <= 0:
-                    self.save_snapshot()
-                ack.send(("ok",))
+            keys = [k for k, _, _ in entries]
+            with self.cond:
+                self._await_migration_locked(keys)
+                if any(k in self._moved for k in keys):
+                    conn.send(("redirect", self.plan_version))
+                    return False
+                missing = [k for k in keys if k not in self.store]
+                if missing:
+                    conn.send(("err", "keys %r have not been initialized"
+                               % (missing,)))
+                else:
+                    # +1: the loop below contributes a final barrier ack
+                    # AFTER the batched snapshot, so in synchronous-
+                    # snapshot mode one RPC costs ONE store snapshot
+                    # (not one per key) while 'acked' still implies
+                    # 'persisted'
+                    ack = _MultiAck(conn, len(entries) + 1)
+                    for key, payload, seq in entries:
+                        self._handle_push(key, payload, ack, rank, seq,
+                                          inc, snap=False)
+                    if self.snapshot_dir is not None \
+                            and self.snapshot_interval <= 0:
+                        self.save_snapshot()
+                    ack.send(("ok",))
         elif kind == "pull_multi":
-            _, keys = msg
-            with self.lock:
-                vals = [self.store[k].copy() if k in self.store else None
-                        for k in keys]
-            miss = [k for k, v in zip(keys, vals) if v is None]
-            if miss:
-                conn.send(("err", "keys %r have not been initialized"
-                           % (miss,)))
-            else:
-                conn.send(("vals", vals))
+            # (pull_multi, keys[, rank]): the optional rank identity
+            # arms the bounded-staleness gate in async mode
+            _, keys = msg[:2]
+            rank = msg[2] if len(msg) > 2 else None
+            self._serve_pull(keys, rank, conn, multi=True)
         elif kind == "pull":
-            _, key = msg
-            with self.lock:
-                val = self.store.get(key)
-                # copy under the lock: the live array is mutated in
-                # place by concurrent pushes, and serialization outside
-                # the lock would otherwise send a torn value
-                if val is not None:
-                    val = val.copy()
-            if val is None:
-                conn.send(("err", "key %r has not been initialized"
-                           % (key,)))
-            else:
-                conn.send(("val", val))
+            _, key = msg[:2]
+            rank = msg[2] if len(msg) > 2 else None
+            self._serve_pull([key], rank, conn, multi=False)
+        elif kind == "migrate_out":
+            # rebalance handshake, source half: (migrate_out, keys,
+            # target_addr, plan_version)
+            _, keys, target_addr, version = msg
+            self._migrate_out(keys, target_addr, version, conn)
+        elif kind == "install_bucket":
+            # rebalance handshake, target half
+            _, version, envelope = msg
+            self._install_bucket(version, envelope)
+            conn.send(("ok",))
         elif kind == "command":
             _, head, body = msg
             self._handle_command(head, body)
@@ -769,6 +1299,46 @@ class Server:
             self.stop_event.set()
             return True
         return False
+
+    def _serve_pull(self, keys, rank, conn, multi):
+        """Serve one pull/pull_multi: wait out any in-flight transfer
+        of these keys, redirect if they migrated away, gate on the
+        staleness bound, then copy under the lock (the live array is
+        mutated in place by concurrent pushes; serialization outside
+        the lock would send a torn value).  A migration starting while
+        the staleness gate was parked loops back to the wait, so the
+        reply is always either fresh data or a post-install redirect —
+        never a spurious 'not initialized'."""
+        try:
+            for _ in range(64):   # plan-churn paranoia bound
+                with self.cond:
+                    self._await_migration_locked(keys)
+                    if any(k in self._moved for k in keys):
+                        conn.send(("redirect", self.plan_version))
+                        return
+                if self._wait_staleness(keys, rank) == "redirect":
+                    conn.send(("redirect", self.plan_version))
+                    return
+                with self.lock:
+                    if any(k in self._migrating for k in keys):
+                        continue   # transfer started mid-gate: re-wait
+                    vals = [self.store[k].copy() if k in self.store
+                            else None for k in keys]
+                break
+            else:
+                raise MXNetError("pull of %r starved by plan churn"
+                                 % (keys,))
+        except MXNetError as exc:
+            conn.send(("err", str(exc)))
+            return
+        miss = [k for k, v in zip(keys, vals) if v is None]
+        if miss:
+            conn.send(("err", "keys %r have not been initialized"
+                       % (miss,)))
+        elif multi:
+            conn.send(("vals", vals))
+        else:
+            conn.send(("val", vals[0]))
 
     def _already_applied(self, key, rank, seq, inc):
         if seq is None:
@@ -818,6 +1388,9 @@ class Server:
                 self._do_update(key, codec.payload_to_array(payload))
                 if seq is not None:
                     self._applied_seq[(key, rank)] = (inc, seq)
+                # version vector rides the SAME apply decision as the
+                # dedup watermark: a deduped resend bumps neither
+                self._bump_version_locked(key, rank)
                 self._mutated(snap)
             conn.send(("ok",))
             return
@@ -864,7 +1437,10 @@ class Server:
 
     def _handle_command(self, head, body):
         """Command 0 carries a pickled optimizer (reference controller at
-        kvstore_dist_server.h:87-115); 'sync_mode' flips bulk-sync on."""
+        kvstore_dist_server.h:87-115); 'sync_mode' flips bulk-sync on;
+        'async_mode' arms the elastic bounded-staleness plane (updater
+        per push, version vectors, staleness-gated pulls — reference
+        kvstore_dist_server.h:199-207 plus the SSP bound)."""
         if head == 0:
             with self.lock:
                 self._install_optimizer(body)
@@ -872,6 +1448,15 @@ class Server:
         elif head == "sync_mode":
             with self.lock:
                 self.sync_mode = True
+                self._mutated()
+        elif head == "async_mode":
+            with self.lock:
+                self.async_mode = True
+                self.sync_mode = False
+                # re-read the bound: the command arrives from rank 0 at
+                # kvstore creation, after this process's env was staged
+                self.max_staleness = int(
+                    get_env("MXNET_KVSTORE_MAX_STALENESS"))
                 self._mutated()
 
 
@@ -910,6 +1495,24 @@ class WorkerClient:
         msg = self.sched.recv()
         self.rank = msg[1]
         self.server_addrs = msg[2]
+        # elastic join: a rank assigned beyond DMLC_NUM_WORKER joined a
+        # running group — it skips the startup barriers, bootstraps
+        # params via pull, and enters the servers' version vectors at
+        # the current frontier (docs/architecture/elastic_ps.md)
+        self.late_join = bool(msg[3]) if len(msg) > 3 else False
+        # key sharding is pinned to the INITIAL server census: added
+        # capacity only ever receives traffic through versioned-plan
+        # bucket overrides, so the hash/range layout never reshuffles
+        self._initial_servers = len(self.server_addrs)
+        # versioned bucket-plan deltas (live shard rebalancing) live on
+        # the shared BucketPlan (single source of truth; refreshed from
+        # the scheduler on a server's redirect reply); _plan_lock
+        # guards every read/mutation of its override state
+        self._plan_lock = lockcheck.make_lock("kvstore.plan")
+        # pulls may legitimately block on the slowest peer when the
+        # bounded-staleness gate is armed (KVStoreDist flips this for
+        # dist_async with MXNET_KVSTORE_MAX_STALENESS >= 0)
+        self.stale_pulls = False
         # small connection pool per server: the async data-plane pipeline
         # (kvstore_pipeline.py) holds several RPCs to one server in
         # flight, and multiprocessing.Connection is one-request-at-a-time
@@ -957,18 +1560,32 @@ class WorkerClient:
     def num_servers(self):
         return len(self.servers)
 
+    @property
+    def plan_version(self):
+        """Adopted bucket-plan version (0 for planless clients)."""
+        with self._plan_lock:
+            return self.plan.version if self.plan is not None else 0
+
+    def server_for_bucket(self, bucket):
+        """Current owner of a fusion bucket: the plan's adopted
+        versioned override when one exists, else the deterministic
+        hash over the INITIAL server census."""
+        with self._plan_lock:
+            return self.plan.owner_of(bucket, self._initial_servers)
+
     def _shard(self, key, size):
         """Return [(server_idx, subkey, start, stop), ...] covering [0, size).
 
-        Bucketed keys: the whole range on the bucket's server (so one
-        multi-key RPC can carry bucket-mates); other small arrays: one
-        hashed server; big arrays: even range partition over all
-        servers (EncodeKey semantics)."""
-        S = self.num_servers
+        Bucketed keys: the whole range on the bucket's current owner
+        (so one multi-key RPC can carry bucket-mates; live rebalancing
+        moves whole buckets via plan overrides); other small arrays:
+        one hashed server; big arrays: even range partition over the
+        initial servers (EncodeKey semantics)."""
+        S = self._initial_servers
         if self.plan is not None:
             b = self.plan.bucket_of(key)
             if b is not None:
-                return [(self.plan.server_of(b, S), (key, 0), 0, size)]
+                return [(self.server_for_bucket(b), (key, 0), 0, size)]
         if size < self.bigarray_bound or S == 1:
             # deterministic across processes (python's str hash is salted)
             import zlib
@@ -1023,6 +1640,14 @@ class WorkerClient:
             try:
                 r = self._rpc_once(sid, slot, msg)
                 breaker.record_success()
+                if isinstance(r, tuple) and r and r[0] == "redirect":
+                    # the bucket plan advanced under us: refresh the
+                    # plan/address tables, then re-shard at the caller
+                    # (the endpoint is healthy — no breaker failure)
+                    self._refresh_plan()
+                    raise PlanMovedError(
+                        "server %d no longer owns %r (plan advanced to "
+                        "v%s)" % (sid, msg[0], r[1]))
                 return r
             except (EOFError, OSError, _RPCTimeout, MXNetConnectError) \
                     as exc:
@@ -1098,10 +1723,13 @@ class WorkerClient:
         """Per-message deadline.  A dist_sync push (single or
         bucket-multi) legitimately blocks until EVERY worker reaches
         the merge round, so it gets barrier-scale patience (a straggler
-        peer is not a dead server); everything else answers within the
-        plain RPC timeout."""
+        peer is not a dead server); a dist_async pull under an armed
+        staleness bound likewise blocks on the slowest live peer;
+        everything else answers within the plain RPC timeout."""
         t = self.policy.timeout
         if t > 0 and kind in ("push", "push_multi") and self.sync_push:
+            t = max(t, float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT")))
+        if t > 0 and kind in ("pull", "pull_multi") and self.stale_pulls:
             t = max(t, float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT")))
         return t
 
@@ -1158,6 +1786,97 @@ class WorkerClient:
                 self._probe_conn = None
                 raise
 
+    def _refresh_plan(self):
+        """Pull the scheduler's current plan version/overrides and
+        server address table; grow the connection pools when capacity
+        was added.  Monotone: an older plan reply never overwrites a
+        newer local view."""
+        r = self._sched_probe(("query_plan",))
+        if self.plan is not None:
+            with self._plan_lock:
+                self.plan.apply_delta(r[1], r[2])
+        addrs = self._sched_probe(("query_servers",))[1]
+        with self._pool_cv:
+            while len(self.servers) < len(addrs):
+                self.server_addrs.append(addrs[len(self.servers)])
+                self.servers.append([None] * self._pool_size)
+                self._free_slots.append(list(range(self._pool_size)))
+                self.breakers.append(CircuitBreaker())
+                self._pool_cv.notify_all()
+            for i, a in enumerate(addrs):
+                if a is not None:
+                    self.server_addrs[i] = a
+
+    def _plan_retry(self, fn, attempts=8):
+        """Run ``fn`` (which computes its own shard targets), chasing
+        plan-version redirects: each PlanMovedError re-shards against
+        the freshly refreshed plan.  Resent messages carry their
+        original seqs, so the migrated dedup watermarks keep the
+        crossing exactly-once.  Exhaustion re-raises the LAST
+        PlanMovedError so the CommPipeline's retryable backstop can
+        re-enqueue the whole batch under pathological plan churn
+        instead of failing the flush."""
+        last = None
+        for _ in range(attempts):
+            try:
+                return fn()
+            except PlanMovedError as exc:
+                last = exc
+        raise last
+
+    def membership(self, timeout=None):
+        """(epoch, [(rank, late), ...]) — the scheduler's current
+        epoched live-worker view (sweeping heartbeats older than
+        ``timeout``, default MXNET_KVSTORE_DEAD_TIMEOUT)."""
+        if timeout is None:
+            timeout = float(get_env("MXNET_KVSTORE_DEAD_TIMEOUT"))
+        r = self._sched_probe(("membership", timeout))
+        return r[1], r[2]
+
+    def migrate_bucket(self, bucket, target_sid):
+        """Live shard rebalancing driver: advance the scheduler's
+        versioned plan, then have the bucket's current owner freeze and
+        transfer its state (values, dedup watermarks, version vectors,
+        per-key updater state) to ``target_sid``.  Other workers
+        retarget on their next RPC via redirect replies.  Returns the
+        new plan version."""
+        t0 = time.perf_counter_ns()
+        if self.plan is None:
+            raise MXNetError("no bucket plan on this worker")
+        keys = self.plan.members(bucket)
+        if not keys:
+            raise MXNetError("bucket %r has no member keys" % (bucket,))
+        self._refresh_plan()
+        src = self.server_for_bucket(bucket)
+        if target_sid >= len(self.servers):
+            raise MXNetError(
+                "migration target server %d unknown (have %d); did the "
+                "capacity-add server register?" % (target_sid,
+                                                   len(self.servers)))
+        r = self._sched_probe(("advance_plan", bucket, target_sid))
+        version, overrides = r[1], r[2]
+        if src != target_sid:
+            wire_keys = [(k, 0) for k in keys]
+            addr = self.server_addrs[target_sid]
+            try:
+                resp = self._rpc(src, ("migrate_out", wire_keys,
+                                       tuple(addr), version))
+            except MXNetError:
+                # transfer failed: point the plan back at the source so
+                # the cluster never routes at a target without state
+                self._sched_probe(("advance_plan", bucket, src))
+                self._refresh_plan()
+                raise
+            if resp[0] != "ok":
+                self._sched_probe(("advance_plan", bucket, src))
+                self._refresh_plan()
+                raise MXNetError("bucket migration failed: %s" % (resp,))
+        with self._plan_lock:
+            self.plan.apply_delta(version, overrides)
+        _prof_record("ps_rebalance[b%s->s%d]" % (bucket, target_sid), t0,
+                     cat="ps_rebalance")
+        return version
+
     def init(self, key, flat):
         for sid, subkey, lo, hi in self._shard(key, flat.size):
             r = self._rpc(sid, ("init", subkey, flat[lo:hi]))
@@ -1209,19 +1928,24 @@ class WorkerClient:
         """Push one key's gradient: a flat fp32 array, or a
         ``kvstore_codec.CompressedGrad`` (each range shard is cut from
         the full code array — elementwise codec, so shard payloads equal
-        per-shard quantization)."""
+        per-shard quantization).  Chases plan redirects: the seq is
+        fixed BEFORE the retry loop, so a resend that crosses a bucket
+        migration is deduped by the migrated watermark."""
         seq = self.next_seq(key)
         compressed = isinstance(value, codec.CompressedGrad)
 
-        def one(shard):
-            sid, subkey, lo, hi = shard
-            payload = value.wire(lo, hi) if compressed else value[lo:hi]
-            r = self._rpc(sid, ("push", subkey, payload,
-                                self.rank, seq, self._incarnation))
-            if r[0] != "ok":
-                raise MXNetError(str(r))
+        def attempt():
+            def one(shard):
+                sid, subkey, lo, hi = shard
+                payload = value.wire(lo, hi) if compressed else value[lo:hi]
+                r = self._rpc(sid, ("push", subkey, payload,
+                                    self.rank, seq, self._incarnation))
+                if r[0] != "ok":
+                    raise MXNetError(str(r))
 
-        self._fanout(self._shard(key, value.size), one)
+            self._fanout(self._shard(key, value.size), one)
+
+        self._plan_retry(attempt)
 
     def push_multi(self, sid, entries):
         """One RPC carrying a whole fusion bucket: ``entries`` is
@@ -1233,31 +1957,49 @@ class WorkerClient:
         if r[0] != "ok":
             raise MXNetError(str(r))
 
+    def push_bucket(self, bucket, entries):
+        """Push a whole fusion bucket to its CURRENT owner, re-resolving
+        through plan redirects (``entries`` as in :meth:`push_multi`;
+        seqs assigned by the caller survive the retries unchanged)."""
+        self._plan_retry(
+            lambda: self.push_multi(self.server_for_bucket(bucket),
+                                    entries))
+
     def pull(self, key, size):
-        out = np.empty((size,), dtype=np.float32)
-        filled = []
+        def attempt():
+            out = np.empty((size,), dtype=np.float32)
+            filled = []
 
-        def one(shard):
-            sid, subkey, lo, hi = shard
-            r = self._rpc(sid, ("pull", subkey))
-            if r[0] != "val":
-                raise MXNetError(str(r))
-            out[lo:hi] = r[1]
-            filled.append(hi - lo)
+            def one(shard):
+                sid, subkey, lo, hi = shard
+                r = self._rpc(sid, ("pull", subkey, self.rank))
+                if r[0] != "val":
+                    raise MXNetError(str(r))
+                out[lo:hi] = r[1]
+                filled.append(hi - lo)
 
-        self._fanout(self._shard(key, size), one)
-        if sum(filled) != size:
-            raise MXNetError("pull(%r): covered %d of %d elements"
-                             % (key, sum(filled), size))
-        return out
+            self._fanout(self._shard(key, size), one)
+            if sum(filled) != size:
+                raise MXNetError("pull(%r): covered %d of %d elements"
+                                 % (key, sum(filled), size))
+            return out
+
+        return self._plan_retry(attempt)
 
     def pull_multi(self, sid, keys):
         """One RPC pulling every (whole-array) key of a bucket from its
         server; returns the values in key order."""
-        r = self._rpc(sid, ("pull_multi", [(key, 0) for key in keys]))
+        r = self._rpc(sid, ("pull_multi", [(key, 0) for key in keys],
+                            self.rank))
         if r[0] != "vals":
             raise MXNetError(str(r))
         return r[1]
+
+    def pull_bucket(self, bucket, keys):
+        """Pull a whole fusion bucket from its CURRENT owner,
+        re-resolving through plan redirects."""
+        return self._plan_retry(
+            lambda: self.pull_multi(self.server_for_bucket(bucket), keys))
 
     def send_command(self, head, body):
         for sid in range(self.num_servers):
@@ -1270,7 +2012,9 @@ class WorkerClient:
         if timeout is None:
             timeout = float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT"))
         with self.sched_lock:
-            self.sched.send(("barrier",))
+            # rank-carrying arrival: the scheduler counts a late joiner
+            # toward the barrier only once it actually arrives
+            self.sched.send(("barrier", self.rank))
             if not self.sched.poll(timeout):
                 raise MXNetError("barrier timed out after %.0fs (a peer "
                                  "likely died)" % timeout)

@@ -23,9 +23,16 @@ immediately) into a bounded in-flight window of worker threads that
 ``flush()`` blocks until everything submitted has completed and
 re-raises the first failure (a failed op also fails the ops chained
 behind it on the same key — a pull after a dead push must not read a
-stale value).  The window size is ``MXNET_KVSTORE_INFLIGHT``;
-``MXNET_KVSTORE_PIPELINE=0`` bypasses this module entirely (the
-kvstore then runs every RPC inline, the PR-2 behavior).
+stale value).  Under the elastic async plane flush is *staleness- and
+rebalance-aware*: a pull gated by the server's bounded-staleness wait
+simply keeps its window slot until the frontier advances (the op is
+blocked server-side, not failed), and a bucket-plan redirect
+(``PlanMovedError``) re-enqueues the batch to re-shard against the
+refreshed plan instead of surfacing as an error
+(docs/architecture/elastic_ps.md).  The window size is
+``MXNET_KVSTORE_INFLIGHT``; ``MXNET_KVSTORE_PIPELINE=0`` bypasses this
+module entirely (the kvstore then runs every RPC inline, the PR-2
+behavior).
 """
 from __future__ import annotations
 
@@ -44,7 +51,8 @@ class CommOp:
     """One logical kvstore operation (push or pull of one key)."""
 
     __slots__ = ("kind", "key", "priority", "group", "payload", "targets",
-                 "size", "done", "error", "_next", "_order", "result")
+                 "size", "done", "error", "_next", "_order", "result",
+                 "_retries")
 
     def __init__(self, kind, key, priority=0, group=None, payload=None,
                  targets=None, size=None):
@@ -61,15 +69,25 @@ class CommOp:
         self.result = None
         self._next = []             # same-key ops waiting on this one
         self._order = None
+        self._retries = 0           # retryable re-enqueues consumed
 
 
 class CommPipeline:
-    def __init__(self, run_batch, window=None, recorder=None):
+    def __init__(self, run_batch, window=None, recorder=None,
+                 retryable=None, max_retries=8):
         """``run_batch(ops)`` executes one wire batch (all ops share
         kind and group, or it's a single op); ``recorder(name, t0, cat)``
-        reports a finished span to the profiler (optional)."""
+        reports a finished span to the profiler (optional).
+
+        ``retryable(exc)`` marks failures that are routing events, not
+        errors — a bucket-plan redirect (``PlanMovedError``) after live
+        shard rebalancing: the batch is re-enqueued (up to
+        ``max_retries`` per op) and re-runs against the refreshed plan
+        instead of failing the flush."""
         self._run_batch = run_batch
         self._recorder = recorder
+        self._retryable = retryable
+        self._max_retries = int(max_retries)
         window = int(get_env("MXNET_KVSTORE_INFLIGHT")) \
             if window is None else int(window)
         self._window = max(1, window)
@@ -179,6 +197,19 @@ class CommPipeline:
             self._complete(batch, err)
 
     def _complete(self, batch, err):
+        if err is not None and self._retryable is not None \
+                and self._retryable(err) \
+                and all(o._retries < self._max_retries for o in batch):
+            # routing event (plan redirect): put the batch back; the
+            # re-run re-shards against the refreshed plan.  Per-key
+            # chains are safe — these ops were the heads of theirs
+            with self._cv:
+                for o in batch:
+                    o._retries += 1
+                    heapq.heappush(self._heap,
+                                   (-o.priority, o._order, o))
+                self._cv.notify_all()
+            return
         with self._cv:
             for o in batch:
                 self._finish_locked(o, err)

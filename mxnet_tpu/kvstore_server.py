@@ -33,7 +33,17 @@ class KVStoreServer:
     compressed gradient payloads, which ``dist_sync`` merges exactly in
     the integer code domain.  Storage, dedup watermarks and snapshots
     stay strictly per-key, so snapshots are bucket-layout independent
-    and restore across restarts regardless of data-plane settings."""
+    and restore across restarts regardless of data-plane settings.
+
+    Async plane (docs/architecture/elastic_ps.md): ``dist_async`` arms
+    the elastic bounded-staleness mode via the ``async_mode`` command —
+    the updater runs per push with an immediate reply, per-key version
+    vectors track each worker's applied updates, pulls are gated by
+    ``MXNET_KVSTORE_MAX_STALENESS`` against the slowest LIVE worker
+    (the scheduler's epoched membership view retires dead/departed
+    ranks from the frontier), and whole fusion buckets migrate between
+    servers under traffic (``migrate_out``/``install_bucket``, with
+    redirect replies retargeting workers)."""
 
     def __init__(self, kvstore=None):
         self.kvstore = kvstore

@@ -11,6 +11,13 @@ src/kvstore/kvstore_dist.h:159-168 and :39,77,178):
   the startup barriers the surviving group is already past, and pushes a
   distinctive value rank 0 waits for — training continued through a
   worker death.
+
+The quick-tier in-process promotion of this scenario — heartbeat death
+bumping the epoched membership view, the staleness frontier retiring
+the dead rank, the barrier releasing without it — lives in
+``tests/test_elastic_ps.py::
+test_heartbeat_death_bumps_epoch_and_unstalls_frontier``; this
+subprocess variant stays as the real-SIGKILL end-to-end check.
 """
 import os
 import signal

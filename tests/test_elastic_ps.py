@@ -1,0 +1,651 @@
+"""Elastic asynchronous parameter server (docs/architecture/elastic_ps.md):
+
+* factory regression: ``dist_async`` now arms the REAL async server mode
+  (version vectors + staleness gating), ``dist_sync`` unchanged, unknown
+  names still raise;
+* bounded staleness (SSP): a property check that no admitted pull ever
+  observes a violation of ``MXNET_KVSTORE_MAX_STALENESS``, and that
+  ``s=0`` byte-matches the dist_sync merge on the same schedule;
+* straggler scenario: one worker injected persistently slow via the new
+  seeded ``straggler`` fault kind — ``dist_async`` at s=4 sustains >= 2x
+  the steps/sec of ``dist_sync`` on the same schedule;
+* epoched elastic membership: heartbeat death bumps the epoch, retires
+  the dead rank's version entries from the staleness frontier (no
+  stall), and shrinks the barrier target (the in-process quick-tier
+  variant of tests/dist_dead_node.py);
+* elastic join: a worker joining mid-run enters the version vectors at
+  the frontier and the final values byte-match the static-membership
+  run;
+* live shard rebalancing: bucket migration between servers under
+  traffic — zero lost or duplicated pushes (the dedup watermarks
+  migrate with the bucket, surviving a lost-reply resend that crosses
+  the migration), including server capacity add/remove mid-run;
+* ``straggler`` fault-kind determinism: two runs of the same seeded
+  schedule produce identical fault logs.
+
+``make elastic-smoke`` runs this file under MXNET_LOCK_CHECK=1 with a
+hard timeout (ci.yaml per-change stage).
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject
+from mxnet_tpu import kvstore_codec as codec
+from mxnet_tpu import kvstore_dist as ksd
+from mxnet_tpu.base import MXNetError
+
+REPO_KEY = 7          # the key most scenarios train on
+SIZE = 8              # elements per key
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_fault_plan():
+    yield
+    faultinject.install(None)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Cluster:
+    """In-process scheduler + N servers; workers are created on demand
+    (bare WorkerClients or full KVStoreDist stores)."""
+
+    def __init__(self, monkeypatch, n_workers=1, n_servers=1, **env):
+        base = {
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(_free_port()),
+            "DMLC_NUM_WORKER": str(n_workers),
+            "DMLC_NUM_SERVER": str(n_servers),
+            "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.1",
+            "MXNET_KVSTORE_DEAD_TIMEOUT": "2.0",
+            "MXNET_KVSTORE_MEMBERSHIP_TTL": "0.05",
+            "MXNET_KVSTORE_BARRIER_TIMEOUT": "30",
+        }
+        base.update({k: str(v) for k, v in env.items()})
+        for k, v in base.items():
+            monkeypatch.setenv(k, v)
+        monkeypatch.delenv("DMLC_PS_RECOVERY_RANK", raising=False)
+        monkeypatch.delenv("MXNET_KVSTORE_SNAPSHOT_DIR", raising=False)
+        self.sched = ksd.Scheduler()
+        threading.Thread(target=self.sched.run, daemon=True).start()
+        self.servers = []
+        for _ in range(n_servers):
+            self.add_server()
+        self.clients = []
+
+    def add_server(self):
+        """Spin one more server (beyond DMLC_NUM_SERVER = a capacity
+        add: it registers, the scheduler's address table grows, and
+        buckets migrate onto it via the versioned plan)."""
+        server = ksd.Server()
+        threading.Thread(target=server.run, daemon=True).start()
+        self.servers.append(server)
+        return server
+
+    def client(self, plan_sizes=None):
+        c = ksd.WorkerClient()
+        if plan_sizes is not None:
+            plan = codec.BucketPlan(bucket_bytes=4096)
+            for k, n in plan_sizes:
+                plan.add(k, n)
+            c.plan = plan
+        self.clients.append(c)
+        return c
+
+    def finalize(self):
+        for i, c in enumerate(self.clients):
+            try:
+                c.finalize(i == len(self.clients) - 1)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+
+def _wait_until(pred, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: factory regression — dist_async routes to the async mode
+# ---------------------------------------------------------------------------
+def test_factory_dist_async_arms_async_server(monkeypatch):
+    cl = _Cluster(monkeypatch, n_workers=1, n_servers=1)
+    kv = mx.create_kvstore("dist_async")
+    try:
+        assert isinstance(kv, mx.kvstore.KVStoreDist)
+        _wait_until(lambda: cl.servers[0].async_mode,
+                    what="async_mode command")
+        assert not cl.servers[0].sync_mode
+    finally:
+        kv.close()
+
+
+def test_factory_dist_sync_unchanged(monkeypatch):
+    cl = _Cluster(monkeypatch, n_workers=1, n_servers=1)
+    kv = mx.create_kvstore("dist_sync")
+    try:
+        _wait_until(lambda: cl.servers[0].sync_mode,
+                    what="sync_mode command")
+        assert not cl.servers[0].async_mode
+    finally:
+        kv.close()
+
+
+def test_factory_unknown_names_still_raise():
+    with pytest.raises(MXNetError):
+        mx.create_kvstore("dist_bogus")
+    with pytest.raises(TypeError):
+        mx.create_kvstore(3)
+
+
+# ---------------------------------------------------------------------------
+# Bounded staleness: property check + s=0 sync parity
+# ---------------------------------------------------------------------------
+def _run_workers(workers):
+    """Run each worker loop in a thread; re-raise the first failure."""
+    errs = []
+
+    def run(fn):
+        try:
+            fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            errs.append(exc)
+
+    ts = [threading.Thread(target=run, args=(fn,), daemon=True)
+          for fn in workers]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+        assert not t.is_alive(), "worker loop wedged"
+    if errs:
+        raise errs[0]
+
+
+def test_staleness_bound_never_violated(monkeypatch):
+    """Property: every ADMITTED gated pull satisfies
+    my_version - slowest_live_version <= s, even with one worker
+    running much slower than the other (seeded jitter)."""
+    s = 2
+    cl = _Cluster(monkeypatch, n_workers=2, n_servers=1,
+                  MXNET_KVSTORE_MAX_STALENESS=s)
+    server = cl.servers[0]
+    server.stale_log = []
+    a, b = cl.client(), cl.client()
+    server._handle_command("async_mode", b"")
+    a.init(REPO_KEY, np.zeros(SIZE, np.float32))
+    rng = np.random.RandomState(11)
+    steps = 8
+
+    def loop(client, slow):
+        for _ in range(steps):
+            if slow:
+                time.sleep(float(rng.uniform(0.01, 0.03)))
+            client.push(REPO_KEY, np.ones(SIZE, np.float32))
+            client.pull(REPO_KEY, SIZE)
+
+    _run_workers([lambda: loop(a, False), lambda: loop(b, True)])
+    out = a.pull(REPO_KEY, SIZE)
+    np.testing.assert_array_equal(
+        out, np.full(SIZE, 2.0 * steps, np.float32))
+    assert server.stale_log, "no gated pulls were observed"
+    lags = [my - slowest for _, _, my, slowest in server.stale_log]
+    assert max(lags) <= s, server.stale_log
+    # the fast worker actually ran ahead (the bound did real work)
+    assert any(lag > 0 for lag in lags)
+    cl.finalize()
+
+
+def _push_pull_schedule(cluster, n_workers, steps, keys):
+    """Deterministic integer-valued schedule all parity runs share."""
+    clients = [cluster.client() for _ in range(n_workers)]
+    clients[0].init(keys[0], np.zeros(SIZE, np.float32))
+    for k in keys[1:]:
+        clients[0].init(k, np.zeros(SIZE, np.float32))
+
+    def loop(client, r):
+        for step in range(steps):
+            for k in keys:
+                client.push(k, np.full(SIZE, float(r + 1), np.float32))
+            for k in keys:
+                client.pull(k, SIZE)
+
+    _run_workers([lambda c=c, r=r: loop(c, r)
+                  for r, c in enumerate(clients)])
+    finals = [clients[0].pull(k, SIZE).copy() for k in keys]
+    return finals
+
+
+def test_s0_byte_matches_dist_sync(monkeypatch):
+    """s=0 degenerates to sync-read semantics: on an integer-valued
+    schedule the final values byte-match the dist_sync merge of the
+    same schedule (accumulate updater; fp32-exact values)."""
+    steps, keys = 3, [1, 2]
+    sync = _Cluster(monkeypatch, n_workers=2, n_servers=1)
+    sync.servers[0]._handle_command("sync_mode", b"")
+    sync_finals = _push_pull_schedule(sync, 2, steps, keys)
+    sync.finalize()
+
+    async_ = _Cluster(monkeypatch, n_workers=2, n_servers=1,
+                      MXNET_KVSTORE_MAX_STALENESS=0)
+    async_.servers[0]._handle_command("async_mode", b"")
+    async_finals = _push_pull_schedule(async_, 2, steps, keys)
+    async_.finalize()
+
+    expected = np.full(SIZE, float(steps * (1 + 2)), np.float32)
+    for sv, av in zip(sync_finals, async_finals):
+        np.testing.assert_array_equal(sv, av)
+        np.testing.assert_array_equal(av, expected)
+
+
+# ---------------------------------------------------------------------------
+# Straggler scenario: async s=4 outruns dist_sync >= 2x
+# ---------------------------------------------------------------------------
+def _straggler_run(cluster, mode, steps, straggler_s):
+    """Two workers; worker 1 is made a persistent straggler by the
+    seeded ``straggler`` fault kind at its send seam.  Returns worker
+    0's steps/sec."""
+    a, b = cluster.client(), cluster.client()
+    server = cluster.servers[0]
+    if mode == "sync":
+        server._handle_command("sync_mode", b"")
+        a.sync_push = b.sync_push = True
+    else:
+        server._handle_command("async_mode", b"")
+    a.init(REPO_KEY, np.zeros(SIZE, np.float32))
+    faultinject.install({"seed": 5, "rules": [
+        {"seam": "worker.send", "rank": 1, "action": "straggler",
+         "seconds": straggler_s}]})
+    elapsed = [None]
+
+    def fast():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            a.push(REPO_KEY, np.ones(SIZE, np.float32))
+            a.pull(REPO_KEY, SIZE)
+        elapsed[0] = time.perf_counter() - t0
+
+    def slow():
+        for _ in range(steps):
+            b.push(REPO_KEY, np.ones(SIZE, np.float32))
+            b.pull(REPO_KEY, SIZE)
+
+    try:
+        _run_workers([fast, slow])
+    finally:
+        faultinject.install(None)
+    final = a.pull(REPO_KEY, SIZE)
+    np.testing.assert_array_equal(
+        final, np.full(SIZE, 2.0 * steps, np.float32))
+    cluster.finalize()
+    return steps / elapsed[0]
+
+
+def test_straggler_async_s4_at_least_2x_dist_sync(monkeypatch):
+    """Acceptance: one worker ~5x slow (every RPC of rank 1 sleeps a
+    straggler delay); over a bounded window of 7 steps the fast worker
+    under dist_async s=4 must sustain >= 2x its dist_sync rate — in
+    sync mode every merge round waits for the straggler, at s=4 the
+    fast worker runs 4 steps ahead of it."""
+    steps, delay = 7, 0.03
+    sync_cl = _Cluster(monkeypatch, n_workers=2, n_servers=1)
+    sync_rate = _straggler_run(sync_cl, "sync", steps, delay)
+    async_cl = _Cluster(monkeypatch, n_workers=2, n_servers=1,
+                        MXNET_KVSTORE_MAX_STALENESS=4)
+    async_rate = _straggler_run(async_cl, "async", steps, delay)
+    assert async_rate >= 2.0 * sync_rate, (async_rate, sync_rate)
+
+
+# ---------------------------------------------------------------------------
+# Epoched membership: heartbeat death (in-process dist_dead_node variant)
+# ---------------------------------------------------------------------------
+def test_heartbeat_death_bumps_epoch_and_unstalls_frontier(monkeypatch):
+    """The quick-tier promotion of tests/dist_dead_node.py: worker 1
+    goes silent mid-run — the epoch bumps, get_num_dead_node sees it,
+    the server retires its version entries so a s=0 pull does NOT
+    stall, and the barrier releases without the dead peer."""
+    cl = _Cluster(monkeypatch, n_workers=2, n_servers=1,
+                  MXNET_KVSTORE_MAX_STALENESS=0,
+                  MXNET_KVSTORE_DEAD_TIMEOUT="0.6")
+    server = cl.servers[0]
+    a, b = cl.client(), cl.client()
+    server._handle_command("async_mode", b"")
+    a.init(REPO_KEY, np.zeros(SIZE, np.float32))
+    one = np.ones(SIZE, np.float32)
+    a.push(REPO_KEY, one)
+    b.push(REPO_KEY, one)
+    a.pull(REPO_KEY, SIZE)          # balanced: admitted immediately
+    epoch0, live0 = a.membership()
+    assert sorted(r for r, _ in live0) == [0, 1]
+
+    # worker 1 "dies": heartbeats stop, no clean finalize
+    b._hb_stop.set()
+    time.sleep(0.3)                 # let the last queued beat drain
+
+    # a keeps training: at s=0 this pull would stall on b forever were
+    # the dead rank not retired from the frontier
+    a.push(REPO_KEY, one)
+    t0 = time.monotonic()
+    out = a.pull(REPO_KEY, SIZE)
+    assert time.monotonic() - t0 < 10.0, "staleness frontier stalled"
+    np.testing.assert_array_equal(out, one * 3)
+
+    assert a.get_num_dead_node(4, timeout=0.6) >= 1
+    epoch1, live1 = a.membership(timeout=0.6)
+    assert epoch1 > epoch0
+    assert sorted(r for r, _ in live1) == [0]
+    # frontier retirement: the dead rank's version entries are gone
+    _wait_until(lambda: 1 not in server._versions.get((REPO_KEY, 0), {}),
+                what="dead rank's version retirement")
+    # the barrier path reads the same epoched view: no hang on the dead
+    # peer
+    t0 = time.monotonic()
+    a.barrier(timeout=20)
+    assert time.monotonic() - t0 < 10.0
+    cl.finalize()
+
+
+def test_revived_worker_resumes_true_version_count(monkeypatch):
+    """A swept-dead rank that HEARTBEATS again (GC pause, not a crash)
+    must resume its retired version count — re-entering at zero would
+    drag the staleness frontier back to the start line and stall every
+    peer for ~N rounds."""
+    cl = _Cluster(monkeypatch, n_workers=2, n_servers=1,
+                  MXNET_KVSTORE_MAX_STALENESS=4,
+                  MXNET_KVSTORE_DEAD_TIMEOUT="0.5")
+    server = cl.servers[0]
+    a, b = cl.client(), cl.client()
+    server._handle_command("async_mode", b"")
+    a.init(REPO_KEY, np.zeros(SIZE, np.float32))
+    one = np.ones(SIZE, np.float32)
+    for _ in range(6):
+        a.push(REPO_KEY, one)
+        b.push(REPO_KEY, one)
+    wire = (REPO_KEY, 0)
+    assert server._versions[wire][1] == 6
+    # b pauses long enough to be declared dead; frontier retires it
+    b._hb_stop.set()
+    a.push(REPO_KEY, one)               # keeps the membership sweep hot
+    _wait_until(lambda: (a.pull(REPO_KEY, SIZE) is not None
+                         and 1 not in server._versions.get(wire, {})),
+                what="retirement of the paused rank")
+    assert server._retired_versions[wire][1] == 6   # stashed, not lost
+    # b revives: heartbeats resume, then it pushes again
+    b._hb_stop = threading.Event()
+    ksd._start_heartbeat("worker", b.rank, b._hb_stop)
+    _wait_until(lambda: a.get_num_dead_node(4, timeout=0.5) == 0,
+                what="revival via heartbeat")
+    b.push(REPO_KEY, one)
+    assert server._versions[wire][1] == 7   # resumed at 6+1, not at 1
+    cl.finalize()
+
+
+# ---------------------------------------------------------------------------
+# Elastic join: mid-run joiner enters at the frontier, values converge
+# ---------------------------------------------------------------------------
+def test_worker_join_mid_run_matches_static_run(monkeypatch):
+    """A worker joining a 1-worker group mid-run (rank beyond
+    DMLC_NUM_WORKER => late) bootstraps via pull, enters the version
+    vectors at the current frontier (no staleness stall in either
+    direction), and the final values byte-match the static run where
+    both pushed from the start."""
+    t1, t2 = 4, 3
+    one = np.ones(SIZE, np.float32)
+
+    def elastic_run():
+        cl = _Cluster(monkeypatch, n_workers=1, n_servers=1,
+                      MXNET_KVSTORE_MAX_STALENESS=0)
+        server = cl.servers[0]
+        a = cl.client()
+        assert not a.late_join
+        server._handle_command("async_mode", b"")
+        a.init(REPO_KEY, np.zeros(SIZE, np.float32))
+        for _ in range(t1):
+            a.push(REPO_KEY, one)
+            a.pull(REPO_KEY, SIZE)      # never stalls: group is {0}
+        frontier = max(server._versions[(REPO_KEY, 0)].values())
+        b = cl.client()
+        assert b.late_join
+        boot = b.pull(REPO_KEY, SIZE)   # bootstrap read at the frontier
+        np.testing.assert_array_equal(boot, one * t1)
+        # post-join the group trains together; at s=0 the gated pulls
+        # admit exactly because the joiner entered at the FRONTIER
+        # (entering at zero would stall a; counting from zero would
+        # stall b)
+        for _ in range(t2):
+            b.push(REPO_KEY, one)
+            a.push(REPO_KEY, one)
+            a.pull(REPO_KEY, SIZE)
+            b.pull(REPO_KEY, SIZE)
+        # the joiner entered at the frontier, not at zero
+        assert server._versions[(REPO_KEY, 0)][1] == frontier + t2
+        out = a.pull(REPO_KEY, SIZE).copy()
+        cl.finalize()
+        return out
+
+    def static_run():
+        cl = _Cluster(monkeypatch, n_workers=2, n_servers=1,
+                      MXNET_KVSTORE_MAX_STALENESS=-1)
+        cl.servers[0]._handle_command("async_mode", b"")
+        a, b = cl.client(), cl.client()
+        a.init(REPO_KEY, np.zeros(SIZE, np.float32))
+        for _ in range(t1 + t2):
+            a.push(REPO_KEY, one)
+        for _ in range(t2):
+            b.push(REPO_KEY, one)
+        out = a.pull(REPO_KEY, SIZE).copy()
+        cl.finalize()
+        return out
+
+    np.testing.assert_array_equal(elastic_run(), static_run())
+
+
+# ---------------------------------------------------------------------------
+# Live shard rebalancing
+# ---------------------------------------------------------------------------
+_BUCKET_KEYS = [(0, SIZE), (1, SIZE)]   # one small fusion bucket
+
+
+def _pusher(client, keys, n, delta, start_evt):
+    def loop():
+        start_evt.wait()
+        for _ in range(n):
+            for k in keys:
+                client.push(k, np.full(SIZE, delta, np.float32))
+    return loop
+
+
+def test_bucket_migration_under_traffic_exactly_once(monkeypatch):
+    """Migrate the bucket between two servers while a pusher hammers
+    it, with a lost push reply scheduled so a dedup-protected resend
+    CROSSES the migration: zero lost, zero duplicated pushes — the
+    final values equal the static run's exactly."""
+    n = 30
+    cl = _Cluster(monkeypatch, n_workers=1, n_servers=2)
+    for srv in cl.servers:
+        srv._handle_command("async_mode", b"")
+    c = cl.client(plan_sizes=_BUCKET_KEYS)
+    for k, sz in _BUCKET_KEYS:
+        c.init(k, np.zeros(sz, np.float32))
+    src = c.server_for_bucket(0)
+    dst = 1 - src
+    # drop one push REPLY mid-stream: the server applies it, the worker
+    # resends — and the resend may land on the post-migration owner,
+    # whose migrated watermark must dedupe it
+    faultinject.install({"seed": 3, "rules": [
+        {"seam": "worker.recv", "kind": "push", "nth": 10,
+         "action": "drop"}]})
+    start = threading.Event()
+    t = threading.Thread(target=_pusher(c, [k for k, _ in _BUCKET_KEYS],
+                                        n, 1.0, start), daemon=True)
+    t.start()
+    start.set()
+    time.sleep(0.05)                     # migration lands mid-traffic
+    version = c.migrate_bucket(0, dst)
+    assert version >= 1
+    t.join(timeout=60)
+    assert not t.is_alive()
+    faultinject.install(None)
+    for k, _ in _BUCKET_KEYS:
+        out = c.pull(k, SIZE)
+        np.testing.assert_array_equal(
+            out, np.full(SIZE, float(n), np.float32))
+        # state actually moved: target serves, source redirects
+        assert (k, 0) in cl.servers[dst].store
+        assert (k, 0) in cl.servers[src]._moved
+        assert (k, 0) not in cl.servers[src].store
+    cl.finalize()
+
+
+def test_capacity_add_and_remove_mid_run(monkeypatch):
+    """Server capacity add (a server registering beyond
+    DMLC_NUM_SERVER) and remove (migrating its buckets away) mid-run:
+    traffic retargets through the versioned plan and the final values
+    byte-match the static single-server run."""
+    n_before, n_on_new, n_after = 8, 8, 8
+    cl = _Cluster(monkeypatch, n_workers=1, n_servers=1)
+    cl.servers[0]._handle_command("async_mode", b"")
+    c = cl.client(plan_sizes=_BUCKET_KEYS)
+    keys = [k for k, _ in _BUCKET_KEYS]
+    for k, sz in _BUCKET_KEYS:
+        c.init(k, np.zeros(sz, np.float32))
+    one = np.ones(SIZE, np.float32)
+    for _ in range(n_before):
+        for k in keys:
+            c.push(k, one)
+    # -- capacity add: new server joins the running cluster ------------
+    added = cl.add_server()
+    _wait_until(lambda: added.rank is not None, what="server join")
+    assert added.rank == 1
+    c.migrate_bucket(0, 1)
+    assert len(c.servers) == 2           # pools grew with the census
+    for _ in range(n_on_new):
+        for k in keys:
+            c.push(k, one)
+    assert all((k, 0) in added.store for k in keys)
+    # the migrated updater-less store kept exact counts so far
+    np.testing.assert_array_equal(
+        c.pull(keys[0], SIZE),
+        np.full(SIZE, float(n_before + n_on_new), np.float32))
+    # -- capacity remove: drain the bucket off, then stop the server ---
+    c.migrate_bucket(0, 0)
+    assert all((k, 0) not in added.store for k in keys)
+    for _ in range(n_after):
+        for k in keys:
+            c.push(k, one)
+    total = float(n_before + n_on_new + n_after)
+    for k in keys:
+        np.testing.assert_array_equal(
+            c.pull(k, SIZE), np.full(SIZE, total, np.float32))
+    cl.finalize()
+
+
+def test_migrated_bucket_carries_updater_state(monkeypatch):
+    """Server-side optimizer state (momentum) migrates with the bucket:
+    post-migration updates continue the SAME momentum stream as an
+    unmigrated run."""
+    import pickle
+
+    from mxnet_tpu import optimizer as opt
+
+    def run(migrate):
+        cl = _Cluster(monkeypatch, n_workers=1, n_servers=2)
+        for srv in cl.servers:
+            srv._handle_command("async_mode", b"")
+        c = cl.client(plan_sizes=_BUCKET_KEYS)
+        c.send_command(0, pickle.dumps(opt.Optimizer.create_optimizer(
+            "sgd", learning_rate=0.1, momentum=0.9)))
+        for k, sz in _BUCKET_KEYS:
+            c.init(k, np.zeros(sz, np.float32))
+        g = np.full(SIZE, 0.5, np.float32)
+        for _ in range(3):
+            c.push(0, g)
+        if migrate:
+            c.migrate_bucket(0, 1 - c.server_for_bucket(0))
+        for _ in range(3):
+            c.push(0, g)
+        out = c.pull(0, SIZE).copy()
+        cl.finalize()
+        return out
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: straggler fault kind is seeded-deterministic
+# ---------------------------------------------------------------------------
+_STRAGGLER_SPEC = {"seed": 13, "rules": [
+    {"seam": "worker.send", "rank": 1, "action": "straggler",
+     "seconds": 0.005},
+    {"seam": "server.recv", "kind": "push", "nth": 3, "count": 2,
+     "action": "straggler", "seconds": 0.005},
+    {"seam": "worker.recv", "kind": "pull", "nth": 2, "action": "drop"},
+]}
+
+
+def _drive_plan(spec):
+    plan = faultinject.install(dict(spec))
+    seq = [("worker.send", {"kind": "push", "rank": 1, "sid": 0}),
+           ("worker.send", {"kind": "push", "rank": 0, "sid": 0}),
+           ("server.recv", {"kind": "push", "rank": 0}),
+           ("server.recv", {"kind": "push", "rank": 0}),
+           ("server.recv", {"kind": "push", "rank": 0}),
+           ("server.recv", {"kind": "pull", "rank": 0}),
+           ("server.recv", {"kind": "push", "rank": 0}),
+           ("worker.recv", {"kind": "pull", "rank": 1, "sid": 0}),
+           ("worker.recv", {"kind": "pull", "rank": 1, "sid": 0}),
+           ("worker.send", {"kind": "pull", "rank": 1, "sid": 0})]
+    out = []
+    for seam, meta in seq:
+        try:
+            out.append((seam, faultinject.hook(seam, **meta)))
+        except OSError as exc:
+            out.append((seam, "raised:%s" % type(exc).__name__))
+    log = list(plan.log)
+    faultinject.install(None)
+    return out, log
+
+
+def test_straggler_fault_kind_deterministic():
+    """Two runs of the same seeded schedule over the same event
+    sequence produce identical fault logs and identical hook outcomes;
+    straggler rules default to count=inf (persistent) unlike delay."""
+    out1, log1 = _drive_plan(_STRAGGLER_SPEC)
+    out2, log2 = _drive_plan(_STRAGGLER_SPEC)
+    assert out1 == out2
+    assert log1 == log2 and log1
+    # straggler fired on EVERY matching event (persistent), delay-style
+    # kinds stay bounded by their count
+    straggler_hits = [e for e in log1 if e[4] == "straggler"
+                      and e[0] == "worker.send"]
+    assert len(straggler_hits) == 2     # BOTH rank-1 sends (count=inf)
+    # and the seeded retry jitter is reproducible under the same plan
+    faultinject.install(dict(_STRAGGLER_SPEC))
+    d1 = [ksd.RetryPolicy().delay(k) for k in range(4)]
+    faultinject.install(dict(_STRAGGLER_SPEC))
+    d2 = [ksd.RetryPolicy().delay(k) for k in range(4)]
+    faultinject.install(None)
+    assert d1 == d2
+
+
+def test_straggler_actually_sleeps():
+    faultinject.install({"rules": [
+        {"seam": "server.recv", "action": "straggler", "seconds": 0.05}]})
+    t0 = time.perf_counter()
+    assert faultinject.hook("server.recv", kind="push") is None
+    assert time.perf_counter() - t0 >= 0.05
+    faultinject.install(None)
