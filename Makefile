@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke spmd-smoke kernels-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke spmd-smoke kernels-smoke data-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -93,6 +93,17 @@ kernels-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu \
 		$(PY) -m pytest tests/test_pallas_kernels.py \
 		tests/test_remat_policy.py -q
+
+# checkpointable-data-plane gate (docs/architecture/data_pipeline.md):
+# the state_dict/load_state round-trip property over every shipped
+# DataIter, seeded mid-epoch fit resume with a byte-identical remaining
+# stream (also under num_parts=2 sharding), the subprocess
+# SIGKILL-mid-epoch scenario, and the banked BENCH_data_cpu.json pins.
+# The conftest thread-leak gate covers the pipeline/stager/prefetch
+# threads; hard timeout like the other smokes
+data-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/test_data_pipeline.py -q
 
 # smoke fit under the profiler -> per-step phase breakdown
 # (data_wait/h2d_stage/compute/metric_fetch) from the dumped trace, so

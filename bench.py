@@ -969,6 +969,140 @@ def bench_input_staging(chip, smoke=False):
             "batch_size": batch}
 
 
+def _sharded_bench_rec(tmp, n, size):
+    """Seeded synthetic recordio + idx sidecar (pixel/label = record id)."""
+    from mxnet_tpu.io import recordio
+    from mxnet_tpu.io.image_util import encode_image
+    rec = os.path.join(tmp, "bench.rec")
+    idx = os.path.join(tmp, "bench.idx")
+    rs = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        img = rs.randint(0, 255, (size, size, 3)).astype(np.uint8)
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0),
+            encode_image(img, quality=90)))
+    w.close()
+    return rec, idx
+
+
+def bench_sharded_stream(mode, chip, smoke=False):
+    """Checkpointable sharded streaming pipeline rows
+    (docs/architecture/data_pipeline.md), CPU-deterministic: seeded
+    synthetic recordio + an injected per-record decode latency (the
+    faultinject-delay pattern standing in for heavy JPEG/augment work).
+
+    * ``throughput``: images/sec of the seeded sharded+shuffled pipeline
+      (4 decode threads behind the double-buffered batch queue) vs the
+      same records decoded serially — the parser-pool overlap.
+    * ``resume_overhead``: wall time to resume mid-epoch (fresh iterator
+      + ``load_state`` + first batch out) vs one epoch's wall time; the
+      production gate is <5% of an epoch (tests pin the banked row)."""
+    import shutil
+    import tempfile
+    import mxnet_tpu as mx
+
+    # the injected latency dominates decode (sleeps release the GIL, so
+    # the overlap measurement is stable even on a 2-core host where the
+    # numpy half of decode serializes); the resume-mode epoch is sized
+    # to ~2s of wall so the resume cost (iterator construction +
+    # load_state + first batch, tens of ms) sits well under the 5%
+    # acceptance gate even on a loaded CI host
+    if smoke:
+        n, size, batch = 96, 16, 8
+    else:
+        n, size, batch = (512, 20, 16) if mode == "throughput" \
+            else (1536, 20, 16)
+    delay_s = 0.004 if mode == "throughput" and not smoke else 0.002
+    shape = (3, size, size)
+    tmp = tempfile.mkdtemp(prefix="mxt-bench-data-")
+    try:
+        rec, idx = _sharded_bench_rec(tmp, n, size)
+
+        class _DelayedRecordIter(mx.io.ImageRecordIter):
+            """Injected per-record decode latency (subclass override so
+            the pipeline's bound decode carries the delay from record
+            zero — no mid-flight swap)."""
+
+            def _decode_one(self, s, meta):
+                time.sleep(delay_s)
+                return super()._decode_one(s, meta)
+
+        def make_iter(threads=4):
+            return _DelayedRecordIter(
+                path_imgrec=rec, path_imgidx=idx, data_shape=shape,
+                batch_size=batch, shuffle=True, preprocess_threads=threads,
+                seed=11)
+
+        def drain_epoch(it):
+            t0 = time.perf_counter()
+            imgs = 0
+            for b in it:
+                imgs += b.data[0].shape[0] - (b.pad or 0)
+            return time.perf_counter() - t0, imgs
+
+        if mode == "throughput":
+            from mxnet_tpu.data import ShardedRecordDataset
+            from mxnet_tpu.io import recordio as rio
+            from mxnet_tpu.io.image_util import decode_record_image
+            ds = ShardedRecordDataset(rec, idx, shuffle=True, seed=11)
+            t0 = time.perf_counter()
+            serial = 0
+            while True:
+                item = ds.read()
+                if item is None:
+                    break
+                header, img_bytes = rio.unpack(item[0])
+                time.sleep(delay_s)
+                decode_record_image(img_bytes, shape)
+                serial += 1
+            t_serial = time.perf_counter() - t0
+            ds.close()
+            it = make_iter(4)
+            t_pipe, imgs = drain_epoch(it)
+            it.close()
+            assert imgs == serial == n
+            return {"metric": "io.sharded_stream.throughput",
+                    "value": round(imgs / t_pipe, 1),
+                    "unit": "images/sec", "vs_baseline": None,
+                    "serial_images_per_sec": round(serial / t_serial, 1),
+                    "speedup_vs_serial": round(t_serial / t_pipe, 3),
+                    "records": n, "batch_size": batch,
+                    "decode_threads": 4,
+                    "injected_decode_latency_ms": delay_s * 1e3,
+                    "note": "seeded shuffle + sharding-capable plan; the "
+                            "same chain is checkpointable mid-epoch "
+                            "(state_dict/load_state)"}
+
+        # resume_overhead: epoch wall vs (fresh iterator + load_state +
+        # first batch)
+        it = make_iter(4)
+        t_epoch, imgs = drain_epoch(it)
+        it.close()
+        part = make_iter(4)
+        for _ in range(max(1, (n // batch) // 2)):
+            next(part)
+        state = part.state_dict()
+        part.close()
+        t0 = time.perf_counter()
+        fresh = make_iter(4)
+        fresh.load_state(state)
+        next(fresh)
+        t_resume = time.perf_counter() - t0
+        fresh.close()
+        ratio = t_resume / t_epoch
+        return {"metric": "io.sharded_stream.resume_overhead",
+                "value": round(t_resume * 1e3, 2), "unit": "ms",
+                "vs_baseline": None,
+                "epoch_ms": round(t_epoch * 1e3, 1),
+                "overhead_vs_epoch": round(ratio, 4),
+                "acceptance": "resume overhead < 5% of one epoch",
+                "passes": bool(ratio < 0.05),
+                "records": n, "batch_size": batch}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _spmd_exec_group_rate(n_ctx, spmd, steps, warmup, batch_per_dev=16,
                           feat=64):
     """Steps/sec of multi-device ``Module`` training driven through the
@@ -1669,6 +1803,12 @@ def main():
         guard("kvstore.async_staleness.%s" % st_mode,
               bench_kvstore_async_staleness, st_mode, chip, smoke)
     guard("io.input_staging", bench_input_staging, chip, smoke)
+    # CPU-deterministic checkpointable-data-plane rows (seeded synthetic
+    # recordio + injected decode latency), banked as BENCH_data_cpu
+    guard("io.sharded_stream.throughput", bench_sharded_stream,
+          "throughput", chip, smoke)
+    guard("io.sharded_stream.resume_overhead", bench_sharded_stream,
+          "resume_overhead", chip, smoke)
     # CPU-deterministic one-SPMD-step-program rows (need >=8 visible
     # devices: XLA_FLAGS=--xla_force_host_platform_device_count=8 on
     # CPU, or a real multi-chip slice; skipped rows otherwise)

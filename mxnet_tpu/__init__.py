@@ -48,6 +48,7 @@ from . import kvstore_server  # noqa: E402  (role hijack runs at kvstore
 from . import faultinject  # noqa: E402  (deterministic dist fault injection)
 from . import io
 from .io import recordio  # noqa: E402
+from . import data  # noqa: E402  (checkpointable sharded streaming datasets)
 from . import module
 from . import module as mod  # mx.mod shorthand (reference __init__.py:53)  # noqa: E402
 from .module import Module  # noqa: E402

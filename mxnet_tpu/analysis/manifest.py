@@ -44,6 +44,7 @@ HOST_SYNC_NP_FUNCS = frozenset(["asarray", "array"])  # np./numpy./onp.
 SPAN_ENTRY_POINTS = (
     ("mxnet_tpu/cached_op.py", "_run"),
     ("mxnet_tpu/engine.py", "Engine.dispatch"),
+    ("mxnet_tpu/io/pipeline.py", "ThreadedBatchPipeline.next_batch"),
     ("mxnet_tpu/io/stager.py", "DeviceStager._stage_batch"),
     ("mxnet_tpu/kvstore_dist.py", "Server._install_bucket"),
     ("mxnet_tpu/kvstore_dist.py", "Server._migrate_out"),

@@ -190,6 +190,15 @@ register_env("MXNET_IO_STAGE_DEPTH", int, 2,
              "input stager (the double-buffer depth).  Each slot pins "
              "one batch of device memory; 2 is classic double "
              "buffering.")
+register_env("MXNET_DATA_SEED", int, 0,
+             "Deterministic data-plane seed (data/sharded.py): epoch "
+             "shuffle permutations derive from Philox(seed, epoch) — "
+             "identical on every worker and restart — and record "
+             "augmentation draws from a per-record generator keyed on "
+             "(seed, epoch, ordinal), so a mid-epoch resume replays "
+             "shuffle AND augmentation exactly.  0/unset = legacy "
+             "behavior bit-for-bit: order and augmentation come from "
+             "the module-global numpy RNG.")
 register_env("MXNET_EXEC_DONATE", bool, True,
              "Donate dead auxiliary-state buffers (BatchNorm moving "
              "stats) into the symbolic Executor's jitted train "

@@ -1,0 +1,19 @@
+"""Checkpointable sharded streaming data plane.
+
+The production face of the input pipeline (ROADMAP item 5): a sharded,
+globally-shuffled RecordIO dataset whose exact read position — record
+cursor, permutation seed+position, shuffle-buffer contents, epoch/batch
+counters — serializes through ``state_dict()`` / ``load_state()`` on
+every stage of the iterator chain, and persists beside PR-2's atomic
+param checkpoints so a killed job resumes mid-epoch with zero replayed
+and zero skipped records (docs/architecture/data_pipeline.md).
+"""
+from .checkpoint import (DATA_STATE_VERSION, data_state_path,
+                         load_data_state, load_state_into,
+                         save_data_state, state_dict_of)
+from .sharded import (ShardedRecordDataset, data_seed, epoch_rng,
+                      record_rng)
+
+__all__ = ["ShardedRecordDataset", "data_seed", "epoch_rng", "record_rng",
+           "DATA_STATE_VERSION", "data_state_path", "save_data_state",
+           "load_data_state", "state_dict_of", "load_state_into"]

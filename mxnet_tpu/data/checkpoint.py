@@ -1,0 +1,119 @@
+"""The data-state checkpoint envelope: iterator position beside params.
+
+PR 2's atomic checkpoints capture params / optimizer / updater state;
+this module adds the missing half of production resumability — WHERE in
+the data the run was.  A ``.dstate`` envelope is written through
+``base.atomic_write`` next to each ``prefix-NNNN.params`` file:
+
+* each file is individually torn-write-safe (unique tmp + fsync +
+  ``os.replace``), and the PAIR is consistent by write ordering — params
+  first, envelope second, both keyed to the same epoch number — plus the
+  envelope recording the exact params filename it describes.  A crash
+  between the two leaves params without an envelope: the loader then
+  returns no data state and the resume falls back to the epoch head,
+  never to a mismatched mid-epoch position.
+* the envelope is versioned JSON.  ``state`` is whatever the iterator
+  chain's ``state_dict()`` produced (record cursor, permutation
+  seed+position, shuffle-buffer ordinals, epoch/batch counters — see
+  docs/architecture/data_pipeline.md for the per-stage protocol).
+
+Epoch-number convention (shared with ``model.save_checkpoint``): file
+``N`` means "a position within epoch N" — an epoch-end checkpoint of
+epoch N-1 writes file N carrying an ``eof`` state that the dataset rolls
+forward to epoch N's start, and mid-epoch batch checkpoints of epoch N
+overwrite file N with progressively later frontiers.  Either way
+``Module.fit(begin_epoch=N, resume_data_state=...)`` continues exactly
+where the stream stopped.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from ..base import MXNetError, atomic_write
+
+__all__ = ["DATA_STATE_VERSION", "data_state_path", "save_data_state",
+           "load_data_state", "state_dict_of", "load_state_into"]
+
+DATA_STATE_VERSION = 1
+
+
+def data_state_path(prefix, epoch):
+    """Envelope path paired with ``prefix-NNNN.params``."""
+    return "%s-%04d.dstate" % (prefix, epoch)
+
+
+def save_data_state(prefix, epoch, state, nbatch=None):
+    """Atomically write the iterator-state envelope for (prefix, epoch).
+
+    ``state=None`` removes any stale envelope instead — a params-only
+    save must not leave an older run's mid-epoch position paired with
+    new params."""
+    path = data_state_path(prefix, epoch)
+    if state is None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    envelope = {
+        "version": DATA_STATE_VERSION,
+        "epoch": int(epoch),
+        "params": os.path.basename("%s-%04d.params" % (prefix, epoch)),
+        "nbatch": nbatch,
+        "state": state,
+    }
+    with atomic_write(path, "w") as f:
+        json.dump(envelope, f)
+    logging.info("Saved data state to \"%s\"", path)
+    return path
+
+
+def load_data_state(prefix, epoch):
+    """The iterator state paired with ``prefix-NNNN.params``, or None
+    when no (valid, matching) envelope exists — the caller then resumes
+    from the epoch head, which is always safe."""
+    path = data_state_path(prefix, epoch)
+    try:
+        with open(path) as f:
+            envelope = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if envelope.get("version") != DATA_STATE_VERSION:
+        logging.warning("ignoring %s: envelope version %r != %d", path,
+                        envelope.get("version"), DATA_STATE_VERSION)
+        return None
+    want = os.path.basename("%s-%04d.params" % (prefix, epoch))
+    if envelope.get("params") != want:
+        logging.warning("ignoring %s: pairs with %r, not %r", path,
+                        envelope.get("params"), want)
+        return None
+    return envelope.get("state")
+
+
+def state_dict_of(data_iter):
+    """``data_iter.state_dict()``, or None when the iterator does not
+    implement the checkpoint protocol (resume then restarts its epoch
+    from the head — correct, just coarser)."""
+    fn = getattr(data_iter, "state_dict", None)
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except NotImplementedError:
+        return None
+
+
+def load_state_into(data_iter, state):
+    """Restore ``state`` into ``data_iter``; a None state is the
+    documented "no mid-epoch position" case and is a no-op."""
+    if state is None:
+        return
+    fn = getattr(data_iter, "load_state", None)
+    if fn is None:
+        raise MXNetError(
+            "resume_data_state given but %s does not implement "
+            "load_state() (docs/architecture/data_pipeline.md)"
+            % type(data_iter).__name__)
+    fn(state)

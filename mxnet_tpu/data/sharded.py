@@ -1,0 +1,462 @@
+"""Sharded, globally-shuffled, checkpointable RecordIO dataset.
+
+Reference: ``src/io/iter_image_recordio_2.cc`` stops at throughput — its
+shuffle draws from an unseeded RNG and its cursor lives in C++ thread
+state, so a killed job restarts at the epoch head.  This module is the
+production answer (ROADMAP item 5): one dataset object that owns the
+*logical* read plan and can serialize it.
+
+Design:
+
+* **Global index** — one-or-many ``.rec`` files (with optional ``.idx``
+  sidecars) are flattened into a single ordinal space ``0..N-1`` in file
+  order.  Every record is addressed by its global ordinal forever after;
+  ordinals are what shuffle buffers, checkpoints, and the per-record
+  augmentation RNG key on.
+* **Seeded epoch permutation** — with an index and ``shuffle=True`` the
+  epoch order is ``perm(seed, epoch)`` over the GLOBAL index, drawn from
+  a counter-based Philox generator, so every worker and every restart of
+  any worker derives the *identical* order with no coordination.  The
+  permutation is partitioned ``order[part_index::num_parts]`` AFTER the
+  shuffle, so parts are disjoint, exhaustive, and balanced to ±1.
+* **Window-shuffle fallback** — index-less files cannot seek, so they
+  stream sequentially through a bounded reservoir (capacity
+  ``shuffle_window``): each emit swaps a uniformly random buffer slot to
+  the tail and pops it — byte-identical to the legacy
+  ``_ShuffleBuffer`` when unseeded.  Seeded, the RNG is a private
+  ``np.random.Generator`` whose bit-generator state rides the checkpoint,
+  and the buffer is captured *as ordinals* so a resume can rebuild it
+  exactly by one sequential re-read.
+* **Checkpointable** — ``state_dict()`` / ``load_state()`` capture and
+  restore the exact read position: epoch, cursor (and, unseeded, the
+  drawn permutation itself), shuffle-buffer ordinals, RNG state.  With
+  ``MXNET_DATA_SEED`` unset the dataset draws from the module-global
+  ``np.random`` exactly like the legacy streams — bit-for-bit parity —
+  and the cursor half of the state still round-trips (zero replayed /
+  zero skipped records); only RNG *replay* needs the seed.
+
+``read()`` returns ``(raw_bytes, meta)`` where ``meta`` carries the
+record's global ordinal and epoch (the per-record augmentation RNG
+key).  Position state is snapshotted by the caller via ``state_dict()``
+right after the reads it cares about — ``ThreadedBatchPipeline
+(stateful=True)`` does so at batch tails to track its consumer frontier
+(docs/architecture/data_pipeline.md).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError, get_env
+
+__all__ = ["ShardedRecordDataset", "data_seed", "record_rng", "epoch_rng"]
+
+# domain-separation constant folded into every Philox key so data-plane
+# streams can never collide with user Philox use of small seeds
+_KEY_SALT = 0x9E3779B97F4A7C15
+
+
+def data_seed():
+    """The configured data-plane seed (``MXNET_DATA_SEED``), or None
+    when unset/0 — the legacy-unseeded escape hatch."""
+    seed = int(get_env("MXNET_DATA_SEED") or 0)
+    return seed if seed else None
+
+
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _philox(seed, domain, a, b):
+    """Philox generator over a 128-bit key folded from (seed, domain,
+    a, b) — counter-based, so any (epoch, ordinal/stream) coordinate
+    derives its generator directly, no sequential jumping."""
+    key0 = (int(seed) ^ _KEY_SALT ^ (domain * 0x9E3779B1)) & _U64
+    key1 = (((int(a) & 0xFFFFFFFF) << 32) ^ (int(b) & _U64)) & _U64
+    return np.random.Generator(np.random.Philox(key=[key0, key1]))
+
+
+def epoch_rng(seed, epoch, stream=0):
+    """Deterministic per-(seed, epoch) Generator: the epoch permutation
+    (stream 0 — identical on every worker) and the window-shuffle draw
+    (stream = 1 + part_index) derive from it."""
+    return _philox(seed, 1, epoch, stream)
+
+
+def record_rng(seed, epoch, ordinal):
+    """Deterministic per-record augmentation Generator.  Keyed on the
+    record's global ordinal (not its batch position), so the same record
+    augments identically whatever thread decodes it, wherever the batch
+    boundary falls, and on either side of a kill/resume."""
+    return _philox(seed, 2, epoch, ordinal)
+
+
+def _as_list(x):
+    if x is None:
+        return None
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [p for p in str(x).split(",") if p]
+
+
+def _rng_state_to_json(state):
+    """bit_generator.state -> plain JSON types (the Philox state dict
+    holds uint64 ndarrays; envelopes are JSON)."""
+    if isinstance(state, dict):
+        return {k: _rng_state_to_json(v) for k, v in state.items()}
+    if isinstance(state, np.ndarray):
+        return {"__ndarray__": state.tolist(), "dtype": str(state.dtype)}
+    if isinstance(state, np.integer):
+        return int(state)
+    return state
+
+
+def _rng_state_from_json(state):
+    if isinstance(state, dict):
+        if "__ndarray__" in state:
+            return np.asarray(state["__ndarray__"],
+                              dtype=np.dtype(state["dtype"]))
+        return {k: _rng_state_from_json(v) for k, v in state.items()}
+    return state
+
+
+class ShardedRecordDataset:
+    """Checkpointable raw-record source over sharded RecordIO files.
+
+    Parameters
+    ----------
+    path_imgrec : str | list of str
+        One or many ``.rec`` files (a comma-separated string works).
+        Multiple files form one global dataset in list order.
+    path_imgidx : str | list of str, optional
+        ``.idx`` sidecars (all files or none).  With sidecars the
+        dataset has random access: global shuffle is a full fresh
+        permutation per epoch and a resume is a pure cursor seek.
+    shuffle : bool
+        Permute (indexed) or window-shuffle (index-less) each epoch.
+    seed : int, optional
+        Deterministic data-plane seed; defaults to ``MXNET_DATA_SEED``.
+        None/0 = legacy behavior: draws come from the module-global
+        ``np.random`` exactly like the pre-dataset streams.
+    part_index, num_parts : int
+        This worker's shard of the global order (dist training).  The
+        kvstore path wires rank/size automatically via
+        :meth:`set_partition`.
+    shuffle_window : int
+        Reservoir capacity of the index-less window shuffle.
+    """
+
+    def __init__(self, path_imgrec, path_imgidx=None, shuffle=False,
+                 seed=None, part_index=0, num_parts=1,
+                 shuffle_window=4096):
+        from ..io import recordio
+        self._recordio = recordio
+        self._rec_paths = _as_list(path_imgrec)
+        if not self._rec_paths:
+            raise MXNetError("path_imgrec must name at least one file")
+        self._idx_paths = _as_list(path_imgidx)
+        if self._idx_paths is not None and \
+                len(self._idx_paths) != len(self._rec_paths):
+            raise MXNetError(
+                "path_imgidx must list one .idx per .rec (%d vs %d)"
+                % (len(self._idx_paths), len(self._rec_paths)))
+        self.shuffle = bool(shuffle)
+        self.seed = data_seed() if seed is None else (int(seed) or None)
+        if num_parts < 1 or not 0 <= part_index < num_parts:
+            raise MXNetError("need 0 <= part_index < num_parts")
+        self.part_index = int(part_index)
+        self.num_parts = int(num_parts)
+        self._window = max(2, int(shuffle_window))
+        self.epoch = 0
+
+        if self._idx_paths is not None:
+            self._open_indexed()
+        else:
+            self._open_sequential()
+        self._check_shardable()
+        self._begin_epoch()
+
+    def _check_shardable(self):
+        """Indexed shuffle shards by slicing ONE global permutation —
+        which only exists when the permutation is seed-derived.
+        Unseeded, each worker would draw its own process-local
+        permutation and the parts would overlap AND miss records, so
+        that combination is an error, not a silent corruption.  (The
+        index-less window shuffle partitions the ordinal stream BEFORE
+        shuffling, so it stays disjoint/exhaustive either way.)"""
+        if self.num_parts > 1 and self.shuffle and self.seed is None \
+                and self._indexed:
+            raise MXNetError(
+                "sharded indexed shuffle (num_parts=%d) needs a "
+                "deterministic seed so every worker derives the same "
+                "global permutation: set MXNET_DATA_SEED (or seed=)"
+                % self.num_parts)
+
+    # -- indexed mode ---------------------------------------------------
+    def _open_indexed(self):
+        self._recs = []
+        self._global = []          # ordinal -> (file_no, key)
+        for fi, (idx, rec) in enumerate(zip(self._idx_paths,
+                                            self._rec_paths)):
+            r = self._recordio.MXIndexedRecordIO(idx, rec, "r")
+            if not r.keys:
+                raise MXNetError("empty or missing index file %s" % idx)
+            self._recs.append(r)
+            self._global.extend((fi, k) for k in r.keys)
+        self._indexed = True
+
+    # -- sequential (index-less) mode -----------------------------------
+    def _open_sequential(self):
+        self._files = [self._recordio.MXRecordIO(p, "r")
+                       for p in self._rec_paths]
+        self._indexed = False
+
+    # -- epoch plan -----------------------------------------------------
+    def _begin_epoch(self):
+        if self._indexed:
+            n = len(self._global)
+            if self.shuffle:
+                # unseeded: the module-global RNG, drawn eagerly at epoch
+                # start — the legacy _PermutedRecordStream call pattern,
+                # bit-for-bit.  Seeded: Philox(seed, epoch), identical on
+                # every worker and every restart.
+                if self.seed is None:
+                    order = np.random.permutation(n)
+                else:
+                    order = epoch_rng(self.seed, self.epoch).permutation(n)
+            else:
+                order = np.arange(n)
+            self._order = order[self.part_index::self.num_parts]
+            self._order_list = None   # per-epoch cache, built on demand
+            self._pos = 0
+        else:
+            self._next_ord = 0       # next global ordinal to read
+            self._file_no = 0
+            self._buf = []           # [(ordinal, raw)] reservoir
+            self._emitted = 0
+            self._src_eof = False
+            self._rng = None if self.seed is None else \
+                epoch_rng(self.seed, self.epoch, 1 + self.part_index)
+
+    def __len__(self):
+        """Records THIS PART sees per epoch."""
+        if self._indexed:
+            return len(self._order)
+        raise TypeError("index-less dataset has no known length")
+
+    # -- reading --------------------------------------------------------
+    def read(self):
+        """Next ``(raw_bytes, meta)`` of this epoch, or None at epoch
+        end.  ``meta`` = {"ordinal", "epoch"} — the per-record RNG key.
+        Position state is NOT captured per record: reads are strictly
+        sequential, so a caller snapshots :meth:`state_dict` right
+        after the reads it cares about (the pipeline does so at batch
+        tails — see ThreadedBatchPipeline)."""
+        if self._indexed:
+            if self._pos >= len(self._order):
+                return None
+            ordinal = int(self._order[self._pos])
+            fi, key = self._global[ordinal]
+            raw = self._recs[fi].read_idx(key)
+            self._pos += 1
+            return raw, {"ordinal": ordinal, "epoch": self.epoch}
+        return self._read_windowed()
+
+    def _read_sequential_raw(self):
+        """Next (ordinal, raw) of THIS PART from the sequential chain,
+        or None at end of the file list."""
+        while self._file_no < len(self._files):
+            raw = self._files[self._file_no].read()
+            if raw is None:
+                self._file_no += 1
+                continue
+            ordinal = self._next_ord
+            self._next_ord += 1
+            if ordinal % self.num_parts != self.part_index:
+                continue
+            return ordinal, raw
+        return None
+
+    def _read_windowed(self):
+        if not self.shuffle:
+            item = self._read_sequential_raw()
+            if item is None:
+                return None
+            ordinal, raw = item
+            self._emitted += 1
+            return raw, {"ordinal": ordinal, "epoch": self.epoch}
+        while not self._src_eof and len(self._buf) < self._window:
+            item = self._read_sequential_raw()
+            if item is None:
+                self._src_eof = True
+                break
+            self._buf.append(item)
+        if not self._buf:
+            return None
+        # legacy _ShuffleBuffer emit, bit-for-bit when unseeded:
+        # uniform slot -> swap to tail -> pop
+        if self._rng is None:
+            i = np.random.randint(len(self._buf))
+        else:
+            i = int(self._rng.integers(len(self._buf)))
+        self._buf[i], self._buf[-1] = self._buf[-1], self._buf[i]
+        ordinal, raw = self._buf.pop()
+        self._emitted += 1
+        return raw, {"ordinal": ordinal, "epoch": self.epoch}
+
+    def reset(self):
+        """New epoch: bump the counter, rewind, redraw the plan."""
+        self.epoch += 1
+        if not self._indexed:
+            for f in self._files:
+                f.reset()
+        self._begin_epoch()
+
+    def rewind_epoch(self):
+        """Restart the CURRENT epoch from record 0 (no epoch bump).
+        Iterators call this before :meth:`set_partition` / after halting
+        their pipeline, discarding producer read-ahead the consumer
+        never saw."""
+        if not self._indexed:
+            for f in self._files:
+                f.reset()
+        self._begin_epoch()
+
+    def set_partition(self, part_index, num_parts, auto=False):
+        """(Re)shard this dataset.  ``auto=True`` is the kvstore's
+        rank/size wiring: it defers to an explicit user partition and
+        refuses to silently repartition a mid-epoch stream."""
+        part_index, num_parts = int(part_index), int(num_parts)
+        if (part_index, num_parts) == (self.part_index, self.num_parts):
+            return
+        if auto and self.num_parts != 1:
+            return          # explicit partition wins over auto wiring
+        consumed = self._pos if self._indexed else self._emitted
+        if consumed:
+            raise MXNetError(
+                "cannot repartition a mid-epoch dataset (consumed %d "
+                "records); rewind_epoch() first or repartition on an "
+                "epoch boundary" % consumed)
+        if num_parts < 1 or not 0 <= part_index < num_parts:
+            raise MXNetError("need 0 <= part_index < num_parts")
+        self.part_index, self.num_parts = part_index, num_parts
+        self._check_shardable()
+        if not self._indexed:
+            for f in self._files:
+                f.reset()
+        self._begin_epoch()
+
+    # -- checkpoint protocol --------------------------------------------
+    def state_dict(self):
+        """Serializable read position (cheap: a handful of ints, plus
+        the buffer's ordinals / the drawn permutation where those are
+        the only exact record)."""
+        st = {"version": 1, "kind": "ShardedRecordDataset",
+              "epoch": self.epoch, "seed": self.seed,
+              "part_index": self.part_index,
+              "num_parts": self.num_parts,
+              "shuffle": self.shuffle, "indexed": self._indexed}
+        if self._indexed:
+            st["pos"] = self._pos
+            if self.shuffle and self.seed is None:
+                # unseeded permutations are not re-derivable: the drawn
+                # order itself IS the state.  Built once per epoch and
+                # SHARED by every capture (read() snapshots per record —
+                # copying N ints per record would be O(N^2) per epoch);
+                # the list is immutable by contract, and JSON/envelope
+                # serialization copies it anyway.
+                if self._order_list is None:
+                    self._order_list = [int(o) for o in self._order]
+                st["order"] = self._order_list
+        else:
+            st["next_ord"] = self._next_ord
+            st["emitted"] = self._emitted
+            st["src_eof"] = self._src_eof
+            if self.shuffle:
+                st["buffer"] = [int(o) for o, _ in self._buf]
+                if self._rng is not None:
+                    st["rng_state"] = _rng_state_to_json(
+                        self._rng.bit_generator.state)
+        return st
+
+    def load_state(self, state):
+        """Restore an exact read position captured by
+        :meth:`state_dict`.  A state carrying ``eof=True`` (stamped by
+        the pipeline when the consumer drained the epoch) rolls forward
+        to the NEXT epoch's start, so an epoch-boundary checkpoint
+        resumes into a fresh epoch instead of an empty one."""
+        if state.get("kind") != "ShardedRecordDataset":
+            raise MXNetError("state kind %r does not match dataset"
+                             % (state.get("kind"),))
+        if bool(state.get("indexed")) != self._indexed:
+            raise MXNetError("checkpoint was taken %s an index; this "
+                             "dataset is constructed %s one"
+                             % ("with" if state.get("indexed") else
+                                "without",
+                                "with" if self._indexed else "without"))
+        if (state.get("part_index", 0), state.get("num_parts", 1)) != \
+                (self.part_index, self.num_parts):
+            raise MXNetError(
+                "checkpoint partition (%s/%s) != dataset partition "
+                "(%d/%d)" % (state.get("part_index"),
+                             state.get("num_parts"),
+                             self.part_index, self.num_parts))
+        if state.get("seed") != self.seed:
+            raise MXNetError("checkpoint data seed %r != dataset seed %r"
+                             " (set MXNET_DATA_SEED consistently)"
+                             % (state.get("seed"), self.seed))
+        if state.get("eof"):
+            self.epoch = int(state["epoch"]) + 1
+            if not self._indexed:
+                for f in self._files:
+                    f.reset()
+            self._begin_epoch()
+            return
+        self.epoch = int(state["epoch"])
+        if self._indexed:
+            self._begin_epoch()
+            if self.shuffle and self.seed is None:
+                self._order = np.asarray(state["order"], dtype=np.int64)
+                self._order_list = [int(o) for o in state["order"]]
+            self._pos = int(state["pos"])
+            if self._pos > len(self._order):
+                raise MXNetError("checkpoint cursor %d beyond epoch "
+                                 "length %d" % (self._pos,
+                                                len(self._order)))
+            return
+        # sequential: one forward re-read rebuilds the reservoir exactly
+        for f in self._files:
+            f.reset()
+        self._begin_epoch()
+        want = {int(o) for o in state.get("buffer", [])}
+        by_ord = {}
+        target = int(state["next_ord"])
+        while self._next_ord < target:
+            item = self._read_sequential_raw()
+            if item is None:
+                # the scan consumed trailing ordinals belonging to OTHER
+                # parts on its way to EOF — next_ord still advanced past
+                # them, so reaching the cursor is success, a short file
+                # is not
+                if self._next_ord >= target:
+                    break
+                raise MXNetError(
+                    "record file shrank under the checkpoint: cursor %d "
+                    "but only %d records readable"
+                    % (target, self._next_ord))
+            if item[0] in want:
+                by_ord[item[0]] = item
+        missing = want - set(by_ord)
+        if missing:
+            raise MXNetError("checkpoint buffer ordinals %s not found "
+                             "on this part" % sorted(missing)[:5])
+        # buffer LIST ORDER is load-bearing: the emit algorithm swaps by
+        # index, so replay needs the same layout, not just the same set
+        self._buf = [by_ord[int(o)] for o in state.get("buffer", [])]
+        self._emitted = int(state.get("emitted", 0))
+        self._src_eof = bool(state.get("src_eof", False))
+        if self._rng is not None and "rng_state" in state:
+            self._rng.bit_generator.state = \
+                _rng_state_from_json(state["rng_state"])
+
+    def close(self):
+        for r in (self._recs if self._indexed else self._files):
+            r.close()

@@ -26,7 +26,12 @@ Rule fields:
 * ``seam`` (required) — where the event fires.  Instrumented seams:
   ``worker.send`` / ``worker.recv`` (``WorkerClient._rpc``, around one
   request/reply), ``server.recv`` (``Server._serve_one``, before the
-  message is handled).
+  message is handled), ``data.next`` (``ThreadedBatchPipeline.
+  next_batch``, the data pipeline's consumer seam — one event per batch
+  the training loop pulls; ``die`` here is the seeded
+  SIGKILL-mid-epoch kill-point the resume tests schedule, ``delay``
+  models a stalled input pipeline, and ``drop`` is meaningless for a
+  batch and proceeds).
 * ``kind`` — match only this message kind (``init`` / ``push`` / ``pull``
   / ``command`` / ``stop``); omitted = any.
 * ``rank`` / ``sid`` — match only this node rank / server index.

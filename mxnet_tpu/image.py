@@ -285,22 +285,25 @@ def hls_to_rgb(h, l, s):
                      channel(h - 1 / 3)], axis=-1)
 
 
-def hsl_jitter(src, random_h=0, random_s=0, random_l=0):
+def hsl_jitter(src, random_h=0, random_s=0, random_l=0, rng=None):
     """Random HSL shift on a 0..255 HWC float image (reference
     image_aug_default.cc random_h/random_s/random_l: additive uniform
     deltas on the cv2 HLS channels — H in degrees of the 0..180
-    half-circle, S and L on the 0..255 scale)."""
+    half-circle, S and L on the 0..255 scale).  ``rng`` (a
+    ``np.random.Generator``) makes the draw deterministic; None keeps
+    the legacy module-global ``np.random`` draw."""
     if not (random_h or random_s or random_l):
         return src
+    uniform = np.random.uniform if rng is None else rng.uniform
     arr = np.clip(np.asarray(src, np.float32), 0, 255) / 255.0
     h, l, s = rgb_to_hls(arr)
     if random_h:
-        h = h + np.random.uniform(-random_h, random_h) / 180.0
+        h = h + uniform(-random_h, random_h) / 180.0
     if random_s:
-        s = np.clip(s + np.random.uniform(-random_s, random_s) / 255.0,
+        s = np.clip(s + uniform(-random_s, random_s) / 255.0,
                     0.0, 1.0)
     if random_l:
-        l = np.clip(l + np.random.uniform(-random_l, random_l) / 255.0,
+        l = np.clip(l + uniform(-random_l, random_l) / 255.0,
                     0.0, 1.0)
     out = hls_to_rgb(h, np.clip(l, 0, 1), np.clip(s, 0, 1))
     return np.clip(out * 255.0, 0, 255).astype(np.float32)

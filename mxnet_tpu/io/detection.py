@@ -6,8 +6,31 @@ RecordIO records whose header label is the detection layout
 decoded + bbox-aware-augmented in worker threads, batched with the label
 tensor padded to a fixed object count with -1 rows (what MultiBoxTarget
 consumes).
+
+The raw plan rides the same :class:`~mxnet_tpu.data.ShardedRecordDataset`
++ stateful :class:`ThreadedBatchPipeline` chain as ``ImageRecordIter``
+(docs/architecture/data_pipeline.md), so the detection surface gets
+sharding, the deterministic seeded global shuffle, and the
+checkpointable-iterator protocol for free — proving the pipeline on
+non-classification batch shapes (variable ``label_width`` labels padded
+to ``(batch, max_objects, object_width)``).
+
+The bbox augmenters draw from the module-global ``np.random``; with
+``MXNET_DATA_SEED`` set, each record's augmentation runs under a
+serialized per-record reseed of that global RNG (state saved/restored
+around it), trading augmenter parallelism for exact replay on resume.
+Caveat: the reseed window is only serialized against OTHER det decode
+threads — a foreign thread drawing from the global ``np.random``
+concurrently would read from the record's deterministic stream and
+then be clobbered by the state restore.  The fit loop itself never
+draws mid-epoch, but do not run other global-RNG consumers (unseeded
+iterator constructions, user sampling threads) concurrently with a
+seeded det pipeline; the classification path has no such window (it
+threads a private Generator through ``decode_record_image``).
 """
 from __future__ import annotations
+
+import threading
 
 import numpy as np
 
@@ -18,6 +41,11 @@ from .io import DataBatch, DataDesc, DataIter
 from .pipeline import ThreadedBatchPipeline
 
 __all__ = ["ImageDetRecordIter"]
+
+# serializes the global-RNG reseed window of seeded det augmentation
+# (the classification path threads a private Generator instead and
+# needs no lock — see image_util.decode_record_image)
+_DET_AUG_LOCK = threading.Lock()
 
 
 class ImageDetRecordIter(DataIter):
@@ -33,9 +61,12 @@ class ImageDetRecordIter(DataIter):
                  label_pad_value=-1.0, max_objects=16,
                  preprocess_threads=4, prefetch_buffer=4,
                  aug_list=None, data_name="data", label_name="label",
-                 mean_pixels=None, std_pixels=None, **aug_kwargs):
+                 mean_pixels=None, std_pixels=None, part_index=0,
+                 num_parts=1, seed=None, shuffle_buffer=4096,
+                 **aug_kwargs):
         super().__init__(batch_size)
         from . import recordio
+        from ..data.sharded import ShardedRecordDataset
         if len(data_shape) != 3:
             raise MXNetError("data_shape must be (c, h, w)")
         self.data_shape = tuple(data_shape)
@@ -44,19 +75,11 @@ class ImageDetRecordIter(DataIter):
         self.label_pad_value = float(label_pad_value)
         self._recordio = recordio
         self._path = path_imgrec
-        if shuffle and not path_imgidx:
-            raise MXNetError("shuffle requires path_imgidx "
-                             "(random access needs the index)")
         self._shuffle = shuffle
-        if path_imgidx:
-            self._rec = recordio.MXIndexedRecordIO(path_imgidx,
-                                                   path_imgrec, "r")
-            self._keys = list(self._rec.keys)
-        else:
-            self._rec = recordio.MXRecordIO(path_imgrec, "r")
-            self._keys = None
-        self._order = None
-        self._pos = 0
+        self._dataset = ShardedRecordDataset(
+            path_imgrec, path_imgidx, shuffle=shuffle, seed=seed,
+            part_index=part_index, num_parts=num_parts,
+            shuffle_window=shuffle_buffer)
 
         if aug_list is None:
             aug_list = CreateDetAugmenter(
@@ -76,51 +99,54 @@ class ImageDetRecordIter(DataIter):
             self.max_objects = max_objects
             self._object_width = self._peek_object_width()
 
+        self._batch = None
         self._pipeline = ThreadedBatchPipeline(
-            self._read_raw, self._decode_one, self._assemble,
-            self._rewind, batch_size,
+            self._dataset.read, self._decode_one, self._assemble,
+            self._dataset.reset, batch_size,
             preprocess_threads=preprocess_threads,
-            prefetch=prefetch_buffer)
+            prefetch=prefetch_buffer, stateful=True,
+            snapshot_fn=self._dataset.state_dict)
 
-    # -- raw record source (producer thread) ---------------------------
     def _peek_object_width(self):
-        s = self._rec.read() if self._keys is None else \
-            self._rec.read_idx(self._keys[0])
-        self._rec.reset() if self._keys is None else None
+        """Label layout of the first record, read through a throwaway
+        sequential handle so the dataset cursor never moves."""
+        first = (self._path if isinstance(self._path, str)
+                 else self._path[0]).split(",")[0]
+        rec = self._recordio.MXRecordIO(first, "r")
+        try:
+            s = rec.read()
+        finally:
+            rec.close()
         if s is None:
-            raise MXNetError("empty record file %s" % self._path)
+            raise MXNetError("empty record file %s" % first)
         header, _ = self._recordio.unpack(s)
         return DetLabel(header.label).object_width
 
-    def _read_raw(self):
-        if self._keys is not None:
-            if self._order is None:
-                self._order = list(self._keys)
-                if self._shuffle:
-                    np.random.shuffle(self._order)
-            if self._pos >= len(self._order):
-                return None
-            s = self._rec.read_idx(self._order[self._pos])
-            self._pos += 1
-            return s
-        return self._rec.read()
-
-    def _rewind(self):
-        self._pos = 0
-        if self._keys is not None:
-            if self._shuffle:
-                np.random.shuffle(self._order)
-        else:
-            self._rec.reset()
-
     # -- per-record decode + augment (pool threads) --------------------
-    def _decode_one(self, raw):
+    def _decode_one(self, raw, meta):
         from .image_util import decode_image
         header, img_bytes = self._recordio.unpack(raw)
         label = DetLabel(header.label)
         img = decode_image(img_bytes)  # uint8 until resize casts
-        for aug in self.auglist:
-            img, label = aug(img, label)
+        if self._dataset.seed is not None and meta is not None:
+            from ..data.sharded import record_rng
+            seed32 = int(record_rng(self._dataset.seed, meta["epoch"],
+                                    meta["ordinal"]).integers(0, 2**32))
+            # the det augmenters draw from the global np.random: run
+            # them under a per-record reseed with the surrounding state
+            # saved/restored, serialized so pool threads cannot
+            # interleave draws
+            with _DET_AUG_LOCK:
+                saved = np.random.get_state()
+                np.random.seed(seed32)
+                try:
+                    for aug in self.auglist:
+                        img, label = aug(img, label)
+                finally:
+                    np.random.set_state(saved)
+        else:
+            for aug in self.auglist:
+                img, label = aug(img, label)
         chw = np.transpose(img, (2, 0, 1))
         objs = label.objects[:self.max_objects]
         padded = np.full((self.max_objects, self._object_width),
@@ -174,3 +200,47 @@ class ImageDetRecordIter(DataIter):
 
     def getpad(self):
         return self._batch.pad
+
+    @property
+    def epoch(self):
+        """Current epoch counter of the underlying dataset."""
+        return self._dataset.epoch
+
+    def set_partition(self, part_index, num_parts, auto=False):
+        """Shard the record plan for dist training (restarts the
+        current epoch; must precede the epoch's first batch)."""
+        if self._pipeline.batches_consumed:
+            raise MXNetError(
+                "cannot repartition after %d consumed batches"
+                % self._pipeline.batches_consumed)
+
+        def _mut():
+            self._dataset.rewind_epoch()
+            self._dataset.set_partition(part_index, num_parts, auto=auto)
+        self._pipeline.reload(_mut)
+
+    # -- checkpoint protocol --------------------------------------------
+    def state_dict(self):
+        """Consumer-frontier capture (see ``ImageRecordIter``)."""
+        st = self._pipeline.state_dict()
+        st["kind"] = "ImageDetRecordIter"
+        return st
+
+    def load_state(self, state):
+        kind = state.get("kind")
+        if kind not in (None, "ImageDetRecordIter"):
+            raise MXNetError(
+                "checkpoint was taken by %r, not an ImageDetRecordIter "
+                "— resuming it here would misinterpret the stream"
+                % kind)
+        self._pipeline.load_state(
+            state, lambda: self._dataset.load_state(state["source"]))
+        self._batch = None
+
+    def close(self):
+        """Stop the pipeline threads and close the record files
+        (best-effort: teardown never masks the caller's failure)."""
+        try:
+            self._pipeline.close()
+        finally:
+            self._dataset.close()

@@ -48,14 +48,25 @@ def decode_record_image(img_bytes, data_shape, rand_crop=False,
                         max_shear_ratio=0.0, min_random_scale=1.0,
                         max_random_scale=1.0, max_aspect_ratio=0.0,
                         random_h=0, random_s=0, random_l=0, pad=0,
-                        fill_value=255):
+                        fill_value=255, rng=None):
     """Decode + augment to CHW float32 — the reference record-iterator
     training augmenter surface (``src/io/image_aug_default.cc``):
     rotation (``max_rotate_angle``), shear (``max_shear_ratio``), random
     scale/aspect applied to the crop window, center/random crop, mirror,
     HSL jitter (``random_h/s/l``), and border ``pad`` with
-    ``fill_value``."""
+    ``fill_value``.
+
+    ``rng`` (an ``np.random.Generator``) makes the augmentation draw
+    deterministic — the record iterators derive one per record from
+    ``MXNET_DATA_SEED`` × epoch × ordinal (``data.record_rng``), so
+    augmentation replays identically across threads, batch boundaries
+    and kill/resume.  ``rng=None`` draws from the module-global
+    ``np.random`` exactly as before (legacy unseeded behavior)."""
     _require_pil()
+    uniform = np.random.uniform if rng is None else rng.uniform
+    randint = np.random.randint if rng is None else \
+        (lambda lo, hi: int(rng.integers(lo, hi)))
+    rand = np.random.rand if rng is None else rng.random
     c, h, w = data_shape
     img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
 
@@ -66,8 +77,8 @@ def decode_record_image(img_bytes, data_shape, rand_crop=False,
         img = ImageOps.expand(img, border=pad, fill=(fill_value,) * 3)
 
     if max_rotate_angle > 0 or max_shear_ratio > 0:
-        angle = np.random.uniform(-max_rotate_angle, max_rotate_angle)
-        shear = np.random.uniform(-max_shear_ratio, max_shear_ratio)
+        angle = uniform(-max_rotate_angle, max_rotate_angle)
+        shear = uniform(-max_shear_ratio, max_shear_ratio)
         fv = (fill_value,) * 3
         if angle:
             img = img.rotate(angle, resample=Image.BILINEAR,
@@ -79,8 +90,8 @@ def decode_record_image(img_bytes, data_shape, rand_crop=False,
                                 resample=Image.BILINEAR, fillcolor=fv)
 
     # crop-window size: target scaled by random scale and aspect jitter
-    scale_jitter = np.random.uniform(min_random_scale, max_random_scale)
-    ar = 1.0 + (np.random.uniform(-max_aspect_ratio, max_aspect_ratio)
+    scale_jitter = uniform(min_random_scale, max_random_scale)
+    ar = 1.0 + (uniform(-max_aspect_ratio, max_aspect_ratio)
                 if max_aspect_ratio > 0 else 0.0)
     ch_, cw_ = h / scale_jitter, (w / scale_jitter) * ar
 
@@ -96,17 +107,17 @@ def decode_record_image(img_bytes, data_shape, rand_crop=False,
     iw, ih = img.size
     cw_i, ch_i = min(int(cw_), iw), min(int(ch_), ih)
     if rand_crop:
-        x0 = np.random.randint(0, iw - cw_i + 1)
-        y0 = np.random.randint(0, ih - ch_i + 1)
+        x0 = randint(0, iw - cw_i + 1)
+        y0 = randint(0, ih - ch_i + 1)
     else:
         x0, y0 = (iw - cw_i) // 2, (ih - ch_i) // 2
     img = img.crop((x0, y0, x0 + cw_i, y0 + ch_i))
     if img.size != (w, h):
         img = img.resize((w, h), Image.BILINEAR)
     arr = np.asarray(img, dtype=np.float32)
-    if rand_mirror and np.random.rand() < 0.5:
+    if rand_mirror and rand() < 0.5:
         arr = arr[:, ::-1]
     if random_h or random_s or random_l:
         from ..image import hsl_jitter
-        arr = hsl_jitter(arr, random_h, random_s, random_l)
+        arr = hsl_jitter(arr, random_h, random_s, random_l, rng=rng)
     return arr.transpose(2, 0, 1)  # HWC -> CHW

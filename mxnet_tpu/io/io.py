@@ -103,6 +103,25 @@ class DataIter:
         batch (0 elsewhere)."""
         raise NotImplementedError()
 
+    # -- checkpoint protocol (docs/architecture/data_pipeline.md) -------
+    def state_dict(self):
+        """Serializable mid-epoch position of this iterator: whatever
+        is needed so a fresh instance over the same data continues the
+        stream with zero replayed and zero skipped records (record
+        cursor, permutation/shuffle state, epoch and batch counters).
+        State reflects the last batch ``next()`` RETURNED — threaded
+        stages capture the consumer frontier, never read-ahead."""
+        raise NotImplementedError(
+            "%s does not implement the checkpointable-iterator "
+            "protocol" % type(self).__name__)
+
+    def load_state(self, state):
+        """Restore a :meth:`state_dict` capture taken from an
+        identically-constructed iterator."""
+        raise NotImplementedError(
+            "%s does not implement the checkpointable-iterator "
+            "protocol" % type(self).__name__)
+
 
 def _init_data(data, allow_empty, default_name):
     """Normalize input data to list of (name, numpy array) (reference
@@ -146,15 +165,23 @@ class NDArrayIter(DataIter):
         self.num_data = self.data[0][1].shape[0]
         assert self.num_data >= batch_size, \
             "batch_size needs to be smaller than data size."
+        # the shuffle is stored as an index array instead of permuted
+        # copies: batches gather through it, which yields the identical
+        # stream AND makes the permutation itself checkpointable
+        # (state_dict) without holding the data twice
+        self._order = None
+        self._order_list = None   # serialized-permutation cache
         if shuffle:
             idx = np.arange(self.num_data)
             np.random.shuffle(idx)
-            self.data = [(k, v[idx]) for k, v in self.data]
-            self.label = [(k, v[idx]) for k, v in self.label]
+            self._order = idx
         if last_batch_handle == "discard":
             new_n = self.num_data - self.num_data % batch_size
-            self.data = [(k, v[:new_n]) for k, v in self.data]
-            self.label = [(k, v[:new_n]) for k, v in self.label]
+            if self._order is not None:
+                self._order = self._order[:new_n]
+            else:
+                self.data = [(k, v[:new_n]) for k, v in self.data]
+                self.label = [(k, v[:new_n]) for k, v in self.label]
             self.num_data = new_n
         self.data_list = [v for _, v in self.data] + \
             [v for _, v in self.label]
@@ -199,10 +226,18 @@ class NDArrayIter(DataIter):
 
     def _getdata(self, data_source):
         assert self.cursor < self.num_data, "DataIter needs reset."
-        if self.cursor + self.batch_size <= self.num_data:
-            return [nd.array(v[self.cursor:self.cursor + self.batch_size])
+        end = self.cursor + self.batch_size
+        if self._order is not None:
+            if end <= self.num_data:
+                sel = self._order[self.cursor:end]
+            else:
+                sel = np.concatenate((self._order[self.cursor:],
+                                      self._order[:end - self.num_data]))
+            return [nd.array(v.take(sel, axis=0)) for _, v in data_source]
+        if end <= self.num_data:
+            return [nd.array(v[self.cursor:end])
                     for _, v in data_source]
-        pad = self.batch_size - self.num_data + self.cursor
+        pad = end - self.num_data
         return [nd.array(np.concatenate(
             (v[self.cursor:], v[:pad]), axis=0)) for _, v in data_source]
 
@@ -217,6 +252,48 @@ class NDArrayIter(DataIter):
                 self.cursor + self.batch_size > self.num_data:
             return self.cursor + self.batch_size - self.num_data
         return 0
+
+    # -- checkpoint protocol --------------------------------------------
+    def state_dict(self):
+        """Cursor + (when shuffled) the drawn permutation — everything
+        a fresh iterator over the same arrays needs to continue this
+        exact stream.  The serialized permutation is built once and
+        SHARED by every capture (the stager/prefetch wrappers snapshot
+        per batch; re-listifying N ints each time would put O(N) work
+        on the input hot path) — immutable by contract, and the
+        envelope's JSON serialization copies it anyway."""
+        if self._order is not None and self._order_list is None:
+            self._order_list = [int(i) for i in self._order]
+        return {"version": 1, "kind": type(self).__name__,
+                "cursor": int(self.cursor),
+                "num_data": int(self.num_data),
+                "order": self._order_list}
+
+    def load_state(self, state):
+        if int(state.get("num_data", -1)) != self.num_data:
+            raise MXNetError(
+                "checkpoint is over %s records, this iterator has %d"
+                % (state.get("num_data"), self.num_data))
+        order = state.get("order")
+        self._order = None if order is None else \
+            np.asarray(order, dtype=np.int64)
+        self._order_list = None if order is None else \
+            [int(i) for i in order]
+        self.cursor = int(state["cursor"])
+        if self.cursor + self.batch_size >= self.num_data:
+            # an exhausted frontier (epoch-boundary checkpoint: the
+            # cursor sits at the epoch's FINAL batch, so the next
+            # iter_next() would end the epoch) rolls forward to the
+            # next epoch's start — otherwise the first resumed epoch
+            # would silently train zero batches.  reset() owns the
+            # per-mode cursor math, but it expects the POST-increment
+            # cursor of the iter_next() that ended the epoch (roll_over
+            # compares cursor > num_data to place the leftover offset),
+            # so advance past the final batch first.  This iterator
+            # never reshuffles between epochs, so the rolled epoch is
+            # exact.
+            self.cursor += self.batch_size
+            self.reset()
 
 
 class ResizeIter(DataIter):
@@ -262,6 +339,22 @@ class ResizeIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
+    # -- checkpoint protocol --------------------------------------------
+    def state_dict(self):
+        """Resize counter + the wrapped iterator's own state."""
+        return {"version": 1, "kind": "ResizeIter", "cur": int(self.cur),
+                "inner": self.data_iter.state_dict()}
+
+    def load_state(self, state):
+        self.cur = int(state["cur"])
+        self.data_iter.load_state(state["inner"])
+        self.current_batch = None
+        if self.cur >= self.size:
+            # epoch-boundary capture: roll into a fresh resize epoch
+            # (reset() also rewinds the wrapped iterator when
+            # reset_internal, matching the clean run's epoch turn)
+            self.reset()
+
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators (reference
@@ -280,6 +373,12 @@ class PrefetchingIter(DataIter):
         self._queues = [queue.Queue(maxsize=2) for _ in iters]
         self._stop = threading.Event()
         self._threads = []
+        # consumer-frontier states per wrapped iterator: the wrapped
+        # iterators run AHEAD of the consumer by up to the queue depth,
+        # so each prefetched batch carries the inner state right after
+        # it was produced, and state_dict() reports the last CONSUMED
+        # batch's capture
+        self._frontier = [None] * self.n_iter
         self._start_threads()
         self.current_batch = [None] * self.n_iter
 
@@ -307,36 +406,77 @@ class PrefetchingIter(DataIter):
                                      i.provide_label)]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
+    @staticmethod
+    def _inner_state(it):
+        from ..data.checkpoint import state_dict_of
+        return state_dict_of(it)
+
     def _start_threads(self):
+        # captured while the threads are parked: the frontier until the
+        # first prefetched batch is consumed
+        self._frontier = [self._inner_state(it) for it in self.iters]
+        stop = self._stop
+        from .pipeline import put_interruptible
+
         def run(i):
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batch = self.iters[i].next()
                 except StopIteration:
-                    self._queues[i].put(None)
+                    put_interruptible(
+                        self._queues[i], stop,
+                        (None, self._inner_state(self.iters[i])))
                     return
-                self._queues[i].put(batch)
+                except BaseException as e:  # surface to the consumer —
+                    # a silently-dead reader would hang iter_next() on
+                    # an empty queue forever
+                    put_interruptible(self._queues[i], stop, e)
+                    return
+                if not put_interruptible(
+                        self._queues[i], stop,
+                        (batch, self._inner_state(self.iters[i]))):
+                    return
 
         self._threads = [threading.Thread(target=run, args=(i,), daemon=True)
                          for i in range(self.n_iter)]
         for t in self._threads:
             t.start()
 
-    def reset(self):
+    def _halt_threads(self):
         self._stop.set()
         for q in self._queues:
             while not q.empty():
                 q.get_nowait()
         for t in self._threads:
-            t.join(timeout=1.0)
-        for it in self.iters:
-            it.reset()
+            t.join(timeout=30)
+        if any(t.is_alive() for t in self._threads):
+            # a reader stuck inside a wrapped iterator's next(): letting
+            # reset/load_state reposition that iterator now would race
+            # its cursor from two threads and silently eat batches when
+            # the stuck call returns — fail loudly instead (the
+            # stager/pipeline halt discipline)
+            raise MXNetError(
+                "prefetch reader stuck in a wrapped iterator for >30s; "
+                "cannot safely reset/load the PrefetchingIter")
         self._stop = threading.Event()
         self._queues = [queue.Queue(maxsize=2) for _ in self.iters]
+
+    def reset(self):
+        self._halt_threads()
+        for it in self.iters:
+            it.reset()
         self._start_threads()
 
     def iter_next(self):
-        batches = [q.get() for q in self._queues]
+        items = [q.get() for q in self._queues]
+        for item in items:
+            if isinstance(item, BaseException):
+                raise MXNetError("prefetch reader failed: %r"
+                                 % (item,)) from item
+        for i, (_, st) in enumerate(items):
+            if st is not None:
+                self._frontier[i] = st
+        batches = [b for b, _ in items]
         if any(b is None for b in batches):
             return False
         self.current_batch = batches
@@ -361,6 +501,25 @@ class PrefetchingIter(DataIter):
 
     def getindex(self):
         return self.current_batch[0].index
+
+    # -- checkpoint protocol --------------------------------------------
+    def state_dict(self):
+        """Per-wrapped-iterator frontier states (the position after the
+        last batch the CONSUMER saw — prefetch read-ahead is never
+        reflected)."""
+        return {"version": 1, "kind": "PrefetchingIter",
+                "iters": list(self._frontier)}
+
+    def load_state(self, state):
+        inner = state.get("iters") or []
+        if len(inner) != self.n_iter:
+            raise MXNetError("checkpoint wraps %d iterators, this one %d"
+                             % (len(inner), self.n_iter))
+        self._halt_threads()
+        for it, st in zip(self.iters, inner):
+            if st is not None:
+                it.load_state(st)
+        self._start_threads()
 
 
 class CSVIter(NDArrayIter):
@@ -413,149 +572,28 @@ class MNISTIter(NDArrayIter):
                          label_name="softmax_label")
 
 
-class _PermutedRecordStream:
-    """Record stream that visits the whole file in a fresh random order
-    each epoch via the .idx sidecar (reference ImageRecordIter
-    shuffle=True with path_imgidx: full random access).
-
-    A background reader thread stays ``capacity`` permuted records ahead
-    so the random seek+read overlaps decode/assembly — the same overlap
-    the sequential path gets from its native prefetcher."""
-
-    def __init__(self, idx_path, rec_path, capacity=16):
-        from . import recordio
-        self._rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
-        if not self._rec.keys:
-            raise MXNetError("empty or missing index file %s" % idx_path)
-        self._cap = capacity
-        self._q = None
-        self._thread = None
-        self._eof = False
-        self._start_epoch()
-
-    def _start_epoch(self):
-        order = np.random.permutation(len(self._rec.keys))
-        q = queue.Queue(maxsize=self._cap)
-        stop = threading.Event()
-
-        def put_interruptible(item):
-            """Blocking put that aborts when reset() raises the stop
-            flag.  Returns False once stopped."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def pump():
-            # the epoch-end sentinel (or the reader's exception, handed
-            # to the consumer to re-raise) is enqueued even when a
-            # corrupt record kills the loop — otherwise read() would
-            # block forever on an empty queue
-            tail = None
-            try:
-                for j in order:
-                    rec = self._rec.read_idx(self._rec.keys[j])
-                    if not put_interruptible(rec):
-                        return
-            except Exception as e:  # noqa: BLE001 — handed to consumer
-                tail = e
-            put_interruptible(tail)
-
-        self._q = q
-        self._stop = stop
-        self._eof = False
-        self._thread = threading.Thread(target=pump, daemon=True)
-        self._thread.start()
-
-    def read(self):
-        if self._eof:
-            return None
-        s = self._q.get()
-        if isinstance(s, Exception):
-            self._eof = True
-            raise s
-        if s is None:
-            self._eof = True
-        return s
-
-    def reset(self):
-        # signal the pump thread to stop rather than draining the rest
-        # of the epoch through the queue (a mid-epoch reset on a large
-        # .rec would otherwise re-read essentially the whole file); a
-        # small timed drain unblocks a pump stuck on a full queue
-        self._stop.set()
-        while self._thread.is_alive():
-            try:
-                self._q.get(timeout=0.05)
-            except queue.Empty:
-                pass
-        self._thread.join()
-        self._start_epoch()
-
-
-class _ShuffleBuffer:
-    """Streaming window shuffle over a sequential record stream: keep a
-    reservoir of up to ``capacity`` records, emit a uniformly random one
-    as each new record arrives.  Gives index-free record files epoch
-    randomization within a bounded memory window (exact when the file
-    fits the window)."""
-
-    def __init__(self, stream, capacity):
-        self._stream = stream
-        self._cap = max(2, int(capacity))
-        self._buf = []
-        self._eof = False
-
-    def read(self):
-        while not self._eof and len(self._buf) < self._cap:
-            s = self._stream.read()
-            if s is None:
-                self._eof = True
-                break
-            self._buf.append(s)
-        if not self._buf:
-            return None
-        i = np.random.randint(len(self._buf))
-        self._buf[i], self._buf[-1] = self._buf[-1], self._buf[i]
-        return self._buf.pop()
-
-    def reset(self):
-        self._stream.reset()
-        self._buf = []
-        self._eof = False
-
-
-class _NativeRecordStream:
-    """Background-prefetched sequential record stream (native runtime)."""
-
-    def __init__(self, path, capacity=16):
-        from .. import native
-        self._native = native
-        self._path = path
-        self._cap = capacity
-        self._pf = native.NativePrefetcher(path, capacity)
-
-    def read(self):
-        try:
-            return next(self._pf)
-        except StopIteration:
-            return None
-
-    def reset(self):
-        self._pf.close()
-        self._pf = self._native.NativePrefetcher(self._path, self._cap)
-
-
 class ImageRecordIter(DataIter):
     """RecordIO image iterator (reference iter_image_recordio_2.cc).
 
-    Throughput path: the native C++ prefetcher overlaps raw record reads
-    with decode, and ``preprocess_threads`` PIL-decode/augment workers run
-    behind a double-buffered batch queue (the dmlc::ThreadedIter + OMP
-    parser-pool analog, iter_image_recordio_2.cc:495-557).
+    Throughput path: ``preprocess_threads`` PIL-decode/augment workers
+    run behind a double-buffered batch queue while the producer thread
+    reads raw records (the dmlc::ThreadedIter + OMP parser-pool analog,
+    iter_image_recordio_2.cc:495-557).
+
+    The raw plan lives in a :class:`~mxnet_tpu.data.ShardedRecordDataset`
+    (docs/architecture/data_pipeline.md): one-or-many ``.rec`` files,
+    deterministic seeded global shuffle (``MXNET_DATA_SEED``; with an
+    ``.idx`` sidecar a full fresh permutation per epoch, without one a
+    streaming window shuffle of ``shuffle_buffer`` records), sharding by
+    ``(part_index, num_parts)`` — the dist-kvstore fit path wires
+    rank/size automatically — and the checkpointable-iterator protocol:
+    ``state_dict()`` / ``load_state()`` capture the consumer frontier
+    (record cursor, permutation position, shuffle buffer, epoch/batch
+    counters) so a killed job resumes mid-epoch with zero replayed and
+    zero skipped records.  With the seed set, augmentation draws from a
+    per-record generator and replays identically on resume; unseeded,
+    order and augmentation come from the module-global ``np.random``
+    exactly as before.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
@@ -565,25 +603,19 @@ class ImageRecordIter(DataIter):
                  max_shear_ratio=0.0, min_random_scale=1.0,
                  max_random_scale=1.0, max_aspect_ratio=0.0, random_h=0,
                  random_s=0, random_l=0, pad=0, fill_value=255,
-                 path_imgidx=None, shuffle_buffer=4096, **kwargs):
+                 path_imgidx=None, shuffle_buffer=4096, part_index=0,
+                 num_parts=1, seed=None, **kwargs):
         super().__init__(batch_size)
         from . import recordio
         from .image_util import decode_record_image
         from .pipeline import ThreadedBatchPipeline
+        from ..data.sharded import ShardedRecordDataset
         self._recordio = recordio
         self._decode = decode_record_image
-        # shuffle (reference iter_image_recordio_2.cc shuffle_): with an
-        # .idx sidecar, a full fresh permutation per epoch via random
-        # access; without, a streaming window shuffle over the
-        # sequential stream (capacity `shuffle_buffer` records)
-        if shuffle and path_imgidx:
-            self.record = _PermutedRecordStream(path_imgidx, path_imgrec)
-        elif recordio._use_native():
-            self.record = _NativeRecordStream(path_imgrec, 16)
-        else:
-            self.record = recordio.MXRecordIO(path_imgrec, "r")
-        if shuffle and not path_imgidx:
-            self.record = _ShuffleBuffer(self.record, shuffle_buffer)
+        self._dataset = ShardedRecordDataset(
+            path_imgrec, path_imgidx, shuffle=shuffle, seed=seed,
+            part_index=part_index, num_parts=num_parts,
+            shuffle_window=shuffle_buffer)
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
@@ -602,16 +634,25 @@ class ImageRecordIter(DataIter):
             fill_value=fill_value)
         self._batch = None
         self._pipeline = ThreadedBatchPipeline(
-            self.record.read, self._decode_one, self._assemble,
-            self.record.reset, batch_size,
+            self._dataset.read, self._decode_one, self._assemble,
+            self._dataset.reset, batch_size,
             preprocess_threads=preprocess_threads,
-            prefetch=prefetch_buffer)
+            prefetch=prefetch_buffer, stateful=True,
+            snapshot_fn=self._dataset.state_dict)
 
-    def _decode_one(self, s):
+    def _decode_one(self, s, meta):
+        from ..data.sharded import record_rng
         header, img_bytes = self._recordio.unpack(s)
+        rng = None
+        if self._dataset.seed is not None and meta is not None:
+            # per-record generator: augmentation is independent of pool
+            # thread scheduling and of where batch/checkpoint boundaries
+            # fall — the resume-replay guarantee
+            rng = record_rng(self._dataset.seed, meta["epoch"],
+                             meta["ordinal"])
         img = self._decode(img_bytes, self.data_shape,
                            rand_crop=self.rand_crop,
-                           rand_mirror=self.rand_mirror,
+                           rand_mirror=self.rand_mirror, rng=rng,
                            **self._aug_kwargs)
         img = (img - self.mean) * self.scale
         lbl = header.label
@@ -662,3 +703,50 @@ class ImageRecordIter(DataIter):
 
     def getpad(self):
         return self._batch.pad if self._batch else 0
+
+    @property
+    def epoch(self):
+        """Current epoch counter of the underlying dataset."""
+        return self._dataset.epoch
+
+    def set_partition(self, part_index, num_parts, auto=False):
+        """Shard the record plan for dist training (restarts the
+        current epoch under the new partition; must be called before
+        any batch of the epoch was consumed)."""
+        if self._pipeline.batches_consumed:
+            raise MXNetError(
+                "cannot repartition after %d consumed batches; "
+                "repartition before iterating or on an epoch boundary"
+                % self._pipeline.batches_consumed)
+
+        def _mut():
+            self._dataset.rewind_epoch()   # discard producer read-ahead
+            self._dataset.set_partition(part_index, num_parts, auto=auto)
+        self._pipeline.reload(_mut)
+
+    # -- checkpoint protocol --------------------------------------------
+    def state_dict(self):
+        """Consumer-frontier capture: the dataset cursor after the last
+        batch ``next()`` returned, plus the epoch batch counter —
+        in-flight decode work is never reflected."""
+        st = self._pipeline.state_dict()
+        st["kind"] = "ImageRecordIter"
+        return st
+
+    def load_state(self, state):
+        kind = state.get("kind")
+        if kind not in (None, "ImageRecordIter"):
+            raise MXNetError(
+                "checkpoint was taken by %r, not an ImageRecordIter — "
+                "resuming it here would misinterpret the stream" % kind)
+        self._pipeline.load_state(
+            state, lambda: self._dataset.load_state(state["source"]))
+        self._batch = None
+
+    def close(self):
+        """Stop the pipeline threads and close the record files
+        (best-effort: teardown never masks the caller's failure)."""
+        try:
+            self._pipeline.close()
+        finally:
+            self._dataset.close()

@@ -38,6 +38,7 @@ import jax
 from .. import profiler as _profiler
 from ..base import MXNetError, get_env, hot_path
 from ..ndarray import NDArray
+from .pipeline import put_interruptible
 
 __all__ = ["DeviceStager", "staging_enabled"]
 
@@ -74,6 +75,12 @@ class DeviceStager:
         self._queue = None
         self._producer = None
         self._stop = threading.Event()
+        # consumer-frontier data state: each staged batch carries the
+        # source's state_dict() captured right after the producer pulled
+        # it, and state_dict() reports the last batch the CONSUMER took
+        # — batches staged ahead are never reflected (checkpointable-
+        # iterator protocol, docs/architecture/data_pipeline.md)
+        self._frontier = None
 
     # -- producer -------------------------------------------------------
     def _start(self):
@@ -82,10 +89,18 @@ class DeviceStager:
         # the old thread feeding (or un-stopping) the new epoch's run
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self._depth)
+        # producer is parked: the source position IS the frontier until
+        # the consumer takes the first staged batch
+        self._frontier = self._source_state(self._source)
         self._producer = threading.Thread(
             target=self._produce, args=(self._queue, self._stop),
             name="mxt-stage", daemon=True)
         self._producer.start()
+
+    @staticmethod
+    def _source_state(source):
+        from ..data.checkpoint import state_dict_of
+        return state_dict_of(source)
 
     def _produce(self, q, stop):
         src = iter(self._source)
@@ -94,18 +109,14 @@ class DeviceStager:
                 try:
                     batch = next(src)
                 except StopIteration:
-                    q.put(_EOF)
+                    q.put((_EOF, self._source_state(self._source)))
                     return
                 staged = self._stage_batch(batch)
+                staged._mxt_data_state = self._source_state(self._source)
                 # bounded hand-off: blocks when the consumer is `depth`
-                # batches behind, with a timeout so reset() can always
-                # win the race against a full queue
-                while not stop.is_set():
-                    try:
-                        q.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                # batches behind, stop-aware so reset() always wins the
+                # race against a full queue
+                put_interruptible(q, stop, staged)
         except BaseException as e:  # surface producer errors to the consumer
             q.put(e)
 
@@ -149,12 +160,18 @@ class DeviceStager:
             # that is not going to run
             self._start()
         item = self._queue.get()
-        if item is _EOF:
-            raise StopIteration
         if isinstance(item, BaseException):
             raise MXNetError("input staging worker failed: %r"
                              % (item,)) from item
-        return item
+        batch, state = item if isinstance(item, tuple) else (item, None)
+        if batch is _EOF:
+            if state is not None:
+                self._frontier = state
+            raise StopIteration
+        st = getattr(batch, "_mxt_data_state", None)
+        if st is not None:
+            self._frontier = st
+        return batch
 
     next = __next__
 
@@ -189,6 +206,22 @@ class DeviceStager:
                 "input staging producer stuck in the source iterator "
                 "for >30s; cannot safely reset/close the stager")
         self._producer = None
+
+    # -- checkpoint protocol --------------------------------------------
+    def state_dict(self):
+        """Consumer-frontier state: the source position after the last
+        batch the consumer pulled THROUGH the stager (staged-ahead
+        batches are discarded on resume, so they must not count)."""
+        if self._producer is None:
+            return self._source_state(self._source)
+        return self._frontier
+
+    def load_state(self, state):
+        """Stop staging, restore the source position; the producer
+        restarts lazily at the next read."""
+        self._halt()
+        self._source.load_state(state)
+        self._frontier = None
 
     def __getattr__(self, name):
         # provide_data / provide_label / batch_size etc. pass through

@@ -106,19 +106,35 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(index * num_device + k, g, w)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    data_state=None):
     """Save prefix-symbol.json + prefix-NNNN.params (reference format).
 
     Both files are written atomically (temp file + rename, see
     ``base.atomic_write``): a crash mid-save leaves the previous epoch's
     checkpoint intact, never a truncated one — pair with
-    ``load_latest_checkpoint`` for crash-safe auto-resume."""
+    ``load_latest_checkpoint`` for crash-safe auto-resume.
+
+    ``data_state`` (an iterator chain's ``state_dict()``) is persisted
+    beside the params as a versioned ``.dstate`` envelope — written
+    AFTER the params and naming them, so the pair is torn-write-safe:
+    a crash between the two leaves params whose loader reports no data
+    state (resume from the epoch head), never a mismatched mid-epoch
+    position.  ``None`` removes any stale envelope for this epoch."""
+    from .data.checkpoint import save_data_state
+    # commit-point ordering (see Module.save_checkpoint): stale envelope
+    # removed before the params are overwritten, new envelope written
+    # only after the asynchronous params write landed
+    save_data_state(prefix, epoch, None)
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
     nd.save(param_name, save_dict)
+    if data_state is not None:
+        nd._wait_pending_write(param_name)
+    save_data_state(prefix, epoch, data_state)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
@@ -167,15 +183,33 @@ def latest_checkpoint(prefix):
     return best
 
 
+class CheckpointBundle(tuple):
+    """A checkpoint load result: unpacks like the plain tuple it always
+    was, and additionally carries ``.data_state`` — the iterator-state
+    envelope saved beside the params (None when the checkpoint has no
+    data state; resume then starts at the epoch head)."""
+
+    data_state = None
+
+    def __new__(cls, items, data_state=None):
+        self = super().__new__(cls, items)
+        self.data_state = data_state
+        return self
+
+
 def load_latest_checkpoint(prefix):
     """Auto-resume helper: load the newest checkpoint saved under
-    ``prefix``.  Returns ``(symbol, arg_params, aux_params, epoch)``, or
-    None when no checkpoint exists yet (start fresh)."""
+    ``prefix``.  Returns ``(symbol, arg_params, aux_params, epoch)``
+    (with the mid-epoch iterator state, if any, as ``.data_state`` on
+    the returned bundle), or None when no checkpoint exists yet (start
+    fresh)."""
     epoch = latest_checkpoint(prefix)
     if epoch is None:
         return None
     symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
-    return (symbol, arg_params, aux_params, epoch)
+    from .data.checkpoint import load_data_state
+    return CheckpointBundle((symbol, arg_params, aux_params, epoch),
+                            load_data_state(prefix, epoch))
 
 
 class FeedForward(BASE_ESTIMATOR):

@@ -150,8 +150,16 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """Train the module (reference base_module.py:368)."""
+            monitor=None, resume_data_state=None):
+        """Train the module (reference base_module.py:368).
+
+        ``resume_data_state`` — an iterator-state envelope from
+        ``model.load_latest_checkpoint(...).data_state`` /
+        ``Module.load_latest(...).data_state``: it is loaded into
+        ``train_data`` before the first batch, so a killed run resumes
+        MID-epoch with zero replayed and zero skipped records (pair
+        with ``begin_epoch`` = the checkpoint's epoch;
+        docs/architecture/data_pipeline.md)."""
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         if initializer is None:
@@ -167,6 +175,18 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        # dist training: shard the record plan by this worker's kvstore
+        # rank/size (a no-op for iterators without set_partition or when
+        # the user partitioned explicitly — auto never overrides)
+        kv = getattr(self, "_kvstore", None)
+        if kv is not None and getattr(kv, "num_workers", 1) > 1 and \
+                hasattr(train_data, "set_partition"):
+            train_data.set_partition(kv.rank, kv.num_workers, auto=True)
+
+        if resume_data_state is not None:
+            from ..data.checkpoint import load_state_into
+            load_state_into(train_data, resume_data_state)
 
         if validation_metric is None:
             validation_metric = eval_metric

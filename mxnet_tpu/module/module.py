@@ -19,6 +19,7 @@ keeps or falls back to full executor-group reference semantics.
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 
 from .. import ndarray as nd
@@ -108,6 +109,21 @@ class Module(BaseModule):
         """Create a Module from a ``save_checkpoint`` prefix/epoch
         (symbol + params; optimizer states restored lazily at
         ``init_optimizer`` when requested)."""
+        if load_optimizer_states:
+            states = "%s-%04d.states" % (prefix, epoch)
+            if not os.path.exists(states):
+                # fail HERE, not deep inside a later fit's
+                # init_optimizer: this checkpoint was saved without
+                # save_optimizer_states (e.g. the model-level
+                # do_checkpoint callback — use module_checkpoint /
+                # batch_checkpoint for states-carrying saves)
+                raise MXNetError(
+                    "checkpoint epoch %d under %r has no optimizer "
+                    "states (%s missing); it was saved without "
+                    "save_optimizer_states — load with "
+                    "load_optimizer_states=False, or checkpoint via "
+                    "module_checkpoint/batch_checkpoint"
+                    % (epoch, prefix, states))
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
         mod._arg_params = args
@@ -120,27 +136,49 @@ class Module(BaseModule):
     @staticmethod
     def load_latest(prefix, load_optimizer_states=False, **kwargs):
         """Auto-resume: load the newest epoch checkpointed under
-        ``prefix``.  Returns ``(module, epoch)``, or None when no
-        checkpoint exists yet — the caller starts training from epoch 0
-        in that case."""
-        from ..model import latest_checkpoint
+        ``prefix``.  Returns ``(module, epoch)`` — with the mid-epoch
+        iterator state, if one was saved beside the params, as
+        ``.data_state`` on the returned bundle (pass it to
+        ``fit(resume_data_state=...)``) — or None when no checkpoint
+        exists yet; the caller starts training from epoch 0 then."""
+        from ..data.checkpoint import load_data_state
+        from ..model import CheckpointBundle, latest_checkpoint
         epoch = latest_checkpoint(prefix)
         if epoch is None:
             return None
-        return (Module.load(prefix, epoch, load_optimizer_states,
-                            **kwargs), epoch)
+        return CheckpointBundle(
+            (Module.load(prefix, epoch, load_optimizer_states,
+                         **kwargs), epoch),
+            load_data_state(prefix, epoch))
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        data_state=None):
         """Write ``prefix-symbol.json`` + ``prefix-NNNN.params`` (and
-        ``.states`` when asked) — the reference checkpoint format."""
+        ``.states`` when asked) — the reference checkpoint format.
+        ``data_state`` persists an iterator chain's ``state_dict()``
+        beside the params (versioned ``.dstate`` envelope, written
+        after them) so training can resume mid-epoch; None removes any
+        stale envelope for this epoch."""
+        from ..data.checkpoint import save_data_state
+        # the envelope is the checkpoint set's COMMIT POINT: any stale
+        # one is removed BEFORE the params/state files are overwritten
+        # and the new one is written last, after the (asynchronous)
+        # params write landed — a kill anywhere inside the save leaves
+        # a no-envelope set (resume falls back to the epoch head, which
+        # never skips data), never a frontier paired with files from a
+        # different save
+        save_data_state(prefix, epoch, None)
         self._symbol.save("%s-symbol.json" % prefix)
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
-        logging.info("Saved checkpoint to \"%s\"", param_name)
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_name)
             logging.info("Saved optimizer state to \"%s\"", state_name)
+        if data_state is not None:
+            nd._wait_pending_write(param_name)
+        save_data_state(prefix, epoch, data_state)
+        logging.info("Saved checkpoint to \"%s\"", param_name)
 
     def save_params(self, fname):
         """Save current parameters (``arg:``/``aux:`` key convention,

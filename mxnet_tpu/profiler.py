@@ -33,14 +33,15 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
 # step-program dispatch (parallel/spmd.py) recorded INSIDE the fit
 # loop's ``compute`` phase: its span against compute shows how much of
 # compute is the one-program dispatch vs frontend packing/metric glue.
-PHASES = ("data_wait", "h2d_stage", "compute", "metric_fetch",
-          "spmd_step")
+PHASES = ("data_wait", "data_next", "h2d_stage", "compute",
+          "metric_fetch", "spmd_step")
 
 # Phases that overlap (h2d_stage: stager thread concurrent with
-# compute) or nest inside (spmd_step: within compute) another phase —
-# reported, but excluded from the step-percentage denominator so the
-# breakdown still sums to 100%.
-_NON_ADDITIVE_PHASES = frozenset(["h2d_stage", "spmd_step"])
+# compute) or nest inside (spmd_step: within compute; data_next: the
+# pipeline consumer seam inside the fit loop's data_wait) another
+# phase — reported, but excluded from the step-percentage denominator
+# so the breakdown still sums to 100%.
+_NON_ADDITIVE_PHASES = frozenset(["h2d_stage", "spmd_step", "data_next"])
 
 # The serving engine's scheduler-cycle phases (serving/scheduler.py):
 # ``serve_wait`` (engine blocked on the request queue), ``serve_batch``

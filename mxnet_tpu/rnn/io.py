@@ -142,6 +142,42 @@ class BucketSentenceIter(DataIter):
         self._plan = [self._plan[k] for k in order]
         self._perms = [np.random.permutation(mat.shape[0])
                        for mat in self._tokens]
+        self._epoch_state = None   # serialized plan/perms cache
+
+    # -- checkpoint protocol (docs/architecture/data_pipeline.md) -------
+    def state_dict(self):
+        """Cursor + the epoch's drawn plan order and per-bucket row
+        permutations, so a resumed iterator replays the identical
+        bucketed batch stream (time-major or batch-major alike).  The
+        plan/perms serialization is fixed within an epoch and cached —
+        per-batch wrapper snapshots must not pay O(dataset) each time;
+        the shared lists are immutable by contract."""
+        if getattr(self, "_epoch_state", None) is None:
+            self._epoch_state = {
+                "plan": [[int(b), int(off)] for b, off in self._plan],
+                "perms": [[int(i) for i in p] for p in self._perms]}
+        return {"version": 1, "kind": "BucketSentenceIter",
+                "cursor": int(self._cursor),
+                "plan": self._epoch_state["plan"],
+                "perms": self._epoch_state["perms"]}
+
+    def load_state(self, state):
+        perms = state["perms"]
+        if len(perms) != len(self._tokens) or any(
+                len(p) != mat.shape[0]
+                for p, mat in zip(perms, self._tokens)):
+            raise ValueError("checkpoint bucket layout does not match "
+                             "this iterator's data")
+        self._plan = [(int(b), int(off)) for b, off in state["plan"]]
+        self._perms = [np.asarray(p, dtype=np.int64) for p in perms]
+        self._epoch_state = None
+        self._cursor = int(state["cursor"])
+        if self._cursor >= len(self._plan):
+            # epoch-boundary capture: roll into a fresh epoch (a new
+            # shuffle from the module-global RNG — this iterator is
+            # unseeded by design, so the rolled epoch is a valid fresh
+            # draw rather than a bit-exact replay)
+            self.reset()
 
     def next(self):
         if self._cursor >= len(self._plan):

@@ -1,0 +1,76 @@
+"""Subprocess body of the SIGKILL-mid-epoch resume scenario.
+
+Driven by tests/test_data_pipeline.py (mirrors the PR-2 server-death
+protocol): the driver launches this script with a seeded
+``MXNET_FAULT_INJECT`` plan whose ``data.next`` rule ``die``s mid-epoch
+(``os._exit(137)`` — the process vanishes exactly like a SIGKILL), then
+relaunches it WITHOUT the plan.  The relaunch finds the latest
+mid-epoch checkpoint envelope (params + optimizer state + iterator
+frontier), resumes, and finishes; the driver then asserts the resumed
+batch stream is byte-identical to the uninterrupted run's suffix and
+the final params byte-match.
+
+Every trained batch appends one ``epoch;labels;sha1(data)`` line to the
+log file, so the stream a run actually trained on is externally
+observable.
+"""
+import hashlib
+import json
+import os
+import sys
+
+
+def main(argv):
+    rec, idx, prefix, out_params, log_path = argv[:5]
+    num_epoch = 2
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.test_utils import smoke_mlp
+
+    # param init must agree between the clean and the killed/resumed
+    # process (the data plane itself is seeded via MXNET_DATA_SEED)
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 12, 12),
+        batch_size=4, shuffle=True, rand_crop=True, rand_mirror=True,
+        max_rotate_angle=10, preprocess_threads=2)
+
+    def log_batch(param):
+        batch = (param.locals or {})["data_batch"]
+        lab = batch.label[0].asnumpy()
+        dig = hashlib.sha1(
+            batch.data[0].asnumpy().tobytes()).hexdigest()[:16]
+        with open(log_path, "a") as f:
+            f.write("%d;%s;%s\n"
+                    % (param.epoch,
+                       ",".join("%g" % v for v in lab), dig))
+
+    latest = mx.Module.load_latest(prefix, load_optimizer_states=True,
+                                   context=mx.cpu())
+    resume_kw = {}
+    if latest is None:
+        mod, begin = mx.Module(smoke_mlp(num_hidden=16),
+                               context=mx.cpu()), 0
+    else:
+        mod, begin = latest
+        resume_kw = dict(arg_params=mod._arg_params,
+                         aux_params=mod._aux_params,
+                         resume_data_state=latest.data_state)
+    cbs = [log_batch,
+           mx.callback.batch_checkpoint(mod, prefix, period=2)]
+    mod.fit(it, num_epoch=num_epoch, begin_epoch=begin,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.05},
+            eval_metric="acc", batch_end_callback=cbs, **resume_kw)
+    mod.save_params(out_params)
+    # machine-readable completion witness for the driver
+    print(json.dumps({"done": True, "begin_epoch": begin,
+                      "resumed": bool(resume_kw)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -358,6 +358,10 @@ def test_stager_records_h2d_and_fit_records_phases(tmp_path, monkeypatch):
     report = profiler.aggregate_phase_trace(trace)
     assert report["steps"] == 8
     for phase in profiler.PHASES:
+        if phase == "data_next":
+            # only emitted by the record pipeline's consumer seam
+            # (ThreadedBatchPipeline); this fit feeds an NDArrayIter
+            continue
         assert phase in report["phases"], phase
         assert report["phases"][phase]["spans"] >= 8 - 1
     # h2d_stage overlaps compute: excluded from the pct base

@@ -321,21 +321,24 @@ def test_bandwidth_measure_tool():
         assert float(bw) > 0 and float(err) == 0.0
 
 
-def test_permuted_stream_reader_error_propagates(tmp_path):
-    """A record-read failure inside the pump thread must surface in
-    read() (not hang the consumer), and a mid-epoch reset must not
-    drain the remaining epoch through the queue."""
+def test_record_reader_error_propagates(tmp_path):
+    """A record-read failure inside the pipeline's producer thread must
+    surface at the consumer seam (not hang it), and a reset afterwards
+    must restart a clean epoch quickly."""
     import time
-    from mxnet_tpu.io.io import _PermutedRecordStream
+    from mxnet_tpu.base import MXNetError
 
     rec = str(tmp_path / "e.rec")
     idx = str(tmp_path / "e.idx")
     _write_labeled_rec(rec, idx_path=idx, n=30)
-    st = _PermutedRecordStream(idx, rec, capacity=4)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 16, 16), batch_size=5,
+                               shuffle=True, preprocess_threads=2)
 
-    # corrupt reads after a couple of successes: read() must raise, not
+    # corrupt reads after a couple of successes: next() must raise, not
     # block forever on an empty queue
-    orig = st._rec.read_idx
+    rec0 = it._dataset._recs[0]
+    orig = rec0.read_idx
     calls = {"n": 0}
 
     def flaky(key):
@@ -344,24 +347,22 @@ def test_permuted_stream_reader_error_propagates(tmp_path):
             raise OSError("truncated record")
         return orig(key)
 
-    st._rec.read_idx = flaky
-    got, err = 0, None
+    rec0.read_idx = flaky
+    err = None
     try:
-        for _ in range(30):
-            if st.read() is None:
-                break
-            got += 1
-    except OSError as e:
+        for _ in range(6):
+            next(it)
+    except MXNetError as e:
         err = e
     assert err is not None and "truncated" in str(err)
-    assert got <= 6  # 2 good reads + up to capacity already queued
 
-    # recovery: reset() restarts a clean epoch quickly (no full drain)
-    st._rec.read_idx = orig
+    # recovery: reset() restarts a clean epoch quickly, full length
+    rec0.read_idx = orig
     t0 = time.time()
-    st.reset()
+    it.reset()
     assert time.time() - t0 < 5.0
     n = 0
-    while st.read() is not None:
-        n += 1
+    for b in it:
+        n += b.data[0].shape[0] - (b.pad or 0)
     assert n == 30
+    it.close()
