@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke spmd-smoke kernels-smoke data-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke decode-smoke spmd-smoke kernels-smoke data-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -71,6 +71,18 @@ elastic-smoke:
 serve-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 		$(PY) tools/serve_smoke.py --seed 11 --qps-floor 3.0
+
+# decode-plane gate (docs/architecture/decode_engine.md): the offset
+# flash kernel vs its dense twin, decode-vs-one-shot logits parity
+# (MXNET_PALLAS routed AND the =0 escape hatch), the -1e30 cache-pad
+# mask pin, the generative program store's AOT warm set, and the
+# continuous-batching GenerationEngine — greedy == reference, seeded-
+# loadgen FIFO admission, close-mid-generation drain, KV-cache growth,
+# plus the banked serving.decode.* rows (continuous >= 2x re-prefill
+# tokens/sec at no worse p99 TTFT, zero drops)
+decode-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/test_decode_engine.py -q -m quick
 
 # one-SPMD-step-program gate under 8 fake host devices: numerical
 # equivalence (dp8 vs single device, dp2xmp2 vs dp4, closed-form SGD),

@@ -55,6 +55,10 @@ SPAN_ENTRY_POINTS = (
     ("mxnet_tpu/kvstore_pipeline.py", "CommPipeline.flush"),
     ("mxnet_tpu/module/base_module.py", "BaseModule._fit_epochs"),
     ("mxnet_tpu/parallel/dp.py", "DataParallelTrainer.step"),
+    ("mxnet_tpu/serving/decode_engine.py",
+     "GenerationEngine._dispatch_decode"),
+    ("mxnet_tpu/serving/decode_engine.py",
+     "GenerationEngine._dispatch_prefill"),
     ("mxnet_tpu/serving/scheduler.py", "ServingEngine._dispatch_once"),
 )
 

@@ -335,6 +335,34 @@ register_env("MXNET_SERVE_DTYPE", str, "",
              "as float32 either way).  Empty keeps the checkpoint "
              "dtype (fp32 serving, bit-equal to the classic "
              "Predictor).")
+register_env("MXNET_SERVE_KV_BLOCK", int, 64,
+             "Tokens per KV-cache block on the serving decode plane "
+             "(serving/program_store.py GenerativeProgramStore): cache "
+             "lengths are quantized UP to block multiples, so one "
+             "decode-step program per (batch-bucket, cache-bucket) "
+             "covers a whole block of sequence lengths and the cache "
+             "grows block-at-a-time instead of per token.")
+register_env("MXNET_SERVE_KV_MAX", int, 1024,
+             "Upper bound on a served sequence's KV-cache length "
+             "(prompt + generated tokens).  Generation requests whose "
+             "prompt_len + max_tokens exceed it are rejected at "
+             "submit, so a decode batch can never outgrow its cache "
+             "mid-flight.")
+register_env("MXNET_SERVE_PROMPT_BUCKETS", str, "16,32,64,128",
+             "Comma-separated prompt-length bucket edges of the "
+             "serving prefill programs: a prompt of p tokens is "
+             "zero-padded up to the smallest edge >= p and runs the "
+             "AOT-compiled prefill program for that (batch, prompt) "
+             "bucket pair.")
+register_env("MXNET_AUTO_RESUME", str, "",
+             "Checkpoint prefix for hands-off crash resume: when set, "
+             "Module.fit() with no explicit resume_data_state loads "
+             "the latest .dstate envelope saved under this prefix "
+             "(data/checkpoint.py) before the first batch.  "
+             "tools/launch.py --auto-resume exports it to (re)launched "
+             "workers so a restarted process picks up the mid-epoch "
+             "frontier without the training script threading it by "
+             "hand.  Empty disables.")
 
 
 def hot_path(fn):

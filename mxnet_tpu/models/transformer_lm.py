@@ -17,7 +17,8 @@ next-token targets of the same shape.
 """
 from .. import symbol as sym
 
-__all__ = ["get_symbol"]
+__all__ = ["get_symbol", "lm_spec", "random_params", "init_cache",
+           "prefill_apply", "decode_apply"]
 
 
 def _attention_block(x, seq_len, num_hidden, num_heads, name):
@@ -76,3 +77,174 @@ def get_symbol(seq_len, num_layers=2, num_hidden=64, num_heads=4,
                                 num_hidden=vocab_size, name="pred")
     return sym.SoftmaxOutput(logits, sym.Reshape(label, shape=(-1,)),
                              name="softmax")
+
+
+# ---------------------------------------------------------------------------
+# Decode-mode graphs: the SAME trained weights (the symbol graph's
+# argument names), applied incrementally against a KV cache.
+#
+# The symbol graph above is one-shot: a (B, seq_len) grid in, all
+# positions out, every token re-paying attention over the whole prefix.
+# Autoregressive serving needs the split form — ``prefill_apply`` runs
+# the prompt once and fills the cache, ``decode_apply`` consumes ONE
+# token per sequence against it — as pure jax functions the serving
+# program store can AOT-compile with the cache donated.  Numerics reuse
+# the op registry's own lowerings (``_rms_fc``/``_ln_fc`` and the
+# ``sdp_attention`` door), so the decode path routes through the same
+# Pallas dispatch seam as the symbol graph and a T-step decode loop
+# reproduces the one-shot forward's per-position logits (pinned by
+# tests/test_decode_engine.py).
+# ---------------------------------------------------------------------------
+def lm_spec(num_layers=2, num_hidden=64, num_heads=4, vocab_size=256):
+    """Validated architecture spec consumed by the decode-mode graphs
+    (``seq_len`` is a property of the *call*, not the weights)."""
+    if num_hidden % num_heads:
+        raise ValueError("num_hidden %d must divide into num_heads %d"
+                         % (num_hidden, num_heads))
+    return {"num_layers": int(num_layers), "num_hidden": int(num_hidden),
+            "num_heads": int(num_heads), "vocab_size": int(vocab_size)}
+
+
+def random_params(spec, seed=0, scale=0.1):
+    """Seeded random weights with the symbol graph's exact argument
+    names/shapes (via ``get_symbol`` + ``infer_shape``) — the shared
+    protocol model of the decode tests and bench rows."""
+    import numpy as np
+    net = get_symbol(seq_len=8, **spec)
+    shapes, _, _ = net.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    rs = np.random.RandomState(seed)
+    return {name: np.asarray(rs.uniform(-scale, scale, shape),
+                             np.float32)
+            for name, shape in zip(net.list_arguments(), shapes)
+            if name not in ("data", "softmax_label")}
+
+
+def init_cache(spec, batch, cache_len, dtype="float32"):
+    """Zeroed stacked KV cache pair, each of shape
+    ``(num_layers, batch, num_heads, cache_len, head_dim)``."""
+    import jax.numpy as jnp
+    dh = spec["num_hidden"] // spec["num_heads"]
+    shape = (spec["num_layers"], batch, spec["num_heads"],
+             int(cache_len), dh)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _block_params(params, i):
+    p = {k: params["blk%d_%s" % (i, k)] for k in
+         ("ln1_gamma", "q_weight", "k_weight", "v_weight", "proj_weight",
+          "ln2_gamma", "ffn1_weight", "ffn1_bias", "ffn2_weight",
+          "ffn2_bias")}
+    return p
+
+
+def _ffn(x2d, bp):
+    import jax.numpy as jnp
+    f = jnp.matmul(x2d, bp["ffn1_weight"].T) + bp["ffn1_bias"]
+    f = jnp.maximum(f, 0)
+    return jnp.matmul(f, bp["ffn2_weight"].T) + bp["ffn2_bias"]
+
+
+def prefill_apply(params, tokens, lengths, cache_len, spec):
+    """Run a padded prompt batch once and fill the KV cache.
+
+    tokens: (B, P) int32, zero-padded past each sequence's ``lengths``;
+    lengths: (B,) int32 true prompt lengths (1 <= lengths <= P).
+    Returns ``(logits, k_cache, v_cache)`` — logits (B, P, vocab) fp32
+    for every position (callers gather position ``lengths-1`` for the
+    first generated token), caches ``(L, B, H, cache_len, head_dim)``
+    holding K/V for positions 0..P-1 and zeros past P.  Pad positions
+    DO write junk K/V inside 0..P-1 for rows shorter than P, but no
+    real query ever attends past its own position (causal), and decode
+    steps overwrite slots from ``lengths`` on — the offset-causal mask
+    keeps them invisible throughout (pinned).
+    """
+    import jax.numpy as jnp
+    from ..ops.attention import sdp_attention
+    from ..ops.nn import _ln_fc, _rms_fc
+
+    L, D = spec["num_layers"], spec["num_hidden"]
+    H = spec["num_heads"]
+    dh = D // H
+    B, P = tokens.shape
+    x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
+                 axis=0)                                    # (B, P, D)
+    ks, vs = [], []
+    for i in range(L):
+        bp = _block_params(params, i)
+        a = _rms_fc({"eps": 1e-6}, x, bp["ln1_gamma"])
+        a2 = a.reshape(-1, D)
+
+        def heads(w):
+            h = jnp.matmul(a2, w.T).reshape(B, P, H, dh)
+            return jnp.transpose(h, (0, 2, 1, 3))           # (B, H, P, dh)
+
+        q, k, v = (heads(bp[t]) for t in
+                   ("q_weight", "k_weight", "v_weight"))
+        pad = ((0, 0), (0, 0), (0, int(cache_len) - P), (0, 0))
+        ks.append(jnp.pad(k, pad))
+        vs.append(jnp.pad(v, pad))
+        att = sdp_attention(q, k, v, causal=True)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(-1, D)
+        x = x + jnp.matmul(att, bp["proj_weight"].T).reshape(B, P, D)
+        f = _rms_fc({"eps": 1e-6}, x, bp["ln2_gamma"]).reshape(-1, D)
+        x = x + _ffn(f, bp).reshape(B, P, D)
+    h = _ln_fc({"axis": -1, "eps": 1e-5}, x, params["final_ln_gamma"],
+               params["final_ln_beta"])
+    logits = (jnp.matmul(h.reshape(-1, D), params["pred_weight"].T) +
+              params["pred_bias"]).reshape(B, P, spec["vocab_size"])
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def decode_apply(params, cache_k, cache_v, tokens, lengths, spec):
+    """One decode step: embed one token per sequence, write its K/V at
+    each sequence's cache frontier, attend offset-causally over the
+    cache, and emit next-token logits.
+
+    tokens: (B,) int32 (the previously sampled token per sequence);
+    lengths: (B,) int32 cache frontiers (the new token's position —
+    must be < cache_len); caches as from :func:`prefill_apply` /
+    :func:`init_cache`.  Returns ``(logits (B, vocab), new_k, new_v)``.
+    Callers AOT-compile this with both caches DONATED, so the update is
+    an in-place ``dynamic_update_slice`` on the one device-resident
+    copy."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.attention import sdp_attention
+    from ..ops.nn import _ln_fc, _rms_fc
+
+    L, D = spec["num_layers"], spec["num_hidden"]
+    H = spec["num_heads"]
+    dh = D // H
+    B = tokens.shape[0]
+    lengths = jnp.asarray(lengths, jnp.int32)
+    x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
+                 axis=0)                                    # (B, D)
+    for i in range(L):
+        bp = _block_params(params, i)
+        a = _rms_fc({"eps": 1e-6}, x, bp["ln1_gamma"])
+
+        def heads(w):
+            return jnp.matmul(a, w.T).reshape(B, H, 1, dh)
+
+        q, k, v = (heads(bp[t]) for t in
+                   ("q_weight", "k_weight", "v_weight"))
+
+        def write(cache_b, kv_b, l_b):
+            # cache_b (H, C, dh), kv_b (H, 1, dh): in-place when donated
+            return jax.lax.dynamic_update_slice(cache_b, kv_b,
+                                                (0, l_b, 0))
+
+        cache_k = cache_k.at[i].set(jax.vmap(write)(cache_k[i], k,
+                                                    lengths))
+        cache_v = cache_v.at[i].set(jax.vmap(write)(cache_v[i], v,
+                                                    lengths))
+        att = sdp_attention(q, cache_k[i], cache_v[i],
+                            q_offsets=lengths)              # (B, H, 1, dh)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(B, D)
+        x = x + jnp.matmul(att, bp["proj_weight"].T)
+        f = _rms_fc({"eps": 1e-6}, x, bp["ln2_gamma"])
+        x = x + _ffn(f, bp)
+    h = _ln_fc({"axis": -1, "eps": 1e-5}, x, params["final_ln_gamma"],
+               params["final_ln_beta"])
+    logits = jnp.matmul(h, params["pred_weight"].T) + params["pred_bias"]
+    return logits, cache_k, cache_v

@@ -184,6 +184,31 @@ class BaseModule:
                 hasattr(train_data, "set_partition"):
             train_data.set_partition(kv.rank, kv.num_workers, auto=True)
 
+        if resume_data_state is None:
+            # hands-off crash resume: a (re)launched worker under
+            # tools/launch.py --auto-resume picks up the latest .dstate
+            # envelope for the exported prefix without the training
+            # script threading it by hand
+            from ..base import get_env
+            auto_prefix = str(get_env("MXNET_AUTO_RESUME") or "")
+            if auto_prefix:
+                from ..model import latest_checkpoint
+                epoch = latest_checkpoint(auto_prefix)
+                if epoch is not None and epoch != begin_epoch:
+                    # fast-forwarding the iterator to another epoch's
+                    # frontier under fresh params would silently skip
+                    # training data — the frontier only pairs with the
+                    # checkpoint it was saved beside
+                    logging.warning(
+                        "ignoring MXNET_AUTO_RESUME=%s: latest "
+                        "checkpoint is epoch %d but fit begins at "
+                        "epoch %d — load params via Module.load_latest"
+                        " and pass begin_epoch to resume it",
+                        auto_prefix, epoch, begin_epoch)
+                elif epoch is not None:
+                    from ..data.checkpoint import load_data_state
+                    resume_data_state = load_data_state(auto_prefix,
+                                                        epoch)
         if resume_data_state is not None:
             from ..data.checkpoint import load_state_into
             load_state_into(train_data, resume_data_state)

@@ -20,11 +20,20 @@ from .registry import Bool, Float, register
 _NEG = -1e30  # flash_attention._NEG: shared mask constant for parity
 
 
-def _dense_attention(q, k, v, causal, scale):
+def _dense_attention(q, k, v, causal, scale, q_offsets=None):
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    if causal:
-        lq, lk = q.shape[2], k.shape[2]
+    lq, lk = q.shape[2], k.shape[2]
+    if q_offsets is not None:
+        # offset-causal: query row r of sequence b sits at global
+        # position q_offsets[b] + r (the decode path's per-sequence
+        # cache frontier); the SAME -1e30 constant as the offset flash
+        # kernel, so the two lowerings stay numerical twins
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        qglob = jnp.asarray(q_offsets, jnp.int32)[:, None, None] + qpos
+        s = jnp.where((qglob >= kpos[None])[:, None], s, _NEG)
+    elif causal:
         qpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
         kpos = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
         s = jnp.where((qpos >= kpos)[None, None], s, _NEG)
@@ -33,18 +42,34 @@ def _dense_attention(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _attn_fc(attrs, query, key, value):
-    if query.ndim != 4:
-        raise MXNetError("DotProductAttention expects [batch, heads, "
-                         "length, head_dim] inputs, got ndim=%d"
-                         % query.ndim)
-    causal = attrs["causal"]
-    scale = attrs["scale"]
-    if scale <= 0.0:
-        scale = 1.0 / (query.shape[-1] ** 0.5)
+def sdp_attention(query, key, value, causal=False, scale=0.0,
+                  q_offsets=None):
+    """Functional scaled-dot-product attention over [B, H, L, D] —
+    the same route decision the ``DotProductAttention`` symbol op
+    makes, callable from pure-JAX graphs (the serving decode engine).
+
+    ``q_offsets`` (a per-sequence int32 vector) selects the
+    offset-causal variant: query row r of sequence b sits at position
+    ``q_offsets[b] + r`` and attends to key positions ``<= q_offsets[b]
+    + r`` of the KV cache — eligible shapes route to
+    ``flash_attention_offset`` (forward-only), everything else (and
+    ``MXNET_PALLAS=0``) to the dense XLA twin with the same masking
+    constant."""
     b, h, lq, d = query.shape
     lk = key.shape[2]
+    if scale <= 0.0:
+        scale = 1.0 / (d ** 0.5)
     from ..pallas_ops import dispatch as _pd
+    if q_offsets is not None:
+        if _pd.use_attention("DotProductAttentionOffset", b, h, lq, lk,
+                             d, query.dtype, offset=True):
+            from ..pallas_ops.flash_attention import flash_attention_offset
+            bs = _pd.block_seq()
+            return flash_attention_offset(
+                query, key, value, q_offsets, scale=scale, block_q=bs,
+                block_k=bs, interpret=_pd.interpret_mode())
+        return _dense_attention(query, key, value, True, scale,
+                                q_offsets=q_offsets)
     if _pd.use_attention("DotProductAttention", b, h, lq, lk, d,
                          query.dtype):
         from ..pallas_ops import flash_attention
@@ -53,6 +78,15 @@ def _attn_fc(attrs, query, key, value):
                                scale=scale, block_q=bs, block_k=bs,
                                interpret=_pd.interpret_mode())
     return _dense_attention(query, key, value, causal, scale)
+
+
+def _attn_fc(attrs, query, key, value):
+    if query.ndim != 4:
+        raise MXNetError("DotProductAttention expects [batch, heads, "
+                         "length, head_dim] inputs, got ndim=%d"
+                         % query.ndim)
+    return sdp_attention(query, key, value, causal=attrs["causal"],
+                         scale=attrs["scale"])
 
 
 def _attn_infer(attrs, in_shapes):

@@ -49,7 +49,8 @@ from .flash_attention import _on_tpu
 __all__ = ["mode", "kernels_active", "interpret_mode", "block_rows",
            "block_seq", "fingerprint", "overriding", "use_rowwise",
            "use_attention", "eligible_rowwise", "eligible_attention",
-           "dispatch_stats", "reset_dispatch_stats"]
+           "eligible_attention_offset", "dispatch_stats",
+           "reset_dispatch_stats"]
 
 MODE_OFF, MODE_AUTO, MODE_FORCE = 0, 1, 2
 
@@ -189,6 +190,25 @@ def eligible_attention(b, h, lq, lk, d, dtype):
     return int(b) >= 1 and int(h) >= 1
 
 
+def eligible_attention_offset(b, h, lq, lk, d, dtype):
+    """May an offset-causal attention pattern (the decode path) run as
+    ``flash_attention_offset``?
+
+    Looser than :func:`eligible_attention`: the offset kernel degrades
+    its blocks to *divisors* of the sequence lengths
+    (``flash_attention.divisor_block``), so KV-cache bucket lengths
+    (multiples of ``MXNET_SERVE_KV_BLOCK``, not of the configured
+    sequence block) never disqualify.  Only dtype/head-dim rules remain.
+    """
+    if str(dtype) not in _FLOAT_DTYPES:
+        return False
+    if int(lq) < 1 or int(lk) < 1:
+        return False
+    if int(d) < 1 or int(d) > 512:
+        return False
+    return int(b) >= 1 and int(h) >= 1
+
+
 # ---------------------------------------------------------------------------
 # Routing decisions (+ trace-time counters, banked by the bench rows)
 # ---------------------------------------------------------------------------
@@ -220,11 +240,12 @@ def use_rowwise(kind, rows, width, dtype):
     return True
 
 
-def use_attention(kind, b, h, lq, lk, d, dtype):
+def use_attention(kind, b, h, lq, lk, d, dtype, offset=False):
     """Route decision for an attention pattern; counts a route when
-    taken."""
-    if not kernels_active() or not eligible_attention(b, h, lq, lk, d,
-                                                      dtype):
+    taken.  ``offset=True`` selects the offset-causal decode variant's
+    (looser) eligibility rules."""
+    elig = eligible_attention_offset if offset else eligible_attention
+    if not kernels_active() or not elig(b, h, lq, lk, d, dtype):
         return False
     _note(kind)
     return True

@@ -29,9 +29,25 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-__all__ = ["flash_attention", "_on_tpu", "_VMEM", "pltpu"]
+__all__ = ["flash_attention", "flash_attention_offset", "divisor_block",
+           "_on_tpu", "_VMEM", "pltpu"]
 
 _NEG = -1e30
+
+
+def divisor_block(length, bound):
+    """Largest block size <= ``bound`` that divides ``length`` exactly.
+
+    The decode-path kernels tile over KV caches whose lengths are
+    multiples of ``MXNET_SERVE_KV_BLOCK``, not of the configured
+    sequence block — degrading the block to a divisor (instead of
+    failing the divisibility assert) keeps every cache bucket eligible.
+    """
+    length, bound = int(length), max(1, int(bound))
+    b = min(length, bound)
+    while length % b:
+        b -= 1
+    return b
 
 
 def _on_tpu():
@@ -186,3 +202,134 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         interpret = not _on_tpu()
     return _flash(q, k, v, causal, float(scale), int(block_q),
                   int(block_k), bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# Causal flash attention WITH QUERY OFFSET — the decode-path kernel.
+#
+# Query row r of sequence b sits at global position offsets[b] + r and
+# attends causally to key positions 0..offsets[b]+r of a kv_len cache.
+# offsets=0 everywhere recovers plain causal attention; a decode step is
+# Lq=1 with offsets = the per-sequence cache lengths, so the freshly
+# written cache slot (position offsets[b]) is attended and every slot
+# past it — prefill pad junk, zero-initialized blocks, retired tenants'
+# leftovers — is masked with the shared -1e30 constant.  The offset is
+# data (a traced per-sequence vector), so block skipping is dynamic
+# (pl.when on a traced predicate) rather than a static grid prune.
+# Inference-only: no custom_vjp — the serving decode loop never
+# differentiates through the cache.
+# ---------------------------------------------------------------------------
+def _fa_offset_kernel(ofs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                      acc_ref, *, scale, block_q, block_k, nk):
+    """One (batch·head, q-block, k-block) grid cell, offset-causal."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    ofs = ofs_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # skip blocks entirely above the (offset) diagonal — dynamic, the
+    # offset is data; block (qi, ki) contributes iff its last query row
+    # can see its first key column
+    run = ofs + qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)        # (BQ, D)
+        kb = k_ref[0].astype(jnp.float32)       # (BK, D)
+        vb = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+        qpos = ofs + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(qpos >= kpos, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            p, vb, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        o_ref[0] = (acc_ref[:] /
+                    jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+def flash_attention_offset(q, k, v, offsets, scale=None, block_q=128,
+                           block_k=128, interpret=None):
+    """Offset-causal flash attention: [B, H, Lq, D] queries whose row r
+    of sequence b sits at position ``offsets[b] + r``, attending to a
+    [B, H, Lk, D] KV cache.  Block sizes degrade to divisors of the
+    sequence lengths (``divisor_block``) so any cache-bucket length is
+    legal.  Forward-only (serving decode never differentiates)."""
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = not _on_tpu()
+    block_q = divisor_block(Lq, block_q)
+    block_k = divisor_block(Lk, block_k)
+    nk = Lk // block_k
+    qr = q.reshape(B * H, Lq, D)
+    kr = k.reshape(B * H, Lk, D)
+    vr = v.reshape(B * H, Lk, D)
+    # one offset scalar per grid row: repeat per head
+    ofs = jnp.repeat(jnp.asarray(offsets, jnp.int32).reshape(B), H)
+
+    kernel = functools.partial(_fa_offset_kernel, scale=float(scale),
+                               block_q=block_q, block_k=block_k, nk=nk)
+
+    def _spec(shape, index_map):
+        if _VMEM is not None:
+            return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+        return pl.BlockSpec(shape, index_map)  # pragma: no cover
+
+    if pltpu is not None:
+        ofs_spec = pl.BlockSpec((1,), lambda b, i, j: (b,),
+                                memory_space=pltpu.SMEM)
+    else:  # pragma: no cover
+        ofs_spec = pl.BlockSpec((1,), lambda b, i, j: (b,))
+    in_specs = [
+        ofs_spec,                                            # offset
+        _spec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # Q tile
+        _spec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # K tile
+        _spec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # V tile
+    ]
+    out_specs = _spec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    if pltpu is not None:
+        scratch = [pltpu.VMEM((block_q, 1), jnp.float32),
+                   pltpu.VMEM((block_q, 1), jnp.float32),
+                   pltpu.VMEM((block_q, D), jnp.float32)]
+        _params_cls = getattr(pltpu, "CompilerParams", None) or \
+            pltpu.TPUCompilerParams
+        params = dict(compiler_params=_params_cls(
+            dimension_semantics=("parallel", "parallel", "arbitrary")))
+    else:  # pragma: no cover
+        scratch = [pl.MemoryRef((block_q, 1), jnp.float32),
+                   pl.MemoryRef((block_q, 1), jnp.float32),
+                   pl.MemoryRef((block_q, D), jnp.float32)]
+        params = {}
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        grid=(B * H, Lq // block_q, nk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **params)(ofs, qr, kr, vr)
+    return out.reshape(B, H, Lq, D)

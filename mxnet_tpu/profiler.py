@@ -23,7 +23,7 @@ from .base import get_env
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "Profiler", "record_phase", "mark_step", "start_step_profile",
            "stop_step_profile", "aggregate_phase_trace", "PHASES",
-           "SERVE_PHASES"]
+           "SERVE_PHASES", "GEN_SERVE_PHASES"]
 
 # The per-step wall-time attribution phases of one Module.fit batch
 # (tools/step_profile.py renders them; docs/perf.md explains the
@@ -51,6 +51,14 @@ _NON_ADDITIVE_PHASES = frozenset(["h2d_stage", "spmd_step", "data_next"])
 # batcher's duty cycle and the step collector can aggregate a serving
 # window exactly like a fit window.
 SERVE_PHASES = ("serve_wait", "serve_batch", "serve_compute")
+
+# The generation engine's decode-loop phases (serving/decode_engine.py):
+# ``serve_prefill`` (one bucketed prompt batch filling the KV cache +
+# first-token logits) and ``serve_decode`` (one continuous-batched
+# decode step over the donated cache).  Separate tuple: the forward
+# batcher emits every SERVE_PHASES entry each cycle (pinned), the
+# decode loop emits these.
+GEN_SERVE_PHASES = ("serve_prefill", "serve_decode")
 
 
 class Profiler:

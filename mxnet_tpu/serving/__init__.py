@@ -17,18 +17,36 @@ Three layers (docs/architecture/serving.md):
   served from one process, each with its own program store and optional
   serving weight dtype (bf16).
 
+The decode plane (docs/architecture/decode_engine.md) adds
+autoregressive generation on the same registry: :mod:`program_store`'s
+:class:`GenerativeProgramStore` splits a generative model into AOT
+prefill programs (per batch/prompt bucket, filling the KV cache) and
+decode-step programs (per batch/cache bucket, one token per sequence,
+cache donated), and :mod:`decode_engine`'s :class:`GenerationEngine`
+runs continuous-batched generation over them — admitting newly
+prefilled sequences into the running decode batch between steps and
+retiring finished ones.
+
 :mod:`loadgen` provides the seeded open-loop load generator (deterministic
 arrival schedule, ``faultinject``-style) driving the p50/p99 + QPS bench
-rows on CPU in CI.
+rows on CPU in CI — and, for the decode plane, the tokens/sec + TTFT +
+inter-token-latency generation protocol.
 """
-from .program_store import ProgramStore, bucket_edges, bucket_for
+from .program_store import (GenerativeProgramStore, ProgramStore,
+                            bucket_edges, bucket_for)
 from .registry import ModelRegistry
-from .scheduler import ServeClosed, ServeRequest, ServeTimeout, ServingEngine
-from .loadgen import OpenLoopSchedule, latency_protocol, run_loadgen
+from .scheduler import (FutureCompleter, ServeClosed, ServeRequest,
+                        ServeTimeout, ServingEngine)
+from .decode_engine import GenerationEngine, GenerationResult, TokenStream
+from .loadgen import (OpenLoopSchedule, generation_protocol,
+                      latency_protocol, run_gen_loadgen, run_loadgen)
 
 __all__ = [
-    "ProgramStore", "bucket_edges", "bucket_for",
+    "ProgramStore", "GenerativeProgramStore", "bucket_edges", "bucket_for",
     "ModelRegistry",
     "ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
+    "FutureCompleter",
+    "GenerationEngine", "GenerationResult", "TokenStream",
     "OpenLoopSchedule", "run_loadgen", "latency_protocol",
+    "run_gen_loadgen", "generation_protocol",
 ]

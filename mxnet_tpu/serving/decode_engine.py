@@ -1,0 +1,591 @@
+"""Autoregressive generation engine: continuous batching on the decode
+plane.
+
+The forward batcher (``scheduler.ServingEngine``) amortizes ONE program
+dispatch across requests; generation needs the same economics across
+*tokens*.  A naive deployment re-runs the full forward for every
+generated token (re-paying attention over the whole prefix — the
+``serving.decode.reprefill`` bench baseline); this engine runs the
+prompt ONCE (prefill, filling the KV cache) and then advances every
+in-flight sequence one token per compiled decode step, admitting newly
+prefilled sequences into the running batch between steps and retiring
+finished ones (EOS / ``max_tokens``) — continuous batching, the regime
+where decode throughput stops being per-request and becomes
+per-step.
+
+One engine thread owns the loop:
+
+* **pump** — drain the submit queue into per-model FIFO waiting deques
+  (blocking only when there is no admitted work at all);
+* **admit** — take waiting requests (FIFO, never overtaking — pinned by
+  the seeded-loadgen test), run one bucketed prefill batch
+  (``serve_prefill`` phase), sample each sequence's first token, and
+  copy its cache rows into free decode slots;
+* **decode** — one compiled step per model with active slots
+  (``serve_decode`` phase): the batch's next-token vector goes in, the
+  donated KV cache is updated in place, next-token logits come out;
+  sampling (greedy, or seeded temperature/top-k per request) happens
+  host-side on the tiny ``(slots, vocab)`` logit matrix;
+* **retire** — a sequence hitting its ``eos_id`` or ``max_tokens``
+  resolves its Future with a :class:`GenerationResult` (and closes its
+  :class:`TokenStream`, if streaming); its slot frees for the next
+  admission.
+
+The KV cache is registry-owned serving state: it lives beside the
+params on the model's :class:`~.program_store.GenerativeProgramStore`
+(one device-resident copy; ``stats()`` describes it) and is threaded
+through the pure decode programs cache-in/cache-out with donation, so
+the per-step write is an in-place ``dynamic_update_slice`` on the
+resident buffers (donation is skipped on the CPU backend, matching the
+training planes' donation guards).
+
+``close(drain=True)`` finishes every admitted AND queued generation
+before the thread exits; ``close(drain=False)`` fails everything fast
+with :class:`~.scheduler.ServeClosed`.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import profiler as _profiler
+from ..analysis.lockcheck import make_lock
+from ..base import MXNetError, hot_path
+from .scheduler import FutureCompleter, ServeClosed, ServeTimeout
+
+__all__ = ["GenerationEngine", "GenerationResult", "TokenStream"]
+
+_STOP = object()
+
+
+class GenerationResult:
+    """One finished generation (what the request's Future resolves to).
+
+    ``tokens`` — the generated ids (prompt excluded); ``finish_reason``
+    — ``'eos'`` or ``'length'``; ``token_times`` — host
+    ``perf_counter()`` stamps taken as each token was sampled, so
+    clients (and the loadgen) derive TTFT (``token_times[0] -
+    t_submit``) and inter-token latency without streaming machinery."""
+
+    __slots__ = ("model", "prompt_len", "tokens", "finish_reason",
+                 "t_submit", "token_times")
+
+    def __init__(self, model, prompt_len, tokens, finish_reason,
+                 t_submit, token_times):
+        self.model = model
+        self.prompt_len = prompt_len
+        self.tokens = tokens
+        self.finish_reason = finish_reason
+        self.t_submit = t_submit
+        self.token_times = token_times
+
+    @property
+    def ttft_s(self):
+        """Submit -> first generated token (seconds)."""
+        return self.token_times[0] - self.t_submit
+
+    def itl_s(self):
+        """Inter-token gaps (seconds), one per token after the first."""
+        return [b - a for a, b in zip(self.token_times,
+                                      self.token_times[1:])]
+
+    def __repr__(self):
+        return ("GenerationResult(model=%r, %d tokens, %s)"
+                % (self.model, len(self.tokens), self.finish_reason))
+
+
+class TokenStream:
+    """Blocking per-sequence token iterator.
+
+    Construct one and pass it to :meth:`GenerationEngine.submit`
+    (``stream=``): the engine pushes each sampled token id as it is
+    generated and closes the stream when the sequence retires, so
+    ``for tok in stream: ...`` sees tokens at inter-token latency
+    instead of waiting for the Future."""
+
+    _CLOSE = object()
+
+    def __init__(self):
+        self._q = queue.Queue()
+
+    def push(self, token):
+        self._q.put(int(token))
+
+    def close(self):
+        self._q.put(self._CLOSE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._CLOSE:
+            raise StopIteration
+        return item
+
+
+class _GenRequest:
+    __slots__ = ("model", "prompt", "max_tokens", "temperature", "top_k",
+                 "rng", "eos_id", "stream", "future", "deadline",
+                 "t_submit", "tokens", "token_times", "seq")
+
+    def __init__(self, model, prompt, max_tokens, temperature, top_k,
+                 seed, eos_id, stream, future, deadline, t_submit, seq):
+        self.model = model
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.rng = np.random.RandomState(seed)
+        self.eos_id = eos_id
+        self.stream = stream
+        self.future = future
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.tokens = []
+        self.token_times = []
+        self.seq = seq
+
+
+class _ModelState:
+    """Live decode batch of one model: slot table + the KV cache."""
+
+    def __init__(self, store):
+        self.store = store
+        self.slots = []                      # _GenRequest or None
+        self.lengths = np.zeros(0, np.int32)   # cache frontier per slot
+        self.next_tok = np.zeros(0, np.int32)  # next token to consume
+        self.cache_k = None
+        self.cache_v = None
+        self.C = 0                           # current cache bucket
+
+    def active(self):
+        return [i for i, r in enumerate(self.slots) if r is not None]
+
+    def free_slot(self):
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def describe(self):
+        act = self.active()
+        d = {"slots": len(self.slots), "active": len(act),
+             "cache_len": self.C}
+        if self.cache_k is not None:
+            d["cache_mb"] = round(
+                2 * self.cache_k.size * self.cache_k.dtype.itemsize
+                / 2**20, 3)
+        return d
+
+
+class GenerationEngine:
+    """Continuous-batching autoregressive generation over a
+    :class:`~.registry.ModelRegistry`'s generative models.
+
+    ``submit(model, tokens, ...)`` returns a
+    ``concurrent.futures.Future`` resolving to a
+    :class:`GenerationResult`.  One engine serves every generative
+    model in the registry; prefill batches and decode steps never mix
+    models.
+    """
+
+    def __init__(self, registry, max_active=None):
+        self._registry = registry
+        self._max_active = (int(max_active) if max_active is not None
+                            else None)
+        self._queue = queue.Queue()
+        self._waiting = {}     # model -> deque[_GenRequest]
+        self._states = {}      # model -> _ModelState
+        self._closed = False
+        self._seq = 0
+        self._submit_lock = make_lock("serving.gen_submit")
+        self._stats_lock = make_lock("serving.gen_stats")
+        self._stats = {"requests": 0, "prefills": 0, "prefill_seqs": 0,
+                       "decode_steps": 0, "generated_tokens": 0,
+                       "finished": 0, "timeouts": 0, "cancelled": 0,
+                       "errors": 0, "cache_grows": 0, "slot_grows": 0,
+                       "max_active": 0}
+        # test seam: (model, seq) admission order; bounded so a
+        # long-lived serving process never accumulates it
+        self._admit_log = collections.deque(maxlen=4096)
+        self._admit_fns = {}   # (prefill shape, cache shape) -> jitted
+        self._completer = FutureCompleter("mxt-gen-done")
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="mxt-gen", daemon=True)
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------
+    def submit(self, model, tokens, max_tokens=16, temperature=0.0,
+               top_k=0, seed=0, eos_id=None, stream=None, timeout=None):
+        """Enqueue one generation request; returns its Future.
+
+        ``tokens`` — prompt token ids (non-empty); ``max_tokens`` —
+        generation cap (>= 1; the prompt+generation total must fit
+        ``MXNET_SERVE_KV_MAX``); ``temperature <= 0`` is greedy,
+        otherwise seeded temperature sampling over the ``top_k``
+        highest logits (``top_k=0`` = full vocab); ``eos_id`` stops
+        early; ``stream`` — an optional :class:`TokenStream` receiving
+        tokens as they are sampled; ``timeout`` (seconds) bounds
+        time-to-admission."""
+        store = self._registry.gen_store(model)
+        prompt = [int(t) for t in tokens]
+        if not prompt:
+            raise MXNetError("empty prompt")
+        vocab = store.spec["vocab_size"]
+        if min(prompt) < 0 or max(prompt) >= vocab:
+            raise MXNetError("prompt token out of range [0, %d)" % vocab)
+        max_tokens = int(max_tokens)
+        if max_tokens < 1:
+            raise MXNetError("max_tokens must be >= 1")
+        store.validate_request(len(prompt), max_tokens)
+        fut = Future()
+        now = time.monotonic()
+        with self._submit_lock:
+            if self._closed:
+                raise ServeClosed("generation engine is closed")
+            req = _GenRequest(
+                model, prompt, max_tokens, float(temperature),
+                int(top_k), seed, eos_id, stream, fut,
+                now + timeout if timeout is not None else None,
+                time.perf_counter(), self._seq)
+            self._seq += 1
+            self._queue.put(req)
+        with self._stats_lock:
+            self._stats["requests"] += 1
+        return fut
+
+    def stats(self):
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["models"] = {m: st.describe()
+                         for m, st in dict(self._states).items()}
+        return out
+
+    def close(self, drain=True, timeout=120.0):
+        """Stop the engine.  ``drain=True`` (default) runs every
+        admitted AND queued generation to completion first —
+        kill-the-server-under-load keeps its promises; ``drain=False``
+        fails queued and in-flight work fast with ServeClosed.
+        Idempotent; joins the engine thread."""
+        with self._submit_lock:
+            if not self._closed:
+                self._closed = True
+                self._drain_on_stop = bool(drain)
+                self._queue.put(_STOP)
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise MXNetError("generation engine thread failed to stop "
+                             "within %.0fs" % timeout)
+        self._completer.close(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- engine thread -------------------------------------------------
+    def _serve_loop(self):
+        stopping = False
+        while True:
+            stopping = self._pump(stopping) or stopping
+            if stopping and not getattr(self, "_drain_on_stop", True):
+                self._fail_all()
+                return
+            self._admit_ready()
+            self._decode_tick()
+            if stopping and not self._has_work():
+                return
+
+    def _has_work(self):
+        if any(self._waiting.values()):
+            return True
+        return any(st.active() for st in self._states.values())
+
+    def _pump(self, stopping):
+        """Move queued requests into the per-model FIFO waiting deques.
+        Blocks only when the engine is otherwise idle (close() unblocks
+        via the _STOP sentinel).  Returns True when _STOP was seen."""
+        stop_seen = False
+        block = not stopping and not self._has_work()
+        while True:
+            try:
+                item = self._queue.get() if block \
+                    else self._queue.get_nowait()
+            except queue.Empty:
+                break
+            block = False
+            if item is _STOP:
+                stop_seen = True
+                continue
+            self._waiting.setdefault(
+                item.model, collections.deque()).append(item)
+        return stop_seen
+
+    # -- admission (prefill) -------------------------------------------
+    def _admit_ready(self):
+        for model in list(self._waiting):
+            dq = self._waiting.get(model)
+            if dq:
+                self._admit_model(model, dq)
+            if not self._waiting.get(model):
+                self._waiting.pop(model, None)
+
+    def _admit_model(self, model, dq):
+        try:
+            store = self._registry.gen_store(model)
+        except MXNetError as e:  # model removed after submit
+            while dq:
+                self._fail_request(dq.popleft(), e)
+            return
+        st = self._states.get(model)
+        cap = store.max_slots()
+        if self._max_active is not None:
+            cap = min(cap, self._max_active)
+        active = len(st.active()) if st else 0
+        free = cap - active
+        group = []
+        now = time.monotonic()
+        while dq and len(group) < free:
+            r = dq.popleft()
+            if r.deadline is not None and now > r.deadline:
+                self._fail_request(r, ServeTimeout(
+                    "generation request for %r timed out after %.1f ms "
+                    "in queue" % (model, (now - r.t_submit) * 1e3)),
+                    kind="timeouts")
+            elif r.future.set_running_or_notify_cancel():
+                group.append(r)
+            else:
+                with self._stats_lock:
+                    self._stats["cancelled"] += 1
+        if not group:
+            return
+        toks, lens = store.pad_prompts([r.prompt for r in group])
+        try:
+            first_logits, pk, pv = self._dispatch_prefill(
+                store, toks, lens)
+            logits = np.asarray(first_logits)
+        except BaseException as e:  # noqa: BLE001 — forwarded to futures
+            exc = e if isinstance(e, MXNetError) \
+                else MXNetError("prefill dispatch failed: %r" % (e,))
+            for r in group:
+                self._fail_request(r, exc, running=True)
+            return
+        with self._stats_lock:
+            self._stats["prefills"] += 1
+            self._stats["prefill_seqs"] += len(group)
+        survivors = []
+        for i, r in enumerate(group):
+            self._admit_log.append((model, r.seq))
+            tok = self._sample(logits[i], r)
+            self._push_token(r, tok)
+            if self._finished_reason(r, tok):
+                self._finish(r, self._finished_reason(r, tok))
+            else:
+                survivors.append((i, r))
+        if not survivors:
+            return
+        if st is None:
+            st = self._states[model] = _ModelState(store)
+            store.cache_state = st
+        need = len(st.active()) + len(survivors)
+        if need > len(st.slots):
+            self._grow_slots(st, store, store.batch_bucket(need))
+        Cp = int(pk.shape[3])
+        if st.cache_k is None:
+            st.cache_k, st.cache_v = store.new_cache(len(st.slots), Cp)
+            st.C = Cp
+        elif Cp > st.C:
+            self._grow_cache(st, store.kv_bucket(Cp))
+        for i, r in survivors:
+            slot = st.free_slot()
+            self._admit_row(st, pk, pv, i, slot)
+            st.slots[slot] = r
+            st.lengths[slot] = len(r.prompt)
+            st.next_tok[slot] = r.tokens[-1]
+        with self._stats_lock:
+            if len(st.active()) > self._stats["max_active"]:
+                self._stats["max_active"] = len(st.active())
+
+    def _admit_row(self, st, pk, pv, row, slot):
+        """Copy one prefilled sequence's cache rows into a decode slot
+        (device-side; the batch cache is consumed and rebound)."""
+        key = (tuple(pk.shape), tuple(st.cache_k.shape))
+        fn = self._admit_fns.get(key)
+        if fn is None:
+            Cp, C = int(pk.shape[3]), int(st.cache_k.shape[3])
+
+            def f(ck, cv, pk_, pv_, slot_, row_):
+                rk = jax.lax.dynamic_slice_in_dim(pk_, row_, 1, 1)
+                rv = jax.lax.dynamic_slice_in_dim(pv_, row_, 1, 1)
+                pad = ((0, 0), (0, 0), (0, 0), (0, C - Cp), (0, 0))
+                rk = jnp.pad(rk, pad)
+                rv = jnp.pad(rv, pad)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, rk, (0, slot_, 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, rv, (0, slot_, 0, 0, 0))
+                return ck, cv
+
+            from .program_store import cache_donate_argnums
+            fn = jax.jit(f, donate_argnums=cache_donate_argnums((0, 1)))
+            self._admit_fns[key] = fn
+        st.cache_k, st.cache_v = fn(st.cache_k, st.cache_v, pk, pv,
+                                    np.int32(slot), np.int32(row))
+
+    def _grow_slots(self, st, store, new_bb):
+        grow = new_bb - len(st.slots)
+        st.slots.extend([None] * grow)
+        st.lengths = np.concatenate(
+            [st.lengths, np.zeros(grow, np.int32)])
+        st.next_tok = np.concatenate(
+            [st.next_tok, np.zeros(grow, np.int32)])
+        if st.cache_k is not None:
+            pad = ((0, 0), (0, grow), (0, 0), (0, 0), (0, 0))
+            st.cache_k = jnp.pad(st.cache_k, pad)
+            st.cache_v = jnp.pad(st.cache_v, pad)
+        with self._stats_lock:
+            self._stats["slot_grows"] += 1
+
+    def _grow_cache(self, st, new_c):
+        pad = ((0, 0), (0, 0), (0, 0), (0, new_c - st.C), (0, 0))
+        st.cache_k = jnp.pad(st.cache_k, pad)
+        st.cache_v = jnp.pad(st.cache_v, pad)
+        st.C = new_c
+        with self._stats_lock:
+            self._stats["cache_grows"] += 1
+
+    # -- decode --------------------------------------------------------
+    def _decode_tick(self):
+        for model, st in list(self._states.items()):
+            act = st.active()
+            if not act:
+                # batch drained: drop the cache (and its memory) until
+                # the next admission starts fresh
+                self._states.pop(model)
+                st.store.cache_state = None
+                continue
+            needed = int(st.lengths[act].max()) + 1
+            if needed > st.C:
+                self._grow_cache(st, st.store.kv_bucket(needed))
+            toks = np.ascontiguousarray(st.next_tok)
+            lens = np.ascontiguousarray(st.lengths)
+            try:
+                logits = np.asarray(
+                    self._dispatch_decode(st, toks, lens))
+            except BaseException as e:  # noqa: BLE001 — to the futures
+                exc = e if isinstance(e, MXNetError) \
+                    else MXNetError("decode dispatch failed: %r" % (e,))
+                for i in act:
+                    r = st.slots[i]
+                    st.slots[i] = None
+                    self._fail_request(r, exc, running=True)
+                continue
+            for i in act:
+                r = st.slots[i]
+                st.lengths[i] += 1
+                tok = self._sample(logits[i], r)
+                self._push_token(r, tok)
+                st.next_tok[i] = tok
+                reason = self._finished_reason(r, tok)
+                if reason:
+                    st.slots[i] = None
+                    st.lengths[i] = 0
+                    st.next_tok[i] = 0
+                    self._finish(r, reason)
+            with self._stats_lock:
+                self._stats["decode_steps"] += 1
+                self._stats["generated_tokens"] += len(act)
+
+    @hot_path
+    def _dispatch_prefill(self, store, tokens, lengths):
+        """Enqueue-only prompt-batch dispatch (serve_prefill phase);
+        the logits fetch happens on the caller side."""
+        t0 = time.perf_counter_ns()
+        out = store.run_prefill(tokens, lengths)
+        _profiler.record_phase("serve_prefill", t0)
+        return out
+
+    @hot_path
+    def _dispatch_decode(self, st, tokens, lengths):
+        """Enqueue-only decode-step dispatch (serve_decode phase).  The
+        donated caches are rebound to the program's outputs before
+        anything can read the consumed buffers."""
+        t0 = time.perf_counter_ns()
+        logits, st.cache_k, st.cache_v = st.store.run_decode(
+            st.cache_k, st.cache_v, tokens, lengths)
+        _profiler.record_phase("serve_decode", t0)
+        return logits
+
+    # -- sampling / retirement -----------------------------------------
+    @staticmethod
+    def _sample(row, req):
+        if req.temperature <= 0.0:
+            return int(np.argmax(row))
+        z = row.astype(np.float64) / req.temperature
+        if req.top_k and req.top_k < z.size:
+            kth = np.partition(z, -req.top_k)[-req.top_k]
+            z = np.where(z >= kth, z, -np.inf)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(req.rng.choice(z.size, p=p))
+
+    @staticmethod
+    def _finished_reason(req, tok):
+        if req.eos_id is not None and tok == req.eos_id:
+            return "eos"
+        if len(req.tokens) >= req.max_tokens:
+            return "length"
+        return None
+
+    def _push_token(self, req, tok):
+        req.tokens.append(tok)
+        req.token_times.append(time.perf_counter())
+        if req.stream is not None:
+            req.stream.push(tok)
+
+    def _finish(self, req, reason):
+        if req.stream is not None:
+            req.stream.close()
+        res = GenerationResult(req.model, len(req.prompt),
+                               list(req.tokens), reason, req.t_submit,
+                               list(req.token_times))
+        self._completer.resolve(req.future, res)
+        with self._stats_lock:
+            self._stats["finished"] += 1
+
+    def _fail_request(self, req, exc, kind="errors", running=False):
+        if not running and not req.future.set_running_or_notify_cancel():
+            with self._stats_lock:
+                self._stats["cancelled"] += 1
+            return
+        if req.stream is not None:
+            req.stream.close()
+        self._completer.resolve(req.future, exc=exc)
+        with self._stats_lock:
+            self._stats[kind] += 1
+
+    def _fail_all(self):
+        """close(drain=False): everything waiting or in flight fails
+        fast."""
+        exc = ServeClosed("generation engine closed before completion")
+        for dq in self._waiting.values():
+            while dq:
+                self._fail_request(dq.popleft(), exc)
+        self._waiting.clear()
+        for model, st in list(self._states.items()):
+            for i in st.active():
+                r = st.slots[i]
+                st.slots[i] = None
+                self._fail_request(r, exc, running=True)
+            st.store.cache_state = None
+        self._states.clear()

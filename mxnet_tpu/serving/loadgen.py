@@ -34,7 +34,8 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["OpenLoopSchedule", "run_loadgen", "latency_protocol"]
+__all__ = ["OpenLoopSchedule", "run_loadgen", "latency_protocol",
+           "run_gen_loadgen", "generation_protocol"]
 
 
 class OpenLoopSchedule:
@@ -42,11 +43,15 @@ class OpenLoopSchedule:
 
     ``arrivals[i]`` — seconds after t0 request ``i`` is offered (cumsum
     of exponential gaps at ``qps``); ``sizes[i]`` — its row count, drawn
-    from ``sizes``/``size_weights``.  Same seed => identical schedule.
+    from ``sizes``/``size_weights``.  For generation workloads,
+    ``gen_tokens`` draws a per-request ``max_tokens[i]`` the same way
+    (None for non-generative schedules).  Same seed => identical
+    schedule.
     """
 
     def __init__(self, seed=0, n_requests=100, qps=100.0, sizes=(1,),
-                 size_weights=None):
+                 size_weights=None, gen_tokens=None,
+                 gen_token_weights=None):
         if qps <= 0 or n_requests < 1:
             raise MXNetError("schedule needs qps > 0 and n_requests >= 1")
         rs = np.random.RandomState(int(seed))
@@ -58,26 +63,33 @@ class OpenLoopSchedule:
             p = p / p.sum()
         self.sizes = rs.choice(np.asarray(sizes, np.int64),
                                int(n_requests), p=p)
+        self.max_tokens = None
+        if gen_tokens is not None:
+            pg = None
+            if gen_token_weights is not None:
+                pg = np.asarray(gen_token_weights, np.float64)
+                pg = pg / pg.sum()
+            self.max_tokens = rs.choice(
+                np.asarray(gen_tokens, np.int64), int(n_requests), p=pg)
         self.seed = int(seed)
         self.qps = float(qps)
         self.n = int(n_requests)
 
 
-def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
-    """Drive ``submit(i, n_rows) -> Future`` on an open-loop schedule.
+def _drive_schedule(submit, schedule, on_success, settle_s, thread_name):
+    """Shared open-loop driver behind :func:`run_loadgen` and
+    :func:`run_gen_loadgen`.
 
-    Returns a summary dict: latency percentiles over successful
-    requests (submit -> result fetched to host), achieved vs offered
-    QPS, and failure counters.  Submission stays open-loop: a request
-    is offered at its scheduled time even when earlier ones are still
-    in flight; ``max_submit_slip_ms`` reports how far the submitting
-    thread itself fell behind the schedule (pacing credibility).
-    """
-    from ..test_utils import fetch_sync
-
+    Offers ``submit(i)`` at the schedule's arrival times (open-loop: a
+    request is offered on time even when earlier ones are still in
+    flight), classifies completions on a waiter thread —
+    ``on_success(result, t_submit)`` turns a successful Future into the
+    per-record payload (and does any completion-clock host fetch) —
+    and returns ``(records, counts, span_s, slip_s)`` where
+    ``records[i] = (status, payload_or_None, t_submit)``."""
     n = schedule.n
     done_q = queue.Queue()
-    records = [None] * n   # (status, latency_s) — waiter thread writes
+    records = [None] * n
     t_last_done = [0.0]
 
     def waiter():
@@ -85,10 +97,8 @@ def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
         while got < n:
             i, t_sub, fut = done_q.get()
             try:
-                res = fut.result()
-                if fetch and res:
-                    fetch_sync(res[0])
-                records[i] = ("ok", time.perf_counter() - t_sub)
+                records[i] = ("ok", on_success(fut.result(), t_sub),
+                              t_sub)
             except Exception as e:  # noqa: BLE001 — tallied by class
                 from .scheduler import ServeTimeout
                 if fut.cancelled():
@@ -97,12 +107,11 @@ def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
                     status = "timeout"
                 else:
                     status = "error"
-                records[i] = (status, time.perf_counter() - t_sub)
+                records[i] = (status, None, t_sub)
             t_last_done[0] = time.perf_counter()
             got += 1
 
-    w = threading.Thread(target=waiter, name="mxt-loadgen-wait",
-                         daemon=True)
+    w = threading.Thread(target=waiter, name=thread_name, daemon=True)
     w.start()
     slip = 0.0
     t0 = time.perf_counter()
@@ -115,26 +124,46 @@ def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
             slip = max(slip, now - due)
         t_sub = time.perf_counter()
         try:
-            fut = submit(i, int(schedule.sizes[i]))
+            fut = submit(i)
         except Exception:  # noqa: BLE001 — submission refusals count too
-            records[i] = ("error", 0.0)
-            done_q.put((i, t_sub, _failed_future()))
-            continue
+            fut = _failed_future()
         fut.add_done_callback(
             lambda f, i=i, t=t_sub: done_q.put((i, t, f)))
     w.join(settle_s)
     if w.is_alive():
         raise MXNetError("loadgen waiter did not drain within %.0fs "
                          "(requests lost?)" % settle_s)
-    lats = np.asarray([r[1] for r in records if r and r[0] == "ok"])
     counts = {}
     for r in records:
         counts[r[0] if r else "lost"] = counts.get(
             r[0] if r else "lost", 0) + 1
-    ok = counts.get("ok", 0)
     span = max(t_last_done[0] - t0, 1e-9)
+    return records, counts, span, slip
+
+
+def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
+    """Drive ``submit(i, n_rows) -> Future`` on an open-loop schedule.
+
+    Returns a summary dict: latency percentiles over successful
+    requests (submit -> result fetched to host), achieved vs offered
+    QPS, and failure counters.  ``max_submit_slip_ms`` reports how far
+    the submitting thread itself fell behind the schedule (pacing
+    credibility).
+    """
+    from ..test_utils import fetch_sync
+
+    def on_success(res, t_sub):
+        if fetch and res:
+            fetch_sync(res[0])
+        return time.perf_counter() - t_sub
+
+    records, counts, span, slip = _drive_schedule(
+        lambda i: submit(i, int(schedule.sizes[i])), schedule,
+        on_success, settle_s, "mxt-loadgen-wait")
+    lats = np.asarray([r[1] for r in records if r and r[0] == "ok"])
+    ok = counts.get("ok", 0)
     return {
-        "n": n,
+        "n": schedule.n,
         "ok": ok,
         "timeouts": counts.get("timeout", 0),
         "cancelled": counts.get("cancelled", 0),
@@ -334,4 +363,223 @@ def latency_protocol(mode="fp32", smoke=False, seed=11, offered_mult=6.0,
         "p99_vs_per_request": (
             round(batch["p99_ms"] / serial_open["p99_ms"], 4)
             if batch["p99_ms"] and serial_open["p99_ms"] else None),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Generation loadgen: the decode-plane protocol.
+# ---------------------------------------------------------------------------
+def run_gen_loadgen(submit, schedule, settle_s=180.0):
+    """Drive ``submit(i, max_tokens) -> Future[GenerationResult]`` on an
+    open-loop schedule (which must carry ``gen_tokens``).
+
+    Latency clocks come from the result's host-side ``token_times``
+    (stamped by the serving engine as each token is sampled), so the
+    summary reports the three generation service metrics without
+    streaming machinery: **TTFT** (submit -> first token), **ITL**
+    (mean/percentile inter-token gap) and **tokens/sec** (total
+    generated tokens over the span)."""
+    if schedule.max_tokens is None:
+        raise MXNetError("run_gen_loadgen needs a schedule built with "
+                         "gen_tokens=...")
+    records, counts, span, slip = _drive_schedule(
+        lambda i: submit(i, int(schedule.max_tokens[i])), schedule,
+        lambda res, t_sub: res, settle_s, "mxt-genload-wait")
+    ok_recs = [(res, t_sub) for (s, res, t_sub) in
+               (r for r in records if r) if s == "ok" and res is not None]
+    ok = len(ok_recs)
+    n = schedule.n
+    ttfts = np.asarray([res.token_times[0] - t_sub
+                        for res, t_sub in ok_recs])
+    itls = np.asarray([g for res, _ in ok_recs for g in res.itl_s()])
+    total_tokens = int(sum(len(res.tokens) for res, _ in ok_recs))
+    e2e = np.asarray([res.token_times[-1] - t_sub
+                      for res, t_sub in ok_recs])
+
+    def _pct(arr, q):
+        return round(float(np.percentile(arr, q)) * 1e3, 3) \
+            if arr.size else None
+
+    return {
+        "n": n,
+        "ok": ok,
+        "timeouts": counts.get("timeout", 0),
+        "cancelled": counts.get("cancelled", 0),
+        "errors": counts.get("error", 0) + counts.get("lost", 0),
+        "tokens": total_tokens,
+        "tokens_per_sec": round(total_tokens / span, 2),
+        "ttft_p50_ms": _pct(ttfts, 50),
+        "ttft_p99_ms": _pct(ttfts, 99),
+        "itl_mean_ms": round(float(itls.mean()) * 1e3, 3)
+        if itls.size else None,
+        "itl_p99_ms": _pct(itls, 99),
+        "e2e_p50_ms": _pct(e2e, 50),
+        "e2e_p99_ms": _pct(e2e, 99),
+        "qps_offered": round(schedule.qps, 2),
+        "qps_achieved": round(ok / span, 2),
+        "duration_s": round(span, 3),
+        "max_submit_slip_ms": round(slip * 1e3, 3),
+        "seed": schedule.seed,
+    }
+
+
+class _ReprefillServer:
+    """The naive generation baseline: one worker thread services a FIFO
+    queue, generating each request to completion by RE-RUNNING the full
+    prefill program over the growing sequence for every token — every
+    token re-pays attention over the whole prefix, and no two requests
+    ever share a dispatch.  Greedy sampling, same prefill programs and
+    weights as the engine, same Future/GenerationResult interface so
+    :func:`run_gen_loadgen` drives both."""
+
+    def __init__(self, store, model="m"):
+        self._store = store
+        self._model = model
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._work,
+                                        name="mxt-reprefill-serve",
+                                        daemon=True)
+        self._thread.start()
+
+    def submit(self, prompt, max_tokens):
+        from concurrent.futures import Future
+        fut = Future()
+        self._q.put((list(prompt), int(max_tokens), time.perf_counter(),
+                     fut))
+        return fut
+
+    def _generate(self, prompt, max_tokens, t_submit):
+        from .decode_engine import GenerationResult
+        seq = list(prompt)
+        times = []
+        for _ in range(max_tokens):
+            toks, lens = self._store.pad_prompts([seq])
+            first, _, _ = self._store.run_prefill(toks, lens)
+            tok = int(np.argmax(np.asarray(first)[0]))
+            seq.append(tok)
+            times.append(time.perf_counter())
+        return GenerationResult(self._model, len(prompt),
+                                seq[len(prompt):], "length", t_submit,
+                                times)
+
+    def _work(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            prompt, max_tokens, t_submit, fut = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(self._generate(prompt, max_tokens,
+                                              t_submit))
+            except BaseException as e:  # noqa: BLE001 — to the future
+                fut.set_exception(e)
+
+    def close(self):
+        self._q.put(None)
+        self._thread.join(60)
+
+
+def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
+                        max_tokens_choices=(8, 16)):
+    """The decode-plane bench protocol (CPU-deterministic).
+
+    1. **Re-prefill baseline, closed loop**: generate one request at a
+       time, re-running the full forward per token — per-request
+       generation capacity ``C`` (requests/sec) of the naive
+       deployment.
+    2. **Re-prefill baseline, open loop**: the same loop behind a FIFO
+       worker, driven by a seeded schedule at ``offered_mult x C`` —
+       TTFT explodes as the queue builds.
+    3. **Continuous batching**: :class:`~.decode_engine
+       .GenerationEngine` (same weights, same prefill programs, greedy
+       sampling both sides) under the SAME schedule — one decode step
+       advances every in-flight sequence, so tokens/sec scales with the
+       batch instead of saturating at ``C``.
+
+    Returns a dict with both loadgen summaries and
+    ``tokens_per_sec_vs_reprefill`` (the >= 2x acceptance figure) and
+    ``ttft_p99_vs_reprefill``."""
+    from ..models.transformer_lm import lm_spec, random_params
+    from .decode_engine import GenerationEngine
+    from .registry import ModelRegistry
+
+    # tiny-but-real LM: decode economics on CPU are dispatch-dominated,
+    # which is exactly the regime continuous batching amortizes.  ONE
+    # batch bucket (prefills and decode steps always run bucket-shaped)
+    # and kv_depth warmup keep the whole run inside the AOT-warmed
+    # program set — no mid-run compile ever lands in a served request.
+    spec = lm_spec(num_layers=2, num_hidden=64, num_heads=4,
+                   vocab_size=128)
+    params = random_params(spec, seed=seed)
+    batch_buckets = (8,)
+    prompt_buckets = (8, 16, 32)   # the re-prefill baseline's growing
+    kv_block, kv_max = 16, 48      # sequences climb the prompt buckets
+    n_closed = 4 if smoke else 8
+    n_load = 24 if smoke else 64
+    rs = np.random.RandomState(seed + 1)
+    prompts = [list(rs.randint(0, 128, rs.randint(4, 9)))
+               for _ in range(max(n_load, n_closed))]
+
+    registry = ModelRegistry()
+    store = registry.add_generative_model(
+        "m", params, spec, batch_buckets=batch_buckets,
+        prompt_buckets=prompt_buckets, kv_block=kv_block, kv_max=kv_max,
+        warmup_kv_depth=kv_max)
+
+    # 1. closed-loop baseline capacity (warm: programs are pre-warmed,
+    # but the first dispatch still initializes runtime state)
+    baseline = _ReprefillServer(store)
+    try:
+        baseline.submit(prompts[0], 4).result(120)
+        mt = int(np.mean(max_tokens_choices))
+        tic = time.perf_counter()
+        for i in range(n_closed):
+            baseline.submit(prompts[i % len(prompts)], mt).result(120)
+        closed_rps = n_closed / (time.perf_counter() - tic)
+
+        # 2. open-loop baseline on the seeded schedule
+        offered = closed_rps * float(offered_mult)
+        schedule = OpenLoopSchedule(seed, n_load, offered,
+                                    gen_tokens=max_tokens_choices)
+        serial_open = run_gen_loadgen(
+            lambda i, mt_: baseline.submit(prompts[i % len(prompts)],
+                                           mt_),
+            schedule)
+    finally:
+        baseline.close()
+
+    # 3. continuous batching on the SAME schedule
+    engine = GenerationEngine(registry)
+    try:
+        for f in [engine.submit("m", prompts[i % len(prompts)],
+                                max_tokens=4)
+                  for i in range(batch_buckets[-1])]:
+            f.result(120)  # warm the batched decode path
+        batch = run_gen_loadgen(
+            lambda i, mt_: engine.submit(
+                "m", prompts[i % len(prompts)], max_tokens=mt_),
+            schedule)
+        batch["engine"] = engine.stats()
+    finally:
+        engine.close()
+    ratio = (batch["tokens_per_sec"] / serial_open["tokens_per_sec"]
+             if serial_open["tokens_per_sec"] else None)
+    return {
+        "seed": seed,
+        "spec": spec,
+        "kv_block": kv_block,
+        "kv_max": kv_max,
+        "batch_buckets": list(batch_buckets),
+        "prompt_buckets": list(prompt_buckets),
+        "closed_rps": round(closed_rps, 3),
+        "offered_mult": float(offered_mult),
+        "reprefill_open": serial_open,
+        "batch": batch,
+        "tokens_per_sec_vs_reprefill": round(ratio, 3) if ratio else None,
+        "ttft_p99_vs_reprefill": (
+            round(batch["ttft_p99_ms"] / serial_open["ttft_p99_ms"], 4)
+            if batch["ttft_p99_ms"] and serial_open["ttft_p99_ms"]
+            else None),
     }

@@ -42,17 +42,19 @@ from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, get_env, hot_path
 from ..pallas_ops import dispatch as _pallas_dispatch
 
-__all__ = ["ProgramStore", "bucket_edges", "bucket_for"]
+__all__ = ["ProgramStore", "GenerativeProgramStore", "bucket_edges",
+           "bucket_for"]
 
 log = logging.getLogger(__name__)
 
 
-def bucket_edges(edges=None):
-    """Resolve bucket edges: an explicit iterable, or the
-    ``MXNET_SERVE_BUCKETS`` comma list; returned sorted, deduplicated,
+def bucket_edges(edges=None, env_var="MXNET_SERVE_BUCKETS"):
+    """Resolve bucket edges: an explicit iterable, or the ``env_var``
+    comma list (batch buckets by default; the prefill programs pass
+    ``MXNET_SERVE_PROMPT_BUCKETS``); returned sorted, deduplicated,
     all positive."""
     if edges is None:
-        raw = get_env("MXNET_SERVE_BUCKETS")
+        raw = get_env(env_var)
         edges = [int(tok) for tok in str(raw).split(",") if tok.strip()]
     out = sorted({int(e) for e in edges})
     if not out or out[0] < 1:
@@ -463,6 +465,377 @@ class ProgramStore:
                 p.bucket for p in self._programs.values())
         out["edges"] = list(self._edges)
         out["compute_dtype"] = str(self._cdt) if self._cdt else None
+        return out
+
+    def reset_stats(self):
+        with self._lock:
+            for k in ("hits", "compiles", "evictions"):
+                self._stats[k] = 0
+            self._stats["compile_ms_total"] = 0.0
+
+
+def cache_donate_argnums(nums):
+    """Donate the KV-cache arguments off-CPU only — PJRT:CPU has no
+    donation (the same never-on-CPU guard as the training planes'
+    donation seams; donating there only warns, once per compiled
+    bucket).  Callers rebind their cache references to the program
+    outputs either way, so behavior is identical."""
+    return () if jax.default_backend() == "cpu" else tuple(nums)
+
+
+# ---------------------------------------------------------------------------
+# Generative (autoregressive) program store: the prefill/decode split.
+#
+# A generation workload is two programs, not one.  PREFILL runs a
+# padded prompt batch once, fills the KV cache and emits the logits the
+# first generated token samples from; DECODE consumes ONE token per
+# sequence against the cache.  Both are AOT-compiled and warmed exactly
+# like the forward store's bucket programs, with the program key space
+#
+#   prefill: (batch-bucket, prompt-bucket)   -> cache sized for the bucket
+#   decode:  (batch-bucket, cache-bucket)    -> cache bucket = a multiple
+#                                               of MXNET_SERVE_KV_BLOCK
+#
+# so arbitrary request shapes and growing sequences hit a small fixed
+# set of executables.  The KV cache itself is SERVING STATE living
+# beside the params (one device-resident copy, owned by whoever drives
+# the programs — the GenerationEngine attaches its live state here for
+# introspection); the programs stay pure — cache in, updated cache out —
+# with both cache arguments DONATED, so the per-step update lowers to an
+# in-place dynamic_update_slice on the resident buffers.
+# ---------------------------------------------------------------------------
+class GenerativeProgramStore:
+    """AOT prefill/decode programs for one autoregressive LM.
+
+    Parameters
+    ----------
+    params : dict
+        name -> array, the ``transformer_lm`` symbol graph's trained
+        arguments (``embed_weight``, ``blk*_*``, ``final_ln_*``,
+        ``pred_*``).
+    spec : dict
+        ``transformer_lm.lm_spec(...)`` architecture spec.
+    batch_buckets / prompt_buckets : iterable of int, optional
+        Bucket edges; default ``MXNET_SERVE_BUCKETS`` /
+        ``MXNET_SERVE_PROMPT_BUCKETS``.
+    kv_block / kv_max : int, optional
+        Cache-length quantum and cap; default ``MXNET_SERVE_KV_BLOCK``
+        / ``MXNET_SERVE_KV_MAX``.
+    max_programs : int, optional
+        LRU bound; default is sized to hold every warmable program
+        (never smaller than ``MXNET_SERVE_PROGRAM_CACHE``).
+    device : jax.Device, optional
+        Pin params (and hence programs + cache) to this device.
+    """
+
+    def __init__(self, params, spec, name="lm", batch_buckets=None,
+                 prompt_buckets=None, kv_block=None, kv_max=None,
+                 max_programs=None, device=None):
+        from ..models.transformer_lm import lm_spec
+        self._spec = lm_spec(**dict(spec))  # validates + canonicalizes
+        self.name = name
+        self._device = device
+        self._batch_edges = bucket_edges(batch_buckets)
+        self._prompt_edges = bucket_edges(
+            prompt_buckets, env_var="MXNET_SERVE_PROMPT_BUCKETS")
+        self.kv_block = int(kv_block if kv_block is not None
+                            else get_env("MXNET_SERVE_KV_BLOCK"))
+        self.kv_max = int(kv_max if kv_max is not None
+                          else get_env("MXNET_SERVE_KV_MAX"))
+        if self.kv_block < 1 or self.kv_max < self.kv_block:
+            raise MXNetError("need 1 <= kv_block <= kv_max, got %d/%d"
+                             % (self.kv_block, self.kv_max))
+        if self._prompt_edges[-1] > self.kv_max:
+            raise MXNetError(
+                "largest prompt bucket (%d) exceeds MXNET_SERVE_KV_MAX "
+                "(%d)" % (self._prompt_edges[-1], self.kv_max))
+
+        def load(v):
+            a = _as_device_array(v)
+            if device is not None:
+                a = jax.device_put(a, device)
+            return a
+
+        missing = [k for k in self._required_params() if k not in params]
+        if missing:
+            raise MXNetError("generative model %r is missing params %s"
+                             % (name, missing))
+        self._params = {k: load(v) for k, v in params.items()}
+
+        # one warm sweep must fit the LRU or AOT is a lie (the forward
+        # store logs the same hazard; here we just size for it)
+        n_warm = (len(self._batch_edges) * len(self._prompt_edges) +
+                  len(self._batch_edges) *
+                  len({self.kv_bucket(p) for p in self._prompt_edges}))
+        if max_programs is None:
+            max_programs = max(int(get_env("MXNET_SERVE_PROGRAM_CACHE")),
+                               2 * n_warm)
+        self.max_programs = max(1, int(max_programs))
+        if self.max_programs < n_warm:
+            log.warning(
+                "generative model %r: program cache (%d) is smaller "
+                "than the warm set (%d); warmed programs will be "
+                "evicted and recompile inside served requests",
+                name, self.max_programs, n_warm)
+        self._programs = OrderedDict()
+        self._lock = make_lock("serving.gen_program_store")
+        self._stats = {"hits": 0, "compiles": 0, "evictions": 0,
+                       "compile_ms_total": 0.0}
+        # live decode state (attached by the GenerationEngine): the
+        # cache lives here, beside the params — registry-owned serving
+        # state, introspectable via stats()
+        self.cache_state = None
+
+    def _required_params(self):
+        names = ["embed_weight", "final_ln_gamma", "final_ln_beta",
+                 "pred_weight", "pred_bias"]
+        for i in range(self._spec["num_layers"]):
+            names += ["blk%d_%s" % (i, k) for k in
+                      ("ln1_gamma", "q_weight", "k_weight", "v_weight",
+                       "proj_weight", "ln2_gamma", "ffn1_weight",
+                       "ffn1_bias", "ffn2_weight", "ffn2_bias")]
+        return names
+
+    # -- geometry ------------------------------------------------------
+    @property
+    def spec(self):
+        return dict(self._spec)
+
+    @property
+    def batch_edges(self):
+        return self._batch_edges
+
+    @property
+    def prompt_edges(self):
+        return self._prompt_edges
+
+    def max_slots(self):
+        return self._batch_edges[-1]
+
+    def batch_bucket(self, n):
+        b = bucket_for(n, self._batch_edges)
+        if b is None:
+            raise MXNetError("batch of %d sequences exceeds the largest "
+                             "serving bucket (%d)"
+                             % (n, self._batch_edges[-1]))
+        return b
+
+    def prompt_bucket(self, p):
+        b = bucket_for(p, self._prompt_edges)
+        if b is None:
+            raise MXNetError(
+                "prompt of %d tokens exceeds the largest prompt bucket "
+                "(%d); raise MXNET_SERVE_PROMPT_BUCKETS or truncate"
+                % (p, self._prompt_edges[-1]))
+        return b
+
+    def kv_bucket(self, length):
+        """Cache length quantized UP to the kv-block quantum."""
+        length = max(1, int(length))
+        c = -(-length // self.kv_block) * self.kv_block
+        if c > self.kv_max:
+            raise MXNetError(
+                "sequence needs a %d-token cache, past MXNET_SERVE_KV_"
+                "MAX (%d)" % (c, self.kv_max))
+        return c
+
+    def validate_request(self, prompt_len, max_tokens):
+        """Reject at submit anything whose cache could outgrow kv_max
+        mid-flight (prompt itself must also fit a prompt bucket)."""
+        self.prompt_bucket(int(prompt_len))
+        need = int(prompt_len) + max(1, int(max_tokens))
+        if need > self.kv_max:
+            raise MXNetError(
+                "prompt_len %d + max_tokens %d exceeds MXNET_SERVE_KV_"
+                "MAX (%d)" % (prompt_len, max_tokens, self.kv_max))
+
+    def new_cache(self, batch, cache_len):
+        from ..models.transformer_lm import init_cache
+        k, v = init_cache(self._spec, batch, cache_len)
+        if self._device is not None:
+            k = jax.device_put(k, self._device)
+            v = jax.device_put(v, self._device)
+        return k, v
+
+    # -- compilation ---------------------------------------------------
+    def _sds(self, shape, dtype):
+        sh = (jax.sharding.SingleDeviceSharding(self._device)
+              if self._device is not None else None)
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+
+    def _param_spec(self):
+        return {k: self._sds(a.shape, a.dtype)
+                for k, a in self._params.items()}
+
+    def _cache_spec(self, batch, cache_len):
+        s = self._spec
+        dh = s["num_hidden"] // s["num_heads"]
+        shape = (s["num_layers"], batch, s["num_heads"],
+                 int(cache_len), dh)
+        return self._sds(shape, jnp.float32)
+
+    def _key(self, kind, bb, lb):
+        # (kind, batch bucket, length bucket) + the dispatch fingerprint
+        # (prefill/decode trace through sdp_attention and the rowwise
+        # norm kernels — an MXNET_PALLAS flip must recompile, not serve
+        # the stale lowering)
+        return ("gen", self.name, kind, int(bb), int(lb),
+                _pallas_dispatch.fingerprint())
+
+    def _compile(self, kind, bb, lb):
+        from ..models.transformer_lm import decode_apply, prefill_apply
+        tic = time.perf_counter()
+        spec = self._spec
+        if kind == "prefill":
+            cache_len = self.kv_bucket(lb)
+
+            def fn(params, tokens, lengths):
+                logits, ck, cv = prefill_apply(params, tokens, lengths,
+                                               cache_len, spec)
+                first = logits[jnp.arange(bb), (lengths - 1)
+                               .astype(jnp.int32)]
+                return first, ck, cv
+
+            args = (self._param_spec(),
+                    self._sds((bb, lb), jnp.int32),
+                    self._sds((bb,), jnp.int32))
+            compiled = jax.jit(fn).lower(*args).compile()
+        else:  # decode
+
+            def fn(params, cache_k, cache_v, tokens, lengths):
+                return decode_apply(params, cache_k, cache_v, tokens,
+                                    lengths, spec)
+
+            args = (self._param_spec(),
+                    self._cache_spec(bb, lb), self._cache_spec(bb, lb),
+                    self._sds((bb,), jnp.int32),
+                    self._sds((bb,), jnp.int32))
+            # the caches are DONATED (off-CPU): the per-step K/V write
+            # is an in-place dynamic_update_slice on the one resident
+            # copy — callers MUST rebind their cache references to the
+            # outputs
+            compiled = jax.jit(
+                fn, donate_argnums=cache_donate_argnums((1, 2))) \
+                .lower(*args).compile()
+        ms = (time.perf_counter() - tic) * 1e3
+        return _Program(compiled, (bb, lb), (), ms)
+
+    def _acquire(self, kind, bb, lb):
+        key = self._key(kind, bb, lb)
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._programs.move_to_end(key)
+                self._stats["hits"] += 1
+                return prog
+        prog = self._compile(kind, bb, lb)
+        with self._lock:
+            raced = self._programs.get(key)
+            if raced is not None:
+                self._stats["hits"] += 1
+                return raced
+            self._stats["compiles"] += 1
+            self._stats["compile_ms_total"] += prog.compile_ms
+            while len(self._programs) >= self.max_programs:
+                self._programs.popitem(last=False)
+                self._stats["evictions"] += 1
+            self._programs[key] = prog
+            return prog
+
+    def warmup(self, execute=True, kv_depth=None):
+        """Compile — and by default execute once on zeros — every
+        (batch, prompt) prefill program and every (batch, cache-bucket)
+        decode program reachable from the prompt buckets, ahead of
+        traffic.  ``kv_depth`` additionally warms every cache bucket up
+        to that length (a sequence *growing* past its prompt's quantum
+        otherwise pays that decode compile at its first step into the
+        new bucket — serving processes that know their generation caps
+        should pass ``kv_depth=prompt_max + max_tokens_max``).  Returns
+        {(kind, bb, lb): compile_ms}."""
+        out = {}
+        cache_buckets = {self.kv_bucket(p) for p in self._prompt_edges}
+        if kv_depth is not None:
+            top = self.kv_bucket(kv_depth)
+            cache_buckets.update(
+                range(self.kv_block, top + 1, self.kv_block))
+        for bb in self._batch_edges:
+            for pb in self._prompt_edges:
+                prog = self._acquire("prefill", bb, pb)
+                out[("prefill", bb, pb)] = prog.compile_ms
+                if execute:
+                    toks = np.zeros((bb, pb), np.int32)
+                    lens = np.ones((bb,), np.int32)
+                    jax.block_until_ready(
+                        prog.fn(self._params, toks, lens))
+            for cb in sorted(cache_buckets):
+                prog = self._acquire("decode", bb, cb)
+                out[("decode", bb, cb)] = prog.compile_ms
+                if execute:
+                    ck, cv = self.new_cache(bb, cb)
+                    toks = np.zeros((bb,), np.int32)
+                    lens = np.zeros((bb,), np.int32)
+                    jax.block_until_ready(
+                        prog.fn(self._params, ck, cv, toks, lens))
+        return out
+
+    # -- execution -----------------------------------------------------
+    @hot_path
+    def run_prefill(self, tokens, lengths):
+        """Dispatch one padded prompt batch.  ``tokens`` (bb, pb) int32
+        and ``lengths`` (bb,) int32 must already be bucket-shaped
+        (``pad_prompts``).  Returns device-resident
+        ``(first_logits (bb, vocab), k_cache, v_cache)`` — enqueue-only,
+        fetch on the caller's side."""
+        bb, pb = tokens.shape
+        prog = self._acquire("prefill", bb, pb)
+        return prog.fn(self._params, tokens, lengths)
+
+    @hot_path
+    def run_decode(self, cache_k, cache_v, tokens, lengths):
+        """Dispatch one decode step over a bucket-shaped cache.  BOTH
+        cache arguments are consumed (donated) — callers must rebind
+        their references to the returned caches."""
+        bb = int(tokens.shape[0])
+        cb = int(cache_k.shape[3])
+        prog = self._acquire("decode", bb, cb)
+        return prog.fn(self._params, cache_k, cache_v, tokens, lengths)
+
+    def pad_prompts(self, prompts):
+        """Host-side canonicalization: a list of token id sequences ->
+        bucket-shaped ``(tokens (bb, pb) int32, lengths (bb,) int32)``.
+        Pad rows get length 1 over token 0 (their logits are discarded;
+        length >= 1 keeps the first-token gather in bounds)."""
+        n = len(prompts)
+        if n < 1:
+            raise MXNetError("empty prompt batch")
+        lens = [len(p) for p in prompts]
+        if min(lens) < 1:
+            raise MXNetError("empty prompt (0 tokens)")
+        bb = self.batch_bucket(n)
+        pb = self.prompt_bucket(max(lens))
+        toks = np.zeros((bb, pb), np.int32)
+        lengths = np.ones((bb,), np.int32)
+        for i, p in enumerate(prompts):
+            toks[i, :lens[i]] = np.asarray(p, np.int32)
+            lengths[i] = lens[i]
+        return toks, lengths
+
+    # -- introspection -------------------------------------------------
+    def stats(self):
+        with self._lock:
+            out = dict(self._stats)
+            out["size"] = len(self._programs)
+            out["max_programs"] = self.max_programs
+            out["programs_resident"] = sorted(
+                (k[2], k[3], k[4]) for k in self._programs)
+        out["generative"] = True
+        out["batch_buckets"] = list(self._batch_edges)
+        out["prompt_buckets"] = list(self._prompt_edges)
+        out["kv_block"] = self.kv_block
+        out["kv_max"] = self.kv_max
+        state = self.cache_state
+        if state is not None:
+            out["cache_state"] = state.describe()
         return out
 
     def reset_stats(self):

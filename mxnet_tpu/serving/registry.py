@@ -17,16 +17,25 @@ from __future__ import annotations
 
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, get_env
-from .program_store import ProgramStore
+from .program_store import GenerativeProgramStore, ProgramStore
 
 __all__ = ["ModelRegistry"]
 
 
 class ModelRegistry:
-    """name -> :class:`ProgramStore` with thread-safe add/remove."""
+    """name -> :class:`ProgramStore` with thread-safe add/remove.
+
+    Generative (autoregressive) models register through
+    :meth:`add_generative_model` into their own namespace of
+    :class:`GenerativeProgramStore` — same name space (a name is either
+    a forward model or a generative one, never both), separate
+    accessor (:meth:`gen_store`), because the two are driven by
+    different engines (:class:`~.scheduler.ServingEngine` vs
+    :class:`~.decode_engine.GenerationEngine`)."""
 
     def __init__(self):
         self._stores = {}
+        self._gen_stores = {}
         self._lock = make_lock("serving.registry")
 
     def add_model(self, name, symbol, arg_params, aux_params=None,
@@ -46,7 +55,7 @@ class ModelRegistry:
                              max_programs=max_programs,
                              input_dtypes=input_dtypes, device=device)
         with self._lock:
-            if name in self._stores:
+            if name in self._stores or name in self._gen_stores:
                 raise MXNetError("model %r is already registered" % name)
             self._stores[name] = store
         if warmup:
@@ -86,6 +95,41 @@ class ModelRegistry:
         kwargs.update(overrides)
         return self.add_model(name, sym, arg_params, aux_params, **kwargs)
 
+    def add_generative_model(self, name, params, spec, warmup=True,
+                             warmup_kv_depth=None, **kwargs):
+        """Register an autoregressive LM for the decode plane.
+
+        ``params`` — the ``transformer_lm`` symbol graph's trained
+        argument arrays (a ``save_checkpoint``'s arg_params works
+        directly); ``spec`` — ``transformer_lm.lm_spec(...)``.  Keyword
+        args (``batch_buckets``, ``prompt_buckets``, ``kv_block``,
+        ``kv_max``, ``max_programs``, ``device``) pass through to
+        :class:`GenerativeProgramStore`.  Compiles + executes every
+        prefill/decode bucket program ahead of traffic unless
+        ``warmup=False``.  Returns the store."""
+        store = GenerativeProgramStore(params, spec, name=name, **kwargs)
+        with self._lock:
+            if name in self._stores or name in self._gen_stores:
+                raise MXNetError("model %r is already registered" % name)
+            self._gen_stores[name] = store
+        if warmup:
+            try:
+                store.warmup(kv_depth=warmup_kv_depth)
+            except BaseException:
+                with self._lock:
+                    self._gen_stores.pop(name, None)
+                raise
+        return store
+
+    def load_generative_checkpoint(self, name, prefix, epoch, spec,
+                                   **kwargs):
+        """Register a generative model from a ``save_checkpoint``
+        prefix/epoch pair (the symbol json is ignored — the decode
+        graphs reuse the trained ARG arrays by name)."""
+        from ..model import load_checkpoint
+        _, arg_params, _ = load_checkpoint(prefix, epoch)
+        return self.add_generative_model(name, arg_params, spec, **kwargs)
+
     def store(self, name):
         """The model's ProgramStore; raises MXNetError when unknown."""
         with self._lock:
@@ -96,25 +140,38 @@ class ModelRegistry:
                              % (name, known))
         return store
 
+    def gen_store(self, name):
+        """The model's GenerativeProgramStore; raises when unknown."""
+        with self._lock:
+            store = self._gen_stores.get(name)
+            known = sorted(self._gen_stores) if store is None else None
+        if store is None:
+            raise MXNetError(
+                "unknown generative serving model %r (registered: %s)"
+                % (name, known))
+        return store
+
     def remove_model(self, name):
         with self._lock:
-            if self._stores.pop(name, None) is None:
+            if self._stores.pop(name, None) is None and \
+                    self._gen_stores.pop(name, None) is None:
                 raise MXNetError("unknown serving model %r" % name)
 
     def models(self):
         with self._lock:
-            return sorted(self._stores)
+            return sorted(list(self._stores) + list(self._gen_stores))
 
     def stats(self):
         """Per-model program-store stats (compile cache, buckets)."""
         with self._lock:
             stores = dict(self._stores)
+            stores.update(self._gen_stores)
         return {name: s.stats() for name, s in stores.items()}
 
     def __contains__(self, name):
         with self._lock:
-            return name in self._stores
+            return name in self._stores or name in self._gen_stores
 
     def __len__(self):
         with self._lock:
-            return len(self._stores)
+            return len(self._stores) + len(self._gen_stores)

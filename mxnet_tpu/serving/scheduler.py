@@ -43,9 +43,56 @@ from .. import profiler as _profiler
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, get_env, hot_path
 
-__all__ = ["ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed"]
+__all__ = ["ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
+           "FutureCompleter"]
 
 _STOP = object()
+
+
+class FutureCompleter:
+    """Future resolution on a dedicated thread (shared by the forward
+    batcher and the generation engine).
+
+    ``set_result`` runs client done-callbacks and wakes every thread
+    blocked in ``Future.result()``, and each wake costs the resolving
+    thread a GIL handoff (up to the 5ms switch interval) — a 32-request
+    batch resolved on a dispatch thread stalled it ~50ms, 40x the
+    actual compute.  Dispatch loops only enqueue (fut, result, exc)
+    triples here."""
+
+    def __init__(self, name="mxt-serve-done"):
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def resolve(self, fut, result=None, exc=None):
+        self._q.put((fut, result, exc))
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            fut, result, exc = item
+            try:
+                if exc is not None:
+                    fut.set_exception(exc)
+                else:
+                    fut.set_result(result)
+            except InvalidStateError:
+                # a client cancel() can land at any point before the
+                # set (exception resolutions target still-PENDING
+                # futures): the cancel wins, the resolution is dropped
+                pass
+
+    def close(self, timeout=60.0):
+        """Stop after everything already enqueued has resolved."""
+        self._q.put(_STOP)
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise MXNetError("serving completer thread failed to stop "
+                             "within %.0fs" % timeout)
 
 # Per-request rows are cut out of the batch output with a jitted
 # dynamic slice whose OFFSET is a traced argument: a static ``o[a:b]``
@@ -115,18 +162,7 @@ class ServingEngine:
         # test seam (faultinject spirit): called with (model, live_reqs)
         # right before each dispatch; tests install sleeps/recorders here
         self._dispatch_hook = None
-        # future resolution happens on a dedicated completer thread:
-        # set_result runs client done-callbacks and wakes every thread
-        # blocked in Future.result(), and each wake costs the resolving
-        # thread a GIL handoff (up to the 5ms switch interval) — a
-        # 32-request batch resolved on the dispatch thread stalled it
-        # ~50ms, 40x the actual compute.  The dispatch loop only
-        # enqueues (fut, result) pairs here.
-        self._done_q = queue.Queue()
-        self._completer = threading.Thread(target=self._complete_loop,
-                                           name="mxt-serve-done",
-                                           daemon=True)
-        self._completer.start()
+        self._completer = FutureCompleter("mxt-serve-done")
         self._thread = threading.Thread(target=self._serve_loop,
                                         name="mxt-serve", daemon=True)
         self._thread.start()
@@ -176,11 +212,7 @@ class ServingEngine:
             raise MXNetError("serving engine thread failed to stop "
                              "within %.0fs" % timeout)
         # every resolution the drain enqueued precedes the sentinel
-        self._done_q.put(_STOP)
-        self._completer.join(timeout)
-        if self._completer.is_alive():
-            raise MXNetError("serving completer thread failed to stop "
-                             "within %.0fs" % timeout)
+        self._completer.close(timeout)
 
     def __enter__(self):
         return self
@@ -188,26 +220,8 @@ class ServingEngine:
     def __exit__(self, *exc):
         self.close()
 
-    # -- completer thread ----------------------------------------------
-    def _complete_loop(self):
-        while True:
-            item = self._done_q.get()
-            if item is _STOP:
-                return
-            fut, result, exc = item
-            try:
-                if exc is not None:
-                    fut.set_exception(exc)
-                else:
-                    fut.set_result(result)
-            except InvalidStateError:
-                # a client cancel() can land at any point before the
-                # set (exception resolutions target still-PENDING
-                # futures): the cancel wins, the resolution is dropped
-                pass
-
     def _resolve(self, fut, result=None, exc=None):
-        self._done_q.put((fut, result, exc))
+        self._completer.resolve(fut, result, exc)
 
     # -- engine thread -------------------------------------------------
     def _serve_loop(self):

@@ -1,0 +1,79 @@
+"""Subprocess body of the tools/launch.py auto-resume restart scenario.
+
+Driven by tests/test_launch_restart.py through the REAL launcher CLI
+(``tools/launch.py -n 1 -s 0 --auto-resume <prefix> --max-restarts 1``):
+the first incarnation checkpoints mid-epoch (``batch_checkpoint``,
+period 2) and ``os._exit(137)``s at batch 4 of epoch 0; the launcher
+relaunches it, and ``Module.fit`` — given NO ``resume_data_state`` by
+this script — picks the frontier up from the ``MXNET_AUTO_RESUME``
+envelope the launcher exported.  The driver asserts the resumed epoch
+trained only the REMAINING batches (mid-epoch resume, not an epoch
+replay).
+"""
+import json
+import os
+import sys
+
+
+def main(argv):
+    prefix, out_json = argv[:2]
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.test_utils import smoke_mlp
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    feat, n, bs = 16, 48, 4          # 12 batches per epoch
+    rs = np.random.RandomState(3)
+    X = rs.uniform(-1, 1, (n, feat)).astype("float32")
+    y = (rs.uniform(size=n) > 0.5).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=bs)
+
+    latest = mx.Module.load_latest(prefix, context=mx.cpu())
+    if latest is None:
+        mod, begin, resume_kw = (mx.Module(smoke_mlp(num_hidden=8),
+                                           context=mx.cpu()), 0, {})
+    else:
+        # params come from the checkpoint; the DATA frontier is
+        # deliberately NOT threaded — MXNET_AUTO_RESUME must supply it
+        mod, begin = latest
+        resume_kw = dict(arg_params=mod._arg_params,
+                         aux_params=mod._aux_params)
+
+    marker = prefix + ".firstrun"
+    first = not os.path.exists(marker)
+    seen = []
+
+    def track(param):
+        seen.append((param.epoch, param.nbatch))
+
+    cbs = [track, mx.callback.batch_checkpoint(mod, prefix, period=2)]
+    if first:
+        with open(marker, "w") as f:
+            f.write("1")
+
+        def killer(param):
+            # dies AFTER the period-2 checkpoint at nbatch 3 banked a
+            # 4-batch frontier
+            if param.epoch == 0 and param.nbatch == 4:
+                os._exit(137)
+
+        cbs.append(killer)
+
+    mod.fit(it, num_epoch=2, begin_epoch=begin, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05}, eval_metric="acc",
+            batch_end_callback=cbs, **resume_kw)
+    with open(out_json, "w") as f:
+        json.dump({
+            "begin_epoch": begin,
+            "epoch0_batches": sum(1 for e, _ in seen if e == begin),
+            "batches": len(seen),
+            "auto_resume_env": os.environ.get("MXNET_AUTO_RESUME", ""),
+        }, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

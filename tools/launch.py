@@ -17,6 +17,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
@@ -27,37 +28,82 @@ def _free_port():
     return port
 
 
-def launch_local(num_workers, num_servers, command, env=None):
-    """Spawn scheduler + servers + workers locally; returns worker rcs."""
+def launch_local(num_workers, num_servers, command, env=None,
+                 auto_resume=None, max_restarts=0):
+    """Spawn scheduler + servers + workers locally; returns worker rcs.
+
+    ``num_servers == 0`` skips the PS cluster entirely (no scheduler,
+    no ``DMLC_*`` env) and just supervises the worker processes — the
+    mode restart-based crash recovery uses.
+
+    ``auto_resume`` exports ``MXNET_AUTO_RESUME=<prefix>`` to every
+    worker, so ``Module.fit`` picks up the latest ``.dstate`` envelope
+    under that prefix (data/checkpoint.py) without the training script
+    threading it by hand; combined with ``max_restarts`` a worker that
+    dies mid-epoch is relaunched and resumes from its last mid-epoch
+    frontier instead of replaying (or losing) the epoch.
+    """
     base = dict(os.environ)
     if env:
         base.update(env)
-    base.update({
-        "DMLC_PS_ROOT_URI": "127.0.0.1",
-        "DMLC_PS_ROOT_PORT": str(_free_port()),
-        "DMLC_NUM_WORKER": str(num_workers),
-        "DMLC_NUM_SERVER": str(num_servers),
-    })
+    if num_servers > 0:
+        base.update({
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(_free_port()),
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_NUM_SERVER": str(num_servers),
+        })
+    if auto_resume:
+        base["MXNET_AUTO_RESUME"] = str(auto_resume)
 
     procs = []
 
     def spawn(role):
         e = dict(base)
-        e["DMLC_ROLE"] = role
+        if num_servers > 0:
+            e["DMLC_ROLE"] = role
         # server/scheduler processes run the same command; importing
         # mxnet_tpu hijacks them into the PS run loop (kvstore_server.py)
         p = subprocess.Popen(command, env=e)
         procs.append((role, p))
         return p
 
-    spawn("scheduler")
-    for _ in range(num_servers):
-        spawn("server")
+    if num_servers > 0:
+        spawn("scheduler")
+        for _ in range(num_servers):
+            spawn("server")
     workers = [spawn("worker") for _ in range(num_workers)]
 
+    # supervise by POLLING all workers: a sequential wait() would only
+    # notice worker k's crash after workers 0..k-1 exited — under a
+    # synchronous kvstore the survivors block on the dead peer's
+    # barrier contribution and the restart never fires
+    restarts_left = [max_restarts] * num_workers
+    pending = dict(enumerate(workers))
+    final_rc = {}
+    while pending:
+        progressed = False
+        for i, w in list(pending.items()):
+            wrc = w.poll()
+            if wrc is None:
+                continue
+            progressed = True
+            if wrc != 0 and restarts_left[i] > 0:
+                restarts_left[i] -= 1
+                print("worker %d exited rc=%d; relaunching (%d "
+                      "restart(s) left)%s"
+                      % (i, wrc, restarts_left[i],
+                         ", auto-resume armed" if auto_resume else ""),
+                      file=sys.stderr)
+                pending[i] = spawn("worker")
+            else:
+                final_rc[i] = wrc
+                del pending[i]
+        if pending and not progressed:
+            time.sleep(0.2)
     rc = 0
-    for w in workers:
-        rc |= w.wait()
+    for wrc in final_rc.values():
+        rc |= wrc
     # workers done -> scheduler/servers should have exited; reap or kill
     for role, p in procs:
         if p.poll() is None:
@@ -73,9 +119,21 @@ def main():
     parser = argparse.ArgumentParser(
         description="Launch a distributed job (reference tools/launch.py).")
     parser.add_argument("-n", "--num-workers", required=True, type=int)
-    parser.add_argument("-s", "--num-servers", type=int)
+    parser.add_argument("-s", "--num-servers", type=int,
+                        help="0 skips the PS cluster (worker "
+                             "supervision only)")
     parser.add_argument("--launcher", default="local",
                         choices=["local", "ssh", "mpi", "sge", "yarn"])
+    parser.add_argument("--auto-resume", default=None, metavar="PREFIX",
+                        help="export MXNET_AUTO_RESUME=PREFIX to "
+                             "workers: Module.fit resumes from the "
+                             "latest .dstate envelope under PREFIX "
+                             "without the script threading it by hand")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="relaunch a worker that exits nonzero up "
+                             "to this many times (pairs with "
+                             "--auto-resume for mid-epoch crash "
+                             "recovery)")
     parser.add_argument("command", nargs="+")
     args, unknown = parser.parse_known_args()
     if args.num_servers is None:
@@ -85,7 +143,9 @@ def main():
                  "tree ships the local tracker (same env protocol)"
                  % args.launcher)
     sys.exit(launch_local(args.num_workers, args.num_servers,
-                          args.command + unknown))
+                          args.command + unknown,
+                          auto_resume=args.auto_resume,
+                          max_restarts=args.max_restarts))
 
 
 if __name__ == "__main__":
