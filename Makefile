@@ -79,10 +79,16 @@ serve-smoke:
 # continuous-batching GenerationEngine — greedy == reference, seeded-
 # loadgen FIFO admission, close-mid-generation drain, KV-cache growth,
 # plus the banked serving.decode.* rows (continuous >= 2x re-prefill
-# tokens/sec at no worse p99 TTFT, zero drops)
+# tokens/sec at no worse p99 TTFT, zero drops) — and the low-precision
+# serving plane (tests/test_quant_serving.py): int8 weight-only
+# (fused dequant-matmul vs dense twin, >= 99% greedy top-1 agreement,
+# ~4x weight bytes), bf16 KV decode (relaxed-tol parity, halved cache
+# bytes/slot), in-graph vs host sampling byte-identical streams and
+# the zero-logits-fetch pin
 decode-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu \
-		$(PY) -m pytest tests/test_decode_engine.py -q -m quick
+		$(PY) -m pytest tests/test_decode_engine.py \
+		tests/test_quant_serving.py -q -m quick
 
 # one-SPMD-step-program gate under 8 fake host devices: numerical
 # equivalence (dp8 vs single device, dp2xmp2 vs dp4, closed-form SGD),

@@ -899,12 +899,21 @@ def bench_serving_latency(mode, chip, smoke=False):
            "dropped": b["timeouts"] + b["errors"] + b["cancelled"],
            "batches": eng.get("batches"),
            "padded_rows": eng.get("padded_rows"),
+           "weight_bytes_by_dtype": eng.get("weight_bytes_by_dtype"),
            "seed": r["seed"]}
     if mode == "bf16":
         row["note"] = ("bf16 serving weights (half the resident memory); "
                        "fp32 serving stays bit-equal to the classic "
                        "Predictor — the accuracy row is "
                        "tests/test_serving.py's bit-equality pin")
+    elif mode == "int8":
+        row["note"] = ("int8 weight-only serving: FC weights quantized "
+                       "once at load (scale-per-row symmetric) and "
+                       "dequantized in-graph through the fused "
+                       "dequant-matmul door (~4x less resident weight "
+                       "memory — weight_bytes_by_dtype is the "
+                       "measurement; top-1 parity is "
+                       "tests/test_quant_serving.py's pin)")
     return row
 
 
@@ -931,7 +940,8 @@ def bench_serving_decode(which, chip, smoke=False):
     if r is None:
         r = generation_protocol(smoke=smoke)
         _GEN_PROTOCOL_CACHE[bool(smoke)] = r
-    side = r["batch"] if which == "continuous" else r["reprefill_open"]
+    side = r["reprefill_open"] if which == "reprefill" else \
+        r["batch"] if which == "continuous" else r[which]
     row = {"metric": "serving.decode.%s" % which,
            "value": side["tokens_per_sec"], "unit": "tokens/sec",
            "vs_baseline": None,
@@ -948,22 +958,71 @@ def bench_serving_decode(which, chip, smoke=False):
            "kv_block": r["kv_block"],
            "kv_max": r["kv_max"],
            "seed": r["seed"]}
+    eng = side.get("engine", {})
+    if which != "reprefill":
+        # fetch-footprint evidence: elements the engine pulled to host
+        # per decode step (tokens under in-graph sampling; the host
+        # hatch pulls the whole (slots, vocab) logits matrix)
+        steps = eng.get("decode_steps") or 0
+        row["decode_fetch_elems_per_step"] = (
+            round(eng.get("decode_fetch_elems", 0) / steps, 1)
+            if steps else None)
+        row["sample_mode"] = side.get("store", {}).get("sample_mode")
     if which == "continuous":
-        eng = side.get("engine", {})
         row.update({
             "tokens_per_sec_vs_reprefill":
                 r["tokens_per_sec_vs_reprefill"],
             "ttft_p99_vs_reprefill": r["ttft_p99_vs_reprefill"],
+            "itl_mean_vs_host_sample": r["itl_mean_vs_host_sample"],
+            "host_sample_itl_mean_ms":
+                r["host_sample"]["itl_mean_ms"],
             "decode_steps": eng.get("decode_steps"),
             "generated_tokens": eng.get("generated_tokens"),
             "max_active": eng.get("max_active"),
             "cache_grows": eng.get("cache_grows"),
             "note": ("one compiled decode step advances every in-flight "
-                     "sequence against the donated KV cache; the "
-                     "baseline re-pays a full prefill per token "
-                     "(acceptance: >= 2x tokens/sec at no worse p99 "
-                     "TTFT, zero drops)"),
+                     "sequence against the donated KV cache, sampling "
+                     "in-graph (the per-step host transfer is the "
+                     "(slots,) token vector); the baseline re-pays a "
+                     "full prefill per token (acceptance: >= 2x "
+                     "tokens/sec at no worse p99 TTFT, zero drops, ITL "
+                     "no worse than the host-sampling hatch)"),
         })
+    elif which in ("bf16", "int8"):
+        st = side.get("store", {})
+        fp_st = r["batch"].get("store", {})
+        hwm = eng.get("cache_hwm", {}).get("m", {})
+        fp_hwm = r["batch"].get("engine", {}).get(
+            "cache_hwm", {}).get("m", {})
+        row.update({
+            "compute_dtype": st.get("compute_dtype"),
+            "kv_dtype": st.get("kv_dtype"),
+            "weight_bytes": st.get("weight_bytes", {}).get("total"),
+            "fp32_weight_bytes":
+                fp_st.get("weight_bytes", {}).get("total"),
+            "cache_bytes_per_slot": hwm.get("cache_bytes_per_slot"),
+            "fp32_cache_bytes_per_slot":
+                fp_hwm.get("cache_bytes_per_slot"),
+            "tokens_per_sec_vs_fp32": (
+                round(side["tokens_per_sec"] /
+                      r["batch"]["tokens_per_sec"], 3)
+                if r["batch"]["tokens_per_sec"] else None),
+        })
+        if which == "bf16":
+            row["note"] = ("bf16 weights AND bf16 KV cache: cache "
+                           "bytes per slot halved vs the fp32 row "
+                           "(cache_bytes_per_slot vs fp32_cache_"
+                           "bytes_per_slot), so the same cache budget "
+                           "holds 2x the concurrent sequences; decode "
+                           "parity pinned at relaxed tol")
+        else:
+            row["note"] = ("int8 weight-only decode: matmul weights "
+                           "travel as (codes, scales) program "
+                           "arguments through the fused dequant-"
+                           "matmul door — ~4x less resident weight "
+                           "memory (weight_bytes vs fp32_weight_"
+                           "bytes); >= 99% greedy top-1 agreement "
+                           "pinned by tests/test_quant_serving.py")
     return row
 
 
@@ -1878,13 +1937,21 @@ def main():
           smoke)
     guard("serving.latency.bf16", bench_serving_latency, "bf16", chip,
           smoke)
+    guard("serving.latency.int8", bench_serving_latency, "int8", chip,
+          smoke)
     # decode-plane generation rows: continuous batching over the KV
     # cache vs the naive re-prefill-per-token baseline, same seeded
-    # open-loop schedule (tokens/sec + TTFT + inter-token latency)
+    # open-loop schedule (tokens/sec + TTFT + inter-token latency),
+    # plus the low-precision decode sides (bf16 cache+weights, int8
+    # weight-only) on the same schedule
     guard("serving.decode.continuous", bench_serving_decode,
           "continuous", chip, smoke)
     guard("serving.decode.reprefill", bench_serving_decode,
           "reprefill", chip, smoke)
+    guard("serving.decode.bf16", bench_serving_decode, "bf16", chip,
+          smoke)
+    guard("serving.decode.int8", bench_serving_decode, "int8", chip,
+          smoke)
     # transformer MFU headline (flash attention + the fused Pallas
     # kernels end-to-end through Module.fit) + the remat batch-scaling
     # row; CPU-deterministic protocol, banked as BENCH_transformer_cpu
@@ -1973,7 +2040,7 @@ def _assemble_out(rows, chip, smoke, t0):
     # the per-request deployment at the same offered load (the >= 3x
     # acceptance figure), surfaced per serving dtype when the rows ran
     serving = {}
-    for mode in ("fp32", "bf16"):
+    for mode in ("fp32", "bf16", "int8"):
         r = by_metric.get("serving.latency.%s" % mode)
         if r and r.get("unit") not in ("error", "skipped"):
             serving[mode] = {
@@ -1989,7 +2056,19 @@ def _assemble_out(rows, chip, smoke, t0):
                 r.get("tokens_per_sec_vs_reprefill"),
             "ttft_p99_ms": r.get("ttft_p99_ms"),
             "itl_mean_ms": r.get("itl_mean_ms"),
+            "itl_mean_vs_host_sample":
+                r.get("itl_mean_vs_host_sample"),
         }
+    for mode in ("bf16", "int8"):
+        r = by_metric.get("serving.decode.%s" % mode)
+        if r and r.get("unit") not in ("error", "skipped"):
+            serving["decode_%s" % mode] = {
+                "tokens_per_sec": r["value"],
+                "tokens_per_sec_vs_fp32":
+                    r.get("tokens_per_sec_vs_fp32"),
+                "weight_bytes": r.get("weight_bytes"),
+                "cache_bytes_per_slot": r.get("cache_bytes_per_slot"),
+            }
 
     out = {
         "metric": "resnet50_train_images_per_sec",

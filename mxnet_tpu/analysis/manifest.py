@@ -58,6 +58,8 @@ SPAN_ENTRY_POINTS = (
     ("mxnet_tpu/serving/decode_engine.py",
      "GenerationEngine._dispatch_decode"),
     ("mxnet_tpu/serving/decode_engine.py",
+     "GenerationEngine._dispatch_decode_sample"),
+    ("mxnet_tpu/serving/decode_engine.py",
      "GenerationEngine._dispatch_prefill"),
     ("mxnet_tpu/serving/scheduler.py", "ServingEngine._dispatch_once"),
 )

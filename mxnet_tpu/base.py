@@ -348,6 +348,29 @@ register_env("MXNET_SERVE_KV_MAX", int, 1024,
              "prompt_len + max_tokens exceed it are rejected at "
              "submit, so a decode batch can never outgrow its cache "
              "mid-flight.")
+register_env("MXNET_SERVE_KV_DTYPE", str, "float32",
+             "KV-cache element dtype on the serving decode plane "
+             "('float32' or 'bfloat16').  bfloat16 halves cache bytes "
+             "per slot — the same cache memory budget holds 2x the "
+             "concurrent sequences — while attention over the cache "
+             "accumulates fp32 in both the offset flash kernel and "
+             "its dense XLA twin; decode parity is pinned at relaxed "
+             "tolerance (tests/test_quant_serving.py).")
+register_env("MXNET_SERVE_SAMPLE", str, "graph",
+             "Where generation sampling runs: 'graph' (default) "
+             "compiles greedy + seeded temperature/top-k INTO the "
+             "decode programs (per-slot jax.random key state rides as "
+             "a donated program argument; the per-step host transfer "
+             "shrinks from the (slots, vocab) logits matrix to the "
+             "(slots,) token vector); 'host' is the escape hatch — "
+             "logits-out decode programs plus the SAME jitted sampler "
+             "on the fetched logits, byte-identical token streams.")
+register_env("MXNET_SERVE_INT8_GRANULARITY", str, "row",
+             "Scale granularity of int8 weight-only serving "
+             "quantization (pallas_ops/dequant_matmul.quantize_int8): "
+             "'row' (default) keeps one fp32 scale per output row — "
+             "per-row absmax isolates badly scaled rows — 'tensor' "
+             "keeps a single scalar scale per weight.")
 register_env("MXNET_SERVE_PROMPT_BUCKETS", str, "16,32,64,128",
              "Comma-separated prompt-length bucket edges of the "
              "serving prefill programs: a prompt of p tokens is "

@@ -18,7 +18,8 @@ next-token targets of the same shape.
 from .. import symbol as sym
 
 __all__ = ["get_symbol", "lm_spec", "random_params", "init_cache",
-           "prefill_apply", "decode_apply"]
+           "prefill_apply", "decode_apply", "quantize_lm_params",
+           "lm_matmul_weights"]
 
 
 def _attention_block(x, seq_len, num_hidden, num_heads, name):
@@ -129,6 +130,36 @@ def init_cache(spec, batch, cache_len, dtype="float32"):
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def lm_matmul_weights(spec):
+    """The 2D matmul weights of the LM argument set — the params int8
+    weight-only serving quantizes (norm scales and biases stay fp32:
+    they are a rounding error of the footprint and the numerics care)."""
+    names = ["embed_weight", "pred_weight"]
+    for i in range(spec["num_layers"]):
+        names += ["blk%d_%s" % (i, k) for k in
+                  ("q_weight", "k_weight", "v_weight", "proj_weight",
+                   "ffn1_weight", "ffn2_weight")]
+    return names
+
+
+def quantize_lm_params(params, spec, granularity=None):
+    """int8 weight-only transform of an LM param dict: every matmul
+    weight (:func:`lm_matmul_weights`) becomes a
+    :class:`~..pallas_ops.dequant_matmul.QuantizedWeight`; everything
+    else passes through untouched.  Pure — the input dict is not
+    mutated."""
+    from ..pallas_ops.dequant_matmul import QuantizedWeight, quantize_int8
+    quant = set(lm_matmul_weights(spec))
+    out = {}
+    for k, v in params.items():
+        if k in quant:
+            codes, scales = quantize_int8(v, granularity)
+            out[k] = QuantizedWeight(codes, scales)
+        else:
+            out[k] = v
+    return out
+
+
 def _block_params(params, i):
     p = {k: params["blk%d_%s" % (i, k)] for k in
          ("ln1_gamma", "q_weight", "k_weight", "v_weight", "proj_weight",
@@ -137,14 +168,42 @@ def _block_params(params, i):
     return p
 
 
+def _mm(x2d, w):
+    """``x @ w^T`` with int8 weight-only routing: a QuantizedWeight
+    dequantizes inside the matmul (fused kernel or its dense XLA twin,
+    per the dispatch seam); a plain array is one MXU matmul."""
+    import jax.numpy as jnp
+    from ..pallas_ops.dequant_matmul import QuantizedWeight, dequant_matmul
+    if isinstance(w, QuantizedWeight):
+        return dequant_matmul(x2d, w.codes, w.scales)
+    return jnp.matmul(x2d, w.T)
+
+
+def _embed(w, tokens):
+    """Embedding gather with int8 routing: quantized rows are gathered
+    as codes and dequantized per row (exact — the per-row scale rides
+    the same gather)."""
+    import jax.numpy as jnp
+    from ..pallas_ops.dequant_matmul import QuantizedWeight
+    ids = tokens.astype(jnp.int32)
+    if isinstance(w, QuantizedWeight):
+        rows = jnp.take(w.codes, ids, axis=0).astype(jnp.float32)
+        scales = jnp.broadcast_to(
+            jnp.asarray(w.scales, jnp.float32).reshape(-1),
+            (w.codes.shape[0],))
+        return rows * jnp.take(scales, ids, axis=0)[..., None]
+    return jnp.take(w, ids, axis=0)
+
+
 def _ffn(x2d, bp):
     import jax.numpy as jnp
-    f = jnp.matmul(x2d, bp["ffn1_weight"].T) + bp["ffn1_bias"]
+    f = _mm(x2d, bp["ffn1_weight"]) + bp["ffn1_bias"]
     f = jnp.maximum(f, 0)
-    return jnp.matmul(f, bp["ffn2_weight"].T) + bp["ffn2_bias"]
+    return _mm(f, bp["ffn2_weight"]) + bp["ffn2_bias"]
 
 
-def prefill_apply(params, tokens, lengths, cache_len, spec):
+def prefill_apply(params, tokens, lengths, cache_len, spec,
+                  cache_dtype="float32"):
     """Run a padded prompt batch once and fill the KV cache.
 
     tokens: (B, P) int32, zero-padded past each sequence's ``lengths``;
@@ -152,11 +211,16 @@ def prefill_apply(params, tokens, lengths, cache_len, spec):
     Returns ``(logits, k_cache, v_cache)`` — logits (B, P, vocab) fp32
     for every position (callers gather position ``lengths-1`` for the
     first generated token), caches ``(L, B, H, cache_len, head_dim)``
-    holding K/V for positions 0..P-1 and zeros past P.  Pad positions
-    DO write junk K/V inside 0..P-1 for rows shorter than P, but no
-    real query ever attends past its own position (causal), and decode
-    steps overwrite slots from ``lengths`` on — the offset-causal mask
-    keeps them invisible throughout (pinned).
+    of ``cache_dtype`` (``'bfloat16'`` halves the resident cache;
+    attention inside the prefill itself still reads the full-precision
+    K/V) holding K/V for positions 0..P-1 and zeros past P.  Pad
+    positions DO write junk K/V inside 0..P-1 for rows shorter than P,
+    but no real query ever attends past its own position (causal), and
+    decode steps overwrite slots from ``lengths`` on — the
+    offset-causal mask keeps them invisible throughout (pinned).
+
+    Params may be bf16 (compute follows them; logits return fp32) or
+    int8 :class:`QuantizedWeight` pairs (matmuls dequantize in-program).
     """
     import jax.numpy as jnp
     from ..ops.attention import sdp_attention
@@ -165,9 +229,9 @@ def prefill_apply(params, tokens, lengths, cache_len, spec):
     L, D = spec["num_layers"], spec["num_hidden"]
     H = spec["num_heads"]
     dh = D // H
+    cdt = jnp.dtype(cache_dtype)
     B, P = tokens.shape
-    x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
-                 axis=0)                                    # (B, P, D)
+    x = _embed(params["embed_weight"], tokens)              # (B, P, D)
     ks, vs = [], []
     for i in range(L):
         bp = _block_params(params, i)
@@ -175,24 +239,24 @@ def prefill_apply(params, tokens, lengths, cache_len, spec):
         a2 = a.reshape(-1, D)
 
         def heads(w):
-            h = jnp.matmul(a2, w.T).reshape(B, P, H, dh)
+            h = _mm(a2, w).reshape(B, P, H, dh)
             return jnp.transpose(h, (0, 2, 1, 3))           # (B, H, P, dh)
 
         q, k, v = (heads(bp[t]) for t in
                    ("q_weight", "k_weight", "v_weight"))
         pad = ((0, 0), (0, 0), (0, int(cache_len) - P), (0, 0))
-        ks.append(jnp.pad(k, pad))
-        vs.append(jnp.pad(v, pad))
+        ks.append(jnp.pad(k.astype(cdt), pad))
+        vs.append(jnp.pad(v.astype(cdt), pad))
         att = sdp_attention(q, k, v, causal=True)
         att = jnp.transpose(att, (0, 2, 1, 3)).reshape(-1, D)
-        x = x + jnp.matmul(att, bp["proj_weight"].T).reshape(B, P, D)
+        x = x + _mm(att, bp["proj_weight"]).reshape(B, P, D)
         f = _rms_fc({"eps": 1e-6}, x, bp["ln2_gamma"]).reshape(-1, D)
         x = x + _ffn(f, bp).reshape(B, P, D)
     h = _ln_fc({"axis": -1, "eps": 1e-5}, x, params["final_ln_gamma"],
                params["final_ln_beta"])
-    logits = (jnp.matmul(h.reshape(-1, D), params["pred_weight"].T) +
+    logits = (_mm(h.reshape(-1, D), params["pred_weight"]) +
               params["pred_bias"]).reshape(B, P, spec["vocab_size"])
-    return logits, jnp.stack(ks), jnp.stack(vs)
+    return (logits.astype(jnp.float32), jnp.stack(ks), jnp.stack(vs))
 
 
 def decode_apply(params, cache_k, cache_v, tokens, lengths, spec):
@@ -203,9 +267,13 @@ def decode_apply(params, cache_k, cache_v, tokens, lengths, spec):
     tokens: (B,) int32 (the previously sampled token per sequence);
     lengths: (B,) int32 cache frontiers (the new token's position —
     must be < cache_len); caches as from :func:`prefill_apply` /
-    :func:`init_cache`.  Returns ``(logits (B, vocab), new_k, new_v)``.
-    Callers AOT-compile this with both caches DONATED, so the update is
-    an in-place ``dynamic_update_slice`` on the one device-resident
+    :func:`init_cache` (their dtype is the cache dtype — the fresh
+    K/V write casts to it, attention reads it back; the flash kernel
+    and its dense twin both accumulate fp32 regardless).  Returns
+    ``(logits (B, vocab) fp32, new_k, new_v)``.  Params may be bf16 or
+    int8 ``QuantizedWeight`` pairs like :func:`prefill_apply`.  Callers
+    AOT-compile this with both caches DONATED, so the update is an
+    in-place ``dynamic_update_slice`` on the one device-resident
     copy."""
     import jax
     import jax.numpy as jnp
@@ -216,15 +284,15 @@ def decode_apply(params, cache_k, cache_v, tokens, lengths, spec):
     H = spec["num_heads"]
     dh = D // H
     B = tokens.shape[0]
+    cdt = cache_k.dtype
     lengths = jnp.asarray(lengths, jnp.int32)
-    x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
-                 axis=0)                                    # (B, D)
+    x = _embed(params["embed_weight"], tokens)              # (B, D)
     for i in range(L):
         bp = _block_params(params, i)
         a = _rms_fc({"eps": 1e-6}, x, bp["ln1_gamma"])
 
         def heads(w):
-            return jnp.matmul(a, w.T).reshape(B, H, 1, dh)
+            return _mm(a, w).reshape(B, H, 1, dh)
 
         q, k, v = (heads(bp[t]) for t in
                    ("q_weight", "k_weight", "v_weight"))
@@ -234,17 +302,19 @@ def decode_apply(params, cache_k, cache_v, tokens, lengths, spec):
             return jax.lax.dynamic_update_slice(cache_b, kv_b,
                                                 (0, l_b, 0))
 
-        cache_k = cache_k.at[i].set(jax.vmap(write)(cache_k[i], k,
+        cache_k = cache_k.at[i].set(jax.vmap(write)(cache_k[i],
+                                                    k.astype(cdt),
                                                     lengths))
-        cache_v = cache_v.at[i].set(jax.vmap(write)(cache_v[i], v,
+        cache_v = cache_v.at[i].set(jax.vmap(write)(cache_v[i],
+                                                    v.astype(cdt),
                                                     lengths))
-        att = sdp_attention(q, cache_k[i], cache_v[i],
+        att = sdp_attention(q.astype(cdt), cache_k[i], cache_v[i],
                             q_offsets=lengths)              # (B, H, 1, dh)
         att = jnp.transpose(att, (0, 2, 1, 3)).reshape(B, D)
-        x = x + jnp.matmul(att, bp["proj_weight"].T)
+        x = x + _mm(att.astype(x.dtype), bp["proj_weight"])
         f = _rms_fc({"eps": 1e-6}, x, bp["ln2_gamma"])
         x = x + _ffn(f, bp)
     h = _ln_fc({"axis": -1, "eps": 1e-5}, x, params["final_ln_gamma"],
                params["final_ln_beta"])
-    logits = jnp.matmul(h, params["pred_weight"].T) + params["pred_bias"]
-    return logits, cache_k, cache_v
+    logits = _mm(h, params["pred_weight"]) + params["pred_bias"]
+    return logits.astype(jnp.float32), cache_k, cache_v

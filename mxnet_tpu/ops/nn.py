@@ -33,7 +33,16 @@ def _fc_args(attrs):
 
 def _fc_fcompute(attrs, data, weight, bias=None):
     x = data.reshape(data.shape[0], -1)
-    out = jnp.matmul(x, weight.T)
+    from ..pallas_ops.dequant_matmul import QuantizedWeight, dequant_matmul
+    if isinstance(weight, QuantizedWeight):
+        # int8 weight-only serving (program_store compute_dtype='int8'):
+        # the weight arrives as (codes, scales) and the dequant fuses
+        # into the matmul through the dispatch door (dense XLA twin off
+        # the kernel route).  Inference-only — the train planes never
+        # feed a QuantizedWeight.
+        out = dequant_matmul(x, weight.codes, weight.scales)
+    else:
+        out = jnp.matmul(x, weight.T)
     if bias is not None:
         out = out + bias
     return out

@@ -9,13 +9,19 @@ where explicit VMEM blocking beats XLA's default schedule:
 * ``softmax_xent``     — fused softmax / softmax-cross-entropy loss
   heads (forward never materializes the probability tensor);
 * ``norm``             — fused RMSNorm / LayerNorm, forward and backward
-  each one VMEM trip.
+  each one VMEM trip;
+* ``dequant_matmul``   — int8 weight-only serving: per-row dequant fused
+  into the matmul tile loop (codes travel to VMEM as int8, fp32
+  accumulation, scale applied once at the last K step).
 
 ``dispatch`` is the routing seam: eligible op lowerings (the registry
 ``fcompute`` layer every execution plane traces through) ask it whether
 to use the kernel or the plain XLA lowering — ``MXNET_PALLAS=0`` is the
 escape hatch (docs/architecture/pallas_kernels.md).
 """
+from .dequant_matmul import (QuantizedWeight, dequant_matmul,
+                             dequant_matmul_dense, dequantize_int8,
+                             quantize_int8)
 from .flash_attention import flash_attention
 from .norm import layer_norm, rms_norm
 from .softmax_xent import (fused_softmax, softmax_output_head,
@@ -23,4 +29,6 @@ from .softmax_xent import (fused_softmax, softmax_output_head,
 from . import dispatch
 
 __all__ = ["flash_attention", "fused_softmax", "softmax_output_head",
-           "softmax_xent_loss", "rms_norm", "layer_norm", "dispatch"]
+           "softmax_xent_loss", "rms_norm", "layer_norm", "dispatch",
+           "quantize_int8", "dequantize_int8", "QuantizedWeight",
+           "dequant_matmul", "dequant_matmul_dense"]

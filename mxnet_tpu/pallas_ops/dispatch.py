@@ -48,8 +48,9 @@ from .flash_attention import _on_tpu
 
 __all__ = ["mode", "kernels_active", "interpret_mode", "block_rows",
            "block_seq", "fingerprint", "overriding", "use_rowwise",
-           "use_attention", "eligible_rowwise", "eligible_attention",
-           "eligible_attention_offset", "dispatch_stats",
+           "use_attention", "use_dequant_matmul", "eligible_rowwise",
+           "eligible_attention", "eligible_attention_offset",
+           "eligible_dequant_matmul", "dispatch_stats",
            "reset_dispatch_stats"]
 
 MODE_OFF, MODE_AUTO, MODE_FORCE = 0, 1, 2
@@ -209,6 +210,35 @@ def eligible_attention_offset(b, h, lq, lk, d, dtype):
     return int(b) >= 1 and int(h) >= 1
 
 
+def eligible_dequant_matmul(m, n, k, dtype):
+    """May an ``x (m, k) @ dequant(codes (n, k))^T`` pattern run as the
+    fused int8 dequant-matmul kernel (``dequant_matmul.py``)?
+
+    Blocks degrade to divisors of every dimension
+    (``flash_attention.divisor_block``), so odd shapes never disqualify
+    — only the activation dtype, a nontrivial reduction (k >= 2; a
+    single-column "matmul" stays with XLA) and the VMEM tile budget
+    remain.  Compiled Mosaic additionally wants the lane dimension
+    aligned: k % 128 == 0 off-interpret (int8 codes tile at (32, 128)).
+    """
+    if str(dtype) not in _FLOAT_DTYPES:
+        return False
+    m, n, k = int(m), int(n), int(k)
+    if m < 1 or n < 1 or k < 2:
+        return False
+    bs = block_seq()
+    bm, bn, bk = min(bs, m), min(bs, n), min(bs, k)
+    # per grid cell: fp32 x tile (bm, bk) + code tile (bn, bk) widened
+    # to fp32 on-tile + fp32 accumulator scratch (bm, bn) — the code
+    # tile scales with n, not m, so a small-m (decode-step) matmul
+    # must still account for it
+    if 4 * (bm * bk + bn * bk + bm * bn) > _VMEM_TILE_BUDGET:
+        return False
+    if not interpret_mode() and k % 128 != 0:
+        return False
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Routing decisions (+ trace-time counters, banked by the bench rows)
 # ---------------------------------------------------------------------------
@@ -235,6 +265,16 @@ def reset_dispatch_stats():
 def use_rowwise(kind, rows, width, dtype):
     """Route decision for a row-wise pattern; counts a route when taken."""
     if not kernels_active() or not eligible_rowwise(rows, width, dtype):
+        return False
+    _note(kind)
+    return True
+
+
+def use_dequant_matmul(kind, m, n, k, dtype):
+    """Route decision for an int8 dequant-matmul pattern; counts a
+    route when taken."""
+    if not kernels_active() or not eligible_dequant_matmul(m, n, k,
+                                                           dtype):
         return False
     _note(kind)
     return True

@@ -54,11 +54,15 @@ SERVE_PHASES = ("serve_wait", "serve_batch", "serve_compute")
 
 # The generation engine's decode-loop phases (serving/decode_engine.py):
 # ``serve_prefill`` (one bucketed prompt batch filling the KV cache +
-# first-token logits) and ``serve_decode`` (one continuous-batched
-# decode step over the donated cache).  Separate tuple: the forward
-# batcher emits every SERVE_PHASES entry each cycle (pinned), the
-# decode loop emits these.
-GEN_SERVE_PHASES = ("serve_prefill", "serve_decode")
+# first-token logits), ``serve_decode`` (one continuous-batched decode
+# step over the donated cache) and ``serve_sample`` (the per-step
+# token materialization: the (slots,) token fetch under in-graph
+# sampling — MXNET_SERVE_SAMPLE=graph — or the (slots, vocab) logits
+# fetch + host-side shared sampler under the =host hatch; the phase's
+# footprint is the acceptance pin's evidence).  Separate tuple: the
+# forward batcher emits every SERVE_PHASES entry each cycle (pinned),
+# the decode loop emits these.
+GEN_SERVE_PHASES = ("serve_prefill", "serve_decode", "serve_sample")
 
 
 class Profiler:

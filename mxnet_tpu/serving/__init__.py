@@ -15,7 +15,9 @@ Three layers (docs/architecture/serving.md):
   drains in-flight work.
 * :mod:`registry` — :class:`ModelRegistry`, multi-model tenancy: N models
   served from one process, each with its own program store and optional
-  serving weight dtype (bf16).
+  serving weight dtype (bf16, or int8 weight-only through the fused
+  dequant-matmul door — ``docs/architecture/serving.md``'s dtype
+  matrix).
 
 The decode plane (docs/architecture/decode_engine.md) adds
 autoregressive generation on the same registry: :mod:`program_store`'s
@@ -33,7 +35,8 @@ rows on CPU in CI — and, for the decode plane, the tokens/sec + TTFT +
 inter-token-latency generation protocol.
 """
 from .program_store import (GenerativeProgramStore, ProgramStore,
-                            bucket_edges, bucket_for)
+                            bucket_edges, bucket_for, host_sample,
+                            sample_tokens)
 from .registry import ModelRegistry
 from .scheduler import (FutureCompleter, ServeClosed, ServeRequest,
                         ServeTimeout, ServingEngine)
@@ -43,6 +46,7 @@ from .loadgen import (OpenLoopSchedule, generation_protocol,
 
 __all__ = [
     "ProgramStore", "GenerativeProgramStore", "bucket_edges", "bucket_for",
+    "sample_tokens", "host_sample",
     "ModelRegistry",
     "ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
     "FutureCompleter",

@@ -23,9 +23,16 @@ One engine thread owns the loop:
   copy its cache rows into free decode slots;
 * **decode** — one compiled step per model with active slots
   (``serve_decode`` phase): the batch's next-token vector goes in, the
-  donated KV cache is updated in place, next-token logits come out;
-  sampling (greedy, or seeded temperature/top-k per request) happens
-  host-side on the tiny ``(slots, vocab)`` logit matrix;
+  donated KV cache is updated in place, and — in the default
+  ``MXNET_SERVE_SAMPLE=graph`` mode — sampling (greedy, or seeded
+  temperature/top-k per request) runs INSIDE the program over per-slot
+  PRNG key state that rides as another donated argument, so the only
+  per-step host transfer is the ``(slots,)`` token vector.
+  ``MXNET_SERVE_SAMPLE=host`` is the escape hatch: the logits-out
+  decode program plus the SAME jitted sampler on the host-fetched
+  ``(slots, vocab)`` matrix — byte-identical token streams, one big
+  fetch per step (``stats()["decode_fetch_elems"]`` counts the
+  difference; the profiler's ``serve_sample`` phase brackets it);
 * **retire** — a sequence hitting its ``eos_id`` or ``max_tokens``
   resolves its Future with a :class:`GenerationResult` (and closes its
   :class:`TokenStream`, if streaming); its slot frees for the next
@@ -33,11 +40,13 @@ One engine thread owns the loop:
 
 The KV cache is registry-owned serving state: it lives beside the
 params on the model's :class:`~.program_store.GenerativeProgramStore`
-(one device-resident copy; ``stats()`` describes it) and is threaded
-through the pure decode programs cache-in/cache-out with donation, so
-the per-step write is an in-place ``dynamic_update_slice`` on the
-resident buffers (donation is skipped on the CPU backend, matching the
-training planes' donation guards).
+(one device-resident copy in the store's ``kv_dtype`` —
+``MXNET_SERVE_KV_DTYPE=bfloat16`` halves the bytes per slot;
+``stats()`` describes it) and is threaded through the pure decode
+programs cache-in/cache-out with donation, so the per-step write is an
+in-place ``dynamic_update_slice`` on the resident buffers (donation is
+skipped on the CPU backend, matching the training planes' donation
+guards).
 
 ``close(drain=True)`` finishes every admitted AND queued generation
 before the thread exits; ``close(drain=False)`` fails everything fast
@@ -133,7 +142,7 @@ class TokenStream:
 
 class _GenRequest:
     __slots__ = ("model", "prompt", "max_tokens", "temperature", "top_k",
-                 "rng", "eos_id", "stream", "future", "deadline",
+                 "seed", "eos_id", "stream", "future", "deadline",
                  "t_submit", "tokens", "token_times", "seq")
 
     def __init__(self, model, prompt, max_tokens, temperature, top_k,
@@ -143,7 +152,7 @@ class _GenRequest:
         self.max_tokens = max_tokens
         self.temperature = temperature
         self.top_k = top_k
-        self.rng = np.random.RandomState(seed)
+        self.seed = int(seed)
         self.eos_id = eos_id
         self.stream = stream
         self.future = future
@@ -155,13 +164,17 @@ class _GenRequest:
 
 
 class _ModelState:
-    """Live decode batch of one model: slot table + the KV cache."""
+    """Live decode batch of one model: slot table + the KV cache +
+    per-slot sampling state (PRNG key chain, temperature, top-k)."""
 
     def __init__(self, store):
         self.store = store
         self.slots = []                      # _GenRequest or None
         self.lengths = np.zeros(0, np.int32)   # cache frontier per slot
         self.next_tok = np.zeros(0, np.int32)  # next token to consume
+        self.temps = np.zeros(0, np.float32)   # <= 0 means greedy
+        self.top_ks = np.zeros(0, np.int32)
+        self.keys = jnp.zeros((0, 2), jnp.uint32)  # threefry key data
         self.cache_k = None
         self.cache_v = None
         self.C = 0                           # current cache bucket
@@ -178,11 +191,16 @@ class _ModelState:
     def describe(self):
         act = self.active()
         d = {"slots": len(self.slots), "active": len(act),
-             "cache_len": self.C}
+             "cache_len": self.C,
+             "sample_mode": self.store.sample_mode}
         if self.cache_k is not None:
-            d["cache_mb"] = round(
-                2 * self.cache_k.size * self.cache_k.dtype.itemsize
-                / 2**20, 3)
+            total = 2 * self.cache_k.size * self.cache_k.dtype.itemsize
+            d["cache_mb"] = round(total / 2**20, 3)
+            d["cache_dtype"] = str(self.cache_k.dtype)
+            # the bf16 claim's measurement: bytes one slot's cache rows
+            # occupy at the current bucket depth (halved vs fp32)
+            if self.slots:
+                d["cache_bytes_per_slot"] = total // len(self.slots)
         return d
 
 
@@ -212,7 +230,18 @@ class GenerationEngine:
                        "decode_steps": 0, "generated_tokens": 0,
                        "finished": 0, "timeouts": 0, "cancelled": 0,
                        "errors": 0, "cache_grows": 0, "slot_grows": 0,
-                       "max_active": 0}
+                       "max_active": 0,
+                       # host elements fetched from decode-step outputs
+                       # (tokens in graph-sampling mode, logits in host
+                       # mode): decode_fetch_elems / decode_steps is
+                       # the per-step fetch footprint the in-graph
+                       # sampler shrinks from (slots, vocab) to
+                       # (slots,) — pinned by tests
+                       "decode_fetch_elems": 0}
+        # high-water cache geometry per model (survives the cache being
+        # dropped when a batch drains — the bf16 bytes-per-slot bench
+        # evidence reads this instead of racing a live batch)
+        self._cache_hwm = {}
         # test seam: (model, seq) admission order; bounded so a
         # long-lived serving process never accumulates it
         self._admit_log = collections.deque(maxlen=4096)
@@ -231,7 +260,10 @@ class GenerationEngine:
         generation cap (>= 1; the prompt+generation total must fit
         ``MXNET_SERVE_KV_MAX``); ``temperature <= 0`` is greedy,
         otherwise seeded temperature sampling over the ``top_k``
-        highest logits (``top_k=0`` = full vocab); ``eos_id`` stops
+        highest logits (``top_k=0`` = full vocab) — the token stream is
+        a pure function of ``seed`` (a per-request threefry key chain,
+        split once per token), identical under in-graph AND host
+        sampling and invariant to batch composition; ``eos_id`` stops
         early; ``stream`` — an optional :class:`TokenStream` receiving
         tokens as they are sampled; ``timeout`` (seconds) bounds
         time-to-admission."""
@@ -265,6 +297,7 @@ class GenerationEngine:
     def stats(self):
         with self._stats_lock:
             out = dict(self._stats)
+            out["cache_hwm"] = dict(self._cache_hwm)
         out["models"] = {m: st.describe()
                          for m, st in dict(self._states).items()}
         return out
@@ -382,10 +415,29 @@ class GenerationEngine:
         with self._stats_lock:
             self._stats["prefills"] += 1
             self._stats["prefill_seqs"] += len(group)
+        # first generated token (the TTFT moment): one shared-sampler
+        # call over the FULL prefill bucket's rows (pad rows sample
+        # junk harmlessly — constant shapes mean the jitted sampler
+        # compiles once per batch bucket, never inside steady-state
+        # admissions) with each request's INITIAL key; the carry keys
+        # seed the per-slot chains, so decode steps — in-graph or
+        # host — continue the same deterministic stream
+        from .program_store import host_sample
+        bb = logits.shape[0]
+        keys0 = np.zeros((bb, 2), np.uint32)
+        temps0 = np.zeros((bb,), np.float32)
+        tks0 = np.zeros((bb,), np.int32)
+        for i, r in enumerate(group):
+            keys0[i] = np.asarray(jax.random.PRNGKey(r.seed))
+            temps0[i] = r.temperature
+            tks0[i] = r.top_k
+        first_toks, carry = host_sample(logits, keys0, temps0, tks0)
+        first_toks = np.asarray(first_toks)
+        carry = np.asarray(carry)
         survivors = []
         for i, r in enumerate(group):
             self._admit_log.append((model, r.seq))
-            tok = self._sample(logits[i], r)
+            tok = int(first_toks[i])
             self._push_token(r, tok)
             if self._finished_reason(r, tok):
                 self._finish(r, self._finished_reason(r, tok))
@@ -405,15 +457,30 @@ class GenerationEngine:
             st.C = Cp
         elif Cp > st.C:
             self._grow_cache(st, store.kv_bucket(Cp))
+        # np.array COPIES: asarray of a jax array is a read-only view
+        slot_keys = np.array(st.keys, np.uint32)
         for i, r in survivors:
             slot = st.free_slot()
             self._admit_row(st, pk, pv, i, slot)
             st.slots[slot] = r
             st.lengths[slot] = len(r.prompt)
             st.next_tok[slot] = r.tokens[-1]
+            st.temps[slot] = r.temperature
+            st.top_ks[slot] = r.top_k
+            slot_keys[slot] = carry[i]
+        st.keys = jnp.asarray(slot_keys)
+        self._note_cache_hwm(model, st)
         with self._stats_lock:
             if len(st.active()) > self._stats["max_active"]:
                 self._stats["max_active"] = len(st.active())
+
+    def _note_cache_hwm(self, model, st):
+        d = st.describe()
+        with self._stats_lock:
+            prev = self._cache_hwm.get(model)
+            if prev is None or d.get("cache_mb", 0.0) >= \
+                    prev.get("cache_mb", 0.0):
+                self._cache_hwm[model] = d
 
     def _admit_row(self, st, pk, pv, row, slot):
         """Copy one prefilled sequence's cache rows into a decode slot
@@ -448,6 +515,12 @@ class GenerationEngine:
             [st.lengths, np.zeros(grow, np.int32)])
         st.next_tok = np.concatenate(
             [st.next_tok, np.zeros(grow, np.int32)])
+        st.temps = np.concatenate(
+            [st.temps, np.zeros(grow, np.float32)])
+        st.top_ks = np.concatenate(
+            [st.top_ks, np.zeros(grow, np.int32)])
+        st.keys = jnp.concatenate(
+            [st.keys, jnp.zeros((grow, 2), jnp.uint32)])
         if st.cache_k is not None:
             pad = ((0, 0), (0, grow), (0, 0), (0, 0), (0, 0))
             st.cache_k = jnp.pad(st.cache_k, pad)
@@ -462,6 +535,7 @@ class GenerationEngine:
         st.C = new_c
         with self._stats_lock:
             self._stats["cache_grows"] += 1
+        self._note_cache_hwm(st.store.name, st)
 
     # -- decode --------------------------------------------------------
     def _decode_tick(self):
@@ -479,8 +553,7 @@ class GenerationEngine:
             toks = np.ascontiguousarray(st.next_tok)
             lens = np.ascontiguousarray(st.lengths)
             try:
-                logits = np.asarray(
-                    self._dispatch_decode(st, toks, lens))
+                sampled = self._decode_and_sample(st, toks, lens)
             except BaseException as e:  # noqa: BLE001 — to the futures
                 exc = e if isinstance(e, MXNetError) \
                     else MXNetError("decode dispatch failed: %r" % (e,))
@@ -492,7 +565,7 @@ class GenerationEngine:
             for i in act:
                 r = st.slots[i]
                 st.lengths[i] += 1
-                tok = self._sample(logits[i], r)
+                tok = int(sampled[i])
                 self._push_token(r, tok)
                 st.next_tok[i] = tok
                 reason = self._finished_reason(r, tok)
@@ -500,10 +573,48 @@ class GenerationEngine:
                     st.slots[i] = None
                     st.lengths[i] = 0
                     st.next_tok[i] = 0
+                    st.temps[i] = 0.0
+                    st.top_ks[i] = 0
                     self._finish(r, reason)
             with self._stats_lock:
                 self._stats["decode_steps"] += 1
                 self._stats["generated_tokens"] += len(act)
+
+    def _decode_and_sample(self, st, toks, lens):
+        """One decode step + one token per slot, host-side np result.
+
+        ``graph`` mode dispatches the sampling decode program (tokens
+        out; the per-slot PRNG keys are donated alongside the caches
+        and rebound) and fetches ONLY the ``(slots,)`` token vector;
+        ``host`` mode dispatches the logits program, fetches the whole
+        ``(slots, vocab)`` matrix and runs the SAME jitted sampler on
+        it.  Either way the fetch + sampling is bracketed by the
+        ``serve_sample`` phase and counted in ``decode_fetch_elems``."""
+        if st.store.sample_mode == "graph":
+            toks_dev = self._dispatch_decode_sample(st, toks, lens)
+            t0 = time.perf_counter_ns()
+            sampled = self._fetch_decode(toks_dev)
+            _profiler.record_phase("serve_sample", t0)
+            return sampled
+        logits_dev = self._dispatch_decode(st, toks, lens)
+        t0 = time.perf_counter_ns()
+        logits = self._fetch_decode(logits_dev)
+        from .program_store import host_sample
+        toks_out, st.keys = host_sample(logits, st.keys, st.temps,
+                                        st.top_ks)
+        sampled = np.asarray(toks_out)
+        _profiler.record_phase("serve_sample", t0)
+        return sampled
+
+    def _fetch_decode(self, arr):
+        """THE host fetch of the decode loop — one np conversion whose
+        element count feeds ``decode_fetch_elems`` (the zero-logits-
+        fetch acceptance pin reads it; tests also spy the shapes
+        here)."""
+        a = np.asarray(arr)
+        with self._stats_lock:
+            self._stats["decode_fetch_elems"] += int(a.size)
+        return a
 
     @hot_path
     def _dispatch_prefill(self, store, tokens, lengths):
@@ -516,29 +627,30 @@ class GenerationEngine:
 
     @hot_path
     def _dispatch_decode(self, st, tokens, lengths):
-        """Enqueue-only decode-step dispatch (serve_decode phase).  The
-        donated caches are rebound to the program's outputs before
-        anything can read the consumed buffers."""
+        """Enqueue-only logits-out decode dispatch (serve_decode phase;
+        the MXNET_SERVE_SAMPLE=host hatch).  The donated caches are
+        rebound to the program's outputs before anything can read the
+        consumed buffers."""
         t0 = time.perf_counter_ns()
         logits, st.cache_k, st.cache_v = st.store.run_decode(
             st.cache_k, st.cache_v, tokens, lengths)
         _profiler.record_phase("serve_decode", t0)
         return logits
 
-    # -- sampling / retirement -----------------------------------------
-    @staticmethod
-    def _sample(row, req):
-        if req.temperature <= 0.0:
-            return int(np.argmax(row))
-        z = row.astype(np.float64) / req.temperature
-        if req.top_k and req.top_k < z.size:
-            kth = np.partition(z, -req.top_k)[-req.top_k]
-            z = np.where(z >= kth, z, -np.inf)
-        z -= z.max()
-        p = np.exp(z)
-        p /= p.sum()
-        return int(req.rng.choice(z.size, p=p))
+    @hot_path
+    def _dispatch_decode_sample(self, st, tokens, lengths):
+        """Enqueue-only sampling decode dispatch (serve_decode phase):
+        tokens come out sampled in-graph; the donated caches AND the
+        per-slot PRNG key state are rebound to the program's outputs."""
+        t0 = time.perf_counter_ns()
+        toks, st.cache_k, st.cache_v, st.keys = \
+            st.store.run_decode_sample(st.cache_k, st.cache_v, tokens,
+                                       lengths, st.keys, st.temps,
+                                       st.top_ks)
+        _profiler.record_phase("serve_decode", t0)
+        return toks
 
+    # -- retirement ----------------------------------------------------
     @staticmethod
     def _finished_reason(req, tok):
         if req.eos_id is not None and tok == req.eos_id:

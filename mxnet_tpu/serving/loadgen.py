@@ -18,11 +18,11 @@ completion timestamps are taken AFTER a dependent-byte host fetch
 on a waiter thread, never on the engine thread.
 
 :func:`latency_protocol` is the full bench protocol shared by
-``bench.py``'s ``serving.latency.{fp32,bf16}`` rows, ``make serve-smoke``
-and the tests: measure per-request ``Predictor.forward`` closed-loop
-(service latency + capacity), then drive BOTH a per-request server and
-the continuous batcher under the same seeded open-loop schedule at a
-multiple of that capacity.
+``bench.py``'s ``serving.latency.{fp32,bf16,int8}`` rows,
+``make serve-smoke`` and the tests: measure per-request
+``Predictor.forward`` closed-loop (service latency + capacity), then
+drive BOTH a per-request server and the continuous batcher under the
+same seeded open-loop schedule at a multiple of that capacity.
 """
 from __future__ import annotations
 
@@ -262,9 +262,10 @@ def latency_protocol(mode="fp32", smoke=False, seed=11, offered_mult=6.0,
        ``offered_mult x C`` — shows queueing collapse (p99 explodes,
        achieved QPS saturates at ~C).
     3. **Continuous batcher**: registry + ServingEngine (same weights,
-       ``mode`` = 'fp32' or 'bf16' serving dtype) under the SAME
-       schedule — achieved QPS tracks the offered load with p99 far
-       below the saturated baseline.
+       ``mode`` = 'fp32', 'bf16' or 'int8' serving dtype — int8 is
+       weight-only through the fused dequant-matmul door) under the
+       SAME schedule — achieved QPS tracks the offered load with p99
+       far below the saturated baseline.
 
     Returns ``{"serial_closed", "serial_open", "batch", ...}`` with
     ``qps_vs_per_request`` = batcher achieved QPS / open-loop baseline
@@ -274,8 +275,9 @@ def latency_protocol(mode="fp32", smoke=False, seed=11, offered_mult=6.0,
     from .registry import ModelRegistry
     from .scheduler import ServingEngine
 
-    if mode not in ("fp32", "bf16"):
-        raise MXNetError("mode must be fp32 or bf16, got %r" % mode)
+    if mode not in ("fp32", "bf16", "int8"):
+        raise MXNetError("mode must be fp32, bf16 or int8, got %r"
+                         % mode)
     # the model must be COMPUTE-dominated for the row to mean anything:
     # at this size a batch-32 forward costs about the same wall time as
     # batch-1 on CPU (the matmuls stream the weights; extra rows ride
@@ -329,7 +331,8 @@ def latency_protocol(mode="fp32", smoke=False, seed=11, offered_mult=6.0,
     registry = ModelRegistry()
     registry.add_model(
         "m", sym, args, {}, input_shapes={"data": (1, feat)},
-        compute_dtype="bfloat16" if mode == "bf16" else None,
+        compute_dtype={"bf16": "bfloat16", "int8": "int8",
+                       "fp32": None}[mode],
         warmup=True)
     engine = ServingEngine(registry, max_delay_ms=max_delay_ms,
                            max_batch=max_batch)
@@ -482,7 +485,8 @@ class _ReprefillServer:
 
 
 def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
-                        max_tokens_choices=(8, 16)):
+                        max_tokens_choices=(8, 16),
+                        lowprec=("bf16", "int8")):
     """The decode-plane bench protocol (CPU-deterministic).
 
     1. **Re-prefill baseline, closed loop**: generate one request at a
@@ -494,13 +498,25 @@ def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
        TTFT explodes as the queue builds.
     3. **Continuous batching**: :class:`~.decode_engine
        .GenerationEngine` (same weights, same prefill programs, greedy
-       sampling both sides) under the SAME schedule — one decode step
-       advances every in-flight sequence, so tokens/sec scales with the
-       batch instead of saturating at ``C``.
+       sampling both sides, in-graph sampling) under the SAME schedule
+       — one decode step advances every in-flight sequence, so
+       tokens/sec scales with the batch instead of saturating at ``C``.
+    4. **Host-sampling hatch**: the engine again with
+       ``MXNET_SERVE_SAMPLE=host`` on the SAME schedule — the ITL
+       comparison behind the in-graph acceptance ("no worse than host
+       sampling", plus the per-step fetch shrinking from (slots, vocab)
+       logits to (slots,) tokens).
+    5. **Low-precision sides** (``lowprec``): ``bf16`` = bf16 weights
+       AND bf16 KV cache (cache bytes per slot halved — the engine's
+       cache high-water stats carry the evidence), ``int8`` = int8
+       weight-only through the fused dequant-matmul door (~4x less
+       resident weight memory — the store's ``weight_bytes`` stats
+       carry it), each on the SAME schedule.
 
-    Returns a dict with both loadgen summaries and
-    ``tokens_per_sec_vs_reprefill`` (the >= 2x acceptance figure) and
-    ``ttft_p99_vs_reprefill``."""
+    Returns a dict with every side's loadgen summary (+ engine/store
+    stats), ``tokens_per_sec_vs_reprefill`` (the >= 2x acceptance
+    figure), ``ttft_p99_vs_reprefill`` and
+    ``itl_mean_vs_host_sample``."""
     from ..models.transformer_lm import lm_spec, random_params
     from .decode_engine import GenerationEngine
     from .registry import ModelRegistry
@@ -522,11 +538,44 @@ def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
     prompts = [list(rs.randint(0, 128, rs.randint(4, 9)))
                for _ in range(max(n_load, n_closed))]
 
+    def make_store(registry, **dtype_kwargs):
+        return registry.add_generative_model(
+            "m", params, spec, batch_buckets=batch_buckets,
+            prompt_buckets=prompt_buckets, kv_block=kv_block,
+            kv_max=kv_max, warmup_kv_depth=kv_max, **dtype_kwargs)
+
+    def run_engine_side(schedule, warm_schedule, **dtype_kwargs):
+        """One engine deployment (own registry/store in the requested
+        dtypes) driven over the shared seeded schedule.  Before the
+        measured run the SAME engine serves a short unbanked warm
+        schedule through the same loadgen machinery — every side
+        measures equally warm (the first side otherwise absorbs
+        process-wide one-time costs and loses ~2x on ITL, which would
+        poison the graph-vs-host and lowprec-vs-fp32 comparisons)."""
+        reg = ModelRegistry()
+        store = make_store(reg, **dtype_kwargs)
+        engine = GenerationEngine(reg)
+        try:
+            for f in [engine.submit("m", prompts[i % len(prompts)],
+                                    max_tokens=4)
+                      for i in range(batch_buckets[-1])]:
+                f.result(120)  # warm the batched decode path
+            run_gen_loadgen(
+                lambda i, mt_: engine.submit(
+                    "m", prompts[i % len(prompts)], max_tokens=mt_),
+                warm_schedule)
+            side = run_gen_loadgen(
+                lambda i, mt_: engine.submit(
+                    "m", prompts[i % len(prompts)], max_tokens=mt_),
+                schedule)
+            side["engine"] = engine.stats()
+            side["store"] = store.stats()
+        finally:
+            engine.close()
+        return side
+
     registry = ModelRegistry()
-    store = registry.add_generative_model(
-        "m", params, spec, batch_buckets=batch_buckets,
-        prompt_buckets=prompt_buckets, kv_block=kv_block, kv_max=kv_max,
-        warmup_kv_depth=kv_max)
+    store = make_store(registry)
 
     # 1. closed-loop baseline capacity (warm: programs are pre-warmed,
     # but the first dispatch still initializes runtime state)
@@ -550,23 +599,34 @@ def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
     finally:
         baseline.close()
 
-    # 3. continuous batching on the SAME schedule
-    engine = GenerationEngine(registry)
-    try:
-        for f in [engine.submit("m", prompts[i % len(prompts)],
-                                max_tokens=4)
-                  for i in range(batch_buckets[-1])]:
-            f.result(120)  # warm the batched decode path
-        batch = run_gen_loadgen(
-            lambda i, mt_: engine.submit(
-                "m", prompts[i % len(prompts)], max_tokens=mt_),
-            schedule)
-        batch["engine"] = engine.stats()
-    finally:
-        engine.close()
+    # the unbanked per-side warm pass (run_engine_side docstring)
+    warm_schedule = OpenLoopSchedule(seed + 101, max(8, n_load // 4),
+                                     offered,
+                                     gen_tokens=max_tokens_choices)
+
+    # 3. continuous batching on the SAME schedule (in-graph sampling
+    # is the default)
+    batch = run_engine_side(schedule, warm_schedule)
+
+    # 4. the host-sampling escape hatch on the SAME schedule
+    host_side = run_engine_side(schedule, warm_schedule, sample="host")
+
+    # 5. low-precision sides on the SAME schedule
+    sides = {}
+    for mode in lowprec or ():
+        if mode == "bf16":
+            sides["bf16"] = run_engine_side(
+                schedule, warm_schedule, compute_dtype="bfloat16",
+                kv_dtype="bfloat16")
+        elif mode == "int8":
+            sides["int8"] = run_engine_side(schedule, warm_schedule,
+                                            compute_dtype="int8")
+        else:
+            raise MXNetError("unknown lowprec mode %r" % (mode,))
+
     ratio = (batch["tokens_per_sec"] / serial_open["tokens_per_sec"]
              if serial_open["tokens_per_sec"] else None)
-    return {
+    out = {
         "seed": seed,
         "spec": spec,
         "kv_block": kv_block,
@@ -577,9 +637,16 @@ def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
         "offered_mult": float(offered_mult),
         "reprefill_open": serial_open,
         "batch": batch,
+        "host_sample": host_side,
         "tokens_per_sec_vs_reprefill": round(ratio, 3) if ratio else None,
         "ttft_p99_vs_reprefill": (
             round(batch["ttft_p99_ms"] / serial_open["ttft_p99_ms"], 4)
             if batch["ttft_p99_ms"] and serial_open["ttft_p99_ms"]
             else None),
+        "itl_mean_vs_host_sample": (
+            round(batch["itl_mean_ms"] / host_side["itl_mean_ms"], 4)
+            if batch["itl_mean_ms"] and host_side["itl_mean_ms"]
+            else None),
     }
+    out.update(sides)
+    return out

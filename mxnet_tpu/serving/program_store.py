@@ -26,7 +26,12 @@ constants like ``deploy.py``'s export), so all buckets share one
 device-resident copy of the weights and a model upgrade swaps arrays
 without recompiling.  ``compute_dtype='bfloat16'`` casts the floating
 weights once at load (half the serving memory) and casts inputs inside
-the program; outputs always come back float32.
+the program; ``compute_dtype='int8'`` quantizes the FullyConnected
+weights once at load into ``(int8 codes, fp32 scales)`` pairs (~4x
+less resident weight memory — ``stats()["weight_bytes"]`` measures it)
+that dequantize INSIDE the programs through the fused dequant-matmul
+door (``pallas_ops/dequant_matmul.py``; dense XLA twin off the kernel
+route); outputs always come back float32.
 """
 from __future__ import annotations
 
@@ -41,9 +46,10 @@ import numpy as np
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, get_env, hot_path
 from ..pallas_ops import dispatch as _pallas_dispatch
+from ..pallas_ops.dequant_matmul import QuantizedWeight, quantize_int8
 
 __all__ = ["ProgramStore", "GenerativeProgramStore", "bucket_edges",
-           "bucket_for"]
+           "bucket_for", "sample_tokens", "host_sample"]
 
 log = logging.getLogger(__name__)
 
@@ -78,6 +84,81 @@ def _as_device_array(v):
     return data if isinstance(data, jax.Array) else jnp.asarray(data)
 
 
+def _fc_weight_only_params(symbol):
+    """Variables consumed EXCLUSIVELY as FullyConnected weight inputs —
+    the int8-quantizable set of a symbol graph.  Any other consumer
+    (a norm, an elementwise op, an output head) would receive the
+    ``(codes, scales)`` pair it does not understand, so shared
+    variables stay full precision."""
+    fc_w, other = set(), set()
+    for node in symbol._nodes():
+        if node.is_variable:
+            continue
+        is_fc = node.op.name == "FullyConnected"
+        for idx, (s, _oi) in enumerate(node.arg_inputs()):
+            if s.is_variable:
+                (fc_w if is_fc and idx == 1 else other).add(s.name)
+    for n, _oi in symbol._outputs:
+        if n.is_variable:
+            other.add(n.name)
+    return fc_w - other
+
+
+def _weight_bytes(tree):
+    """Resident bytes of a param/aux pytree grouped by storage dtype —
+    the measurement behind the int8 ~4x / bf16 2x weight-memory claims
+    (``stats()["weight_bytes"]``; the bench rows read this instead of
+    recomputing)."""
+    by_dtype = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = str(leaf.dtype)
+        by_dtype[dt] = by_dtype.get(dt, 0) + \
+            int(leaf.size) * int(leaf.dtype.itemsize)
+    return {"total": sum(by_dtype.values()), "by_dtype": by_dtype}
+
+
+# ---------------------------------------------------------------------------
+# Token sampling: ONE pure function for both serving modes.
+# ---------------------------------------------------------------------------
+def sample_tokens(logits, keys, temps, top_ks):
+    """One sampling step over a ``(S, V)`` logits batch.
+
+    Per slot: ``temps[s] <= 0`` is greedy (argmax); otherwise seeded
+    temperature sampling over the ``top_ks[s]`` highest logits
+    (``top_ks[s] <= 0`` = full vocab) via ``jax.random.categorical``.
+    ``keys`` is the per-slot threefry key data ``(S, 2) uint32``, split
+    once per step (counter-based, so the stream is a pure function of
+    the request seed and the step index); returns ``(tokens (S,) int32,
+    new_keys (S, 2))``.
+
+    PURE and shared: the SAME body traces into the ``decode_sample``
+    program (in-graph sampling, ``MXNET_SERVE_SAMPLE=graph``) and jits
+    standalone over host-fetched logits for the ``host`` escape hatch —
+    identical ops on identical values, so the two modes emit
+    byte-identical token streams from the same seeds (pinned)."""
+    logits = jnp.asarray(logits, jnp.float32)
+    n_vocab = logits.shape[-1]
+    keys = jnp.asarray(keys, jnp.uint32)
+    temps = jnp.asarray(temps, jnp.float32)
+    top_ks = jnp.asarray(top_ks, jnp.int32)
+    pairs = jax.vmap(jax.random.split)(keys)        # (S, 2, 2)
+    carry, use = pairs[:, 0], pairs[:, 1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    z = logits / jnp.maximum(temps, 1e-6)[:, None]
+    k = jnp.clip(jnp.where(top_ks <= 0, n_vocab, top_ks), 1, n_vocab)
+    kth = jnp.take_along_axis(-jnp.sort(-z, axis=-1),
+                              (k - 1)[:, None], axis=-1)
+    z = jnp.where(z >= kth, z, -jnp.inf)
+    sampled = jax.vmap(jax.random.categorical)(use, z).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled), carry
+
+
+# the host escape hatch's sampler: the same function, jitted standalone
+# (jax re-specializes per logits shape; the decode engine calls it on
+# the fetched (slots, vocab) matrix)
+host_sample = jax.jit(sample_tokens)
+
+
 class _Program:
     __slots__ = ("fn", "bucket", "out_batch_major", "compile_ms")
 
@@ -107,8 +188,11 @@ class ProgramStore:
         Cache-key / diagnostics tag.
     compute_dtype : str, optional
         ``'bfloat16'`` casts floating weights once at load and inputs
-        inside the program; outputs return float32.  None = master
-        dtype (fp32 bit-equal serving).
+        inside the program; ``'int8'`` quantizes the FC weights once at
+        load (scale-per-row symmetric, ``quantize_int8``) into
+        ``(codes, scales)`` program arguments that dequantize in-graph
+        through the fused dequant-matmul door; outputs return float32
+        either way.  None = master dtype (fp32 bit-equal serving).
     buckets : iterable of int, optional
         Bucket edges; overrides ``MXNET_SERVE_BUCKETS``.
     max_programs : int, optional
@@ -127,7 +211,15 @@ class ProgramStore:
         self._symbol = symbol
         self.name = name
         self._edges = bucket_edges(buckets)
-        self._cdt = jnp.dtype(compute_dtype) if compute_dtype else None
+        self._quant8 = str(compute_dtype).lower() == "int8" \
+            if compute_dtype else False
+        self._cdt = (None if self._quant8 or not compute_dtype
+                     else jnp.dtype(compute_dtype))
+        # cache-key / stats tag for the serving dtype (int8 has no jnp
+        # compute dtype — activations stay fp32, weights are codes)
+        self._dtype_tag = ("int8" if self._quant8 else
+                           str(self._cdt) if self._cdt is not None
+                           else None)
         self._input_names = list(input_shapes)
         if not self._input_names:
             raise MXNetError("serving needs at least one input")
@@ -173,8 +265,23 @@ class ProgramStore:
                            if n not in input_shapes
                            and n not in arg_params]
 
-        def load(v):
+        # int8: quantize exactly the variables every consumer of which
+        # is a FullyConnected WEIGHT input (the matmul door understands
+        # the pair; nothing else does) — in an MLP/classifier head that
+        # is the overwhelming share of the bytes
+        quant_names = (_fc_weight_only_params(symbol) if self._quant8
+                       else frozenset())
+
+        def load(v, name=None):
             a = _as_device_array(v)
+            if name in quant_names and a.ndim == 2 and \
+                    jnp.issubdtype(a.dtype, jnp.floating):
+                codes, scales = quantize_int8(np.asarray(a))
+                c, s = jnp.asarray(codes), jnp.asarray(scales)
+                if device is not None:
+                    c = jax.device_put(c, device)
+                    s = jax.device_put(s, device)
+                return QuantizedWeight(c, s)
             if self._cdt is not None and a.dtype != self._cdt and \
                     jnp.issubdtype(a.dtype, jnp.floating):
                 a = a.astype(self._cdt)
@@ -184,7 +291,8 @@ class ProgramStore:
                 a = jax.device_put(a, device)
             return a
 
-        self._params = {n: load(arg_params[n]) for n in self._param_names}
+        self._params = {n: load(arg_params[n], n)
+                        for n in self._param_names}
         aux = []
         # aux states missing from the checkpoint keep predictor.py's
         # policy: zero-filled at their inferred shape
@@ -280,8 +388,7 @@ class ProgramStore:
         # through the op-lowering seam, and this LRU outlives an
         # MXNET_PALLAS flip — the escape hatch must recompile, not
         # serve the stale lowering
-        return ("serve", self.name, bucket, sig,
-                str(self._cdt) if self._cdt is not None else None,
+        return ("serve", self.name, bucket, sig, self._dtype_tag,
                 _pallas_dispatch.fingerprint())
 
     def _build_forward(self, bucket):
@@ -464,7 +571,8 @@ class ProgramStore:
             out["buckets_resident"] = sorted(
                 p.bucket for p in self._programs.values())
         out["edges"] = list(self._edges)
-        out["compute_dtype"] = str(self._cdt) if self._cdt else None
+        out["compute_dtype"] = self._dtype_tag
+        out["weight_bytes"] = _weight_bytes((self._params, self._aux))
         return out
 
     def reset_stats(self):
@@ -521,6 +629,27 @@ class GenerativeProgramStore:
     kv_block / kv_max : int, optional
         Cache-length quantum and cap; default ``MXNET_SERVE_KV_BLOCK``
         / ``MXNET_SERVE_KV_MAX``.
+    compute_dtype : str, optional
+        None (fp32, the parity baseline), ``'bfloat16'`` (weights cast
+        once at load, decode-mode compute follows them, logits return
+        fp32) or ``'int8'`` (matmul weights quantized once at load into
+        ``(codes, scales)`` pairs — ``transformer_lm.
+        quantize_lm_params`` — dequantized in-program through the fused
+        dequant-matmul door; ~4x less resident weight memory).
+    kv_dtype : str, optional
+        KV-cache element dtype: ``'float32'`` or ``'bfloat16'``
+        (halves cache bytes per slot, so the same ``MXNET_SERVE_KV_
+        MAX`` memory budget holds twice the concurrent sequences);
+        default ``MXNET_SERVE_KV_DTYPE``.  Attention over the cache
+        accumulates fp32 in the kernel AND the dense twin regardless.
+    sample : str, optional
+        ``'graph'`` (default via ``MXNET_SERVE_SAMPLE``) compiles
+        sampling INTO the decode programs (``decode_sample`` kind:
+        per-slot PRNG keys ride as a donated argument, the host fetch
+        shrinks from (slots, vocab) logits to (slots,) tokens);
+        ``'host'`` keeps the logits-returning decode programs — the
+        escape hatch, byte-identical token streams (shared
+        :func:`sample_tokens`).
     max_programs : int, optional
         LRU bound; default is sized to hold every warmable program
         (never smaller than ``MXNET_SERVE_PROGRAM_CACHE``).
@@ -530,11 +659,34 @@ class GenerativeProgramStore:
 
     def __init__(self, params, spec, name="lm", batch_buckets=None,
                  prompt_buckets=None, kv_block=None, kv_max=None,
+                 compute_dtype=None, kv_dtype=None, sample=None,
                  max_programs=None, device=None):
         from ..models.transformer_lm import lm_spec
         self._spec = lm_spec(**dict(spec))  # validates + canonicalizes
         self.name = name
         self._device = device
+        self._compute = None
+        if compute_dtype:
+            c = str(compute_dtype).lower()
+            if c in ("float32", "fp32"):
+                c = None
+            elif c not in ("bfloat16", "int8"):
+                raise MXNetError(
+                    "generative compute_dtype must be None/'float32'/"
+                    "'bfloat16'/'int8', got %r" % compute_dtype)
+            self._compute = c
+        kv = str(kv_dtype if kv_dtype is not None
+                 else get_env("MXNET_SERVE_KV_DTYPE") or "float32")
+        if kv not in ("float32", "bfloat16"):
+            raise MXNetError("kv_dtype must be 'float32' or 'bfloat16', "
+                             "got %r" % kv)
+        self.kv_dtype = jnp.dtype(kv)
+        sm = str(sample if sample is not None
+                 else get_env("MXNET_SERVE_SAMPLE") or "graph").lower()
+        if sm not in ("graph", "host"):
+            raise MXNetError("MXNET_SERVE_SAMPLE must be 'graph' or "
+                             "'host', got %r" % sm)
+        self.sample_mode = sm
         self._batch_edges = bucket_edges(batch_buckets)
         self._prompt_edges = bucket_edges(
             prompt_buckets, env_var="MXNET_SERVE_PROMPT_BUCKETS")
@@ -550,17 +702,39 @@ class GenerativeProgramStore:
                 "largest prompt bucket (%d) exceeds MXNET_SERVE_KV_MAX "
                 "(%d)" % (self._prompt_edges[-1], self.kv_max))
 
-        def load(v):
-            a = _as_device_array(v)
-            if device is not None:
-                a = jax.device_put(a, device)
-            return a
-
         missing = [k for k in self._required_params() if k not in params]
         if missing:
             raise MXNetError("generative model %r is missing params %s"
                              % (name, missing))
-        self._params = {k: load(v) for k, v in params.items()}
+
+        def load(v):
+            a = _as_device_array(v)
+            if self._compute == "bfloat16" and \
+                    jnp.issubdtype(a.dtype, jnp.floating) and \
+                    a.dtype != jnp.bfloat16:
+                a = a.astype(jnp.bfloat16)
+            if device is not None:
+                a = jax.device_put(a, device)
+            return a
+
+        if self._compute == "int8":
+            from ..models.transformer_lm import quantize_lm_params
+            host = {k: np.asarray(_as_device_array(v), np.float32)
+                    if jnp.issubdtype(_as_device_array(v).dtype,
+                                      jnp.floating) else v
+                    for k, v in params.items()}
+            self._params = {}
+            for k, v in quantize_lm_params(host, self._spec).items():
+                if isinstance(v, QuantizedWeight):
+                    c, s = jnp.asarray(v.codes), jnp.asarray(v.scales)
+                    if device is not None:
+                        c = jax.device_put(c, device)
+                        s = jax.device_put(s, device)
+                    self._params[k] = QuantizedWeight(c, s)
+                else:
+                    self._params[k] = load(v)
+        else:
+            self._params = {k: load(v) for k, v in params.items()}
 
         # one warm sweep must fit the LRU or AOT is a lie (the forward
         # store logs the same hazard; here we just size for it)
@@ -651,7 +825,8 @@ class GenerativeProgramStore:
 
     def new_cache(self, batch, cache_len):
         from ..models.transformer_lm import init_cache
-        k, v = init_cache(self._spec, batch, cache_len)
+        k, v = init_cache(self._spec, batch, cache_len,
+                          dtype=self.kv_dtype)
         if self._device is not None:
             k = jax.device_put(k, self._device)
             v = jax.device_put(v, self._device)
@@ -664,34 +839,41 @@ class GenerativeProgramStore:
         return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
 
     def _param_spec(self):
-        return {k: self._sds(a.shape, a.dtype)
-                for k, a in self._params.items()}
+        # tree_map descends QuantizedWeight pairs to their code/scale
+        # leaves (registered pytree), so int8 params spec like arrays
+        return jax.tree_util.tree_map(
+            lambda a: self._sds(a.shape, a.dtype), self._params)
 
     def _cache_spec(self, batch, cache_len):
         s = self._spec
         dh = s["num_hidden"] // s["num_heads"]
         shape = (s["num_layers"], batch, s["num_heads"],
                  int(cache_len), dh)
-        return self._sds(shape, jnp.float32)
+        return self._sds(shape, self.kv_dtype)
 
     def _key(self, kind, bb, lb):
-        # (kind, batch bucket, length bucket) + the dispatch fingerprint
-        # (prefill/decode trace through sdp_attention and the rowwise
-        # norm kernels — an MXNET_PALLAS flip must recompile, not serve
-        # the stale lowering)
+        # (kind, batch bucket, length bucket) + the serving dtypes +
+        # the dispatch fingerprint (prefill/decode trace through
+        # sdp_attention, the rowwise norm kernels and the dequant-
+        # matmul door — an MXNET_PALLAS flip must recompile, not serve
+        # the stale lowering; the dtypes are per-store constants, in
+        # the key as insurance)
         return ("gen", self.name, kind, int(bb), int(lb),
+                self._compute, str(self.kv_dtype),
                 _pallas_dispatch.fingerprint())
 
     def _compile(self, kind, bb, lb):
         from ..models.transformer_lm import decode_apply, prefill_apply
         tic = time.perf_counter()
         spec = self._spec
+        kv = self.kv_dtype
         if kind == "prefill":
             cache_len = self.kv_bucket(lb)
 
             def fn(params, tokens, lengths):
                 logits, ck, cv = prefill_apply(params, tokens, lengths,
-                                               cache_len, spec)
+                                               cache_len, spec,
+                                               cache_dtype=kv)
                 first = logits[jnp.arange(bb), (lengths - 1)
                                .astype(jnp.int32)]
                 return first, ck, cv
@@ -700,7 +882,30 @@ class GenerativeProgramStore:
                     self._sds((bb, lb), jnp.int32),
                     self._sds((bb,), jnp.int32))
             compiled = jax.jit(fn).lower(*args).compile()
-        else:  # decode
+        elif kind == "decode_sample":
+            # in-graph sampling: the decode step emits TOKENS, not
+            # logits — per-slot PRNG keys ride beside the caches and
+            # are donated with them (split in-graph each step)
+
+            def fn(params, cache_k, cache_v, tokens, lengths, keys,
+                   temps, top_ks):
+                logits, ck, cv = decode_apply(params, cache_k, cache_v,
+                                              tokens, lengths, spec)
+                toks, new_keys = sample_tokens(logits, keys, temps,
+                                               top_ks)
+                return toks, ck, cv, new_keys
+
+            args = (self._param_spec(),
+                    self._cache_spec(bb, lb), self._cache_spec(bb, lb),
+                    self._sds((bb,), jnp.int32),
+                    self._sds((bb,), jnp.int32),
+                    self._sds((bb, 2), jnp.uint32),
+                    self._sds((bb,), jnp.float32),
+                    self._sds((bb,), jnp.int32))
+            compiled = jax.jit(
+                fn, donate_argnums=cache_donate_argnums((1, 2, 5))) \
+                .lower(*args).compile()
+        else:  # decode (logits out — the MXNET_SERVE_SAMPLE=host hatch)
 
             def fn(params, cache_k, cache_v, tokens, lengths):
                 return decode_apply(params, cache_k, cache_v, tokens,
@@ -758,6 +963,10 @@ class GenerativeProgramStore:
             top = self.kv_bucket(kv_depth)
             cache_buckets.update(
                 range(self.kv_block, top + 1, self.kv_block))
+        # the decode kind the engine will dispatch: tokens-out
+        # (in-graph sampling) or logits-out (the host hatch)
+        dkind = ("decode_sample" if self.sample_mode == "graph"
+                 else "decode")
         for bb in self._batch_edges:
             for pb in self._prompt_edges:
                 prog = self._acquire("prefill", bb, pb)
@@ -768,14 +977,21 @@ class GenerativeProgramStore:
                     jax.block_until_ready(
                         prog.fn(self._params, toks, lens))
             for cb in sorted(cache_buckets):
-                prog = self._acquire("decode", bb, cb)
-                out[("decode", bb, cb)] = prog.compile_ms
+                prog = self._acquire(dkind, bb, cb)
+                out[(dkind, bb, cb)] = prog.compile_ms
                 if execute:
                     ck, cv = self.new_cache(bb, cb)
                     toks = np.zeros((bb,), np.int32)
                     lens = np.zeros((bb,), np.int32)
-                    jax.block_until_ready(
-                        prog.fn(self._params, ck, cv, toks, lens))
+                    if dkind == "decode_sample":
+                        jax.block_until_ready(prog.fn(
+                            self._params, ck, cv, toks, lens,
+                            np.zeros((bb, 2), np.uint32),
+                            np.zeros((bb,), np.float32),
+                            np.zeros((bb,), np.int32)))
+                    else:
+                        jax.block_until_ready(
+                            prog.fn(self._params, ck, cv, toks, lens))
         return out
 
     # -- execution -----------------------------------------------------
@@ -792,13 +1008,28 @@ class GenerativeProgramStore:
 
     @hot_path
     def run_decode(self, cache_k, cache_v, tokens, lengths):
-        """Dispatch one decode step over a bucket-shaped cache.  BOTH
-        cache arguments are consumed (donated) — callers must rebind
-        their references to the returned caches."""
+        """Dispatch one logits-out decode step over a bucket-shaped
+        cache (the ``MXNET_SERVE_SAMPLE=host`` hatch and the test
+        references).  BOTH cache arguments are consumed (donated) —
+        callers must rebind their references to the returned caches."""
         bb = int(tokens.shape[0])
         cb = int(cache_k.shape[3])
         prog = self._acquire("decode", bb, cb)
         return prog.fn(self._params, cache_k, cache_v, tokens, lengths)
+
+    @hot_path
+    def run_decode_sample(self, cache_k, cache_v, tokens, lengths,
+                          keys, temps, top_ks):
+        """Dispatch one decode step with IN-GRAPH sampling: returns
+        ``(tokens (bb,) int32, new_k, new_v, new_keys)``.  The caches
+        AND the per-slot PRNG key state are consumed (donated) —
+        callers rebind all three; the only host-sized fetch left per
+        step is the token vector."""
+        bb = int(tokens.shape[0])
+        cb = int(cache_k.shape[3])
+        prog = self._acquire("decode_sample", bb, cb)
+        return prog.fn(self._params, cache_k, cache_v, tokens, lengths,
+                       keys, temps, top_ks)
 
     def pad_prompts(self, prompts):
         """Host-side canonicalization: a list of token id sequences ->
@@ -833,6 +1064,10 @@ class GenerativeProgramStore:
         out["prompt_buckets"] = list(self._prompt_edges)
         out["kv_block"] = self.kv_block
         out["kv_max"] = self.kv_max
+        out["compute_dtype"] = self._compute
+        out["kv_dtype"] = str(self.kv_dtype)
+        out["sample_mode"] = self.sample_mode
+        out["weight_bytes"] = _weight_bytes(self._params)
         state = self.cache_state
         if state is not None:
             out["cache_state"] = state.describe()

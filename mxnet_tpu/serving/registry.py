@@ -11,7 +11,13 @@ requests for a removed model fail cleanly at dispatch).
 Serving weight dtype: ``compute_dtype='bfloat16'`` (or the
 ``MXNET_SERVE_DTYPE`` default) casts floating weights once at load —
 half the resident memory per tenant, the PR-4 ``compute_dtype`` policy
-applied to the serving plane.
+applied to the serving plane — and ``compute_dtype='int8'`` quantizes
+FC weights once at load into ``(codes, scales)`` program arguments
+(~4x less resident memory, dequantized in-graph through the fused
+dequant-matmul door).  Both apply to generative models too
+(``add_generative_model``), which additionally take ``kv_dtype`` /
+``sample`` (``MXNET_SERVE_KV_DTYPE`` / ``MXNET_SERVE_SAMPLE``) for the
+decode plane's cache precision and sampling placement.
 """
 from __future__ import annotations
 
@@ -103,10 +109,15 @@ class ModelRegistry:
         argument arrays (a ``save_checkpoint``'s arg_params works
         directly); ``spec`` — ``transformer_lm.lm_spec(...)``.  Keyword
         args (``batch_buckets``, ``prompt_buckets``, ``kv_block``,
-        ``kv_max``, ``max_programs``, ``device``) pass through to
-        :class:`GenerativeProgramStore`.  Compiles + executes every
-        prefill/decode bucket program ahead of traffic unless
-        ``warmup=False``.  Returns the store."""
+        ``kv_max``, ``compute_dtype``, ``kv_dtype``, ``sample``,
+        ``max_programs``, ``device``) pass through to
+        :class:`GenerativeProgramStore`; like :meth:`add_model`, an
+        unset ``compute_dtype`` falls back to the ``MXNET_SERVE_DTYPE``
+        default.  Compiles + executes every prefill/decode bucket
+        program ahead of traffic unless ``warmup=False``.  Returns the
+        store."""
+        if kwargs.get("compute_dtype") is None:
+            kwargs["compute_dtype"] = get_env("MXNET_SERVE_DTYPE") or None
         store = GenerativeProgramStore(params, spec, name=name, **kwargs)
         with self._lock:
             if name in self._stores or name in self._gen_stores:

@@ -191,10 +191,19 @@ class ServingEngine:
         return fut
 
     def stats(self):
-        """Scheduler counters plus each model's program-store stats."""
+        """Scheduler counters plus each model's program-store stats,
+        with a cross-model resident-weight rollup by storage dtype (the
+        bf16/int8 memory claims' one-stop measurement — bench rows and
+        serve_smoke read this instead of recomputing)."""
         with self._stats_lock:
             out = dict(self._stats)
         out["models"] = self._registry.stats()
+        rollup = {}
+        for m in out["models"].values():
+            for dt, n in m.get("weight_bytes", {}).get(
+                    "by_dtype", {}).items():
+                rollup[dt] = rollup.get(dt, 0) + n
+        out["weight_bytes_by_dtype"] = rollup
         return out
 
     def close(self, drain=True, timeout=60.0):

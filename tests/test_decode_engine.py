@@ -196,16 +196,19 @@ def test_store_bucket_geometry(registry):
 
 def test_store_warmup_covers_the_served_programs(registry):
     """Every program the engine dispatches in these tests was compiled
-    at warmup — steady-state serving never compiles (AOT promise)."""
+    at warmup — steady-state serving never compiles (AOT promise).
+    The decode kind tracks the store's sample mode: in-graph sampling
+    (the default) serves ``decode_sample`` programs."""
     store = registry.gen_store("m")
     st = store.stats()
     assert st["generative"] is True
+    dkind = "decode_sample" if st["sample_mode"] == "graph" else "decode"
     kinds = {(k, b, c) for k, b, c in st["programs_resident"]}
     for bb in BATCH_BUCKETS:
         for pb in PROMPT_BUCKETS:
             assert ("prefill", bb, pb) in kinds
         for cb in range(KV_BLOCK, store.kv_bucket(KV_MAX) + 1, KV_BLOCK):
-            assert ("decode", bb, cb) in kinds
+            assert (dkind, bb, cb) in kinds
 
 
 def test_store_missing_params_rejected():
@@ -425,7 +428,8 @@ def test_submit_validation(registry):
 
 def test_gen_spans_in_profiler_trace(registry, tmp_path):
     """The decode loop's dispatches emit serve_prefill / serve_decode
-    phases through the step-phase seam."""
+    phases through the step-phase seam, and the per-step token
+    materialization emits serve_sample."""
     trace = str(tmp_path / "gen_trace.json")
     mx.profiler.profiler_set_config(filename=trace)
     mx.profiler.profiler_set_state("run")
@@ -441,6 +445,7 @@ def test_gen_spans_in_profiler_trace(registry, tmp_path):
                  if isinstance(ev, dict)}
     assert "serve_prefill" in names
     assert "serve_decode" in names
+    assert "serve_sample" in names
 
 
 def test_gen_schedule_determinism():

@@ -8,7 +8,13 @@ Runs the shared serving latency protocol
    schedule (the no-batching deployment under overload),
 3. the continuous batcher under the SAME schedule.
 
-Gates (exit 1 on failure):
+``--dtype`` selects the serving dtype (fp32 / bf16 / int8 weight-only
+via the fused dequant-matmul door) or ``all`` to cycle the whole dtype
+matrix through the SAME seeded schedule — one command demonstrates
+fp32, bf16 and int8 serving end to end, printing each side's resident
+weight bytes beside its latency table.
+
+Gates (exit 1 on failure, per dtype):
 
 * the batcher's achieved QPS >= ``--qps-floor`` (default 3.0) times the
   per-request deployment's achieved QPS — the ratio is host-relative, so
@@ -24,6 +30,7 @@ measured numbers, not the schedule.
 Usage::
 
     python tools/serve_smoke.py [--seed 11] [--qps-floor 3.0] [--full]
+        [--dtype fp32|bf16|int8|all]
 """
 from __future__ import annotations
 
@@ -41,21 +48,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--seed", type=int, default=11)
-    ap.add_argument("--qps-floor", type=float, default=3.0,
-                    help="min batcher/per-request achieved-QPS ratio")
-    ap.add_argument("--full", action="store_true",
-                    help="full-size protocol (bench row scale)")
-    ap.add_argument("--mode", default="fp32", choices=("fp32", "bf16"))
-    ap.add_argument("--json", action="store_true",
-                    help="dump the full protocol result as JSON")
-    args = ap.parse_args(argv)
-
+def run_mode(mode, args):
+    """One dtype through the shared protocol; returns the failure list
+    (empty = this side's gates hold)."""
     from mxnet_tpu.serving.loadgen import latency_protocol
-    r = latency_protocol(mode=args.mode, smoke=not args.full,
-                         seed=args.seed)
+    r = latency_protocol(mode=mode, smoke=not args.full, seed=args.seed)
     if args.json:
         print(json.dumps(r, indent=1))
 
@@ -66,8 +63,12 @@ def main(argv=None):
         # — the gate below turns that into a FAIL, not a TypeError
         return ("n/a" if v is None else spec % v).rjust(10)
 
-    print("serve-smoke (%s, seed %d, offered %.0fx capacity)"
-          % (args.mode, args.seed, r["offered_mult"]))
+    wb = b.get("engine", {}).get("weight_bytes_by_dtype", {})
+    print("serve-smoke (%s, seed %d, offered %.0fx capacity, "
+          "resident weights: %s)"
+          % (mode, args.seed, r["offered_mult"],
+             " + ".join("%d B %s" % (n, dt)
+                        for dt, n in sorted(wb.items())) or "?"))
     print("  %-28s %10s %10s %10s" % ("", "qps", "p50 ms", "p99 ms"))
     print("  %-28s %s %s %s"
           % ("per-request closed-loop", f(sc["qps"], "%.1f"),
@@ -100,11 +101,37 @@ def main(argv=None):
         failures.append("batcher p99 %.1fms worse than per-request "
                         "%.1fms at the same offered load"
                         % (b["p99_ms"], so["p99_ms"]))
+    return ["%s: %s" % (mode, msg) for msg in failures]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--qps-floor", type=float, default=3.0,
+                    help="min batcher/per-request achieved-QPS ratio")
+    ap.add_argument("--full", action="store_true",
+                    help="full-size protocol (bench row scale)")
+    ap.add_argument("--dtype", default="fp32",
+                    choices=("fp32", "bf16", "int8", "all"),
+                    help="serving dtype, or 'all' to cycle the whole "
+                         "fp32/bf16/int8 matrix on the same schedule")
+    ap.add_argument("--mode", dest="dtype",
+                    choices=("fp32", "bf16", "int8"),
+                    help=argparse.SUPPRESS)  # pre-dtype-matrix alias
+    ap.add_argument("--json", action="store_true",
+                    help="dump the full protocol result as JSON")
+    args = ap.parse_args(argv)
+
+    modes = (("fp32", "bf16", "int8") if args.dtype == "all"
+             else (args.dtype,))
+    failures = []
+    for mode in modes:
+        failures += run_mode(mode, args)
     if failures:
         for msg in failures:
             print("FAIL: %s" % msg)
         return 1
-    print("serve-smoke: OK")
+    print("serve-smoke: OK (%s)" % ", ".join(modes))
     return 0
 
 
