@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke decode-smoke spmd-smoke kernels-smoke data-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke kernels-smoke data-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -71,6 +71,18 @@ elastic-smoke:
 serve-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 		$(PY) tools/serve_smoke.py --seed 11 --qps-floor 3.0
+
+# serving front-door gate (docs/architecture/serving_frontdoor.md):
+# HTTP endpoint vs in-process on the SAME seeded schedule (zero drops,
+# achieved tracks offered), kill-one-of-3-replicas under load (100% of
+# accepted requests resolve, balancer converges to survivors, post-kill
+# QPS >= 2/3 pre-kill) and hot weight swap under traffic (every
+# response bit-matches exactly one weight version, version counter +1).
+# Hard timeout like the other smokes.
+frontdoor-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+		$(PY) tools/serve_smoke.py --seed 11 --replicas 3 \
+		--http --kill-one --swap
 
 # decode-plane gate (docs/architecture/decode_engine.md): the offset
 # flash kernel vs its dense twin, decode-vs-one-shot logits parity

@@ -917,6 +917,78 @@ def bench_serving_latency(mode, chip, smoke=False):
     return row
 
 
+def bench_serving_frontdoor(which, chip, smoke=False):
+    """Front-door rows (serving/frontdoor.py + replica_set.py, the
+    protocols ``make frontdoor-smoke`` gates on):
+
+    * ``http_overhead`` — the SAME engine under the SAME seeded
+      open-loop schedule, driven in-process and over the HTTP front
+      door (npz transport, persistent connections): the p50/p99 delta
+      is pure front-door cost, measured below either side's
+      saturation.
+    * ``failover`` — 3 shared-nothing replicas behind the least-loaded
+      balancer; a seeded ``die`` at the serve.dispatch faultinject
+      seam SIGKILLs one mid-run.  Acceptance: 100% of accepted
+      requests resolve (zero drops), and post-kill achieved QPS
+      (windowed from one probe interval after the kill) >= 2/3 of the
+      pre-kill steady state."""
+    from mxnet_tpu.serving.loadgen import (failover_protocol,
+                                           frontdoor_protocol)
+
+    if which == "http_overhead":
+        r = frontdoor_protocol(smoke=smoke)
+        h, ip = r["http"], r["inproc"]
+        return {
+            "metric": "serving.frontdoor.http_overhead",
+            "value": h["qps_achieved"], "unit": "qps",
+            "vs_baseline": None,
+            "p50_ms": h["p50_ms"], "p99_ms": h["p99_ms"],
+            "inproc_qps": ip["qps_achieved"],
+            "inproc_p50_ms": ip["p50_ms"], "inproc_p99_ms": ip["p99_ms"],
+            "http_p50_overhead_ms": r["http_p50_overhead_ms"],
+            "http_p99_vs_inproc": r["http_p99_vs_inproc"],
+            "http_qps_vs_inproc": r["http_qps_vs_inproc"],
+            "closed_loop_qps": r["closed_loop_qps"],
+            "http_closed_loop_qps": r["http_closed_loop_qps"],
+            "offered_mult": r["offered_mult"],
+            "n_requests": h["n"],
+            "dropped": h["timeouts"] + h["errors"] + h["cancelled"],
+            "inproc_dropped": ip["timeouts"] + ip["errors"] +
+            ip["cancelled"],
+            "seed": r["seed"],
+            "note": ("one engine, one seeded schedule, two transports: "
+                     "the p50/p99 delta is the HTTP front door's cost "
+                     "(http.server + npz round-trip) below saturation "
+                     "— achieved QPS tracks offered on both sides"),
+        }
+    r = failover_protocol(smoke=smoke)
+    s = r["summary"]
+    return {
+        "metric": "serving.frontdoor.failover",
+        "value": r.get("post_vs_pre_qps"), "unit": "ratio",
+        "vs_baseline": None,
+        "n_replicas": r["n_replicas"],
+        "n_requests": s["n"], "resolved": r["resolved"],
+        "dropped": r["dropped"], "shed": r["shed"],
+        "pre_kill_qps": r.get("pre_kill_qps"),
+        "post_kill_qps": r.get("post_kill_qps"),
+        "recovery_ms": r.get("recovery_ms"),
+        "probe_interval_s": r["probe_interval_s"],
+        "kill_nth_dispatch": r["kill_nth_dispatch"],
+        "failovers": r["failovers"], "retries": r["retries"],
+        "live_after": r["live_after"],
+        "p99_ms": s["p99_ms"],
+        "seed": r["seed"],
+        "note": ("one of %d shared-nothing replicas SIGKILLed by a "
+                 "seeded die at the serve.dispatch seam under open-loop "
+                 "load: every accepted request resolves (dropped=0 is "
+                 "the zero-drop evidence), forwards fail over with "
+                 "backoff onto survivors, and the balancer converges "
+                 "within one probe interval (acceptance: post/pre QPS "
+                 ">= 2/3)" % r["n_replicas"]),
+    }
+
+
 # the generation protocol runs both sides (re-prefill baseline +
 # continuous-batching engine) in one sweep; cache it so the two
 # serving.decode.* rows don't pay it twice
@@ -1939,6 +2011,13 @@ def main():
           smoke)
     guard("serving.latency.int8", bench_serving_latency, "int8", chip,
           smoke)
+    # front-door rows: HTTP transport overhead on the same schedule,
+    # and the kill-one-of-3-replicas failover drain (zero drops,
+    # post-kill QPS recovery)
+    guard("serving.frontdoor.http_overhead", bench_serving_frontdoor,
+          "http_overhead", chip, smoke)
+    guard("serving.frontdoor.failover", bench_serving_frontdoor,
+          "failover", chip, smoke)
     # decode-plane generation rows: continuous batching over the KV
     # cache vs the naive re-prefill-per-token baseline, same seeded
     # open-loop schedule (tokens/sec + TTFT + inter-token latency),
@@ -2048,6 +2127,20 @@ def _assemble_out(rows, chip, smoke, t0):
                 "qps_vs_per_request": r.get("qps_vs_per_request"),
                 "p99_ms": r.get("p99_ms"),
             }
+    r = by_metric.get("serving.frontdoor.http_overhead")
+    if r and r.get("unit") not in ("error", "skipped"):
+        serving["frontdoor"] = {
+            "qps": r["value"],
+            "http_p99_vs_inproc": r.get("http_p99_vs_inproc"),
+            "http_p50_overhead_ms": r.get("http_p50_overhead_ms"),
+        }
+    r = by_metric.get("serving.frontdoor.failover")
+    if r and r.get("unit") not in ("error", "skipped"):
+        serving["failover"] = {
+            "post_vs_pre_qps": r["value"],
+            "dropped": r.get("dropped"),
+            "recovery_ms": r.get("recovery_ms"),
+        }
     r = by_metric.get("serving.decode.continuous")
     if r and r.get("unit") not in ("error", "skipped"):
         serving["decode"] = {

@@ -377,6 +377,46 @@ register_env("MXNET_SERVE_PROMPT_BUCKETS", str, "16,32,64,128",
              "zero-padded up to the smallest edge >= p and runs the "
              "AOT-compiled prefill program for that (batch, prompt) "
              "bucket pair.")
+register_env("MXNET_SERVE_MAX_INFLIGHT", int, 0,
+             "Admission-control budget of a serving engine: the max "
+             "number of accepted-but-unresolved requests (forward or "
+             "generation) it holds before SHEDDING new submits with a "
+             "structured ServeOverloaded (HTTP 429 at the front door) "
+             "instead of queueing them into timeout collapse.  0 "
+             "(default) = unbounded.  Per engine, so per replica in a "
+             "ReplicaSet (serving/replica_set.py).")
+register_env("MXNET_SERVE_PROBE_INTERVAL", float, 0.25,
+             "Health-probe period (seconds) of the serving ReplicaSet's "
+             "prober thread: every interval each replica is probed "
+             "through the serve.dispatch seam and its circuit breaker "
+             "updated — a dead replica leaves the balancer rotation "
+             "within one interval, a recovered one returns.  <= 0 "
+             "disables the prober (tests drive probe_once() directly).")
+register_env("MXNET_SERVE_RETRIES", int, 2,
+             "Failover budget of the serving ReplicaSet: how many times "
+             "one forward request may be re-dispatched onto a surviving "
+             "replica after a retryable failure (replica died, engine "
+             "closed, connection severed) before its last error is "
+             "surfaced.  Forward requests are idempotent; generation "
+             "requests only retry placement failures — once admitted "
+             "they fail fast (their KV state dies with the replica).")
+register_env("MXNET_SERVE_RETRY_BACKOFF", float, 0.02,
+             "Base (seconds) of the ReplicaSet's failover backoff: "
+             "retry k of a failed-over request sleeps "
+             "backoff_delay(k, base, 16*base) (mxnet_tpu/retry.py — "
+             "the kvstore plane's exponential policy math) before "
+             "re-dispatching.")
+register_env("MXNET_SERVE_CB_FAILS", int, 2,
+             "Consecutive dispatch/probe failures that open one serving "
+             "replica's circuit breaker (mxnet_tpu/retry.py "
+             "CircuitBreaker): an open breaker takes the replica out of "
+             "the balancer rotation without paying its failure latency "
+             "per request.")
+register_env("MXNET_SERVE_CB_RESET", float, 1.0,
+             "Cool-down (seconds) before an OPEN serving-replica "
+             "breaker admits one half-open trial (the next probe or "
+             "request): trial success re-closes the breaker and the "
+             "replica rejoins the rotation, failure re-opens it.")
 register_env("MXNET_AUTO_RESUME", str, "",
              "Checkpoint prefix for hands-off crash resume: when set, "
              "Module.fit() with no explicit resume_data_state loads "

@@ -31,7 +31,14 @@ Rule fields:
   the training loop pulls; ``die`` here is the seeded
   SIGKILL-mid-epoch kill-point the resume tests schedule, ``delay``
   models a stalled input pipeline, and ``drop`` is meaningless for a
-  batch and proceeds).
+  batch and proceeds), and ``serve.dispatch`` (the serving replica
+  set's per-dispatch seam, ``serving/replica_set.py`` — one event per
+  request/probe routed to a replica, with ``sid`` = the replica index
+  and ``kind`` in {``forward``, ``gen``, ``probe``}; replicas are
+  in-process shared-nothing engines, so the replica set registers a
+  *die handler* and ``die`` here SIGKILLs the targeted REPLICA — its
+  engines stop abruptly, in-flight work fails with a retryable error —
+  instead of exiting the process).
 * ``kind`` — match only this message kind (``init`` / ``push`` / ``pull``
   / ``command`` / ``stop``); omitted = any.
 * ``rank`` / ``sid`` — match only this node rank / server index.
@@ -79,7 +86,7 @@ import time
 from .base import get_env
 
 __all__ = ["hook", "install", "active", "seed", "FaultPlan",
-           "InjectedError"]
+           "InjectedError", "register_die_handler"]
 
 _ACTIONS = ("drop", "delay", "straggler", "error", "die")
 
@@ -213,6 +220,32 @@ def seed():
     return None if plan is None else plan.seed
 
 
+# seam -> callable(meta): in-process planes whose "process" is a thread
+# group (the serving replica set) register a handler so a scheduled
+# ``die`` kills THEIR unit of failure instead of the whole test process;
+# the handler performs the death (and may raise to fail the caller's
+# dispatch like a severed connection would).
+_die_handlers = {}
+
+
+def register_die_handler(seam, fn):
+    """Install (or, with ``fn=None``, remove) the ``die`` handler for a
+    seam.  With a handler installed, a scheduled ``die`` at that seam
+    calls ``fn(meta)`` instead of ``os._exit`` — the in-process analog
+    of a SIGKILL scoped to the component the seam belongs to."""
+    if fn is None:
+        _die_handlers.pop(seam, None)
+    else:
+        _die_handlers[seam] = fn
+
+
+def die_handler(seam):
+    """The currently installed die handler for a seam (or None) — lets
+    an owner deregister only its OWN handler on teardown instead of
+    clobbering a successor's."""
+    return _die_handlers.get(seam)
+
+
 def hook(seam, **meta):
     """Fault-point: called by instrumented seams on every message.
 
@@ -237,5 +270,9 @@ def hook(seam, **meta):
         raise InjectedError("fault injected: sever at %s (%s)"
                             % (seam, meta.get("kind")))
     if action == "die":
+        handler = _die_handlers.get(seam)
+        if handler is not None:
+            handler(meta)
+            return None
         os._exit(rule.exit_code)
     return "drop"

@@ -23,7 +23,7 @@ from .base import get_env
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "Profiler", "record_phase", "mark_step", "start_step_profile",
            "stop_step_profile", "aggregate_phase_trace", "PHASES",
-           "SERVE_PHASES", "GEN_SERVE_PHASES"]
+           "SERVE_PHASES", "GEN_SERVE_PHASES", "FRONTDOOR_PHASES"]
 
 # The per-step wall-time attribution phases of one Module.fit batch
 # (tools/step_profile.py renders them; docs/perf.md explains the
@@ -63,6 +63,16 @@ SERVE_PHASES = ("serve_wait", "serve_batch", "serve_compute")
 # forward batcher emits every SERVE_PHASES entry each cycle (pinned),
 # the decode loop emits these.
 GEN_SERVE_PHASES = ("serve_prefill", "serve_decode", "serve_sample")
+
+# The serving front door's phases (serving/frontdoor.py,
+# serving/replica_set.py): ``serve_http`` brackets one HTTP request end
+# to end on its handler thread (parse -> submit -> wait -> encode), and
+# ``serve_dispatch`` brackets one replica-set placement (pick replica,
+# cross the serve.dispatch faultinject seam, hand to the replica's
+# engine).  The engine-side SERVE_PHASES nest inside serve_http's
+# window on other threads, so a Chrome trace shows HTTP/transport
+# overhead as the gap between serve_http and serve_compute.
+FRONTDOOR_PHASES = ("serve_http", "serve_dispatch")
 
 
 class Profiler:

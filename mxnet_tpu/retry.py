@@ -1,0 +1,129 @@
+"""Shared retry / backoff / circuit-breaker policy primitives.
+
+Factored out of the PR-2 kvstore worker client so BOTH fault planes —
+the training side's parameter-server RPCs (``kvstore_dist.py``) and the
+serving side's multi-replica front door (``serving/replica_set.py``) —
+run the same policy math instead of drifting copies:
+
+* :func:`backoff_delay` — pure exponential-backoff-with-equal-jitter
+  math (the policy unit tests drive it directly);
+* :class:`RetryPolicy` — deadline + bounded-retry knobs for one
+  worker's RPCs (defaults stay the ``MXNET_KVSTORE_RPC_*`` registry
+  entries — the kvstore plane's behavior is unchanged; the serving
+  plane passes its own ``MXNET_SERVE_*`` values explicitly);
+* :class:`CircuitBreaker` — per-endpoint closed/open/half-open breaker
+  with a single-trial half-open gate.
+
+Everything here is host-side policy: no jax imports, safe to use from
+any thread.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from . import faultinject
+from .base import get_env
+
+__all__ = ["backoff_delay", "RetryPolicy", "CircuitBreaker"]
+
+
+def backoff_delay(attempt, base, cap, rng=None):
+    """Exponential backoff with equal jitter: attempt ``k`` (0-based)
+    sleeps ``d = min(cap, base * 2**k)``, jittered uniformly into
+    ``[d/2, d]`` when an ``rng`` is given (AWS "equal jitter"; keeps a
+    floor so retry storms still spread without collapsing to zero).
+    Pure function — the policy-math unit tests drive it directly."""
+    d = min(float(cap), float(base) * (2.0 ** attempt))
+    if rng is None:
+        return d
+    return d * 0.5 + d * 0.5 * rng.random()
+
+
+class RetryPolicy:
+    """Deadline + bounded-retry knobs for one worker's RPCs.
+
+    Defaults come from ``MXNET_KVSTORE_RPC_TIMEOUT`` (seconds per reply,
+    0 = wait forever), ``_RETRIES`` (attempts after the first) and
+    ``_BACKOFF`` / ``_BACKOFF_CAP`` (exponential sleep between
+    attempts).  When a fault-injection plan is active the jitter RNG is
+    seeded from the plan so scheduled-fault runs are reproducible."""
+
+    def __init__(self, timeout=None, retries=None, backoff=None, cap=None,
+                 rng=None):
+        # defaults live in base.py's env registry (single source of truth)
+        self.timeout = float(get_env("MXNET_KVSTORE_RPC_TIMEOUT")) \
+            if timeout is None else float(timeout)
+        self.retries = int(get_env("MXNET_KVSTORE_RPC_RETRIES")) \
+            if retries is None else int(retries)
+        self.backoff = float(get_env("MXNET_KVSTORE_RPC_BACKOFF")) \
+            if backoff is None else float(backoff)
+        self.cap = float(get_env("MXNET_KVSTORE_RPC_BACKOFF_CAP")) \
+            if cap is None else float(cap)
+        if rng is None:
+            fseed = faultinject.seed()
+            rng = random.Random(fseed) if fseed is not None \
+                else random.Random()
+        self.rng = rng
+
+    def delay(self, attempt):
+        return backoff_delay(attempt, self.backoff, self.cap, self.rng)
+
+
+class CircuitBreaker:
+    """Per-endpoint breaker: after ``fail_threshold`` consecutive
+    failures the endpoint is presumed dead and calls fail fast with
+    ``MXNetError`` for ``reset_after`` seconds (no more full
+    timeout+retry cycles hanging every fanout thread); then one
+    half-open trial is let through — success re-closes, failure
+    re-opens.  Thread-safe; ``clock`` is injectable for tests."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, fail_threshold=None, reset_after=None,
+                 clock=time.monotonic):
+        self.fail_threshold = int(get_env("MXNET_KVSTORE_RPC_CB_FAILS")) \
+            if fail_threshold is None else int(fail_threshold)
+        self.reset_after = float(get_env("MXNET_KVSTORE_RPC_CB_RESET")) \
+            if reset_after is None else float(reset_after)
+        self.clock = clock
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_at = None
+        self.last_error = None
+        self._trial_inflight = False
+        self._lock = threading.Lock()
+
+    def allow(self):
+        """May a call proceed right now?  Flips OPEN->HALF_OPEN once the
+        cool-down elapsed; exactly ONE caller becomes the trial — other
+        threads keep failing fast until the trial reports back (else a
+        wide fanout would stampede a dead endpoint every window)."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.HALF_OPEN:
+                return not self._trial_inflight
+            if self.clock() - self.opened_at >= self.reset_after:
+                self.state = self.HALF_OPEN
+                self._trial_inflight = True
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self.state = self.CLOSED
+            self.failures = 0
+            self.last_error = None
+            self._trial_inflight = False
+
+    def record_failure(self, exc=None):
+        with self._lock:
+            self.failures += 1
+            self.last_error = exc
+            if (self.state == self.HALF_OPEN
+                    or self.failures >= self.fail_threshold):
+                self.state = self.OPEN
+                self.opened_at = self.clock()
+            self._trial_inflight = False

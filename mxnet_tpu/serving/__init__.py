@@ -38,19 +38,27 @@ from .program_store import (GenerativeProgramStore, ProgramStore,
                             bucket_edges, bucket_for, host_sample,
                             sample_tokens)
 from .registry import ModelRegistry
-from .scheduler import (FutureCompleter, ServeClosed, ServeRequest,
-                        ServeTimeout, ServingEngine)
+from .scheduler import (FutureCompleter, ServeClosed, ServeOverloaded,
+                        ServeRequest, ServeTimeout, ServingEngine)
 from .decode_engine import GenerationEngine, GenerationResult, TokenStream
-from .loadgen import (OpenLoopSchedule, generation_protocol,
-                      latency_protocol, run_gen_loadgen, run_loadgen)
+from .replica_set import (NoLiveReplicas, Replica, ReplicaDied,
+                          ReplicaSet)
+from .frontdoor import HttpClient, HttpFrontDoor
+from .loadgen import (OpenLoopSchedule, failover_protocol,
+                      frontdoor_protocol, generation_protocol,
+                      latency_protocol, run_gen_loadgen, run_loadgen,
+                      swap_protocol)
 
 __all__ = [
     "ProgramStore", "GenerativeProgramStore", "bucket_edges", "bucket_for",
     "sample_tokens", "host_sample",
     "ModelRegistry",
     "ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
-    "FutureCompleter",
+    "ServeOverloaded", "FutureCompleter",
     "GenerationEngine", "GenerationResult", "TokenStream",
+    "Replica", "ReplicaSet", "ReplicaDied", "NoLiveReplicas",
+    "HttpFrontDoor", "HttpClient",
     "OpenLoopSchedule", "run_loadgen", "latency_protocol",
-    "run_gen_loadgen", "generation_protocol",
+    "run_gen_loadgen", "generation_protocol", "frontdoor_protocol",
+    "failover_protocol", "swap_protocol",
 ]

@@ -66,8 +66,9 @@ import numpy as np
 
 from .. import profiler as _profiler
 from ..analysis.lockcheck import make_lock
-from ..base import MXNetError, hot_path
-from .scheduler import FutureCompleter, ServeClosed, ServeTimeout
+from ..base import MXNetError, get_env, hot_path
+from .scheduler import (FutureCompleter, ServeClosed, ServeOverloaded,
+                        ServeTimeout)
 
 __all__ = ["GenerationEngine", "GenerationResult", "TokenStream"]
 
@@ -215,10 +216,14 @@ class GenerationEngine:
     models.
     """
 
-    def __init__(self, registry, max_active=None):
+    def __init__(self, registry, max_active=None, max_inflight=None):
         self._registry = registry
         self._max_active = (int(max_active) if max_active is not None
                             else None)
+        if max_inflight is None:
+            max_inflight = int(get_env("MXNET_SERVE_MAX_INFLIGHT"))
+        self._max_inflight = max(0, int(max_inflight))  # 0 = unbounded
+        self._inflight = 0
         self._queue = queue.Queue()
         self._waiting = {}     # model -> deque[_GenRequest]
         self._states = {}      # model -> _ModelState
@@ -229,8 +234,8 @@ class GenerationEngine:
         self._stats = {"requests": 0, "prefills": 0, "prefill_seqs": 0,
                        "decode_steps": 0, "generated_tokens": 0,
                        "finished": 0, "timeouts": 0, "cancelled": 0,
-                       "errors": 0, "cache_grows": 0, "slot_grows": 0,
-                       "max_active": 0,
+                       "errors": 0, "shed": 0, "cache_grows": 0,
+                       "slot_grows": 0, "max_active": 0,
                        # host elements fetched from decode-step outputs
                        # (tokens in graph-sampling mode, logits in host
                        # mode): decode_fetch_elems / decode_steps is
@@ -267,14 +272,32 @@ class GenerationEngine:
         early; ``stream`` — an optional :class:`TokenStream` receiving
         tokens as they are sampled; ``timeout`` (seconds) bounds
         time-to-admission."""
+        if self._closed:
+            # cheap early gate: every post-close submit raises
+            # ServeClosed, never a validation error about its payload
+            raise ServeClosed("generation engine is closed")
         store = self._registry.gen_store(model)
-        prompt = [int(t) for t in tokens]
+        # coerce EVERY request field up front, mapping coercion errors
+        # to MXNetError (the front door's 400 class — a malformed body
+        # is a client error, not a 500) and, crucially, BEFORE the
+        # admission bookkeeping: a ValueError after the inflight
+        # increment would leak the budget slot forever (no future ever
+        # carries the decrement)
+        try:
+            prompt = [int(t) for t in tokens]
+            max_tokens = int(max_tokens)
+            temperature = float(temperature)
+            top_k = int(top_k)
+            seed = int(seed)
+            eos_id = None if eos_id is None else int(eos_id)
+            timeout = None if timeout is None else float(timeout)
+        except (TypeError, ValueError) as e:
+            raise MXNetError("invalid generation parameter: %s" % e)
         if not prompt:
             raise MXNetError("empty prompt")
         vocab = store.spec["vocab_size"]
         if min(prompt) < 0 or max(prompt) >= vocab:
             raise MXNetError("prompt token out of range [0, %d)" % vocab)
-        max_tokens = int(max_tokens)
         if max_tokens < 1:
             raise MXNetError("max_tokens must be >= 1")
         store.validate_request(len(prompt), max_tokens)
@@ -283,21 +306,41 @@ class GenerationEngine:
         with self._submit_lock:
             if self._closed:
                 raise ServeClosed("generation engine is closed")
+            if self._max_inflight and self._inflight >= self._max_inflight:
+                with self._stats_lock:
+                    self._stats["shed"] += 1
+                raise ServeOverloaded(
+                    "generation engine is at its inflight budget (%d); "
+                    "request shed — back off and retry"
+                    % self._max_inflight)
+            self._inflight += 1
             req = _GenRequest(
-                model, prompt, max_tokens, float(temperature),
-                int(top_k), seed, eos_id, stream, fut,
+                model, prompt, max_tokens, temperature,
+                top_k, seed, eos_id, stream, fut,
                 now + timeout if timeout is not None else None,
                 time.perf_counter(), self._seq)
             self._seq += 1
             self._queue.put(req)
+        fut.add_done_callback(self._note_resolved)
         with self._stats_lock:
             self._stats["requests"] += 1
         return fut
+
+    def _note_resolved(self, _fut):
+        with self._submit_lock:
+            self._inflight -= 1
+
+    def alive(self):
+        """Liveness witness (the front door's /healthz reads it)."""
+        return not self._closed and self._thread.is_alive()
 
     def stats(self):
         with self._stats_lock:
             out = dict(self._stats)
             out["cache_hwm"] = dict(self._cache_hwm)
+        with self._submit_lock:
+            out["inflight"] = self._inflight
+        out["max_inflight"] = self._max_inflight
         out["models"] = {m: st.describe()
                          for m, st in dict(self._states).items()}
         return out
@@ -327,16 +370,34 @@ class GenerationEngine:
 
     # -- engine thread -------------------------------------------------
     def _serve_loop(self):
-        stopping = False
-        while True:
-            stopping = self._pump(stopping) or stopping
-            if stopping and not getattr(self, "_drain_on_stop", True):
-                self._fail_all()
-                return
-            self._admit_ready()
-            self._decode_tick()
-            if stopping and not self._has_work():
-                return
+        try:
+            stopping = False
+            while True:
+                stopping = self._pump(stopping) or stopping
+                if stopping and not getattr(self, "_drain_on_stop", True):
+                    self._fail_all()
+                    return
+                self._admit_ready()
+                self._decode_tick()
+                if stopping and not self._has_work():
+                    return
+        finally:
+            # same exit contract as the forward engine: the loop is
+            # gone (clean close OR crash), so latch closed and fail
+            # anything still queued/waiting/in-flight — an accepted
+            # request is never silently dropped
+            with self._submit_lock:
+                self._closed = True
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _STOP:
+                    self._fail_request(item, ServeClosed(
+                        "generation engine dispatch loop exited before "
+                        "this request could be served"))
+            self._fail_all()
 
     def _has_work(self):
         if any(self._waiting.values()):

@@ -35,7 +35,8 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["OpenLoopSchedule", "run_loadgen", "latency_protocol",
-           "run_gen_loadgen", "generation_protocol"]
+           "run_gen_loadgen", "generation_protocol",
+           "frontdoor_protocol", "failover_protocol", "swap_protocol"]
 
 
 class OpenLoopSchedule:
@@ -100,11 +101,15 @@ def _drive_schedule(submit, schedule, on_success, settle_s, thread_name):
                 records[i] = ("ok", on_success(fut.result(), t_sub),
                               t_sub)
             except Exception as e:  # noqa: BLE001 — tallied by class
-                from .scheduler import ServeTimeout
+                from .scheduler import ServeOverloaded, ServeTimeout
                 if fut.cancelled():
                     status = "cancelled"
                 elif isinstance(e, ServeTimeout):
                     status = "timeout"
+                elif isinstance(e, ServeOverloaded):
+                    # admission-control shed: structured backpressure,
+                    # counted apart from hard errors
+                    status = "shed"
                 else:
                     status = "error"
                 records[i] = (status, None, t_sub)
@@ -125,8 +130,9 @@ def _drive_schedule(submit, schedule, on_success, settle_s, thread_name):
         t_sub = time.perf_counter()
         try:
             fut = submit(i)
-        except Exception:  # noqa: BLE001 — submission refusals count too
-            fut = _failed_future()
+        except Exception as e:  # noqa: BLE001 — submission refusals
+            fut = _failed_future(e)  # classified by the waiter (a shed
+            #                          keeps its ServeOverloaded class)
         fut.add_done_callback(
             lambda f, i=i, t=t_sub: done_q.put((i, t, f)))
     w.join(settle_s)
@@ -141,14 +147,17 @@ def _drive_schedule(submit, schedule, on_success, settle_s, thread_name):
     return records, counts, span, slip
 
 
-def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
+def run_loadgen(submit, schedule, fetch=True, settle_s=60.0,
+                return_records=False):
     """Drive ``submit(i, n_rows) -> Future`` on an open-loop schedule.
 
     Returns a summary dict: latency percentiles over successful
     requests (submit -> result fetched to host), achieved vs offered
     QPS, and failure counters.  ``max_submit_slip_ms`` reports how far
     the submitting thread itself fell behind the schedule (pacing
-    credibility).
+    credibility).  ``return_records=True`` additionally returns the
+    per-request ``(status, latency_s, t_submit)`` records (perf_counter
+    clock) — the failover protocol windows pre/post-kill QPS from them.
     """
     from ..test_utils import fetch_sync
 
@@ -162,12 +171,16 @@ def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
         on_success, settle_s, "mxt-loadgen-wait")
     lats = np.asarray([r[1] for r in records if r and r[0] == "ok"])
     ok = counts.get("ok", 0)
-    return {
+    out = {
         "n": schedule.n,
         "ok": ok,
         "timeouts": counts.get("timeout", 0),
         "cancelled": counts.get("cancelled", 0),
+        "shed": counts.get("shed", 0),
         "errors": counts.get("error", 0) + counts.get("lost", 0),
+        # never-resolved slots on their own (also inside errors for
+        # back-compat): the failover protocol's client-hang evidence
+        "lost": counts.get("lost", 0),
         "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3)
         if ok else None,
         "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3)
@@ -181,12 +194,16 @@ def run_loadgen(submit, schedule, fetch=True, settle_s=60.0):
         "max_submit_slip_ms": round(slip * 1e3, 3),
         "seed": schedule.seed,
     }
+    if return_records:
+        return out, records
+    return out
 
 
-def _failed_future():
+def _failed_future(exc=None):
     from concurrent.futures import Future
     f = Future()
-    f.set_exception(MXNetError("submit refused"))
+    f.set_exception(exc if exc is not None
+                    else MXNetError("submit refused"))
     return f
 
 
@@ -408,6 +425,7 @@ def run_gen_loadgen(submit, schedule, settle_s=180.0):
         "ok": ok,
         "timeouts": counts.get("timeout", 0),
         "cancelled": counts.get("cancelled", 0),
+        "shed": counts.get("shed", 0),
         "errors": counts.get("error", 0) + counts.get("lost", 0),
         "tokens": total_tokens,
         "tokens_per_sec": round(total_tokens / span, 2),
@@ -650,3 +668,304 @@ def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
     }
     out.update(sides)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Front-door protocols: HTTP overhead, kill-one failover, swap consistency.
+# ---------------------------------------------------------------------------
+def _frontdoor_model(seed, feat=512, hidden=2048):
+    """Shared front-door smoke model + request pool (the latency
+    protocol's compute-dominated MLP so batching economics hold)."""
+    sym, args = _smoke_model(feat, hidden, seed)
+    rs = np.random.RandomState(seed + 1)
+    pool = [np.asarray(rs.uniform(-1, 1, (1, feat)), np.float32)
+            for _ in range(16)]
+    return sym, args, pool, feat
+
+
+def _engine_capacity(submit_result, n):
+    """Closed-loop requests/sec of one submit->result roundtrip loop
+    (the pacing anchor the open-loop schedules scale from)."""
+    tic = time.perf_counter()
+    for i in range(n):
+        submit_result(i)
+    return n / (time.perf_counter() - tic)
+
+
+def frontdoor_protocol(smoke=False, seed=17, offered_mult=2.0):
+    """HTTP-overhead protocol: the SAME engine, the SAME seeded
+    open-loop schedule, driven twice — in-process ``submit`` futures
+    vs the HTTP front door through :class:`~.frontdoor.HttpClient`'s
+    npz transport.  The delta is pure front-door cost (parse + HTTP +
+    npz round-trip); the offered rate is a moderate multiple of the
+    closed-loop per-request capacity so neither side saturates and the
+    p50/p99 gap reads as overhead, not queueing."""
+    from .frontdoor import HttpClient, HttpFrontDoor
+    from .registry import ModelRegistry
+    from .scheduler import ServingEngine
+
+    sym, args, pool, feat = _frontdoor_model(seed)
+    n_closed = 30 if smoke else 80
+    n_load = 120 if smoke else 400
+    registry = ModelRegistry()
+    registry.add_model("m", sym, args, {},
+                       input_shapes={"data": (1, feat)}, warmup=True)
+    engine = ServingEngine(registry, max_delay_ms=2.0)
+    door = HttpFrontDoor(engine)
+    client = HttpClient(door.address, threads=8)
+    try:
+        # both transports warm before any measurement
+        for _ in range(2):
+            engine.submit("m", data=pool[0]).result(60)
+            client.submit("m", {"data": pool[0]}).result(60)
+        closed_qps = _engine_capacity(
+            lambda i: engine.submit(
+                "m", data=pool[i % len(pool)]).result(60), n_closed)
+        http_closed_qps = _engine_capacity(
+            lambda i: client.submit(
+                "m", {"data": pool[i % len(pool)]}).result(60), n_closed)
+        # anchor on the SLOWER transport's closed-loop capacity: both
+        # sides must sustain the offered rate, or the HTTP side's p99
+        # measures queueing collapse instead of transport overhead
+        offered = min(closed_qps, http_closed_qps) * float(offered_mult)
+        schedule = OpenLoopSchedule(seed, n_load, offered, sizes=(1,))
+        inproc = run_loadgen(
+            lambda i, n: engine.submit("m", data=pool[i % len(pool)]),
+            schedule, fetch=True)
+        http = run_loadgen(
+            lambda i, n: client.submit(
+                "m", {"data": pool[i % len(pool)]}),
+            schedule, fetch=True)
+        stats = engine.stats()
+    finally:
+        client.close()
+        door.close()
+        engine.close()
+    return {
+        "seed": seed,
+        "closed_loop_qps": round(closed_qps, 2),
+        "http_closed_loop_qps": round(http_closed_qps, 2),
+        "offered_mult": float(offered_mult),
+        "inproc": inproc,
+        "http": http,
+        "engine": stats,
+        "http_p50_overhead_ms": (
+            round(http["p50_ms"] - inproc["p50_ms"], 3)
+            if http["p50_ms"] is not None and inproc["p50_ms"] is not None
+            else None),
+        "http_p99_vs_inproc": (
+            round(http["p99_ms"] / inproc["p99_ms"], 3)
+            if http["p99_ms"] and inproc["p99_ms"] else None),
+        "http_qps_vs_inproc": (
+            round(http["qps_achieved"] / inproc["qps_achieved"], 3)
+            if inproc["qps_achieved"] else None),
+    }
+
+
+def failover_protocol(smoke=False, seed=19, n_replicas=3,
+                      offered_mult=2.0, kill_frac=0.4,
+                      probe_interval=0.15):
+    """Kill-one-replica-under-load: N shared-nothing replicas behind
+    the least-loaded balancer, the seeded open-loop schedule offering
+    a multiple of closed-loop capacity, and a seeded ``die`` at the
+    ``serve.dispatch`` faultinject seam SIGKILLing whichever replica
+    serves the ``kill_frac``-th dispatch.  Acceptance (the bench row
+    and ``serve_smoke --kill-one`` gate): 100% of accepted requests
+    resolve (zero drops, zero hangs), the balancer converges to the
+    survivors, and achieved QPS over the post-kill window (beginning
+    one probe interval after the kill) recovers to >= 2/3 of the
+    pre-kill steady state."""
+    from .. import faultinject
+    from .registry import ModelRegistry
+    from .replica_set import ReplicaSet
+
+    sym, args, pool, feat = _frontdoor_model(seed)
+    n_closed = 20 if smoke else 60
+    n_load = 150 if smoke else 400
+
+    def build(_i):
+        reg = ModelRegistry()
+        # each replica loads its OWN weight copy: shared-nothing
+        reg.add_model("m", sym, {k: v.copy() for k, v in args.items()},
+                      {}, input_shapes={"data": (1, feat)}, warmup=True)
+        return reg
+
+    rset = ReplicaSet(build, n_replicas=n_replicas,
+                      probe_interval=probe_interval, max_delay_ms=2.0)
+    kill_t = [None]
+    die_inner = rset._injected_die
+
+    def noting_die(meta):
+        if kill_t[0] is None:
+            kill_t[0] = time.perf_counter()
+        die_inner(meta)
+
+    try:
+        for _ in range(2):
+            rset.submit("m", data=pool[0]).result(60)
+        closed_qps = _engine_capacity(
+            lambda i: rset.submit(
+                "m", data=pool[i % len(pool)]).result(60), n_closed)
+        # the run must span several probe intervals with completions on
+        # both sides of the kill, or the pre/post windows are too thin
+        # to read a recovery from — floor the duration
+        min_duration = 4.0 if smoke else 8.0
+        offered = min(closed_qps * float(offered_mult),
+                      n_load / min_duration)
+        schedule = OpenLoopSchedule(seed, n_load, offered, sizes=(1,))
+        kill_nth = max(2, int(n_load * float(kill_frac)))
+        faultinject.install({"seed": seed, "rules": [
+            {"seam": "serve.dispatch", "kind": "forward",
+             "nth": kill_nth, "action": "die"}]})
+        faultinject.register_die_handler("serve.dispatch", noting_die)
+        summary, records = run_loadgen(
+            lambda i, n: rset.submit("m", data=pool[i % len(pool)]),
+            schedule, fetch=True, return_records=True)
+        stats = rset.stats()
+        live_after = rset.live_replicas()
+    finally:
+        faultinject.install(None)
+        # drop the kill-time-noting wrapper so rset.close()'s
+        # own-handler check cannot leave it dangling
+        faultinject.register_die_handler("serve.dispatch", None)
+        rset.close()
+
+    # window the achieved QPS around the kill moment (completion clock
+    # = t_submit + latency on the shared perf_counter timeline)
+    done_ts = sorted(t_sub + lat for status, lat, t_sub in
+                     (r for r in records if r) if status == "ok")
+    out = {
+        "seed": seed,
+        "n_replicas": n_replicas,
+        "probe_interval_s": probe_interval,
+        "closed_loop_qps": round(closed_qps, 2),
+        "offered_mult": float(offered_mult),
+        "kill_nth_dispatch": kill_nth,
+        "summary": summary,
+        # a shed IS a resolution (structured 429, not a hang) but is
+        # reported on its own — it is neither a success nor a drop.
+        # "lost" slots (a future that never resolved) are the client
+        # hangs the acceptance forbids, so they are NOT resolved
+        "resolved": summary["ok"] + summary["timeouts"] +
+        summary["cancelled"] + summary["errors"] + summary["shed"] -
+        summary["lost"],
+        "shed": summary["shed"],
+        "dropped": summary["timeouts"] + summary["errors"] +
+        summary["cancelled"],
+        "failovers": stats["failovers"], "retries": stats["retries"],
+        "live_after": live_after,
+    }
+    if kill_t[0] is not None and done_ts:
+        k = kill_t[0]
+        pre = [t for t in done_ts if t < k]
+        post = [t for t in done_ts if t >= k + probe_interval]
+        pre_qps = (len(pre) / max(pre[-1] - done_ts[0], 1e-9)
+                   if len(pre) > 1 else None)
+        post_qps = (len(post) / max(done_ts[-1] - (k + probe_interval),
+                                    1e-9)
+                    if len(post) > 1 else None)
+        nxt = next((t for t in done_ts if t >= k), None)
+        out.update({
+            "killed": True,
+            "pre_kill_qps": round(pre_qps, 2) if pre_qps else None,
+            "post_kill_qps": round(post_qps, 2) if post_qps else None,
+            "post_vs_pre_qps": (round(post_qps / pre_qps, 3)
+                                if pre_qps and post_qps else None),
+            "recovery_ms": (round((nxt - k) * 1e3, 3)
+                            if nxt is not None else None),
+        })
+    else:
+        out["killed"] = kill_t[0] is not None
+    return out
+
+
+def swap_protocol(smoke=False, seed=23):
+    """Hot-swap-under-traffic bit-consistency: one engine under
+    concurrent submit threads while ``swap_params`` republishes a
+    second weight set mid-stream.  Geometry is bucket-pinned (single
+    batch bucket) so every response is bit-comparable to reference
+    forwards of the two versions; the acceptance is an exact
+    partition — every response bit-matches the OLD or the NEW weights'
+    forward, none matches neither (a torn read would), and the store's
+    version counter advances exactly once per swap."""
+    from .registry import ModelRegistry
+    from .scheduler import ServingEngine
+
+    sym, args, pool, feat = _frontdoor_model(seed, feat=128, hidden=256)
+    rs = np.random.RandomState(seed + 7)
+    args2 = {k: np.asarray(v + rs.uniform(0.05, 0.1, v.shape),
+                           np.float32) for k, v in args.items()}
+    n_requests = 120 if smoke else 400
+    x = pool[0]
+    registry = ModelRegistry()
+    # single bucket edge: every dispatch runs the same program at the
+    # same batch geometry, so fp32 outputs are bit-comparable across
+    # the whole run (cross-bucket XLA fusion differences would muddy
+    # the exact old-xor-new partition this protocol asserts)
+    store = registry.add_model("m", sym, args, {},
+                               input_shapes={"data": (1, feat)},
+                               buckets=(1,), warmup=True)
+    engine = ServingEngine(registry, max_delay_ms=0)
+    try:
+        ref_old = np.asarray(
+            engine.submit("m", data=x).result(60)[0])
+        version_before = store.stats()["version"]
+        # a submitter thread streams the traffic while the main thread
+        # swaps once a third of the RESPONSES have resolved (swapping
+        # at a submission index is meaningless — on a warm host the
+        # whole stream can enqueue before the engine serves anything):
+        # the first third is guaranteed old-version, the last third is
+        # submitted only after the swap returned so it is guaranteed
+        # new-version, and the middle third lands on whichever side of
+        # the publish its dispatch read — every response must still
+        # bit-match exactly one side
+        futs = []
+        done = [0]
+        done_lock = threading.Lock()
+
+        def on_done(_f):
+            with done_lock:
+                done[0] += 1
+
+        swapped = threading.Event()
+
+        def submitter():
+            for i in range(n_requests):
+                if i == (2 * n_requests) // 3:
+                    swapped.wait(60)
+                f = engine.submit("m", data=x)
+                f.add_done_callback(on_done)
+                futs.append(f)
+                time.sleep(0.001)
+
+        t = threading.Thread(target=submitter, name="mxt-swap-submit")
+        t.start()
+        deadline = time.monotonic() + 60
+        while done[0] < n_requests // 3 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        registry.swap_params("m", args2)
+        swapped.set()
+        t.join(60)
+        ref_new = np.asarray(
+            engine.submit("m", data=x).result(60)[0])
+        counts = {"old": 0, "new": 0, "neither": 0}
+        for f in futs:
+            r = np.asarray(f.result(60)[0])
+            if np.array_equal(r, ref_old):
+                counts["old"] += 1
+            elif np.array_equal(r, ref_new):
+                counts["new"] += 1
+            else:
+                counts["neither"] += 1
+        version_after = store.stats()["version"]
+    finally:
+        engine.close()
+    return {
+        "seed": seed,
+        "n": n_requests,
+        "old": counts["old"], "new": counts["new"],
+        "neither": counts["neither"],
+        "version_before": version_before,
+        "version_after": version_after,
+        "version_increments": version_after - version_before,
+    }

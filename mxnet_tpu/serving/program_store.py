@@ -269,29 +269,11 @@ class ProgramStore:
         # is a FullyConnected WEIGHT input (the matmul door understands
         # the pair; nothing else does) — in an MLP/classifier head that
         # is the overwhelming share of the bytes
-        quant_names = (_fc_weight_only_params(symbol) if self._quant8
-                       else frozenset())
+        self._quant_names = (_fc_weight_only_params(symbol)
+                             if self._quant8 else frozenset())
+        self._aux_names = list(aux_names)
 
-        def load(v, name=None):
-            a = _as_device_array(v)
-            if name in quant_names and a.ndim == 2 and \
-                    jnp.issubdtype(a.dtype, jnp.floating):
-                codes, scales = quantize_int8(np.asarray(a))
-                c, s = jnp.asarray(codes), jnp.asarray(scales)
-                if device is not None:
-                    c = jax.device_put(c, device)
-                    s = jax.device_put(s, device)
-                return QuantizedWeight(c, s)
-            if self._cdt is not None and a.dtype != self._cdt and \
-                    jnp.issubdtype(a.dtype, jnp.floating):
-                a = a.astype(self._cdt)
-            if device is not None:
-                # committed params pin the compiled programs' placement
-                # (uncommitted request inputs follow them)
-                a = jax.device_put(a, device)
-            return a
-
-        self._params = {n: load(arg_params[n], n)
+        self._params = {n: self._load_param(arg_params[n], n)
                         for n in self._param_names}
         aux = []
         # aux states missing from the checkpoint keep predictor.py's
@@ -300,7 +282,7 @@ class ProgramStore:
         _, _, aux_shapes = symbol.infer_shape_partial(**shapes)
         for n, shape in zip(aux_names, aux_shapes):
             if n in aux_params:
-                aux.append(load(aux_params[n]))
+                aux.append(self._load_param(aux_params[n]))
             elif shape is not None:
                 z = jnp.zeros(tuple(shape), self._cdt or jnp.float32)
                 aux.append(z if device is None
@@ -309,6 +291,12 @@ class ProgramStore:
                 raise MXNetError("auxiliary state %r is not in the params "
                                  "and its shape cannot be inferred" % n)
         self._aux = tuple(aux)
+        # the PUBLISHED weight set: dispatch reads this tuple exactly
+        # once per run, so a hot swap (swap_params) is atomic per
+        # request — every in-flight request executes against exactly
+        # one (params, aux, version) snapshot, never a mix
+        self._version = 1
+        self._live = (self._params, self._aux, self._version)
 
         if max_programs is None:
             max_programs = int(get_env("MXNET_SERVE_PROGRAM_CACHE"))
@@ -329,6 +317,97 @@ class ProgramStore:
         self._lock = make_lock("serving.program_store")
         self._stats = {"hits": 0, "compiles": 0, "evictions": 0,
                        "compile_ms_total": 0.0}
+
+    def _load_param(self, v, name=None):
+        """One parameter through the serving dtype policy: int8-quantize
+        the FC-weight-only set, cast floats to the compute dtype, pin to
+        the store's device.  Shared by load-time and swap-time paths so
+        a swapped weight set goes through EXACTLY the original
+        pipeline."""
+        a = _as_device_array(v)
+        if name in self._quant_names and a.ndim == 2 and \
+                jnp.issubdtype(a.dtype, jnp.floating):
+            codes, scales = quantize_int8(np.asarray(a))
+            c, s = jnp.asarray(codes), jnp.asarray(scales)
+            if self._device is not None:
+                c = jax.device_put(c, self._device)
+                s = jax.device_put(s, self._device)
+            return QuantizedWeight(c, s)
+        if self._cdt is not None and a.dtype != self._cdt and \
+                jnp.issubdtype(a.dtype, jnp.floating):
+            a = a.astype(self._cdt)
+        if self._device is not None:
+            # committed params pin the compiled programs' placement
+            # (uncommitted request inputs follow them)
+            a = jax.device_put(a, self._device)
+        return a
+
+    # -- hot weight swap -----------------------------------------------
+    def swap_params(self, arg_params, aux_params=None):
+        """Atomically republish the device-resident weight arguments.
+
+        ``arg_params`` must cover every non-input argument the store
+        serves (same names/shapes/dtypes as the loaded checkpoint —
+        the AOT programs were lowered against those avals and are NOT
+        recompiled).  ``aux_params=None`` keeps the current auxiliary
+        states.  The new set goes through the same dtype pipeline as
+        load (bf16 cast / int8 quantization / device pinning), then ONE
+        reference assignment publishes ``(params, aux, version)``;
+        requests dispatched before the swap keep the old snapshot,
+        requests after get the new one, and no request ever sees a mix
+        (``run`` reads the snapshot exactly once).  Returns the new
+        version (monotonic, reported by ``stats()['version']``)."""
+        missing = [n for n in self._param_names if n not in arg_params]
+        if missing:
+            raise MXNetError("swap_params for %r is missing %s"
+                             % (self.name, sorted(missing)))
+        new_params = {}
+        for n in self._param_names:
+            a = self._load_param(arg_params[n], n)
+            old = self._params[n]
+            quant = isinstance(old, QuantizedWeight)
+            if quant != isinstance(a, QuantizedWeight):
+                pairs = None
+            elif quant:
+                pairs = ((a.codes, old.codes), (a.scales, old.scales))
+            else:
+                pairs = ((a, old),)
+            if pairs is None or any(
+                    x.shape != y.shape or x.dtype != y.dtype
+                    for x, y in pairs):
+                raise MXNetError(
+                    "swap_params for %r: parameter %r does not match "
+                    "the compiled programs' signature (the serving "
+                    "programs are not recompiled on swap)" % (self.name,
+                                                              n))
+            new_params[n] = a
+        if aux_params is None:
+            new_aux = self._aux
+        else:
+            new_aux = []
+            for n, old in zip(self._aux_names, self._aux):
+                if n not in aux_params:
+                    new_aux.append(old)
+                    continue
+                a = self._load_param(aux_params[n])
+                if a.shape != old.shape or a.dtype != old.dtype:
+                    raise MXNetError(
+                        "swap_params for %r: auxiliary state %r does "
+                        "not match the compiled programs' signature"
+                        % (self.name, n))
+                new_aux.append(a)
+            new_aux = tuple(new_aux)
+        with self._lock:
+            self._params = new_params
+            self._aux = new_aux
+            self._version += 1
+            # single reference assignment = the atomic publish point
+            self._live = (new_params, new_aux, self._version)
+        return self._version
+
+    @property
+    def version(self):
+        return self._version
 
     # -- geometry ------------------------------------------------------
     @property
@@ -518,8 +597,8 @@ class ProgramStore:
                 feed = {n: np.zeros((b,) + self._input_tails[n],
                                     self._input_dtypes[n])
                         for n in self._input_names}
-                jax.block_until_ready(
-                    prog.fn(self._params, self._aux, feed))
+                params, aux, _v = self._live
+                jax.block_until_ready(prog.fn(params, aux, feed))
         return out
 
     # -- execution -----------------------------------------------------
@@ -552,7 +631,11 @@ class ProgramStore:
                 pad[:n] = v
                 v = pad
             feed[name] = v
-        outs = prog.fn(self._params, self._aux, feed)
+        # ONE read of the published (params, aux, version) snapshot:
+        # the hot-swap atomicity guarantee — this request runs entirely
+        # against one weight version
+        params, aux, _v = self._live
+        outs = prog.fn(params, aux, feed)
         if slice_outputs:
             outs = [o[:n] if bm and n != bucket else o
                     for o, bm in zip(outs, prog.out_batch_major)]
@@ -572,7 +655,9 @@ class ProgramStore:
                 p.bucket for p in self._programs.values())
         out["edges"] = list(self._edges)
         out["compute_dtype"] = self._dtype_tag
-        out["weight_bytes"] = _weight_bytes((self._params, self._aux))
+        params, aux, version = self._live
+        out["version"] = version
+        out["weight_bytes"] = _weight_bytes((params, aux))
         return out
 
     def reset_stats(self):
@@ -707,34 +792,8 @@ class GenerativeProgramStore:
             raise MXNetError("generative model %r is missing params %s"
                              % (name, missing))
 
-        def load(v):
-            a = _as_device_array(v)
-            if self._compute == "bfloat16" and \
-                    jnp.issubdtype(a.dtype, jnp.floating) and \
-                    a.dtype != jnp.bfloat16:
-                a = a.astype(jnp.bfloat16)
-            if device is not None:
-                a = jax.device_put(a, device)
-            return a
-
-        if self._compute == "int8":
-            from ..models.transformer_lm import quantize_lm_params
-            host = {k: np.asarray(_as_device_array(v), np.float32)
-                    if jnp.issubdtype(_as_device_array(v).dtype,
-                                      jnp.floating) else v
-                    for k, v in params.items()}
-            self._params = {}
-            for k, v in quantize_lm_params(host, self._spec).items():
-                if isinstance(v, QuantizedWeight):
-                    c, s = jnp.asarray(v.codes), jnp.asarray(v.scales)
-                    if device is not None:
-                        c = jax.device_put(c, device)
-                        s = jax.device_put(s, device)
-                    self._params[k] = QuantizedWeight(c, s)
-                else:
-                    self._params[k] = load(v)
-        else:
-            self._params = {k: load(v) for k, v in params.items()}
+        self._params = self._load_params(params)
+        self._version = 1
 
         # one warm sweep must fit the LRU or AOT is a lie (the forward
         # store logs the same hazard; here we just size for it)
@@ -759,6 +818,79 @@ class GenerativeProgramStore:
         # cache lives here, beside the params — registry-owned serving
         # state, introspectable via stats()
         self.cache_state = None
+
+    def _load_params(self, params):
+        """The trained weight dict through the serving dtype policy
+        (fp32 pass-through / bf16 cast / int8 matmul-weight
+        quantization) and device pinning; shared by load and
+        :meth:`swap_params` so both produce identical trees."""
+        device = self._device
+
+        def load(v):
+            a = _as_device_array(v)
+            if self._compute == "bfloat16" and \
+                    jnp.issubdtype(a.dtype, jnp.floating) and \
+                    a.dtype != jnp.bfloat16:
+                a = a.astype(jnp.bfloat16)
+            if device is not None:
+                a = jax.device_put(a, device)
+            return a
+
+        if self._compute == "int8":
+            from ..models.transformer_lm import quantize_lm_params
+            host = {k: np.asarray(_as_device_array(v), np.float32)
+                    if jnp.issubdtype(_as_device_array(v).dtype,
+                                      jnp.floating) else v
+                    for k, v in params.items()}
+            out = {}
+            for k, v in quantize_lm_params(host, self._spec).items():
+                if isinstance(v, QuantizedWeight):
+                    c, s = jnp.asarray(v.codes), jnp.asarray(v.scales)
+                    if device is not None:
+                        c = jax.device_put(c, device)
+                        s = jax.device_put(s, device)
+                    out[k] = QuantizedWeight(c, s)
+                else:
+                    out[k] = load(v)
+            return out
+        return {k: load(v) for k, v in params.items()}
+
+    # -- hot weight swap -----------------------------------------------
+    def swap_params(self, params):
+        """Atomically republish the decode plane's weight arguments
+        (same contract as :meth:`ProgramStore.swap_params`: identical
+        names/shapes/dtypes, no recompile, one reference assignment).
+        Each program DISPATCH binds one version — a prefill or a decode
+        step is never torn — but a multi-step generation that straddles
+        the swap continues on the NEW weights from its next step (its
+        KV cache holds old-version context); latency-sensitive
+        deployments that need whole-generation pinning should drain
+        before swapping.  Returns the new version."""
+        missing = [k for k in self._required_params() if k not in params]
+        if missing:
+            raise MXNetError("swap_params for %r is missing %s"
+                             % (self.name, sorted(missing)))
+        new_params = self._load_params(params)
+        old_leaves = jax.tree_util.tree_leaves(
+            {k: self._params[k] for k in sorted(self._params)})
+        new_leaves = jax.tree_util.tree_leaves(
+            {k: new_params[k] for k in sorted(self._params)
+             if k in new_params})
+        if sorted(new_params) != sorted(self._params) or any(
+                a.shape != b.shape or a.dtype != b.dtype
+                for a, b in zip(new_leaves, old_leaves)):
+            raise MXNetError(
+                "swap_params for %r: the new weight set does not match "
+                "the compiled programs' signature (the decode programs "
+                "are not recompiled on swap)" % self.name)
+        with self._lock:
+            self._params = new_params
+            self._version += 1
+        return self._version
+
+    @property
+    def version(self):
+        return self._version
 
     def _required_params(self):
         names = ["embed_weight", "final_ln_gamma", "final_ln_beta",
@@ -1060,6 +1192,7 @@ class GenerativeProgramStore:
             out["programs_resident"] = sorted(
                 (k[2], k[3], k[4]) for k in self._programs)
         out["generative"] = True
+        out["version"] = self._version
         out["batch_buckets"] = list(self._batch_edges)
         out["prompt_buckets"] = list(self._prompt_edges)
         out["kv_block"] = self.kv_block

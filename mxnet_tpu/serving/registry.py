@@ -162,6 +162,27 @@ class ModelRegistry:
                 % (name, known))
         return store
 
+    def swap_params(self, name, arg_params, aux_params=None):
+        """Hot weight swap under traffic: atomically republish model
+        ``name``'s device-resident weight arguments (the programs take
+        params as ARGUMENTS — no recompile).  Works for forward stores
+        (``aux_params`` optionally refreshes auxiliary states) and
+        generative stores (``aux_params`` must be None).  Every
+        in-flight request executes against exactly one version — see
+        the stores' ``swap_params`` docstrings; the new version shows
+        up in ``stats()``.  Returns the new version number."""
+        with self._lock:
+            store = self._stores.get(name)
+            gstore = self._gen_stores.get(name)
+        if store is not None:
+            return store.swap_params(arg_params, aux_params)
+        if gstore is not None:
+            if aux_params is not None:
+                raise MXNetError("generative models have no auxiliary "
+                                 "states to swap")
+            return gstore.swap_params(arg_params)
+        raise MXNetError("unknown serving model %r" % name)
+
     def remove_model(self, name):
         with self._lock:
             if self._stores.pop(name, None) is None and \

@@ -1,0 +1,566 @@
+"""Shared-nothing multi-replica serving with health-checked failover.
+
+One replica = one private :class:`~.registry.ModelRegistry` plus its own
+:class:`~.scheduler.ServingEngine` (and optionally a
+:class:`~.decode_engine.GenerationEngine`): no weights, caches, program
+stores or queues are shared between replicas, so a replica dying takes
+down exactly its own state — the shared-nothing failure unit the
+training side's parameter servers already are.
+
+:class:`ReplicaSet` fronts N replicas with a **least-loaded balancer**:
+
+* every dispatch (request or health probe) crosses the
+  ``serve.dispatch`` faultinject seam, so seeded schedules can drop /
+  delay / sever / SIGKILL a replica deterministically (``die`` at this
+  seam kills the targeted REPLICA in-process via the registered die
+  handler instead of exiting the test process);
+* each replica carries a :class:`~..retry.CircuitBreaker` (the PR-2
+  kvstore plane's breaker, factored into ``mxnet_tpu/retry.py``):
+  consecutive dispatch/probe failures open it and the balancer routes
+  around the replica without paying its failure latency;
+* **forward** requests are idempotent (pure bucketed forward), so a
+  dispatch that fails retryably — the replica died, its engine closed,
+  the connection severed — is retried with bounded
+  exponential backoff (``mxnet_tpu.retry.backoff_delay``;
+  ``MXNET_SERVE_RETRIES`` / ``MXNET_SERVE_RETRY_BACKOFF``) onto a
+  SURVIVING replica, excluding every replica already observed failing
+  for that request;
+* **generation** requests fail fast once admitted: their KV cache died
+  with the replica and silently regenerating would replay the sampled
+  stream from scratch — the client gets a structured, retryable
+  :class:`ReplicaDied` and decides (before admission — the dispatch
+  itself failing — they retry like forwards, nothing is lost yet);
+* a **prober** thread re-probes every replica each
+  ``MXNET_SERVE_PROBE_INTERVAL`` seconds: probe failures open the
+  breaker (a dead replica leaves the rotation within one interval),
+  probe successes close it again (a transiently severed replica
+  returns).
+
+Hot weight swap fans out: :meth:`ReplicaSet.swap_params` republishes the
+new weights on every live replica's registry (each replica's swap is
+atomic per request — see ``program_store.swap_params``).
+
+Admission control composes: each replica's engine sheds with
+:class:`~.scheduler.ServeOverloaded` at its ``MXNET_SERVE_MAX_INFLIGHT``
+budget; the balancer treats a shed as "try the next replica" and only
+surfaces 429 to the client when EVERY live replica is at budget.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+from .. import faultinject
+from .. import profiler as _profiler
+from ..analysis.lockcheck import make_lock
+from ..base import MXNetError, get_env
+from ..retry import CircuitBreaker, backoff_delay
+from .registry import ModelRegistry
+from .scheduler import (ServeClosed, ServeOverloaded, ServeTimeout,
+                        ServingEngine)
+
+__all__ = ["Replica", "ReplicaSet", "ReplicaDied", "NoLiveReplicas"]
+
+SEAM = "serve.dispatch"
+
+
+class ReplicaDied(MXNetError):
+    """The replica serving (or about to serve) this request died.
+
+    Retryable by contract: the balancer retries forward requests onto a
+    survivor automatically; a generation request admitted to the dead
+    replica surfaces this to the client (its KV state is gone — the
+    client owns the resubmit decision)."""
+
+
+class NoLiveReplicas(MXNetError):
+    """Every replica is dead, breaker-open, or excluded by this
+    request's failure history; nothing can serve it."""
+
+
+class Replica:
+    """One shared-nothing serving unit: a private registry + engines.
+
+    ``populate(registry)`` happened before construction — the caller
+    builds and fills the registry (each replica loads its OWN copy of
+    the weights; nothing is shared).  ``gen=True`` also starts a
+    GenerationEngine over the same registry."""
+
+    def __init__(self, index, registry, gen=False, max_delay_ms=None,
+                 max_batch=None, max_inflight=None, breaker=None):
+        self.index = int(index)
+        self.registry = registry
+        self.engine = ServingEngine(registry, max_delay_ms=max_delay_ms,
+                                    max_batch=max_batch,
+                                    max_inflight=max_inflight)
+        self.gen_engine = None
+        if gen:
+            from .decode_engine import GenerationEngine
+            self.gen_engine = GenerationEngine(
+                registry, max_inflight=max_inflight)
+        if breaker is None:
+            # default from the SERVING knobs — the shared
+            # CircuitBreaker's own constructor defaults belong to the
+            # kvstore plane
+            breaker = CircuitBreaker(
+                fail_threshold=int(get_env("MXNET_SERVE_CB_FAILS")),
+                reset_after=float(get_env("MXNET_SERVE_CB_RESET")))
+        self.breaker = breaker
+        self.alive = True
+        self.inflight = 0           # balancer-tracked, set-lock guarded
+        self._life_lock = make_lock("serving.replica")
+
+    def kill(self):
+        """Simulated SIGKILL: the replica stops abruptly.  Queued and
+        forming work fails fast with ServeClosed (the balancer maps it
+        to a retryable failover); in-flight generations lose their KV
+        state.  Idempotent; callable from any non-engine thread."""
+        with self._life_lock:
+            if not self.alive:
+                return
+            self.alive = False
+        # drain=False: fail-fast close, the in-process analog of the
+        # process vanishing (dispatched device work completes — a real
+        # SIGKILL would also leave the accelerator step finishing)
+        self.engine.close(drain=False)
+        if self.gen_engine is not None:
+            self.gen_engine.close(drain=False)
+
+    def close(self, drain=True):
+        """Graceful stop (drains by default); used by ReplicaSet.close."""
+        with self._life_lock:
+            already_dead = not self.alive
+            self.alive = False
+        if already_dead:
+            return
+        self.engine.close(drain=drain)
+        if self.gen_engine is not None:
+            self.gen_engine.close(drain=drain)
+
+
+class ReplicaSet:
+    """Least-loaded balancer + failover over N shared-nothing replicas.
+
+    Parameters
+    ----------
+    build_registry : callable(index) -> ModelRegistry, or list
+        Factory producing each replica's PRIVATE registry (load the
+        same checkpoint N times — replicas share nothing), or an
+        explicit list of pre-built registries.
+    n_replicas : int
+        Replica count (ignored when a list is passed).
+    gen : bool
+        Also run a GenerationEngine per replica.
+    retries / backoff : int / float, optional
+        Forward failover policy; default ``MXNET_SERVE_RETRIES`` /
+        ``MXNET_SERVE_RETRY_BACKOFF`` (backoff cap is 16x the base).
+    cb_fails / cb_reset : optional
+        Per-replica breaker thresholds; default ``MXNET_SERVE_CB_FAILS``
+        / ``MXNET_SERVE_CB_RESET``.
+    probe_interval : float, optional
+        Health-probe period (seconds); default
+        ``MXNET_SERVE_PROBE_INTERVAL``.  ``<= 0`` disables the prober.
+    max_delay_ms / max_batch / max_inflight :
+        Passed through to every replica's engine(s).
+    """
+
+    def __init__(self, build_registry, n_replicas=3, gen=False,
+                 retries=None, backoff=None, cb_fails=None, cb_reset=None,
+                 probe_interval=None, max_delay_ms=None, max_batch=None,
+                 max_inflight=None):
+        if retries is None:
+            retries = int(get_env("MXNET_SERVE_RETRIES"))
+        if backoff is None:
+            backoff = float(get_env("MXNET_SERVE_RETRY_BACKOFF"))
+        if cb_fails is None:
+            cb_fails = int(get_env("MXNET_SERVE_CB_FAILS"))
+        if cb_reset is None:
+            cb_reset = float(get_env("MXNET_SERVE_CB_RESET"))
+        if probe_interval is None:
+            probe_interval = float(get_env("MXNET_SERVE_PROBE_INTERVAL"))
+        self._retries = max(0, int(retries))
+        self._backoff = max(0.0, float(backoff))
+        self._probe_interval = float(probe_interval)
+        if isinstance(build_registry, (list, tuple)):
+            registries = list(build_registry)
+        else:
+            registries = [build_registry(i) for i in range(n_replicas)]
+        if not registries:
+            raise MXNetError("a ReplicaSet needs at least one replica")
+        for i, reg in enumerate(registries):
+            if not isinstance(reg, ModelRegistry):
+                raise MXNetError("replica %d: build_registry must yield "
+                                 "a ModelRegistry, got %r" % (i, reg))
+        self._replicas = [
+            Replica(i, reg, gen=gen, max_delay_ms=max_delay_ms,
+                    max_batch=max_batch, max_inflight=max_inflight,
+                    breaker=CircuitBreaker(fail_threshold=cb_fails,
+                                           reset_after=cb_reset))
+            for i, reg in enumerate(registries)]
+        self._lock = make_lock("serving.replica_set")
+        self._stats = {"submitted": 0, "dispatched": 0, "retries": 0,
+                       "failovers": 0, "shed": 0, "no_live": 0,
+                       "probe_failures": 0, "gen_submitted": 0,
+                       "gen_aborted": 0}
+        self._closed = False
+        # the in-process SIGKILL: a scheduled `die` at the
+        # serve.dispatch seam kills the TARGETED replica (meta carries
+        # sid) and fails the triggering dispatch like a severed
+        # connection — os._exit would take the whole test process
+        faultinject.register_die_handler(SEAM, self._injected_die)
+        self._probe_stop = threading.Event()
+        self._prober = None
+        if self._probe_interval > 0:
+            self._prober = threading.Thread(target=self._probe_loop,
+                                            name="mxt-serve-probe",
+                                            daemon=True)
+            self._prober.start()
+
+    # -- faultinject ---------------------------------------------------
+    def _injected_die(self, meta):
+        sid = meta.get("sid")
+        if sid is not None and 0 <= int(sid) < len(self._replicas):
+            self._replicas[int(sid)].kill()
+        raise ReplicaDied("replica %s died (injected at %s)"
+                          % (sid, SEAM))
+
+    # -- balancer ------------------------------------------------------
+    def _pick(self, excluded):
+        """Least-loaded live replica whose breaker admits a call; None
+        when nothing is eligible.  Iterates load-ordered so at most the
+        chosen replica consumes a half-open trial slot."""
+        with self._lock:
+            order = sorted(
+                (r for r in self._replicas
+                 if r.alive and r.index not in excluded),
+                key=lambda r: (r.inflight, r.index))
+        for r in order:
+            if r.breaker.allow():
+                return r
+        return None
+
+    def replicas(self):
+        return list(self._replicas)
+
+    def alive(self):
+        """Liveness witness (the front door's /healthz reads it): at
+        least one replica can serve."""
+        return not self._closed and any(r.alive for r in self._replicas)
+
+    def live_replicas(self):
+        return [r.index for r in self._replicas if r.alive]
+
+    def kill_replica(self, index):
+        """Kill one replica (tests / chaos drills); the balancer
+        converges to the survivors within one probe interval."""
+        self._replicas[index].kill()
+
+    # -- forward requests ----------------------------------------------
+    def submit(self, model, timeout=None, **inputs):
+        """Balanced forward submit; returns a Future resolving to the
+        output arrays.  ``timeout`` is the END-TO-END deadline: it
+        propagates into each attempt's queue budget and bounds the
+        whole retry chain."""
+        fut = Future()
+        state = {
+            "model": model, "inputs": inputs, "future": fut,
+            "deadline": (time.monotonic() + timeout
+                         if timeout is not None else None),
+            "attempt": 0, "excluded": set(), "last_exc": None,
+        }
+        with self._lock:
+            self._stats["submitted"] += 1
+        self._dispatch(state)
+        return fut
+
+    def _dispatch(self, state):
+        """One placement attempt: pick a replica, cross the faultinject
+        seam, submit to its engine.  Retryable failures (replica died /
+        engine closed / severed) reroute; ServeOverloaded excludes the
+        replica and tries the next immediately; when nothing is left
+        the request resolves with the structured last error.  Runs on
+        the submitting thread or a retry timer thread — never on an
+        engine thread."""
+        t0 = time.perf_counter_ns()
+        while True:
+            if state["deadline"] is not None \
+                    and time.monotonic() > state["deadline"]:
+                self._resolve(state["future"], exc=ServeTimeout(
+                    "request deadline expired during replica failover "
+                    "(last error: %r)" % (state["last_exc"],)))
+                return
+            r = self._pick(state["excluded"])
+            if r is None:
+                self._resolve_no_replica(state)
+                return
+            try:
+                faultinject.hook(SEAM, kind="forward", sid=r.index,
+                                 model=state["model"])
+                if not r.alive:
+                    raise ReplicaDied("replica %d is dead" % r.index)
+                remaining = None
+                if state["deadline"] is not None:
+                    remaining = max(0.0,
+                                    state["deadline"] - time.monotonic())
+                inner = r.engine.submit(state["model"], timeout=remaining,
+                                        **state["inputs"])
+            except ServeOverloaded as e:
+                # this replica is at budget — others may have room.
+                # The structured shed proves the engine is ALIVE, so
+                # report success to the breaker (a consumed half-open
+                # trial slot must be released or the replica wedges
+                # out of rotation when the prober is disabled)
+                r.breaker.record_success()
+                state["excluded"].add(r.index)
+                state["last_exc"] = e
+                continue
+            except (ReplicaDied, ServeClosed, OSError) as e:
+                r.breaker.record_failure(e)
+                state["excluded"].add(r.index)
+                state["last_exc"] = e
+                if not self._schedule_retry(state):
+                    return
+                continue
+            except MXNetError as e:
+                # validation/config errors are not retryable, and this
+                # may run on a retry-timer thread — resolve, never
+                # raise.  The replica answered: healthy for the breaker
+                r.breaker.record_success()
+                self._resolve(state["future"], exc=e)
+                return
+            with self._lock:
+                r.inflight += 1
+                self._stats["dispatched"] += 1
+            inner.add_done_callback(
+                lambda f, s=state, rep=r: self._inner_done(s, rep, f))
+            _profiler.record_phase("serve_dispatch", t0)
+            return
+
+    def _schedule_retry(self, state):
+        """Count one failover attempt; False = budget exhausted and the
+        request was resolved with its last error."""
+        state["attempt"] += 1
+        with self._lock:
+            self._stats["retries"] += 1
+        if state["attempt"] > self._retries:
+            self._resolve(state["future"], exc=state["last_exc"])
+            return False
+        return True
+
+    def _resolve_no_replica(self, state):
+        last = state["last_exc"]
+        with self._lock:
+            if isinstance(last, ServeOverloaded):
+                self._stats["shed"] += 1
+            else:
+                self._stats["no_live"] += 1
+        if isinstance(last, ServeOverloaded):
+            exc = last  # every live replica is at its inflight budget
+        else:
+            exc = NoLiveReplicas(
+                "no live replica can serve this request (last error: %r)"
+                % (last,))
+        self._resolve(state["future"], exc=exc)
+
+    def _inner_done(self, state, r, inner):
+        """Completion of one replica attempt (runs on the replica
+        engine's completer thread — schedule, never sleep, here)."""
+        with self._lock:
+            r.inflight -= 1
+        if inner.cancelled():
+            state["future"].cancel()
+            return
+        exc = inner.exception()
+        if exc is None:
+            r.breaker.record_success()
+            self._resolve(state["future"], result=inner.result())
+            return
+        if isinstance(exc, (ReplicaDied, ServeClosed, OSError)):
+            # the replica accepted the request but could not serve it
+            # (killed / closed under us): a forward is idempotent —
+            # fail over to a survivor after backoff
+            r.breaker.record_failure(exc)
+            state["excluded"].add(r.index)
+            state["last_exc"] = exc
+            with self._lock:
+                self._stats["failovers"] += 1
+            if not self._schedule_retry(state):
+                return
+            delay = backoff_delay(state["attempt"] - 1, self._backoff,
+                                  self._backoff * 16.0)
+            timer = threading.Timer(delay, self._dispatch, args=(state,))
+            timer.daemon = True
+            timer.start()
+            return
+        # non-retryable (ServeTimeout, validation errors): as-is
+        self._resolve(state["future"], exc=exc)
+
+    def _resolve(self, fut, result=None, exc=None):
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        except InvalidStateError:
+            pass  # client cancel raced the resolution: the cancel wins
+
+    # -- generation requests -------------------------------------------
+    def submit_gen(self, model, tokens, **kwargs):
+        """Balanced generation submit; returns a Future resolving to a
+        GenerationResult.  Placement failures retry like forwards
+        (nothing is lost before admission), but once a replica accepts
+        the request there is NO transparent retry: if the replica dies,
+        its KV cache — and the partially sampled stream — died with it,
+        and the future fails fast with :class:`ReplicaDied` so the
+        client owns the resubmit decision."""
+        fut = Future()
+        state = {"attempt": 0, "excluded": set(), "last_exc": None}
+        with self._lock:
+            self._stats["gen_submitted"] += 1
+        while True:
+            r = self._pick(state["excluded"])
+            if r is None:
+                last = state["last_exc"]
+                self._resolve(fut, exc=last if isinstance(
+                    last, ServeOverloaded) else NoLiveReplicas(
+                    "no live replica can serve this generation "
+                    "(last error: %r)" % (last,)))
+                return fut
+            if r.gen_engine is None:
+                raise MXNetError("this ReplicaSet was built without "
+                                 "generation engines (gen=True)")
+            try:
+                faultinject.hook(SEAM, kind="gen", sid=r.index,
+                                 model=model)
+                if not r.alive:
+                    raise ReplicaDied("replica %d is dead" % r.index)
+                inner = r.gen_engine.submit(model, tokens, **kwargs)
+            except ServeOverloaded as e:
+                r.breaker.record_success()   # alive, just at budget
+                state["excluded"].add(r.index)
+                state["last_exc"] = e
+                continue
+            except (ReplicaDied, ServeClosed, OSError) as e:
+                r.breaker.record_failure(e)
+                state["excluded"].add(r.index)
+                state["last_exc"] = e
+                state["attempt"] += 1
+                with self._lock:
+                    self._stats["retries"] += 1
+                if state["attempt"] > self._retries:
+                    self._resolve(fut, exc=e)
+                    return fut
+                continue
+            except MXNetError as e:
+                r.breaker.record_success()   # the replica answered
+                self._resolve(fut, exc=e)
+                return fut
+            with self._lock:
+                r.inflight += 1
+                self._stats["dispatched"] += 1
+            inner.add_done_callback(
+                lambda f, rep=r: self._gen_done(fut, rep, f))
+            return fut
+
+    def _gen_done(self, fut, r, inner):
+        with self._lock:
+            r.inflight -= 1
+        if inner.cancelled():
+            fut.cancel()
+            return
+        exc = inner.exception()
+        if exc is None:
+            r.breaker.record_success()
+            self._resolve(fut, result=inner.result())
+            return
+        if isinstance(exc, (ServeClosed, OSError)) and not r.alive:
+            r.breaker.record_failure(exc)
+            with self._lock:
+                self._stats["gen_aborted"] += 1
+            exc = ReplicaDied(
+                "generation was lost with replica %d (its KV state "
+                "died); resubmit to regenerate" % r.index)
+        self._resolve(fut, exc=exc)
+
+    # -- health probing ------------------------------------------------
+    def _probe_loop(self):
+        while not self._probe_stop.wait(self._probe_interval):
+            self.probe_once()
+
+    def probe_once(self):
+        """One health sweep (the prober's body; tests call it directly
+        for clock-free determinism).  A probe crosses the same
+        ``serve.dispatch`` seam as requests — seeded fault schedules
+        see ``kind='probe'`` events — and the engine's ``alive()``
+        (dispatch loop running, accepting submits) is the liveness
+        witness; failures open the breaker, successes close it."""
+        for r in self._replicas:
+            try:
+                faultinject.hook(SEAM, kind="probe", sid=r.index)
+                if not r.alive:
+                    raise ReplicaDied("replica %d is dead" % r.index)
+                if not r.engine.alive():
+                    # the engine's dispatch loop is gone (crashed or
+                    # closed under us) even though nobody called
+                    # kill(): the probe must NOT re-close the breaker
+                    # or the set would flap this replica back into
+                    # rotation every interval
+                    raise ReplicaDied(
+                        "replica %d's engine dispatch loop has exited"
+                        % r.index)
+                r.breaker.record_success()
+            except BaseException as e:  # noqa: BLE001 — health verdict
+                r.breaker.record_failure(e)
+                with self._lock:
+                    self._stats["probe_failures"] += 1
+
+    # -- management ----------------------------------------------------
+    def swap_params(self, name, arg_params, aux_params=None):
+        """Fan the hot weight swap out to every LIVE replica's registry.
+        Each replica's swap is atomic per request; returns
+        {replica_index: new_version}."""
+        out = {}
+        for r in self._replicas:
+            if r.alive:
+                out[r.index] = r.registry.swap_params(name, arg_params,
+                                                      aux_params)
+        if not out:
+            raise NoLiveReplicas("no live replica to swap %r on" % name)
+        return out
+
+    def stats(self):
+        with self._lock:
+            out = dict(self._stats)
+            inflight = {r.index: r.inflight for r in self._replicas}
+        out["replicas"] = {
+            r.index: {"alive": r.alive, "breaker": r.breaker.state,
+                      "inflight": inflight[r.index],
+                      "engine": r.engine.stats()}
+            for r in self._replicas}
+        out["live"] = self.live_replicas()
+        return out
+
+    def close(self, drain=True, timeout=60.0):
+        """Stop the prober, close every replica (draining by default),
+        release the die-handler seam.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._probe_stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout)
+        # deregister only OUR handler: a newer ReplicaSet may have
+        # installed its own, and clobbering it would send the next
+        # scheduled die through os._exit (the whole-process kill the
+        # handler exists to avoid)
+        if faultinject.die_handler(SEAM) is self._injected_die:
+            faultinject.register_die_handler(SEAM, None)
+        for r in self._replicas:
+            r.close(drain=drain)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -44,7 +44,7 @@ from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, get_env, hot_path
 
 __all__ = ["ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
-           "FutureCompleter"]
+           "ServeOverloaded", "FutureCompleter"]
 
 _STOP = object()
 
@@ -119,6 +119,13 @@ class ServeClosed(MXNetError):
     """The engine is shut down (or shutting down without drain)."""
 
 
+class ServeOverloaded(MXNetError):
+    """Admission control shed the request: the engine's inflight budget
+    (``MXNET_SERVE_MAX_INFLIGHT``) is full.  Structured overload — the
+    HTTP front door maps it to 429 — instead of queueing into timeout
+    collapse; clients should back off and retry."""
+
+
 class ServeRequest:
     """One queued inference request (internal; clients hold the Future)."""
 
@@ -143,7 +150,8 @@ class ServingEngine:
     never mix models.
     """
 
-    def __init__(self, registry, max_delay_ms=None, max_batch=None):
+    def __init__(self, registry, max_delay_ms=None, max_batch=None,
+                 max_inflight=None):
         self._registry = registry
         if max_delay_ms is None:
             max_delay_ms = float(get_env("MXNET_SERVE_MAX_DELAY_MS"))
@@ -151,14 +159,19 @@ class ServingEngine:
         if max_batch is None:
             max_batch = int(get_env("MXNET_SERVE_MAX_BATCH"))
         self._max_batch = max(1, int(max_batch))
+        if max_inflight is None:
+            max_inflight = int(get_env("MXNET_SERVE_MAX_INFLIGHT"))
+        self._max_inflight = max(0, int(max_inflight))  # 0 = unbounded
+        self._inflight = 0
         self._queue = queue.Queue()
         self._pending = collections.deque()
         self._closed = False
+        self._inflight_reqs = ()
         self._submit_lock = make_lock("serving.submit")
         self._stats_lock = make_lock("serving.stats")
         self._stats = {"requests": 0, "batches": 0, "rows": 0,
                        "padded_rows": 0, "timeouts": 0, "cancelled": 0,
-                       "errors": 0, "max_rows_in_batch": 0}
+                       "errors": 0, "shed": 0, "max_rows_in_batch": 0}
         # test seam (faultinject spirit): called with (model, live_reqs)
         # right before each dispatch; tests install sleeps/recorders here
         self._dispatch_hook = None
@@ -174,7 +187,20 @@ class ServingEngine:
         ``timeout`` (seconds) bounds time-in-queue: an expired request
         fails with :class:`ServeTimeout` instead of computing.  Input
         validation/canonicalization (np conversion, dtype, shapes)
-        happens here on the caller's thread."""
+        happens here on the caller's thread.
+
+        Admission control: when ``MXNET_SERVE_MAX_INFLIGHT`` (or the
+        constructor's ``max_inflight``) is set, a submit that would
+        push the number of accepted-but-unresolved requests past the
+        budget is SHED with :class:`ServeOverloaded` instead of queued
+        — under sustained overload the queue would otherwise grow
+        without bound and every request would time out (the loadgen's
+        collapse phase); shedding keeps the accepted requests' latency
+        flat and gives clients a structured back-off signal."""
+        if self._closed:
+            # cheap early gate so EVERY post-close submit raises
+            # ServeClosed — not a validation error about its payload
+            raise ServeClosed("serving engine is closed")
         store = self._registry.store(model)
         canon, n = store.canon_inputs(inputs)
         fut = Future()
@@ -185,10 +211,30 @@ class ServingEngine:
         with self._submit_lock:
             if self._closed:
                 raise ServeClosed("serving engine is closed")
+            if self._max_inflight and self._inflight >= self._max_inflight:
+                with self._stats_lock:
+                    self._stats["shed"] += 1
+                raise ServeOverloaded(
+                    "serving engine is at its inflight budget (%d); "
+                    "request shed — back off and retry"
+                    % self._max_inflight)
+            self._inflight += 1
             self._queue.put(req)
+        # exactly one resolution per accepted request (result, error or
+        # cancel) ends its inflight accounting
+        fut.add_done_callback(self._note_resolved)
         with self._stats_lock:
             self._stats["requests"] += 1
         return fut
+
+    def _note_resolved(self, _fut):
+        with self._submit_lock:
+            self._inflight -= 1
+
+    def alive(self):
+        """Liveness witness (the front door's /healthz reads it): the
+        dispatch loop is running and accepting submits."""
+        return not self._closed and self._thread.is_alive()
 
     def stats(self):
         """Scheduler counters plus each model's program-store stats,
@@ -197,6 +243,9 @@ class ServingEngine:
         serve_smoke read this instead of recomputing)."""
         with self._stats_lock:
             out = dict(self._stats)
+        with self._submit_lock:
+            out["inflight"] = self._inflight
+        out["max_inflight"] = self._max_inflight
         out["models"] = self._registry.stats()
         rollup = {}
         for m in out["models"].values():
@@ -234,8 +283,46 @@ class ServingEngine:
 
     # -- engine thread -------------------------------------------------
     def _serve_loop(self):
-        while self._dispatch_once():
-            pass
+        try:
+            while self._dispatch_once():
+                pass
+        finally:
+            # the dispatch loop is exiting — normally (close()) or
+            # because a cycle raised something unexpected.  Either way
+            # the queue must never again accept a request that nothing
+            # will serve: latch closed FIRST (submit raises ServeClosed
+            # from here on), then fail whatever is still queued.  On a
+            # clean close() the sweep finds nothing; on a crashed loop
+            # it turns silently-dropped requests into ServeClosed.
+            with self._submit_lock:
+                self._closed = True
+            self._fail_remaining()
+
+    def _fail_remaining(self):
+        """Resolve everything still parked or queued with ServeClosed
+        (nothing will ever dispatch it) — including the whole batch the
+        loop had already taken off the queue when it crashed."""
+        inflight = self._inflight_reqs
+        self._inflight_reqs = ()
+        for r in inflight:
+            # double-resolution of an already-served request is
+            # harmless: the completer swallows InvalidStateError
+            self._resolve(r.future, exc=ServeClosed(
+                "serving engine dispatch loop exited before this "
+                "request could be served"))
+        while True:
+            if self._pending:
+                head = self._pending.popleft()
+            else:
+                try:
+                    head = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+            if head is _STOP:
+                continue
+            self._resolve(head.future, exc=ServeClosed(
+                "serving engine dispatch loop exited before this "
+                "request could be served"))
 
     @hot_path
     def _dispatch_once(self):
@@ -248,16 +335,33 @@ class ServingEngine:
         if head is _STOP:
             self._shutdown()
             return False
+        # from here until their batch resolves, the head — and then
+        # every request _collect gathers around it — lives in neither
+        # the queue nor the pending deque: track the whole set so a
+        # crashing cycle cannot silently drop ANY accepted request
+        # (the exit sweep resolves them with ServeClosed)
+        self._inflight_reqs = (head,)
         if self._closed and not getattr(self, "_drain_on_stop", True):
             # close(drain=False): queued work ahead of the STOP
             # sentinel fails fast instead of being served out
             self._resolve(head.future, exc=ServeClosed(
                 "serving engine closed before dispatch"))
+            self._inflight_reqs = ()
             return True
         t1 = time.perf_counter_ns()
         reqs, rows, stop = self._collect(head)
+        self._inflight_reqs = tuple(reqs)
         _profiler.record_phase("serve_batch", t1)
-        self._dispatch_batch(head.model, reqs, rows)
+        if self._closed and not getattr(self, "_drain_on_stop", True):
+            # close(drain=False) landed while the batch was forming:
+            # fail-fast semantics apply to the whole collected batch,
+            # not just heads taken after the flag flipped
+            for r in reqs:
+                self._resolve(r.future, exc=ServeClosed(
+                    "serving engine closed before dispatch"))
+        else:
+            self._dispatch_batch(head.model, reqs, rows)
+        self._inflight_reqs = ()
         if stop:
             self._shutdown()
             return False
@@ -413,8 +517,11 @@ class ServingEngine:
                 self._resolve(head.future, exc=ServeClosed(
                     "serving engine closed before dispatch"))
                 continue
+            self._inflight_reqs = (head,)
             reqs, rows, _ = self._collect_ready(head)
+            self._inflight_reqs = tuple(reqs)
             self._dispatch_batch(head.model, reqs, rows)
+            self._inflight_reqs = ()
 
     def _collect_ready(self, head):
         """Shutdown-time batch forming: same-model coalescing, but only

@@ -398,6 +398,17 @@ def test_close_nodrain_fails_fast(registry):
 def test_timeout_expires_in_queue(registry):
     from mxnet_tpu.serving import ServeTimeout
     eng = GenerationEngine(registry, max_active=1)
+    # throttle decode steps so the slot-occupying generation is STILL
+    # active when the queued request's deadline is checked (on a warm
+    # process 30 unthrottled steps can finish inside the sleep below,
+    # letting the queued request admit instead of timing out)
+    orig_decode = eng._decode_and_sample
+
+    def slow_decode(st, toks, lens):
+        time.sleep(0.01)
+        return orig_decode(st, toks, lens)
+
+    eng._decode_and_sample = slow_decode
     try:
         slow = eng.submit("m", [1, 2], max_tokens=30)
         time.sleep(0.05)   # occupy the single slot

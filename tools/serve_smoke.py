@@ -27,10 +27,26 @@ Deterministic: the arrival schedule and request contents derive from
 ``--seed`` (faultinject-style); residual wall-clock noise moves the
 measured numbers, not the schedule.
 
+Front-door modes (``make frontdoor-smoke`` runs all three; each is a
+seeded deterministic scenario over the shared loadgen protocols in
+``serving/loadgen.py``):
+
+* ``--http`` — HTTP front door vs in-process on the SAME schedule
+  (gates: zero drops on both transports, achieved QPS tracks offered);
+* ``--kill-one`` (with ``--replicas N``) — one of N shared-nothing
+  replicas SIGKILLed by a seeded ``die`` at the ``serve.dispatch``
+  faultinject seam under open-loop load (gates: 100% of accepted
+  requests resolve, zero drops, balancer converges to N-1 survivors,
+  post-kill achieved QPS >= 2/3 of pre-kill);
+* ``--swap`` — hot weight swap under concurrent traffic (gates: every
+  response bit-matches exactly one of {old, new} weights — zero torn
+  reads — and the version counter advances exactly once).
+
 Usage::
 
     python tools/serve_smoke.py [--seed 11] [--qps-floor 3.0] [--full]
         [--dtype fp32|bf16|int8|all]
+        [--replicas 3] [--kill-one] [--swap] [--http]
 """
 from __future__ import annotations
 
@@ -104,6 +120,96 @@ def run_mode(mode, args):
     return ["%s: %s" % (mode, msg) for msg in failures]
 
 
+def run_http(args):
+    """HTTP-vs-in-process on the same seeded schedule; returns the
+    failure list."""
+    from mxnet_tpu.serving.loadgen import frontdoor_protocol
+    r = frontdoor_protocol(smoke=not args.full, seed=args.seed + 6)
+    if args.json:
+        print(json.dumps(r, indent=1))
+    h, ip = r["http"], r["inproc"]
+
+    def f(v):
+        # a side with zero successes reports None percentiles: keep
+        # the report printable so the FAIL lines below still emit
+        return "n/a" if v is None else "%.2f" % v
+
+    print("frontdoor-http (seed %d): in-process p50/p99 %s/%s ms, "
+          "HTTP %s/%s ms (p99 ratio %s), achieved %s vs %s qps"
+          % (args.seed + 6, f(ip["p50_ms"]), f(ip["p99_ms"]),
+             f(h["p50_ms"]), f(h["p99_ms"]), r["http_p99_vs_inproc"],
+             h["qps_achieved"], ip["qps_achieved"]))
+    failures = []
+    for tag, side in (("in-process", ip), ("http", h)):
+        bad = side["timeouts"] + side["errors"] + side["cancelled"]
+        if bad:
+            failures.append("http: %s side dropped %d of %d"
+                            % (tag, bad, side["n"]))
+    if r["http_qps_vs_inproc"] is None or r["http_qps_vs_inproc"] < 0.8:
+        failures.append("http: achieved QPS over HTTP is %s of "
+                        "in-process (want >= 0.8 below saturation)"
+                        % r["http_qps_vs_inproc"])
+    return failures
+
+
+def run_kill_one(args):
+    """Kill-one-of-N drain scenario; returns the failure list."""
+    from mxnet_tpu.serving.loadgen import failover_protocol
+    r = failover_protocol(smoke=not args.full, seed=args.seed + 8,
+                          n_replicas=args.replicas)
+    if args.json:
+        print(json.dumps(r, indent=1))
+    s = r["summary"]
+    print("frontdoor-kill-one (seed %d, %d replicas): %d/%d resolved, "
+          "%d dropped, failovers %d, live after %s, post/pre qps %s, "
+          "recovery %s ms"
+          % (args.seed + 8, r["n_replicas"], r["resolved"], s["n"],
+             r["dropped"], r["failovers"], r["live_after"],
+             r.get("post_vs_pre_qps"), r.get("recovery_ms")))
+    failures = []
+    if not r["killed"]:
+        failures.append("kill-one: the seeded die never fired")
+    if r["resolved"] != s["n"]:
+        failures.append("kill-one: %d of %d requests never resolved "
+                        "(client hang)" % (s["n"] - r["resolved"],
+                                           s["n"]))
+    if r["dropped"]:
+        failures.append("kill-one: %d accepted requests dropped"
+                        % r["dropped"])
+    if len(r["live_after"]) != args.replicas - 1:
+        failures.append("kill-one: balancer did not converge to %d "
+                        "survivors (live: %s)"
+                        % (args.replicas - 1, r["live_after"]))
+    ratio = r.get("post_vs_pre_qps")
+    if ratio is not None and ratio < 2.0 / 3.0:
+        failures.append("kill-one: post-kill QPS %.2f of pre-kill "
+                        "(want >= 2/3)" % ratio)
+    return failures
+
+
+def run_swap(args):
+    """Hot-swap bit-consistency scenario; returns the failure list."""
+    from mxnet_tpu.serving.loadgen import swap_protocol
+    r = swap_protocol(smoke=not args.full, seed=args.seed + 12)
+    if args.json:
+        print(json.dumps(r, indent=1))
+    print("frontdoor-swap (seed %d): %d responses -> %d old + %d new + "
+          "%d neither; version %d -> %d"
+          % (args.seed + 12, r["n"], r["old"], r["new"], r["neither"],
+             r["version_before"], r["version_after"]))
+    failures = []
+    if r["neither"]:
+        failures.append("swap: %d responses matched NEITHER weight "
+                        "version (torn read)" % r["neither"])
+    if not (r["old"] and r["new"]):
+        failures.append("swap: traffic did not straddle the swap "
+                        "(old=%d new=%d)" % (r["old"], r["new"]))
+    if r["version_increments"] != 1:
+        failures.append("swap: version counter advanced %d times "
+                        "(want exactly 1)" % r["version_increments"])
+    return failures
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=11)
@@ -118,20 +224,41 @@ def main(argv=None):
     ap.add_argument("--mode", dest="dtype",
                     choices=("fp32", "bf16", "int8"),
                     help=argparse.SUPPRESS)  # pre-dtype-matrix alias
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="replica count for --kill-one")
+    ap.add_argument("--kill-one", action="store_true",
+                    help="kill-one-replica-under-load drain gate")
+    ap.add_argument("--swap", action="store_true",
+                    help="hot-weight-swap bit-consistency gate")
+    ap.add_argument("--http", action="store_true",
+                    help="HTTP front door vs in-process gate")
     ap.add_argument("--json", action="store_true",
                     help="dump the full protocol result as JSON")
     args = ap.parse_args(argv)
 
-    modes = (("fp32", "bf16", "int8") if args.dtype == "all"
-             else (args.dtype,))
     failures = []
-    for mode in modes:
-        failures += run_mode(mode, args)
+    ran = []
+    frontdoor_only = args.kill_one or args.swap or args.http
+    if args.http:
+        failures += run_http(args)
+        ran.append("http")
+    if args.kill_one:
+        failures += run_kill_one(args)
+        ran.append("kill-one")
+    if args.swap:
+        failures += run_swap(args)
+        ran.append("swap")
+    if not frontdoor_only:
+        modes = (("fp32", "bf16", "int8") if args.dtype == "all"
+                 else (args.dtype,))
+        for mode in modes:
+            failures += run_mode(mode, args)
+        ran += list(modes)
     if failures:
         for msg in failures:
             print("FAIL: %s" % msg)
         return 1
-    print("serve-smoke: OK (%s)" % ", ".join(modes))
+    print("serve-smoke: OK (%s)" % ", ".join(ran))
     return 0
 
 
