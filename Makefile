@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke kernels-smoke data-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke kernels-smoke data-smoke obs-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -134,6 +134,19 @@ kernels-smoke:
 data-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu \
 		$(PY) -m pytest tests/test_data_pipeline.py -q
+
+# telemetry-plane gate (docs/architecture/observability.md): the
+# trace-id propagation pin (one HTTP :generate yields a connected
+# frontdoor->replica->engine->prefill->decode span tree under a single
+# trace id, across a replica retry), log-bucketed histogram quantile
+# accuracy vs numpy.percentile, deterministic seeded trace sampling,
+# the flight-recorder postmortem after the seeded replica-die scenario
+# (artifact names the dying replica), GET /metrics Prometheus parse,
+# the cached /stats age_ms contract, stats()-reads-through-registry
+# pins, and the live + banked telemetry overhead gates
+obs-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/test_observability.py -q
 
 # smoke fit under the profiler -> per-step phase breakdown
 # (data_wait/h2d_stage/compute/metric_fetch) from the dumped trace, so

@@ -989,6 +989,42 @@ def bench_serving_frontdoor(which, chip, smoke=False):
     }
 
 
+def bench_observability(chip, smoke=False):
+    """Telemetry overhead row (serving/loadgen.py
+    observability_protocol): the SAME engine+schedule served with
+    telemetry fully ON (default trace sampling, metrics, flight ring,
+    live JSONL export) vs fully OFF, plus the MXNET_TRACE_SAMPLE=0
+    hatch.  The capacity ratio is the direct overhead evidence; the
+    open-loop p99 ratio shows the tail cost under load."""
+    from mxnet_tpu.serving.loadgen import observability_protocol
+
+    r = observability_protocol(smoke=smoke)
+    return {
+        "metric": "serving.observability.overhead",
+        "value": r["qps_full_vs_baseline"], "unit": "ratio",
+        "vs_baseline": None,
+        "baseline_closed_qps": r["baseline"]["closed_qps"],
+        "full_closed_qps": r["full"]["closed_qps"],
+        "sample0_closed_qps": r["sample0"]["closed_qps"],
+        "baseline_p99_ms": r["baseline"]["p99_ms"],
+        "full_p99_ms": r["full"]["p99_ms"],
+        "sample0_p99_ms": r["sample0"]["p99_ms"],
+        "p99_full_vs_baseline": r["p99_full_vs_baseline"],
+        "qps_sample0_vs_baseline": r["qps_sample0_vs_baseline"],
+        "p99_sample0_vs_baseline": r["p99_sample0_vs_baseline"],
+        "traces_exported": r["traces_exported"],
+        "dropped": r["full"]["dropped"],
+        "n_requests": r["n_load"],
+        "seed": r["seed"],
+        "note": ("full tracing (sample=1.0, JSONL export) + metrics + "
+                 "flight ring vs the untelemetered engine on one "
+                 "seeded schedule; acceptance: capacity ratio >= 0.95, "
+                 "p99 ratio <= 1.10, and MXNET_TRACE_SAMPLE=0 back "
+                 "within noise (tests/test_observability.py pins the "
+                 "banked figures)"),
+    }
+
+
 # the generation protocol runs both sides (re-prefill baseline +
 # continuous-batching engine) in one sweep; cache it so the two
 # serving.decode.* rows don't pay it twice
@@ -2018,6 +2054,12 @@ def main():
           "http_overhead", chip, smoke)
     guard("serving.frontdoor.failover", bench_serving_frontdoor,
           "failover", chip, smoke)
+    # telemetry-plane overhead row: full tracing+metrics+flight at
+    # default sampling vs the untelemetered engine on the same seeded
+    # schedule (acceptance: <= 5% capacity, <= 10% p99; sample=0
+    # restores baseline within noise)
+    guard("serving.observability.overhead", bench_observability, chip,
+          smoke)
     # decode-plane generation rows: continuous batching over the KV
     # cache vs the naive re-prefill-per-token baseline, same seeded
     # open-loop schedule (tokens/sec + TTFT + inter-token latency),

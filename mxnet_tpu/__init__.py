@@ -58,6 +58,8 @@ from . import callback  # noqa: E402
 from . import monitor  # noqa: E402
 from .monitor import Monitor  # noqa: E402
 from . import profiler  # noqa: E402
+from . import metrics  # noqa: E402  (process metrics registry)
+from . import tracing  # noqa: E402  (request tracing + flight recorder)
 from . import rnn  # noqa: E402
 from . import visualization  # noqa: E402
 from . import visualization as viz  # noqa: E402

@@ -61,8 +61,10 @@ SPAN_ENTRY_POINTS = (
      "GenerationEngine._dispatch_decode_sample"),
     ("mxnet_tpu/serving/decode_engine.py",
      "GenerationEngine._dispatch_prefill"),
+    ("mxnet_tpu/serving/frontdoor.py", "_Handler._serve_generate"),
     ("mxnet_tpu/serving/frontdoor.py", "_Handler._serve_predict"),
     ("mxnet_tpu/serving/replica_set.py", "ReplicaSet._dispatch"),
+    ("mxnet_tpu/serving/replica_set.py", "ReplicaSet.submit_gen"),
     ("mxnet_tpu/serving/scheduler.py", "ServingEngine._dispatch_once"),
 )
 

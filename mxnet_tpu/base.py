@@ -417,6 +417,50 @@ register_env("MXNET_SERVE_CB_RESET", float, 1.0,
              "breaker admits one half-open trial (the next probe or "
              "request): trial success re-closes the breaker and the "
              "replica rejoins the rotation, failure re-opens it.")
+register_env("MXNET_TRACE_SAMPLE", float, 1.0,
+             "Per-request trace sampling rate in [0, 1] "
+             "(mxnet_tpu/tracing.py): each trace minted at the serving "
+             "front door (or at submit for in-process callers) is "
+             "sampled deterministically from (MXNET_TRACE_SEED, mint "
+             "sequence); unsampled traces keep their id but record no "
+             "spans.  0 restores the untraced fast path; 1 (default) "
+             "traces every request.")
+register_env("MXNET_TRACE_SEED", int, 0,
+             "Seed of the deterministic per-trace sampling hash: the "
+             "same (seed, sequence, rate) samples the same requests on "
+             "every host and run (tracing.sample_decision).")
+register_env("MXNET_TRACE_JSONL", str, "",
+             "Path of the structured per-trace JSONL sink: every "
+             "finished SAMPLED trace appends one JSON line (trace id, "
+             "status, span tree with parent ids and ms timings).  "
+             "Empty disables the sink (spans still reach the Chrome "
+             "trace when the profiler runs, and the flight ring "
+             "either way).")
+register_env("MXNET_METRICS", bool, True,
+             "Ambient metrics instrumentation (mxnet_tpu/metrics.py): "
+             "'0' silences the record_phase histogram feed and other "
+             "ambient observation seams.  Explicitly created "
+             "instruments — the counters legacy stats() trees read "
+             "through — keep counting either way.")
+register_env("MXNET_FLIGHT_CAPACITY", int, 2048,
+             "Events held by the crash flight recorder's bounded ring "
+             "(mxnet_tpu/tracing.py FlightRecorder: recent spans/"
+             "events/errors, fixed memory, dumped on engine-loop "
+             "crash, on the serve.dispatch faultinject die path, and "
+             "on demand via GET /debug/flight or flight.dump()).  0 "
+             "disables recording entirely.")
+register_env("MXNET_FLIGHT_DIR", str, "",
+             "Directory where flight-recorder postmortems are written "
+             "(flight.<pid>.<n>.json via base.atomic_write) when an "
+             "engine loop crashes or a serving replica is killed.  "
+             "Empty disables the on-disk dumps; the in-memory ring "
+             "stays readable (GET /debug/flight).")
+register_env("MXNET_SERVE_STATS_TTL_MS", float, 250.0,
+             "Max age (milliseconds) of the serving front door's "
+             "cached /stats snapshot: within it, polls are served "
+             "from the cache (with an age_ms field) instead of "
+             "re-walking the full stats tree per request.  <= 0 "
+             "re-walks every poll (the pre-cache behavior).")
 register_env("MXNET_AUTO_RESUME", str, "",
              "Checkpoint prefix for hands-off crash resume: when set, "
              "Module.fit() with no explicit resume_data_state loads "

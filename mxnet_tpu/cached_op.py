@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import engine as _engine
+from . import metrics as _metrics
 from .analysis.lockcheck import make_lock
 from .base import get_env, hot_path
 from .pallas_ops import dispatch as _pallas_dispatch
@@ -199,6 +200,20 @@ def stats():
     """Per-op hit/miss/eviction counters plus totals (engine surface:
     ``engine.get().imperative_cache_stats()``)."""
     return _get_cache().snapshot()
+
+
+def _snapshot_field(key):
+    return lambda: _get_cache().snapshot()[key]
+
+
+# The dispatch path is the hottest loop in the package, so the metrics
+# plane reads the cache's own counters at SCRAPE time (pull gauges)
+# instead of paying a registry increment per imperative op.
+for _key in ("hits", "misses", "evictions", "size"):
+    _metrics.gauge_fn("imperative_cache_" + _key, _snapshot_field(_key),
+                      help="imperative cached-op LRU, read-through "
+                      "from cached_op.stats()")
+del _key
 
 
 def enabled():

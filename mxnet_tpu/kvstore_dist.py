@@ -81,6 +81,7 @@ import numpy as np
 
 from . import faultinject
 from . import kvstore_codec as codec
+from . import metrics as _metrics
 from .analysis import lockcheck
 from .base import MXNetError, atomic_write, get_env
 
@@ -133,6 +134,15 @@ class PlanMovedError(MXNetError):
 # replica failover runs the SAME math; re-imported here so this module
 # remains their historical import path (tests and callers unchanged).
 from .retry import CircuitBreaker, RetryPolicy, backoff_delay  # noqa: E402,F401
+
+
+def _wire_counter(name, rpc):
+    """Bytes-on-wire counter in the process metrics registry (one
+    series per rpc direction; metrics.cached_counter keeps _account at
+    one dict lookup per RPC)."""
+    return _metrics.cached_counter(
+        name, labels={"rpc": rpc},
+        help="dist-kvstore payload accounting (wire_stats twin)")
 
 
 def _prof_record(name, start_ns, cat):
@@ -1615,6 +1625,10 @@ class WorkerClient:
         with self._wire_lock:
             self._wire[rpc + "_bytes"] += int(n)
             self._wire[rpc + "_rpcs"] += 1
+        # the same accounting feeds the process metrics registry, so
+        # GET /metrics carries bytes-on-wire beside the serving plane
+        _wire_counter("kvstore_wire_bytes_total", rpc).inc(int(n))
+        _wire_counter("kvstore_wire_rpcs_total", rpc).inc()
 
     def wire_stats(self):
         """Snapshot of the payload-byte / RPC counters."""

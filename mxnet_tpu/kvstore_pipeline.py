@@ -41,10 +41,21 @@ import itertools
 import threading
 import time
 
+from . import metrics as _metrics
 from .analysis import lockcheck
 from .base import MXNetError, get_env, hot_path
 
 __all__ = ["CommOp", "CommPipeline"]
+
+# data-plane pipeline instruments (one worker process runs one
+# pipeline, so the outstanding gauge is process-scoped like the rest)
+_C_OPS = _metrics.counter(
+    "kvstore_pipeline_ops_total", labels=None,
+    help="operations submitted into the async kvstore pipeline")
+_G_OUT = _metrics.gauge(
+    "kvstore_pipeline_outstanding",
+    help="submitted-but-unfinished ops in the pipeline's in-flight "
+    "window")
 
 
 class CommOp:
@@ -124,6 +135,8 @@ class CommPipeline:
                 self._epoch_t0 = time.perf_counter_ns()
             self._epoch_ops += 1
             self._outstanding += 1
+            _C_OPS.inc()
+            _G_OUT.set(self._outstanding)
             prev = self._chains.get(op.key)
             self._chains[op.key] = op
             if prev is None:
@@ -224,6 +237,7 @@ class CommPipeline:
         op.error = err
         op.done.set()
         self._outstanding -= 1
+        _G_OUT.set(self._outstanding)
         if self._chains.get(op.key) is op:
             del self._chains[op.key]
         for nxt in op._next:

@@ -17,6 +17,8 @@ import threading
 import time
 
 from . import engine as _engine
+from . import metrics as _metrics
+from . import tracing as _tracing
 from .analysis.lockcheck import make_lock
 from .base import get_env
 
@@ -196,14 +198,29 @@ def stop_step_profile():
     return col.report() if col is not None else None
 
 
+def _phase_hist(name):
+    """The phase's registry histogram (the metrics plane's aggregate
+    view of the same spans: p50/p95/p99 per phase without storing
+    samples; metrics.cached_histogram keeps this one dict lookup)."""
+    return _metrics.cached_histogram(
+        "phase_seconds", help="wall time of one profiler phase span",
+        labels={"phase": name})
+
+
 def record_phase(name, start_ns, end_ns=None):
-    """Report one step-phase span to whichever sinks are active (the
-    step collector and/or the Chrome-trace profiler).  A no-op costing
-    two dict lookups when neither is on — callers may invoke it
+    """Report one step-phase span to whichever sinks are active: the
+    step collector, the Chrome-trace profiler, the metrics registry's
+    per-phase histogram (``phase_seconds{phase=...}``, unless
+    ``MXNET_METRICS=0``), any traces activated on this thread
+    (tracing.on_phase — the span becomes a child of each request's
+    trace) and the flight-recorder ring.  A no-op costing a few dict/
+    env lookups when everything is off — callers may invoke it
     unconditionally from hot loops."""
     col = _phase_state["collector"]
     prof = _state["profiler"]
-    if col is None and prof is None:
+    mets = _metrics.phase_on()
+    if col is None and prof is None and not mets \
+            and not _tracing.sinks_active():
         return
     if end_ns is None:
         end_ns = time.perf_counter_ns()
@@ -211,13 +228,20 @@ def record_phase(name, start_ns, end_ns=None):
         col.record(name, end_ns - start_ns)
     if prof is not None:
         prof.record(name, start_ns, end_ns, cat="step_phase")
+    if mets:
+        _phase_hist(name).observe((end_ns - start_ns) / 1e9)
+    _tracing.on_phase(name, start_ns, end_ns)
 
 
 def mark_step():
-    """Count one completed fit step (phase ``pct`` normalizes by it)."""
+    """Count one completed fit step (phase ``pct`` normalizes by it;
+    the registry's ``fit_steps_total`` counts it too)."""
     col = _phase_state["collector"]
     if col is not None:
         col.mark_step()
+    if _metrics.phase_on():
+        _metrics.counter("fit_steps_total",
+                         help="completed Module.fit steps").inc()
 
 
 def aggregate_phase_trace(filename):

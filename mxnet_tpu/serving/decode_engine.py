@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import collections
 import queue
+import sys
 import threading
 import time
 from concurrent.futures import Future
@@ -64,11 +65,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import metrics as _metrics
 from .. import profiler as _profiler
+from .. import tracing as _tracing
 from ..analysis.lockcheck import make_lock
-from ..base import MXNetError, get_env, hot_path
+from ..base import MXNetError, _uid, get_env, hot_path
 from .scheduler import (FutureCompleter, ServeClosed, ServeOverloaded,
                         ServeTimeout)
+
+# Aggregate generation histograms (process-wide; gated on
+# MXNET_METRICS like every ambient observation seam).  TTFT and ITL
+# are THE generation service metrics — the /metrics scrape carries
+# their p50/p95/p99 without storing a sample per token.
+_H_TTFT = _metrics.histogram(
+    "serve_ttft_seconds",
+    help="generation time-to-first-token, submit to first sample")
+_H_ITL = _metrics.histogram(
+    "serve_itl_seconds",
+    help="generation inter-token latency, gap between samples")
 
 __all__ = ["GenerationEngine", "GenerationResult", "TokenStream"]
 
@@ -144,7 +158,8 @@ class TokenStream:
 class _GenRequest:
     __slots__ = ("model", "prompt", "max_tokens", "temperature", "top_k",
                  "seed", "eos_id", "stream", "future", "deadline",
-                 "t_submit", "tokens", "token_times", "seq")
+                 "t_submit", "tokens", "token_times", "seq", "trace",
+                 "trace_parent")
 
     def __init__(self, model, prompt, max_tokens, temperature, top_k,
                  seed, eos_id, stream, future, deadline, t_submit, seq):
@@ -162,6 +177,10 @@ class _GenRequest:
         self.tokens = []
         self.token_times = []
         self.seq = seq
+        # trace context captured on the submitting thread and
+        # re-activated around this request's prefill/decode dispatches
+        self.trace = None
+        self.trace_parent = None
 
 
 class _ModelState:
@@ -231,18 +250,25 @@ class GenerationEngine:
         self._seq = 0
         self._submit_lock = make_lock("serving.gen_submit")
         self._stats_lock = make_lock("serving.gen_stats")
-        self._stats = {"requests": 0, "prefills": 0, "prefill_seqs": 0,
-                       "decode_steps": 0, "generated_tokens": 0,
-                       "finished": 0, "timeouts": 0, "cancelled": 0,
-                       "errors": 0, "shed": 0, "cache_grows": 0,
-                       "slot_grows": 0, "max_active": 0,
-                       # host elements fetched from decode-step outputs
-                       # (tokens in graph-sampling mode, logits in host
-                       # mode): decode_fetch_elems / decode_steps is
-                       # the per-step fetch footprint the in-graph
-                       # sampler shrinks from (slots, vocab) to
-                       # (slots,) — pinned by tests
-                       "decode_fetch_elems": 0}
+        # counters live in the process metrics registry (one labeled
+        # series per engine); stats() reads THROUGH them —
+        # decode_fetch_elems counts host elements fetched from
+        # decode-step outputs (tokens in graph-sampling mode, logits in
+        # host mode): per decode_step it is the per-step fetch
+        # footprint the in-graph sampler shrinks from (slots, vocab)
+        # to (slots,) — pinned by tests
+        self._mlabels = {"engine": "gen%d" % _uid()}
+        self._stats = _metrics.CounterDict(
+            "serve_gen_",
+            ("requests", "prefills", "prefill_seqs", "decode_steps",
+             "generated_tokens", "finished", "timeouts", "cancelled",
+             "errors", "shed", "cache_grows", "slot_grows",
+             "decode_fetch_elems"),
+            labels=self._mlabels, help="generation engine counter")
+        self._g_inflight = _metrics.gauge(
+            "serve_gen_inflight", labels=self._mlabels,
+            help="accepted-but-unresolved generation requests")
+        self._max_active_seen = 0   # high-water mark (stats)
         # high-water cache geometry per model (survives the cache being
         # dropped when a batch drains — the bf16 bytes-per-slot bench
         # evidence reads this instead of racing a live batch)
@@ -303,40 +329,60 @@ class GenerationEngine:
         store.validate_request(len(prompt), max_tokens)
         fut = Future()
         now = time.monotonic()
-        with self._submit_lock:
-            if self._closed:
-                raise ServeClosed("generation engine is closed")
-            if self._max_inflight and self._inflight >= self._max_inflight:
-                with self._stats_lock:
-                    self._stats["shed"] += 1
-                raise ServeOverloaded(
-                    "generation engine is at its inflight budget (%d); "
-                    "request shed — back off and retry"
-                    % self._max_inflight)
-            self._inflight += 1
-            req = _GenRequest(
-                model, prompt, max_tokens, temperature,
-                top_k, seed, eos_id, stream, fut,
-                now + timeout if timeout is not None else None,
-                time.perf_counter(), self._seq)
-            self._seq += 1
-            self._queue.put(req)
+        # trace context: an ingress trace active on this thread (HTTP
+        # handler, replica-set placement) rides the request; a bare
+        # in-process submit mints its own
+        ctx = _tracing.current_context()
+        owned = None
+        if ctx is None:
+            owned = _tracing.start_trace("serve.generate", model=model)
+            ctx = (owned, owned.root_id)
+        try:
+            with self._submit_lock:
+                if self._closed:
+                    raise ServeClosed("generation engine is closed")
+                if self._max_inflight \
+                        and self._inflight >= self._max_inflight:
+                    self._stats.inc("shed")
+                    raise ServeOverloaded(
+                        "generation engine is at its inflight budget "
+                        "(%d); request shed — back off and retry"
+                        % self._max_inflight)
+                self._inflight += 1
+                self._g_inflight.set(self._inflight)
+                req = _GenRequest(
+                    model, prompt, max_tokens, temperature,
+                    top_k, seed, eos_id, stream, fut,
+                    now + timeout if timeout is not None else None,
+                    time.perf_counter(), self._seq)
+                req.trace, req.trace_parent = ctx
+                self._seq += 1
+                self._queue.put(req)
+        except (ServeClosed, ServeOverloaded) as e:
+            # export the self-minted trace with the shed/closed status
+            # (outside the lock) instead of dropping it unfinished
+            if owned is not None:
+                owned.finish(status=type(e).__name__)
+            raise
         fut.add_done_callback(self._note_resolved)
-        with self._stats_lock:
-            self._stats["requests"] += 1
+        if owned is not None:
+            fut.add_done_callback(_tracing.finish_on_done(owned))
+        self._stats.inc("requests")
         return fut
 
     def _note_resolved(self, _fut):
         with self._submit_lock:
             self._inflight -= 1
+            self._g_inflight.set(self._inflight)
 
     def alive(self):
         """Liveness witness (the front door's /healthz reads it)."""
         return not self._closed and self._thread.is_alive()
 
     def stats(self):
+        out = self._stats.as_dict()
         with self._stats_lock:
-            out = dict(self._stats)
+            out["max_active"] = self._max_active_seen
             out["cache_hwm"] = dict(self._cache_hwm)
         with self._submit_lock:
             out["inflight"] = self._inflight
@@ -361,6 +407,8 @@ class GenerationEngine:
             raise MXNetError("generation engine thread failed to stop "
                              "within %.0fs" % timeout)
         self._completer.close(timeout)
+        # retire this engine's labeled series from the process scrape
+        _metrics.drop(self._mlabels)
 
     def __enter__(self):
         return self
@@ -385,7 +433,15 @@ class GenerationEngine:
             # same exit contract as the forward engine: the loop is
             # gone (clean close OR crash), so latch closed and fail
             # anything still queued/waiting/in-flight — an accepted
-            # request is never silently dropped
+            # request is never silently dropped.  A crash additionally
+            # dumps the flight ring as a postmortem naming the failure.
+            exc = sys.exc_info()[1]
+            if exc is not None:
+                fl = _tracing.flight()
+                fl.record("crash", "generation engine loop",
+                          error=repr(exc))
+                fl.dump(reason="generation engine loop crashed: %r"
+                        % (exc,))
             with self._submit_lock:
                 self._closed = True
             while True:
@@ -458,24 +514,29 @@ class GenerationEngine:
             elif r.future.set_running_or_notify_cancel():
                 group.append(r)
             else:
-                with self._stats_lock:
-                    self._stats["cancelled"] += 1
+                self._stats.inc("cancelled")
         if not group:
             return
         toks, lens = store.pad_prompts([r.prompt for r in group])
         try:
-            first_logits, pk, pv = self._dispatch_prefill(
-                store, toks, lens)
+            # one prefill serves the whole admitted group: its span
+            # lands in every member's trace
+            with _tracing.activate_many(
+                    [(r.trace, r.trace_parent) for r in group]):
+                first_logits, pk, pv = self._dispatch_prefill(
+                    store, toks, lens)
             logits = np.asarray(first_logits)
         except BaseException as e:  # noqa: BLE001 — forwarded to futures
             exc = e if isinstance(e, MXNetError) \
                 else MXNetError("prefill dispatch failed: %r" % (e,))
+            _tracing.flight().record(
+                "error", "prefill_dispatch_failed", model=model,
+                error=repr(e), requests=len(group))
             for r in group:
                 self._fail_request(r, exc, running=True)
             return
-        with self._stats_lock:
-            self._stats["prefills"] += 1
-            self._stats["prefill_seqs"] += len(group)
+        self._stats.inc("prefills")
+        self._stats.inc("prefill_seqs", len(group))
         # first generated token (the TTFT moment): one shared-sampler
         # call over the FULL prefill bucket's rows (pad rows sample
         # junk harmlessly — constant shapes mean the jitted sampler
@@ -532,8 +593,8 @@ class GenerationEngine:
         st.keys = jnp.asarray(slot_keys)
         self._note_cache_hwm(model, st)
         with self._stats_lock:
-            if len(st.active()) > self._stats["max_active"]:
-                self._stats["max_active"] = len(st.active())
+            if len(st.active()) > self._max_active_seen:
+                self._max_active_seen = len(st.active())
 
     def _note_cache_hwm(self, model, st):
         d = st.describe()
@@ -586,16 +647,14 @@ class GenerationEngine:
             pad = ((0, 0), (0, grow), (0, 0), (0, 0), (0, 0))
             st.cache_k = jnp.pad(st.cache_k, pad)
             st.cache_v = jnp.pad(st.cache_v, pad)
-        with self._stats_lock:
-            self._stats["slot_grows"] += 1
+        self._stats.inc("slot_grows")
 
     def _grow_cache(self, st, new_c):
         pad = ((0, 0), (0, 0), (0, 0), (0, new_c - st.C), (0, 0))
         st.cache_k = jnp.pad(st.cache_k, pad)
         st.cache_v = jnp.pad(st.cache_v, pad)
         st.C = new_c
-        with self._stats_lock:
-            self._stats["cache_grows"] += 1
+        self._stats.inc("cache_grows")
         self._note_cache_hwm(st.store.name, st)
 
     # -- decode --------------------------------------------------------
@@ -614,10 +673,19 @@ class GenerationEngine:
             toks = np.ascontiguousarray(st.next_tok)
             lens = np.ascontiguousarray(st.lengths)
             try:
-                sampled = self._decode_and_sample(st, toks, lens)
+                # one decode step advances every active slot: its
+                # serve_decode/serve_sample spans land in each slot's
+                # trace
+                with _tracing.activate_many(
+                        [(st.slots[i].trace, st.slots[i].trace_parent)
+                         for i in act]):
+                    sampled = self._decode_and_sample(st, toks, lens)
             except BaseException as e:  # noqa: BLE001 — to the futures
                 exc = e if isinstance(e, MXNetError) \
                     else MXNetError("decode dispatch failed: %r" % (e,))
+                _tracing.flight().record(
+                    "error", "decode_dispatch_failed", model=model,
+                    error=repr(e), slots=len(act))
                 for i in act:
                     r = st.slots[i]
                     st.slots[i] = None
@@ -637,9 +705,8 @@ class GenerationEngine:
                     st.temps[i] = 0.0
                     st.top_ks[i] = 0
                     self._finish(r, reason)
-            with self._stats_lock:
-                self._stats["decode_steps"] += 1
-                self._stats["generated_tokens"] += len(act)
+            self._stats.inc("decode_steps")
+            self._stats.inc("generated_tokens", len(act))
 
     def _decode_and_sample(self, st, toks, lens):
         """One decode step + one token per slot, host-side np result.
@@ -673,8 +740,7 @@ class GenerationEngine:
         fetch acceptance pin reads it; tests also spy the shapes
         here)."""
         a = np.asarray(arr)
-        with self._stats_lock:
-            self._stats["decode_fetch_elems"] += int(a.size)
+        self._stats.inc("decode_fetch_elems", int(a.size))
         return a
 
     @hot_path
@@ -721,8 +787,14 @@ class GenerationEngine:
         return None
 
     def _push_token(self, req, tok):
+        now = time.perf_counter()
+        if _metrics.phase_on():
+            if not req.token_times:
+                _H_TTFT.observe(now - req.t_submit)
+            else:
+                _H_ITL.observe(now - req.token_times[-1])
         req.tokens.append(tok)
-        req.token_times.append(time.perf_counter())
+        req.token_times.append(now)
         if req.stream is not None:
             req.stream.push(tok)
 
@@ -733,19 +805,16 @@ class GenerationEngine:
                                list(req.tokens), reason, req.t_submit,
                                list(req.token_times))
         self._completer.resolve(req.future, res)
-        with self._stats_lock:
-            self._stats["finished"] += 1
+        self._stats.inc("finished")
 
     def _fail_request(self, req, exc, kind="errors", running=False):
         if not running and not req.future.set_running_or_notify_cancel():
-            with self._stats_lock:
-                self._stats["cancelled"] += 1
+            self._stats.inc("cancelled")
             return
         if req.stream is not None:
             req.stream.close()
         self._completer.resolve(req.future, exc=exc)
-        with self._stats_lock:
-            self._stats[kind] += 1
+        self._stats.inc(kind)
 
     def _fail_all(self):
         """close(drain=False): everything waiting or in flight fails

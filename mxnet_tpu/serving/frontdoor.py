@@ -60,8 +60,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from .. import metrics as _metrics
 from .. import profiler as _profiler
-from ..base import MXNetError
+from .. import tracing as _tracing
+from ..base import MXNetError, get_env
 from .replica_set import NoLiveReplicas, ReplicaDied
 from .scheduler import ServeClosed, ServeOverloaded, ServeTimeout
 
@@ -160,6 +162,16 @@ class _Handler(BaseHTTPRequestHandler):
                 })
             elif self.path == "/stats":
                 self._reply(200, self._door.target_stats())
+            elif self.path == "/metrics":
+                # Prometheus text exposition of the process metrics
+                # registry (docs/architecture/observability.md)
+                self._reply(200,
+                            _metrics.render_prometheus().encode("utf-8"),
+                            content_type="text/plain; version=0.0.4")
+            elif self.path == "/debug/flight":
+                fl = _tracing.flight()
+                self._reply(200, {"capacity": fl.capacity,
+                                  "events": fl.events()})
             else:
                 self._reply(404, {"error": "unknown path %r" % self.path,
                                   "kind": "NotFound", "retryable": False})
@@ -222,27 +234,39 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 — client-caused: 400
             raise MXNetError("invalid request body: %s: %s"
                              % (type(e).__name__, e))
+        # the request's trace is minted HERE — the network ingress —
+        # and stays active across the submit, so every downstream span
+        # (balancer dispatch, batch compute) is a child of this trace
+        tr = _tracing.start_trace("http.predict", model=model)
+        status = "error"
         try:
-            fut = self._door.target.submit(model, timeout=timeout,
-                                           **inputs)
-            outs = fut.result(self._door.wait_budget(timeout))
-        except BaseException as e:  # noqa: BLE001 — structured mapping
-            self._reply_error(self._door.as_serving_error(e))
-            return
-        outs = [np.asarray(o) for o in outs]
-        if npz:
-            buf = io.BytesIO()
-            np.savez(buf, **{"output_%d" % i: o
-                             for i, o in enumerate(outs)})
-            self._reply(200, buf.getvalue(),
-                        content_type="application/x-npz")
-        else:
-            self._reply(200, {
-                "outputs": [o.tolist() for o in outs],
-                "shapes": [list(o.shape) for o in outs],
-                "dtypes": [str(o.dtype) for o in outs],
-            })
-        _profiler.record_phase("serve_http", t0)
+            with _tracing.activate(tr):
+                try:
+                    fut = self._door.target.submit(model, timeout=timeout,
+                                                   **inputs)
+                    outs = fut.result(self._door.wait_budget(timeout))
+                except BaseException as e:  # noqa: BLE001 — structured
+                    err = self._door.as_serving_error(e)
+                    status = type(err).__name__
+                    self._reply_error(err)
+                    return
+                outs = [np.asarray(o) for o in outs]
+                if npz:
+                    buf = io.BytesIO()
+                    np.savez(buf, **{"output_%d" % i: o
+                                     for i, o in enumerate(outs)})
+                    self._reply(200, buf.getvalue(),
+                                content_type="application/x-npz")
+                else:
+                    self._reply(200, {
+                        "outputs": [o.tolist() for o in outs],
+                        "shapes": [list(o.shape) for o in outs],
+                        "dtypes": [str(o.dtype) for o in outs],
+                    })
+                _profiler.record_phase("serve_http", t0)
+                status = "ok"
+        finally:
+            tr.finish(status=status)
 
     def _serve_generate(self, model):
         t0 = time.perf_counter_ns()
@@ -258,25 +282,38 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 — client-caused: 400
             raise MXNetError("invalid request body: %s: %s"
                              % (type(e).__name__, e))
+        # generation ingress mints the trace too: the prefill/decode/
+        # sample spans of THIS request — across replica placement
+        # retries — land under one trace id (the propagation pin)
+        tr = _tracing.start_trace("http.generate", model=model)
+        status = "error"
         try:
-            fut = self._door.gen_submit(model, tokens,
-                                        timeout=timeout, **kwargs)
-            res = fut.result(self._door.wait_budget(timeout))
-        except BaseException as e:  # noqa: BLE001 — structured mapping
-            self._reply_error(self._door.as_serving_error(e))
-            return
-        self._reply(200, {
-            "model": res.model,
-            "tokens": [int(t) for t in res.tokens],
-            "finish_reason": res.finish_reason,
-            "prompt_len": int(res.prompt_len),
-            # host perf_counter stamps (CLOCK_MONOTONIC: comparable
-            # across processes on one host) so same-host clients — and
-            # the loadgen — derive TTFT/ITL exactly like in-process
-            "t_submit": res.t_submit,
-            "token_times": list(res.token_times),
-        })
-        _profiler.record_phase("serve_http", t0)
+            with _tracing.activate(tr):
+                try:
+                    fut = self._door.gen_submit(model, tokens,
+                                                timeout=timeout, **kwargs)
+                    res = fut.result(self._door.wait_budget(timeout))
+                except BaseException as e:  # noqa: BLE001 — structured
+                    err = self._door.as_serving_error(e)
+                    status = type(err).__name__
+                    self._reply_error(err)
+                    return
+                self._reply(200, {
+                    "model": res.model,
+                    "tokens": [int(t) for t in res.tokens],
+                    "finish_reason": res.finish_reason,
+                    "prompt_len": int(res.prompt_len),
+                    # host perf_counter stamps (CLOCK_MONOTONIC:
+                    # comparable across processes on one host) so
+                    # same-host clients — and the loadgen — derive
+                    # TTFT/ITL exactly like in-process
+                    "t_submit": res.t_submit,
+                    "token_times": list(res.token_times),
+                })
+                _profiler.record_phase("serve_http", t0)
+                status = "ok"
+        finally:
+            tr.finish(status=status)
 
 
 class _Server(ThreadingHTTPServer):
@@ -303,6 +340,12 @@ class HttpFrontDoor:
         self._max_wait = float(max_wait)
         self._server = _Server((host, int(port)), _Handler)
         self._server.frontdoor = self
+        # /stats snapshot cache: one stats-tree walk per
+        # MXNET_SERVE_STATS_TTL_MS window no matter how many pollers
+        # (replies carry age_ms); /healthz's model listing shares it
+        self._stats_cache = None
+        self._stats_cache_t = 0.0
+        self._stats_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="mxt-http",
             daemon=True)
@@ -324,6 +367,15 @@ class HttpFrontDoor:
         return bool(alive()) if callable(alive) else True
 
     def models(self):
+        # health pollers share the cached stats snapshot when it is
+        # fresh instead of re-walking registries per probe
+        with self._stats_lock:
+            cached, t = self._stats_cache, self._stats_cache_t
+        if cached is not None \
+                and time.monotonic() - t <= self._stats_ttl():
+            m = self._models_from_snapshot(cached)
+            if m is not None:
+                return m
         t = self.target
         reg = getattr(t, "_registry", None)
         if reg is not None:
@@ -335,8 +387,46 @@ class HttpFrontDoor:
                     return r.registry.models()
         return []
 
+    @staticmethod
+    def _models_from_snapshot(cached):
+        """Model names out of a cached stats tree, for either target
+        shape: an engine's top-level ``models`` dict, or a replica
+        set's ``replicas -> {i: {alive, engine: {models}}}`` nesting
+        (first live replica wins — replicas are shared-nothing copies
+        of the same registry).  None = shape unknown, walk instead."""
+        m = cached.get("models")
+        if isinstance(m, dict):
+            return sorted(m)
+        reps = cached.get("replicas")
+        if isinstance(reps, dict):
+            for r in reps.values():
+                if not isinstance(r, dict) or not r.get("alive", False):
+                    continue
+                em = r.get("engine", {}).get("models")
+                if isinstance(em, dict):
+                    return sorted(em)
+            return []
+        return None
+
+    @staticmethod
+    def _stats_ttl():
+        return max(0.0, float(get_env("MXNET_SERVE_STATS_TTL_MS"))) / 1e3
+
     def target_stats(self):
-        return self.target.stats()
+        """The target's stats tree, served from a TTL-bounded cache:
+        a poll within ``MXNET_SERVE_STATS_TTL_MS`` of the last walk
+        returns the cached snapshot (its ``age_ms`` field says how
+        stale) instead of re-walking every engine/replica/store stats
+        surface per request."""
+        now = time.monotonic()
+        with self._stats_lock:
+            if self._stats_cache is None \
+                    or now - self._stats_cache_t > self._stats_ttl():
+                self._stats_cache = self.target.stats()
+                self._stats_cache_t = now
+            out = dict(self._stats_cache)
+            out["age_ms"] = round((now - self._stats_cache_t) * 1e3, 3)
+        return out
 
     def gen_submit(self, model, tokens, **kwargs):
         # an EXPLICIT gen_target wins over the forward target's own
@@ -469,6 +559,24 @@ class HttpClient:
         code, payload = fut.result(self._timeout)
         if code != 200:
             raise MXNetError("stats failed: HTTP %d" % code)
+        return payload
+
+    def metrics_text(self):
+        """``GET /metrics``: the Prometheus text exposition."""
+        fut = self._enqueue("GET", "/metrics", None, {},
+                            lambda status, body: (status, body))
+        code, body = fut.result(self._timeout)
+        if code != 200:
+            raise MXNetError("metrics failed: HTTP %d" % code)
+        return body.decode("utf-8")
+
+    def debug_flight(self):
+        """``GET /debug/flight``: the server's flight-recorder ring."""
+        fut = self._enqueue("GET", "/debug/flight", None, {},
+                            self._parse_raw)
+        code, payload = fut.result(self._timeout)
+        if code != 200:
+            raise MXNetError("debug/flight failed: HTTP %d" % code)
         return payload
 
     def close(self):

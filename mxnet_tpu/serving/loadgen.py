@@ -36,7 +36,8 @@ from ..base import MXNetError
 
 __all__ = ["OpenLoopSchedule", "run_loadgen", "latency_protocol",
            "run_gen_loadgen", "generation_protocol",
-           "frontdoor_protocol", "failover_protocol", "swap_protocol"]
+           "frontdoor_protocol", "failover_protocol", "swap_protocol",
+           "observability_protocol"]
 
 
 class OpenLoopSchedule:
@@ -877,6 +878,141 @@ def failover_protocol(smoke=False, seed=19, n_replicas=3,
     else:
         out["killed"] = kill_t[0] is not None
     return out
+
+
+def observability_protocol(smoke=False, seed=29, offered_mult=2.0):
+    """Telemetry overhead protocol (the ``serving.observability.
+    overhead`` bench row): the SAME model and the SAME seeded open-loop
+    schedule, served three times with different telemetry settings —
+
+    1. **baseline** — everything off (``MXNET_METRICS=0``,
+       ``MXNET_TRACE_SAMPLE=0``, ``MXNET_FLIGHT_CAPACITY=0``): the
+       untelemetered engine;
+    2. **full** — the DEFAULTS (metrics on, trace sampling 1.0, flight
+       ring on) plus a live JSONL trace sink, i.e. every request fully
+       traced and exported;
+    3. **sample0** — metrics on but ``MXNET_TRACE_SAMPLE=0``: the
+       sampling knob's escape hatch.
+
+    Each side measures closed-loop capacity (best of two passes —
+    the direct overhead evidence: every submit/resolve pays the
+    telemetry cost back to back) and the open-loop p50/p99 on the
+    shared schedule.  Acceptance: full/baseline capacity >= 0.95 and
+    p99 <= 1.10; sample0 restores baseline within noise."""
+    import os
+    import tempfile
+
+    from .. import tracing as tracing_mod
+    from .registry import ModelRegistry
+    from .scheduler import ServingEngine
+
+    _ENV_KEYS = ("MXNET_METRICS", "MXNET_TRACE_SAMPLE",
+                 "MXNET_FLIGHT_CAPACITY", "MXNET_TRACE_JSONL")
+    sym, args = _smoke_model(512, 2048, seed)
+    feat = 512
+    rs = np.random.RandomState(seed + 1)
+    pool = [np.asarray(rs.uniform(-1, 1, (1, feat)), np.float32)
+            for _ in range(16)]
+    n_closed = 30 if smoke else 80
+    n_load = 100 if smoke else 300
+
+    def run_side(env, sink=None):
+        saved = {k: os.environ.pop(k, None) for k in _ENV_KEYS}
+        os.environ.update(env)
+        tracing_mod.reset_flight()
+        tracing_mod.set_jsonl_sink(sink)
+        try:
+            registry = ModelRegistry()
+            registry.add_model("m", sym,
+                               {k: v.copy() for k, v in args.items()},
+                               {}, input_shapes={"data": (1, feat)},
+                               warmup=True)
+            engine = ServingEngine(registry, max_delay_ms=2.0)
+            try:
+                for _ in range(3):
+                    for f in [engine.submit("m",
+                                            data=pool[i % len(pool)])
+                              for i in range(8)]:
+                        f.result(60)
+                closed = max(_engine_capacity(
+                    lambda i: engine.submit(
+                        "m", data=pool[i % len(pool)]).result(60),
+                    n_closed) for _ in range(2))
+                schedule = OpenLoopSchedule(seed, n_load, offered,
+                                            sizes=(1,))
+                open_sum = run_loadgen(
+                    lambda i, n: engine.submit(
+                        "m", data=pool[i % len(pool)]),
+                    schedule, fetch=True)
+            finally:
+                engine.close()
+        finally:
+            tracing_mod.set_jsonl_sink(None)
+            os.environ.update(
+                {k: v for k, v in saved.items() if v is not None})
+            for k in _ENV_KEYS:
+                if saved.get(k) is None:
+                    os.environ.pop(k, None)
+            tracing_mod.reset_flight()
+        return {"closed_qps": round(closed, 2),
+                "p50_ms": open_sum["p50_ms"],
+                "p99_ms": open_sum["p99_ms"],
+                "qps_achieved": open_sum["qps_achieved"],
+                "dropped": open_sum["timeouts"] + open_sum["errors"] +
+                open_sum["cancelled"]}
+
+    # anchor the shared offered rate BELOW saturation so the open-loop
+    # sides compare overhead, not queueing (a quick untelemetered
+    # capacity probe sets it)
+    probe_reg = ModelRegistry()
+    probe_reg.add_model("m", sym, args, {},
+                        input_shapes={"data": (1, feat)}, warmup=True)
+    probe = ServingEngine(probe_reg, max_delay_ms=2.0)
+    try:
+        for f in [probe.submit("m", data=pool[i % len(pool)])
+                  for i in range(8)]:
+            f.result(60)
+        offered = _engine_capacity(
+            lambda i: probe.submit(
+                "m", data=pool[i % len(pool)]).result(60),
+            n_closed) * float(offered_mult)
+    finally:
+        probe.close()
+
+    baseline = run_side({"MXNET_METRICS": "0", "MXNET_TRACE_SAMPLE": "0",
+                         "MXNET_FLIGHT_CAPACITY": "0"})
+    sink = os.path.join(tempfile.mkdtemp(prefix="mxt_obs_"),
+                        "traces.jsonl")
+    full = run_side({}, sink=sink)
+    traces = 0
+    if os.path.exists(sink):
+        with open(sink) as f:
+            traces = sum(1 for _ in f)
+    sample0 = run_side({"MXNET_TRACE_SAMPLE": "0"})
+
+    def ratio(a, b, inv=False):
+        if not a or not b:
+            return None
+        return round((a / b) if not inv else (b / a), 4)
+
+    return {
+        "seed": seed,
+        "offered_mult": float(offered_mult),
+        "n_load": n_load,
+        "baseline": baseline,
+        "full": full,
+        "sample0": sample0,
+        "traces_exported": traces,
+        # capacity ratios >= is better; p99 ratios <= is better
+        "qps_full_vs_baseline": ratio(full["closed_qps"],
+                                      baseline["closed_qps"]),
+        "p99_full_vs_baseline": ratio(full["p99_ms"],
+                                      baseline["p99_ms"]),
+        "qps_sample0_vs_baseline": ratio(sample0["closed_qps"],
+                                         baseline["closed_qps"]),
+        "p99_sample0_vs_baseline": ratio(sample0["p99_ms"],
+                                         baseline["p99_ms"]),
+    }
 
 
 def swap_protocol(smoke=False, seed=23):

@@ -43,9 +43,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import metrics as _metrics
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, get_env, hot_path
 from ..pallas_ops import dispatch as _pallas_dispatch
+
+def _cache_event(event):
+    """Process-wide program-cache counter (every store feeds it; the
+    per-store split stays on each store's stats() tree)."""
+    return _metrics.cached_counter(
+        "serve_program_cache_%s_total" % event,
+        help="AOT serving-program LRU events across all stores")
 from ..pallas_ops.dequant_matmul import QuantizedWeight, quantize_int8
 
 __all__ = ["ProgramStore", "GenerativeProgramStore", "bucket_edges",
@@ -567,18 +575,22 @@ class ProgramStore:
             if prog is not None:
                 self._programs.move_to_end(key)
                 self._stats["hits"] += 1
+                _cache_event("hits").inc()
                 return prog
         prog = self._compile(bucket)
         with self._lock:
             raced = self._programs.get(key)
             if raced is not None:
                 self._stats["hits"] += 1
+                _cache_event("hits").inc()
                 return raced
             self._stats["compiles"] += 1
             self._stats["compile_ms_total"] += prog.compile_ms
+            _cache_event("compiles").inc()
             while len(self._programs) >= self.max_programs:
                 self._programs.popitem(last=False)
                 self._stats["evictions"] += 1
+                _cache_event("evictions").inc()
             self._programs[key] = prog
             return prog
 
@@ -1064,18 +1076,22 @@ class GenerativeProgramStore:
             if prog is not None:
                 self._programs.move_to_end(key)
                 self._stats["hits"] += 1
+                _cache_event("hits").inc()
                 return prog
         prog = self._compile(kind, bb, lb)
         with self._lock:
             raced = self._programs.get(key)
             if raced is not None:
                 self._stats["hits"] += 1
+                _cache_event("hits").inc()
                 return raced
             self._stats["compiles"] += 1
             self._stats["compile_ms_total"] += prog.compile_ms
+            _cache_event("compiles").inc()
             while len(self._programs) >= self.max_programs:
                 self._programs.popitem(last=False)
                 self._stats["evictions"] += 1
+                _cache_event("evictions").inc()
             self._programs[key] = prog
             return prog
 

@@ -52,10 +52,16 @@ import time
 from concurrent.futures import Future, InvalidStateError
 
 from .. import faultinject
+from .. import metrics as _metrics
 from .. import profiler as _profiler
+from .. import tracing as _tracing
 from ..analysis.lockcheck import make_lock
-from ..base import MXNetError, get_env
+from ..base import MXNetError, _uid, get_env
 from ..retry import CircuitBreaker, backoff_delay
+
+# breaker-state gauge encoding (serve_replica_breaker{replica=...})
+_BREAKER_STATES = {"closed": 0, "half-open": 1, "half_open": 1,
+                   "open": 2}
 from .registry import ModelRegistry
 from .scheduler import (ServeClosed, ServeOverloaded, ServeTimeout,
                         ServingEngine)
@@ -199,10 +205,18 @@ class ReplicaSet:
                                            reset_after=cb_reset))
             for i, reg in enumerate(registries)]
         self._lock = make_lock("serving.replica_set")
-        self._stats = {"submitted": 0, "dispatched": 0, "retries": 0,
-                       "failovers": 0, "shed": 0, "no_live": 0,
-                       "probe_failures": 0, "gen_submitted": 0,
-                       "gen_aborted": 0}
+        # counters live in the process metrics registry (labeled per
+        # set); stats() reads THROUGH them.  Per-replica liveness and
+        # breaker state are gauges keyed by replica index.
+        self._mlabels = {"rset": "rs%d" % _uid()}
+        self._stats = _metrics.CounterDict(
+            "serve_rs_",
+            ("submitted", "dispatched", "retries", "failovers", "shed",
+             "no_live", "probe_failures", "gen_submitted",
+             "gen_aborted", "replica_deaths"),
+            labels=self._mlabels, help="serving replica-set counter")
+        for r in self._replicas:
+            self._note_breaker(r)
         self._closed = False
         # the in-process SIGKILL: a scheduled `die` at the
         # serve.dispatch seam kills the TARGETED replica (meta carries
@@ -217,11 +231,39 @@ class ReplicaSet:
                                             daemon=True)
             self._prober.start()
 
+    def _note_breaker(self, r):
+        """Publish one replica's breaker state + liveness as gauges
+        (called on probe sweeps and failure transitions — the scrape's
+        view of the rotation)."""
+        labels = dict(self._mlabels, replica=str(r.index))
+        _metrics.gauge("serve_replica_breaker", labels=labels,
+                       help="0=closed 1=half-open 2=open").set(
+            _BREAKER_STATES.get(str(r.breaker.state), -1))
+        _metrics.gauge("serve_replica_alive", labels=labels,
+                       help="1 while the replica can serve").set(
+            1 if r.alive else 0)
+
+    def _note_death(self, index, how):
+        """One replica died: count it, flight-record it, and dump the
+        postmortem artifact NAMING the dead replica (the PR-13
+        kill-one-under-load scenario's readable evidence)."""
+        self._stats.inc("replica_deaths")
+        fl = _tracing.flight()
+        fl.record("replica_died", "replica %s" % index,
+                  sid=index, how=how,
+                  live=[r.index for r in self._replicas if r.alive])
+        fl.dump(reason="replica %s died (%s)" % (index, how))
+
     # -- faultinject ---------------------------------------------------
     def _injected_die(self, meta):
         sid = meta.get("sid")
         if sid is not None and 0 <= int(sid) < len(self._replicas):
-            self._replicas[int(sid)].kill()
+            r = self._replicas[int(sid)]
+            was_alive = r.alive
+            r.kill()
+            if was_alive:
+                self._note_death(r.index, "injected die at %s" % SEAM)
+                self._note_breaker(r)
         raise ReplicaDied("replica %s died (injected at %s)"
                           % (sid, SEAM))
 
@@ -254,7 +296,12 @@ class ReplicaSet:
     def kill_replica(self, index):
         """Kill one replica (tests / chaos drills); the balancer
         converges to the survivors within one probe interval."""
-        self._replicas[index].kill()
+        r = self._replicas[index]
+        was_alive = r.alive
+        r.kill()
+        if was_alive:
+            self._note_death(r.index, "kill_replica")
+            self._note_breaker(r)
 
     # -- forward requests ----------------------------------------------
     def submit(self, model, timeout=None, **inputs):
@@ -263,14 +310,25 @@ class ReplicaSet:
         propagates into each attempt's queue budget and bounds the
         whole retry chain."""
         fut = Future()
+        # trace context: captured here (an HTTP ingress trace, or a
+        # fresh mint for bare in-process callers) and re-activated by
+        # every placement attempt — retries on other replicas stay
+        # spans of the SAME trace
+        ctx = _tracing.current_context()
+        owned = None
+        if ctx is None:
+            owned = _tracing.start_trace("serve.forward", model=model)
+            ctx = (owned, owned.root_id)
         state = {
             "model": model, "inputs": inputs, "future": fut,
             "deadline": (time.monotonic() + timeout
                          if timeout is not None else None),
             "attempt": 0, "excluded": set(), "last_exc": None,
+            "trace": ctx[0], "trace_parent": ctx[1],
         }
-        with self._lock:
-            self._stats["submitted"] += 1
+        if owned is not None:
+            fut.add_done_callback(_tracing.finish_on_done(owned))
+        self._stats.inc("submitted")
         self._dispatch(state)
         return fut
 
@@ -282,6 +340,10 @@ class ReplicaSet:
         the request resolves with the structured last error.  Runs on
         the submitting thread or a retry timer thread — never on an
         engine thread."""
+        with _tracing.activate(state["trace"], state["trace_parent"]):
+            self._dispatch_traced(state)
+
+    def _dispatch_traced(self, state):
         t0 = time.perf_counter_ns()
         while True:
             if state["deadline"] is not None \
@@ -317,6 +379,7 @@ class ReplicaSet:
                 continue
             except (ReplicaDied, ServeClosed, OSError) as e:
                 r.breaker.record_failure(e)
+                self._note_breaker(r)
                 state["excluded"].add(r.index)
                 state["last_exc"] = e
                 if not self._schedule_retry(state):
@@ -331,7 +394,7 @@ class ReplicaSet:
                 return
             with self._lock:
                 r.inflight += 1
-                self._stats["dispatched"] += 1
+            self._stats.inc("dispatched")
             inner.add_done_callback(
                 lambda f, s=state, rep=r: self._inner_done(s, rep, f))
             _profiler.record_phase("serve_dispatch", t0)
@@ -341,8 +404,7 @@ class ReplicaSet:
         """Count one failover attempt; False = budget exhausted and the
         request was resolved with its last error."""
         state["attempt"] += 1
-        with self._lock:
-            self._stats["retries"] += 1
+        self._stats.inc("retries")
         if state["attempt"] > self._retries:
             self._resolve(state["future"], exc=state["last_exc"])
             return False
@@ -350,11 +412,10 @@ class ReplicaSet:
 
     def _resolve_no_replica(self, state):
         last = state["last_exc"]
-        with self._lock:
-            if isinstance(last, ServeOverloaded):
-                self._stats["shed"] += 1
-            else:
-                self._stats["no_live"] += 1
+        if isinstance(last, ServeOverloaded):
+            self._stats.inc("shed")
+        else:
+            self._stats.inc("no_live")
         if isinstance(last, ServeOverloaded):
             exc = last  # every live replica is at its inflight budget
         else:
@@ -381,10 +442,10 @@ class ReplicaSet:
             # (killed / closed under us): a forward is idempotent —
             # fail over to a survivor after backoff
             r.breaker.record_failure(exc)
+            self._note_breaker(r)
             state["excluded"].add(r.index)
             state["last_exc"] = exc
-            with self._lock:
-                self._stats["failovers"] += 1
+            self._stats.inc("failovers")
             if not self._schedule_retry(state):
                 return
             delay = backoff_delay(state["attempt"] - 1, self._backoff,
@@ -416,8 +477,23 @@ class ReplicaSet:
         client owns the resubmit decision."""
         fut = Future()
         state = {"attempt": 0, "excluded": set(), "last_exc": None}
-        with self._lock:
-            self._stats["gen_submitted"] += 1
+        self._stats.inc("gen_submitted")
+        # same trace discipline as forwards: the whole placement loop —
+        # and the engine submit inside it — runs under the request's
+        # trace, so placement retries stay spans of ONE trace
+        ctx = _tracing.current_context()
+        owned = None
+        if ctx is None:
+            owned = _tracing.start_trace("serve.generate", model=model)
+            ctx = (owned, owned.root_id)
+        if owned is not None:
+            fut.add_done_callback(_tracing.finish_on_done(owned))
+        with _tracing.activate(ctx[0], ctx[1]):
+            return self._submit_gen_traced(model, tokens, fut, state,
+                                           **kwargs)
+
+    def _submit_gen_traced(self, model, tokens, fut, state, **kwargs):
+        t0 = time.perf_counter_ns()
         while True:
             r = self._pick(state["excluded"])
             if r is None:
@@ -443,11 +519,11 @@ class ReplicaSet:
                 continue
             except (ReplicaDied, ServeClosed, OSError) as e:
                 r.breaker.record_failure(e)
+                self._note_breaker(r)
                 state["excluded"].add(r.index)
                 state["last_exc"] = e
                 state["attempt"] += 1
-                with self._lock:
-                    self._stats["retries"] += 1
+                self._stats.inc("retries")
                 if state["attempt"] > self._retries:
                     self._resolve(fut, exc=e)
                     return fut
@@ -458,9 +534,10 @@ class ReplicaSet:
                 return fut
             with self._lock:
                 r.inflight += 1
-                self._stats["dispatched"] += 1
+            self._stats.inc("dispatched")
             inner.add_done_callback(
                 lambda f, rep=r: self._gen_done(fut, rep, f))
+            _profiler.record_phase("serve_dispatch", t0)
             return fut
 
     def _gen_done(self, fut, r, inner):
@@ -476,8 +553,8 @@ class ReplicaSet:
             return
         if isinstance(exc, (ServeClosed, OSError)) and not r.alive:
             r.breaker.record_failure(exc)
-            with self._lock:
-                self._stats["gen_aborted"] += 1
+            self._note_breaker(r)
+            self._stats.inc("gen_aborted")
             exc = ReplicaDied(
                 "generation was lost with replica %d (its KV state "
                 "died); resubmit to regenerate" % r.index)
@@ -512,8 +589,8 @@ class ReplicaSet:
                 r.breaker.record_success()
             except BaseException as e:  # noqa: BLE001 — health verdict
                 r.breaker.record_failure(e)
-                with self._lock:
-                    self._stats["probe_failures"] += 1
+                self._stats.inc("probe_failures")
+            self._note_breaker(r)
 
     # -- management ----------------------------------------------------
     def swap_params(self, name, arg_params, aux_params=None):
@@ -530,8 +607,8 @@ class ReplicaSet:
         return out
 
     def stats(self):
+        out = self._stats.as_dict()
         with self._lock:
-            out = dict(self._stats)
             inflight = {r.index: r.inflight for r in self._replicas}
         out["replicas"] = {
             r.index: {"alive": r.alive, "breaker": r.breaker.state,
@@ -558,6 +635,8 @@ class ReplicaSet:
             faultinject.register_die_handler(SEAM, None)
         for r in self._replicas:
             r.close(drain=drain)
+        # retire this set's labeled series (incl. per-replica gauges)
+        _metrics.drop(self._mlabels)
 
     def __enter__(self):
         return self

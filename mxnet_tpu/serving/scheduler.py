@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import collections
 import queue
+import sys
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
@@ -39,9 +40,24 @@ from concurrent.futures import Future, InvalidStateError
 import jax
 import numpy as np
 
+from .. import metrics as _metrics
 from .. import profiler as _profiler
+from .. import tracing as _tracing
 from ..analysis.lockcheck import make_lock
-from ..base import MXNetError, get_env, hot_path
+from ..base import MXNetError, _uid, get_env, hot_path
+
+# Aggregate serving histograms (process-wide: every engine feeds them;
+# per-engine counts live on the labeled serve_*_total counters).  The
+# ambient observes are gated on MXNET_METRICS like the phase feed.
+_H_LATENCY = _metrics.histogram(
+    "serve_latency_seconds",
+    help="forward request latency, submit to resolution")
+_H_QWAIT = _metrics.histogram(
+    "serve_queue_wait_seconds",
+    help="forward request time-in-queue, submit to dispatch")
+_H_BATCH = _metrics.histogram(
+    "serve_batch_fill_rows", lo=1.0, hi=65536.0,
+    help="rows coalesced into one serving dispatch")
 
 __all__ = ["ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
            "ServeOverloaded", "FutureCompleter"]
@@ -129,7 +145,8 @@ class ServeOverloaded(MXNetError):
 class ServeRequest:
     """One queued inference request (internal; clients hold the Future)."""
 
-    __slots__ = ("model", "inputs", "n", "future", "deadline", "t_submit")
+    __slots__ = ("model", "inputs", "n", "future", "deadline", "t_submit",
+                 "trace", "trace_parent")
 
     def __init__(self, model, inputs, n, future, deadline, t_submit):
         self.model = model
@@ -138,6 +155,12 @@ class ServeRequest:
         self.future = future
         self.deadline = deadline  # monotonic seconds, or None
         self.t_submit = t_submit
+        # the request's trace context, captured on the submitting
+        # thread (tracing.current_context) and re-activated by the
+        # engine thread around its dispatch — the cross-thread span
+        # propagation handshake
+        self.trace = None
+        self.trace_parent = None
 
 
 class ServingEngine:
@@ -169,9 +192,18 @@ class ServingEngine:
         self._inflight_reqs = ()
         self._submit_lock = make_lock("serving.submit")
         self._stats_lock = make_lock("serving.stats")
-        self._stats = {"requests": 0, "batches": 0, "rows": 0,
-                       "padded_rows": 0, "timeouts": 0, "cancelled": 0,
-                       "errors": 0, "shed": 0, "max_rows_in_batch": 0}
+        # counters live in the process metrics registry (one labeled
+        # series per engine); stats() reads THROUGH them, so the legacy
+        # tree and GET /metrics can never disagree
+        self._mlabels = {"engine": "fwd%d" % _uid()}
+        self._stats = _metrics.CounterDict(
+            "serve_", ("requests", "batches", "rows", "padded_rows",
+                       "timeouts", "cancelled", "errors", "shed"),
+            labels=self._mlabels, help="forward serving engine counter")
+        self._g_inflight = _metrics.gauge(
+            "serve_inflight", labels=self._mlabels,
+            help="accepted-but-unresolved forward requests")
+        self._max_rows = 0
         # test seam (faultinject spirit): called with (model, live_reqs)
         # right before each dispatch; tests install sleeps/recorders here
         self._dispatch_hook = None
@@ -208,28 +240,53 @@ class ServingEngine:
         req = ServeRequest(model, canon, n, fut,
                            now + timeout if timeout is not None else None,
                            now)
-        with self._submit_lock:
-            if self._closed:
-                raise ServeClosed("serving engine is closed")
-            if self._max_inflight and self._inflight >= self._max_inflight:
-                with self._stats_lock:
-                    self._stats["shed"] += 1
-                raise ServeOverloaded(
-                    "serving engine is at its inflight budget (%d); "
-                    "request shed — back off and retry"
-                    % self._max_inflight)
-            self._inflight += 1
-            self._queue.put(req)
+        # trace context: an ingress trace already active on this thread
+        # (HTTP handler, replica-set dispatch) is captured onto the
+        # request; a bare in-process submit mints its own and finishes
+        # it when the future resolves
+        ctx = _tracing.current_context()
+        owned = None
+        if ctx is None:
+            owned = _tracing.start_trace("serve.forward", model=model)
+            ctx = (owned, owned.root_id)
+        req.trace, req.trace_parent = ctx
+        try:
+            with self._submit_lock:
+                if self._closed:
+                    raise ServeClosed("serving engine is closed")
+                if self._max_inflight \
+                        and self._inflight >= self._max_inflight:
+                    self._stats.inc("shed")
+                    raise ServeOverloaded(
+                        "serving engine is at its inflight budget (%d); "
+                        "request shed — back off and retry"
+                        % self._max_inflight)
+                self._inflight += 1
+                self._g_inflight.set(self._inflight)
+                self._queue.put(req)
+        except (ServeClosed, ServeOverloaded) as e:
+            # a self-minted trace still exports (status = the shed/
+            # closed class): overload is exactly the condition the
+            # telemetry plane exists to diagnose.  Finished OUTSIDE
+            # the lock — the JSONL append must not serialize sheds.
+            if owned is not None:
+                owned.finish(status=type(e).__name__)
+            raise
         # exactly one resolution per accepted request (result, error or
         # cancel) ends its inflight accounting
         fut.add_done_callback(self._note_resolved)
-        with self._stats_lock:
-            self._stats["requests"] += 1
+        if _metrics.phase_on():
+            fut.add_done_callback(
+                lambda f, t=now: _H_LATENCY.observe(time.monotonic() - t))
+        if owned is not None:
+            fut.add_done_callback(_tracing.finish_on_done(owned))
+        self._stats.inc("requests")
         return fut
 
     def _note_resolved(self, _fut):
         with self._submit_lock:
             self._inflight -= 1
+            self._g_inflight.set(self._inflight)
 
     def alive(self):
         """Liveness witness (the front door's /healthz reads it): the
@@ -241,8 +298,9 @@ class ServingEngine:
         with a cross-model resident-weight rollup by storage dtype (the
         bf16/int8 memory claims' one-stop measurement — bench rows and
         serve_smoke read this instead of recomputing)."""
+        out = self._stats.as_dict()
         with self._stats_lock:
-            out = dict(self._stats)
+            out["max_rows_in_batch"] = self._max_rows
         with self._submit_lock:
             out["inflight"] = self._inflight
         out["max_inflight"] = self._max_inflight
@@ -271,6 +329,9 @@ class ServingEngine:
                              "within %.0fs" % timeout)
         # every resolution the drain enqueued precedes the sentinel
         self._completer.close(timeout)
+        # retire this engine's labeled series from the process scrape
+        # (stats() keeps reading through its own references)
+        _metrics.drop(self._mlabels)
 
     def __enter__(self):
         return self
@@ -287,6 +348,16 @@ class ServingEngine:
             while self._dispatch_once():
                 pass
         finally:
+            # a crashed loop (anything but the clean close() exit)
+            # leaves a postmortem: the flight ring dumps with the
+            # failure named, before the sweep below fails the queue
+            exc = sys.exc_info()[1]
+            if exc is not None:
+                fl = _tracing.flight()
+                fl.record("crash", "serving engine loop",
+                          error=repr(exc))
+                fl.dump(reason="serving engine dispatch loop "
+                        "crashed: %r" % (exc,))
             # the dispatch loop is exiting — normally (close()) or
             # because a cycle raised something unexpected.  Either way
             # the queue must never again accept a request that nothing
@@ -443,19 +514,20 @@ class ServingEngine:
             return
         t2 = time.perf_counter_ns()
         now = time.monotonic()
+        mets = _metrics.phase_on()
         live = []
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
                 self._resolve(r.future, exc=ServeTimeout(
                     "request for %r timed out after %.1f ms in queue"
                     % (r.model, (now - r.t_submit) * 1e3)))
-                with self._stats_lock:
-                    self._stats["timeouts"] += 1
+                self._stats.inc("timeouts")
             elif r.future.set_running_or_notify_cancel():
                 live.append(r)
+                if mets:
+                    _H_QWAIT.observe(now - r.t_submit)
             else:
-                with self._stats_lock:
-                    self._stats["cancelled"] += 1
+                self._stats.inc("cancelled")
         if not live:
             return
         if self._dispatch_hook is not None:
@@ -467,37 +539,52 @@ class ServingEngine:
             names = live[0].inputs.keys()
             inputs = {k: np.concatenate([r.inputs[k] for r in live])
                       for k in names}
-        try:
-            store = self._registry.store(model)
-            outs, bucket, batch_major = store.run(inputs, n=rows,
-                                                  slice_outputs=False)
-        except BaseException as e:  # noqa: BLE001 — forwarded to futures
-            exc = e if isinstance(e, MXNetError) \
-                else MXNetError("serving dispatch failed: %r" % (e,))
+        # the batch's compute span belongs to EVERY member's trace:
+        # activate them all, so serve_compute lands in each as a child
+        # of that request's ingress span
+        with _tracing.activate_many(
+                [(r.trace, r.trace_parent) for r in live]):
+            try:
+                store = self._registry.store(model)
+                outs, bucket, batch_major = store.run(inputs, n=rows,
+                                                      slice_outputs=False)
+            except BaseException as e:  # noqa: BLE001 — to the futures
+                exc = e if isinstance(e, MXNetError) \
+                    else MXNetError("serving dispatch failed: %r" % (e,))
+                _tracing.flight().record(
+                    "error", "serve_dispatch_failed", model=model,
+                    error=repr(e), requests=len(live))
+                for r in live:
+                    self._resolve(r.future, exc=exc)
+                self._stats.inc("errors", len(live))
+                return
+            # outs are bucket-shaped (pad rows still on); every request
+            # gets its rows via the shared traced-offset slicer, so no
+            # per-batch or per-offset slice program ever compiles here
+            ofs = 0
+            sliced = []
             for r in live:
-                self._resolve(r.future, exc=exc)
-            with self._stats_lock:
-                self._stats["errors"] += len(live)
-            return
-        # outs are bucket-shaped (pad rows still on); every request gets
-        # its rows via the shared traced-offset slicer, so no per-batch
-        # or per-offset slice program ever compiles on this thread
-        ofs = 0
-        for r in live:
-            res = []
-            for o, bm in zip(outs, batch_major):
-                if bm and r.n != bucket:
-                    o = _row_slice(o, ofs, r.n)
-                res.append(o)
-            self._resolve(r.future, res)
-            ofs += r.n
-        _profiler.record_phase("serve_compute", t2)
+                res = []
+                for o, bm in zip(outs, batch_major):
+                    if bm and r.n != bucket:
+                        o = _row_slice(o, ofs, r.n)
+                    res.append(o)
+                sliced.append(res)
+                ofs += r.n
+            # phase recorded BEFORE the resolutions enqueue: a resolved
+            # future finishes its minter's trace, and a span landing
+            # after finish would be dropped from the export
+            _profiler.record_phase("serve_compute", t2)
+            for r, res in zip(live, sliced):
+                self._resolve(r.future, res)
+        if mets:
+            _H_BATCH.observe(rows)
+        self._stats.inc("batches")
+        self._stats.inc("rows", rows)
+        self._stats.inc("padded_rows", bucket - rows)
         with self._stats_lock:
-            self._stats["batches"] += 1
-            self._stats["rows"] += rows
-            self._stats["padded_rows"] += bucket - rows
-            if rows > self._stats["max_rows_in_batch"]:
-                self._stats["max_rows_in_batch"] = rows
+            if rows > self._max_rows:
+                self._max_rows = rows
 
     def _shutdown(self):
         """Drain everything already submitted (or fail it when

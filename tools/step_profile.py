@@ -115,6 +115,33 @@ def add_flops_columns(report, cost):
     return report
 
 
+def render_metrics(snap):
+    """Human-readable registry snapshot (``--metrics``): the per-phase
+    histograms (count + p50/p95/p99 ms) beside the counters/gauges —
+    the AGGREGATE answer next to the phase table's per-step one, from
+    the same record_phase spans."""
+    lines = ["-- metrics registry (mxnet_tpu/metrics.py snapshot) --"]
+    hists = snap.get("histograms", {})
+    if hists:
+        lines.append("%-44s %8s %10s %10s %10s" % (
+            "histogram", "count", "p50_ms", "p95_ms", "p99_ms"))
+        for name, d in sorted(hists.items()):
+            if not d["count"]:
+                continue
+            lines.append("%-44s %8d %10.3f %10.3f %10.3f" % (
+                name, d["count"], (d["p50"] or 0) * 1e3,
+                (d["p95"] or 0) * 1e3, (d["p99"] or 0) * 1e3))
+    counters = {k: v for k, v in snap.get("counters", {}).items() if v}
+    if counters:
+        lines.append("counters: " + "  ".join(
+            "%s=%d" % kv for kv in sorted(counters.items())))
+    gauges = {k: v for k, v in snap.get("gauges", {}).items() if v == v}
+    if gauges:
+        lines.append("gauges:   " + "  ".join(
+            "%s=%g" % kv for kv in sorted(gauges.items())))
+    return "\n".join(lines)
+
+
 def render(report):
     """Human-readable phase table from an aggregated report."""
     lines = []
@@ -156,6 +183,11 @@ def main(argv=None):
                         "smoke iterator (the faultinject-delay pattern)")
     parser.add_argument("--keep-trace", help="also copy the smoke trace "
                         "to this path")
+    parser.add_argument("--metrics", action="store_true",
+                        help="also print the metrics-registry snapshot "
+                        "(phase histograms + counters) beside the phase "
+                        "table — one tool answers both the 'trace' and "
+                        "the 'aggregate' question")
     args = parser.parse_args(argv)
 
     from mxnet_tpu import profiler
@@ -187,10 +219,15 @@ def main(argv=None):
         # core fit phases must always be there — CI pins the format
         print("ERROR: phases missing from trace: %s" % missing)
         return 1
+    if args.metrics:
+        from mxnet_tpu import metrics as _metrics
+        report["metrics"] = _metrics.snapshot()
     if args.json:
         print(json.dumps(report))
     else:
         print(render(report))
+        if args.metrics:
+            print(render_metrics(report["metrics"]))
     return 0
 
 
