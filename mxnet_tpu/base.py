@@ -356,6 +356,33 @@ register_env("MXNET_SERVE_KV_DTYPE", str, "float32",
              "accumulates fp32 in both the offset flash kernel and "
              "its dense XLA twin; decode parity is pinned at relaxed "
              "tolerance (tests/test_quant_serving.py).")
+register_env("MXNET_SERVE_PAGED", int, 1,
+             "Paged KV cache on the serving decode plane ('1', "
+             "default): cache memory is a global pool of "
+             "MXNET_SERVE_KV_BLOCK-token blocks addressed through "
+             "per-slot block tables, with copy-on-write prefix "
+             "sharing and chunked prefill "
+             "(docs/architecture/decode_engine.md).  '0' is the "
+             "escape hatch: the contiguous per-slot cache plane, "
+             "bit-for-bit the pre-paging behavior (pinned by "
+             "tests/test_paged_decode.py).")
+register_env("MXNET_SERVE_PREFILL_CHUNK", int, 32,
+             "Chunked-prefill quantum of the paged decode plane: a "
+             "prompt is consumed this many tokens per engine tick, "
+             "interleaved with the running decode batch's steps, so "
+             "one long prompt cannot stall every other stream's "
+             "inter-token latency for its whole prefill.  Clamped to "
+             "MXNET_SERVE_KV_MAX; only the paged plane "
+             "(MXNET_SERVE_PAGED=1) chunks.")
+register_env("MXNET_SERVE_KV_POOL_BLOCKS", int, 0,
+             "Physical block count of the paged KV pool (including "
+             "the reserved trash block 0 that zero table entries "
+             "point at).  0 (default) sizes the pool so the largest "
+             "batch bucket can hold full-depth sequences: "
+             "max_batch_bucket * ceil(kv_max / kv_block) + 1.  The "
+             "pool — not per-slot max-length reservations — bounds "
+             "admission: requests that cannot fit shed with "
+             "ServeOverloaded.")
 register_env("MXNET_SERVE_SAMPLE", str, "graph",
              "Where generation sampling runs: 'graph' (default) "
              "compiles greedy + seeded temperature/top-k INTO the "

@@ -574,6 +574,12 @@ class Server:
         self._migrating = set()  # keys frozen by an in-flight transfer
         self.stop_event = threading.Event()
         self.rank = None
+        # set once the scheduler has assigned this server's rank.  Rank
+        # follows registration ARRIVAL order, so a launcher spinning
+        # several servers back-to-back must wait_registered() between
+        # starts or the ranks race the thread scheduler — the bring-up
+        # race behind the old dst-store-empty migration-test flake
+        self.registered = threading.Event()
         # -- crash durability (docs/architecture/fault_tolerance.md) --
         self.snapshot_dir = get_env("MXNET_KVSTORE_SNAPSHOT_DIR") or None
         self.snapshot_interval = float(
@@ -1025,6 +1031,18 @@ class Server:
                      % (len(envelope["store"]), version), t0,
                      cat="ps_rebalance")
 
+    def wait_registered(self, timeout=30.0):
+        """Block until the scheduler has assigned this server's rank;
+        returns the rank.  The scheduler hands out ranks in registration
+        ARRIVAL order, so a launcher starting N servers must interpose
+        this between starts for "creation order == rank" to hold — the
+        registration RPCs of concurrently started servers race the
+        thread scheduler."""
+        if not self.registered.wait(timeout):
+            raise MXNetError("server did not complete scheduler "
+                             "registration within %.1fs" % timeout)
+        return self.rank
+
     def run(self):
         # register with scheduler; a restarted server re-claims its old
         # rank (DMLC_PS_RECOVERY_RANK) so workers can re-resolve it
@@ -1033,6 +1051,7 @@ class Server:
         sched = _connect(_root_addr())
         sched.send(("register_server", self.listener.address, recover))
         _, self.rank = sched.recv()
+        self.registered.set()
         # restore BEFORE serving: in-flight pulls that retry against the
         # rejoined server must see the recovered state, not an empty
         # store.  Gated on the recovery rank — a FRESH job pointed at a

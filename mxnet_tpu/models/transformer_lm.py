@@ -18,7 +18,8 @@ next-token targets of the same shape.
 from .. import symbol as sym
 
 __all__ = ["get_symbol", "lm_spec", "random_params", "init_cache",
-           "prefill_apply", "decode_apply", "quantize_lm_params",
+           "init_pool", "prefill_apply", "decode_apply",
+           "paged_step_apply", "quantize_lm_params",
            "lm_matmul_weights"]
 
 
@@ -127,6 +128,20 @@ def init_cache(spec, batch, cache_len, dtype="float32"):
     dh = spec["num_hidden"] // spec["num_heads"]
     shape = (spec["num_layers"], batch, spec["num_heads"],
              int(cache_len), dh)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def init_pool(spec, num_blocks, block_size, dtype="float32"):
+    """Zeroed paged KV pool pair, each of shape ``(num_layers,
+    num_heads, num_blocks * block_size, head_dim)`` — one GLOBAL pool
+    shared by every sequence, addressed through per-sequence block
+    tables (:func:`paged_step_apply`).  Block 0 is conventionally the
+    reserved trash block: pad writes target it, no real table entry
+    points at it."""
+    import jax.numpy as jnp
+    dh = spec["num_hidden"] // spec["num_heads"]
+    shape = (spec["num_layers"], spec["num_heads"],
+             int(num_blocks) * int(block_size), dh)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -318,3 +333,82 @@ def decode_apply(params, cache_k, cache_v, tokens, lengths, spec):
                params["final_ln_beta"])
     logits = _mm(h, params["pred_weight"]) + params["pred_bias"]
     return logits.astype(jnp.float32), cache_k, cache_v
+
+
+def paged_step_apply(params, pool_k, pool_v, tables, tokens, positions,
+                     valid, spec, block_size):
+    """One PAGED step — the unified prefill-chunk/decode graph of the
+    paged KV plane (docs/architecture/decode_engine.md).
+
+    tokens: (B, Lq) int32 — ``Lq`` tokens per sequence (a prefill chunk;
+    ``Lq=1`` is a decode step); positions: (B,) int32 — global position
+    of ``tokens[:, 0]`` (row r sits at ``positions[b] + r``); valid:
+    (B,) int32 — rows ``r < valid[b]`` are real (``1 <= valid <= Lq``;
+    rows past it are pad); tables: (B, T) int32 per-sequence block
+    tables over the global pools (``(L, H, num_blocks * block_size,
+    dh)``, :func:`init_pool`); table entries past a sequence's frontier
+    must point at a VALID pool block — conventionally the reserved
+    trash block 0.
+
+    Each layer scatters the chunk's K/V to pool rows ``tables[b, p //
+    bs] * bs + p % bs`` (pad rows scatter into block 0) and attends
+    through the ``sdp_attention_paged`` door — so intra-chunk causality
+    and pad invisibility both come from the one offset-causal mask, and
+    the pool arrays lower to in-place scatters when DONATED.  Returns
+    ``(logits (B, vocab) fp32 at each row's LAST VALID position, pool_k,
+    pool_v)``.  Rows whose table is all zeros (non-participating slots
+    in a fused dispatch) read/write only the trash block and yield
+    garbage logits — callers discard them.  Params may be bf16 or int8
+    ``QuantizedWeight`` pairs like :func:`prefill_apply`."""
+    import jax.numpy as jnp
+    from ..ops.attention import sdp_attention_paged
+    from ..ops.nn import _ln_fc, _rms_fc
+
+    L, D = spec["num_layers"], spec["num_hidden"]
+    H = spec["num_heads"]
+    dh = D // H
+    bs = int(block_size)
+    B, Lq = tokens.shape
+    cdt = pool_k.dtype
+    tables = jnp.asarray(tables, jnp.int32)
+    positions = jnp.asarray(positions, jnp.int32)
+    valid = jnp.asarray(valid, jnp.int32)
+    r = jnp.arange(Lq, dtype=jnp.int32)
+    p = positions[:, None] + r[None, :]                     # (B, Lq)
+    dest = tables[jnp.arange(B)[:, None], p // bs] * bs + p % bs
+    # pad rows scatter into the trash block (their keys are never
+    # attended: every real query's mask stops at its own frontier)
+    dest = jnp.where(r[None, :] < valid[:, None], dest,
+                     p % bs).reshape(-1)                    # (B*Lq,)
+    x = _embed(params["embed_weight"], tokens)              # (B, Lq, D)
+    for i in range(L):
+        bp = _block_params(params, i)
+        a = _rms_fc({"eps": 1e-6}, x, bp["ln1_gamma"])
+        a2 = a.reshape(-1, D)
+
+        def heads(w):
+            h = _mm(a2, w).reshape(B, Lq, H, dh)
+            return jnp.transpose(h, (0, 2, 1, 3))           # (B, H, Lq, dh)
+
+        q, k, v = (heads(bp[t]) for t in
+                   ("q_weight", "k_weight", "v_weight"))
+        # advanced-index scatter: (layer, :, rows, :) puts the indexed
+        # dimension first, so updates arrive as (B*Lq, H, dh)
+        kT = jnp.transpose(k.astype(cdt), (0, 2, 1, 3)).reshape(
+            B * Lq, H, dh)
+        vT = jnp.transpose(v.astype(cdt), (0, 2, 1, 3)).reshape(
+            B * Lq, H, dh)
+        pool_k = pool_k.at[i, :, dest, :].set(kT)
+        pool_v = pool_v.at[i, :, dest, :].set(vT)
+        att = sdp_attention_paged(q.astype(cdt), pool_k[i], pool_v[i],
+                                  tables, positions, bs)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(-1, D)
+        x = x + _mm(att.astype(x.dtype), bp["proj_weight"]).reshape(
+            B, Lq, D)
+        f = _rms_fc({"eps": 1e-6}, x, bp["ln2_gamma"]).reshape(-1, D)
+        x = x + _ffn(f, bp).reshape(B, Lq, D)
+    h = _ln_fc({"axis": -1, "eps": 1e-5}, x, params["final_ln_gamma"],
+               params["final_ln_beta"])
+    last = h[jnp.arange(B), valid - 1]                      # (B, D)
+    logits = _mm(last, params["pred_weight"]) + params["pred_bias"]
+    return logits.astype(jnp.float32), pool_k, pool_v

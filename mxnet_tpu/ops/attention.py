@@ -80,6 +80,35 @@ def sdp_attention(query, key, value, causal=False, scale=0.0,
     return _dense_attention(query, key, value, causal, scale)
 
 
+def sdp_attention_paged(query, k_pool, v_pool, tables, positions,
+                        block_size, scale=0.0):
+    """Paged scaled-dot-product attention: [B, H, Lq, D] queries whose
+    row r of sequence b sits at global position ``positions[b] + r``,
+    attending over a global block pool (``(H, num_blocks * block_size,
+    D)``) through per-sequence block tables (``(B, T)`` int32) — the
+    decode engine's paged-KV door (docs/architecture/decode_engine.md).
+
+    Eligible shapes route to ``flash_attention_paged`` (scalar-prefetch
+    block tables, dynamic block skip, forward-only); everything else —
+    and ``MXNET_PALLAS=0`` — lowers to ``paged_attention_reference``,
+    the gather + dense twin with the same masking constant."""
+    b, h, lq, d = query.shape
+    t = tables.shape[1]
+    bs = int(block_size)
+    if scale <= 0.0:
+        scale = 1.0 / (d ** 0.5)
+    from ..pallas_ops import dispatch as _pd
+    if _pd.use_attention_paged("DotProductAttentionPaged", b, h, lq,
+                               t * bs, d, query.dtype):
+        from ..pallas_ops.paged_attention import flash_attention_paged
+        return flash_attention_paged(
+            query, k_pool, v_pool, tables, positions, bs, scale=scale,
+            block_q=_pd.block_seq(), interpret=_pd.interpret_mode())
+    from ..pallas_ops.paged_attention import paged_attention_reference
+    return paged_attention_reference(query, k_pool, v_pool, tables,
+                                     positions, bs, scale=scale)
+
+
 def _attn_fc(attrs, query, key, value):
     if query.ndim != 4:
         raise MXNetError("DotProductAttention expects [batch, heads, "

@@ -12,7 +12,10 @@ where explicit VMEM blocking beats XLA's default schedule:
   each one VMEM trip;
 * ``dequant_matmul``   — int8 weight-only serving: per-row dequant fused
   into the matmul tile loop (codes travel to VMEM as int8, fp32
-  accumulation, scale applied once at the last K step).
+  accumulation, scale applied once at the last K step);
+* ``paged_attention``  — block-table flash attention over the serving
+  decode plane's paged KV pool (scalar-prefetch tables, dynamic block
+  skip — the gather XLA cannot re-block on its own).
 
 ``dispatch`` is the routing seam: eligible op lowerings (the registry
 ``fcompute`` layer every execution plane traces through) ask it whether
@@ -24,11 +27,14 @@ from .dequant_matmul import (QuantizedWeight, dequant_matmul,
                              quantize_int8)
 from .flash_attention import flash_attention
 from .norm import layer_norm, rms_norm
+from .paged_attention import (flash_attention_paged,
+                              paged_attention_reference)
 from .softmax_xent import (fused_softmax, softmax_output_head,
                            softmax_xent_loss)
 from . import dispatch
 
-__all__ = ["flash_attention", "fused_softmax", "softmax_output_head",
-           "softmax_xent_loss", "rms_norm", "layer_norm", "dispatch",
-           "quantize_int8", "dequantize_int8", "QuantizedWeight",
-           "dequant_matmul", "dequant_matmul_dense"]
+__all__ = ["flash_attention", "flash_attention_paged",
+           "paged_attention_reference", "fused_softmax",
+           "softmax_output_head", "softmax_xent_loss", "rms_norm",
+           "layer_norm", "dispatch", "quantize_int8", "dequantize_int8",
+           "QuantizedWeight", "dequant_matmul", "dequant_matmul_dense"]

@@ -44,12 +44,13 @@ import contextlib
 import threading
 
 from ..base import get_env
-from .flash_attention import _on_tpu
+from .flash_attention import _on_tpu, pltpu
 
 __all__ = ["mode", "kernels_active", "interpret_mode", "block_rows",
            "block_seq", "fingerprint", "overriding", "use_rowwise",
-           "use_attention", "use_dequant_matmul", "eligible_rowwise",
-           "eligible_attention", "eligible_attention_offset",
+           "use_attention", "use_attention_paged", "use_dequant_matmul",
+           "eligible_rowwise", "eligible_attention",
+           "eligible_attention_offset", "eligible_attention_paged",
            "eligible_dequant_matmul", "dispatch_stats",
            "reset_dispatch_stats"]
 
@@ -210,6 +211,23 @@ def eligible_attention_offset(b, h, lq, lk, d, dtype):
     return int(b) >= 1 and int(h) >= 1
 
 
+def eligible_attention_paged(b, h, lq, lk, d, dtype):
+    """May a paged-KV attention pattern (block tables over a global
+    pool) run as ``flash_attention_paged``?
+
+    The offset rules (:func:`eligible_attention_offset`) plus one
+    structural requirement: the kernel's block tables ride as
+    scalar-prefetch operands (``pltpu.PrefetchScalarGridSpec``), so the
+    Pallas TPU backend module must be importable — pure-CPU jaxlib
+    builds without it keep the gather-based dense twin
+    (``paged_attention_reference``).  ``lk`` is the logical length the
+    table addresses (table width × block size).
+    """
+    if pltpu is None:  # pragma: no cover - present on this jaxlib
+        return False
+    return eligible_attention_offset(b, h, lq, lk, d, dtype)
+
+
 def eligible_dequant_matmul(m, n, k, dtype):
     """May an ``x (m, k) @ dequant(codes (n, k))^T`` pattern run as the
     fused int8 dequant-matmul kernel (``dequant_matmul.py``)?
@@ -286,6 +304,17 @@ def use_attention(kind, b, h, lq, lk, d, dtype, offset=False):
     (looser) eligibility rules."""
     elig = eligible_attention_offset if offset else eligible_attention
     if not kernels_active() or not elig(b, h, lq, lk, d, dtype):
+        return False
+    _note(kind)
+    return True
+
+
+def use_attention_paged(kind, b, h, lq, lk, d, dtype):
+    """Route decision for a paged-KV attention pattern; counts a route
+    when taken."""
+    if not kernels_active() or not eligible_attention_paged(b, h, lq,
+                                                            lk, d,
+                                                            dtype):
         return False
     _note(kind)
     return True

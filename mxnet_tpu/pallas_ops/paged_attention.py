@@ -1,0 +1,198 @@
+"""Paged flash attention: block-table indirection over a global KV pool.
+
+The decode plane's contiguous cache reserves ``(slots, cache_len)``
+per-sequence rectangles; the paged plane replaces them with a single
+pool of ``MXNET_SERVE_KV_BLOCK``-token blocks shared by every sequence,
+addressed through per-sequence block tables.  Logical token position p
+of sequence b lives at pool row ``tables[b, p // bs] * bs + p % bs`` —
+so sequences share physical blocks (prefix reuse), grow one block at a
+time, and free their blocks at retire.
+
+The kernel rides the ``flash_attention_offset`` machinery: same online
+softmax, same ``-1e30`` masking constant, same fp32 accumulation, same
+dynamic block skip on the per-sequence frontier.  What changes is WHERE
+a K/V tile comes from: the k-grid dimension walks LOGICAL blocks and the
+BlockSpec index map dereferences the block table — Pallas fetches the
+physical tile ``tables[b, j]`` from the pool.  The tables and frontiers
+ride as scalar-prefetch operands (``PrefetchScalarGridSpec``): they land
+in SMEM before the grid runs, so index maps can read them.
+
+``paged_attention_reference`` is the dense XLA twin — gather the pool
+rows through the same table arithmetic, then the exact dense
+offset-causal attention of ``ops/attention._dense_attention`` — the
+``MXNET_PALLAS=0`` lowering and the parity oracle
+(tests/test_paged_decode.py).  Forward-only, like every decode kernel.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import _VMEM, _on_tpu, divisor_block, pltpu
+
+__all__ = ["flash_attention_paged", "paged_attention_reference"]
+
+_NEG = -1e30  # flash_attention._NEG: shared mask constant for parity
+
+
+def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                  l_ref, acc_ref, *, scale, block_q, block_size, nt):
+    """One (batch, head, q-block, logical-block) grid cell.
+
+    ``tbl_ref``/``pos_ref`` are the scalar-prefetch operands (SMEM);
+    the k dimension walks logical blocks j — the index maps already
+    dereferenced ``tbl_ref[b, j]``, so ``k_ref``/``v_ref`` hold the
+    PHYSICAL tile.  Masking happens in logical position space."""
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    ofs = pos_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # dynamic skip: logical block ki contributes iff the last query row
+    # (global position ofs + qi*block_q + block_q - 1) can see its first
+    # key position (ki * block_size)
+    run = ofs + qi * block_q + block_q - 1 >= ki * block_size
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)     # (BQ, D)
+        kb = k_ref[0].astype(jnp.float32)       # (BS, D)
+        vb = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (BQ, BS)
+        qpos = ofs + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_size), 0)
+        kpos = ki * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_size), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(qpos >= kpos, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            p, vb, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nt - 1)
+    def _finalize():
+        l = l_ref[:]
+        o_ref[0, 0] = (acc_ref[:] /
+                       jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+def flash_attention_paged(q, k_pool, v_pool, tables, positions,
+                          block_size, scale=None, block_q=128,
+                          interpret=None):
+    """Offset-causal flash attention against a PAGED KV pool.
+
+    q: (B, H, Lq, D) — query row r of sequence b sits at global
+    position ``positions[b] + r``; k_pool/v_pool: (H, num_blocks *
+    block_size, D) global pools; tables: (B, T) int32 per-sequence
+    block tables mapping logical block j to a physical pool block
+    (entries past a sequence's frontier must point at a valid block —
+    conventionally the reserved trash block 0 — their keys are masked
+    either way); positions: (B,) int32 frontiers.
+
+    The tables/positions ride as scalar-prefetch operands so BlockSpec
+    index maps can gather physical tiles; blocks a sequence cannot see
+    are skipped dynamically like ``flash_attention_offset``.  Requires
+    the Pallas TPU backend module (``PrefetchScalarGridSpec``) — callers
+    gate on ``dispatch.eligible_attention_paged``.  Forward-only."""
+    if pltpu is None:  # pragma: no cover - eligibility gates this
+        raise RuntimeError("flash_attention_paged needs pallas.tpu "
+                           "(PrefetchScalarGridSpec)")
+    B, H, Lq, D = q.shape
+    T = tables.shape[1]
+    bs = int(block_size)
+    assert k_pool.shape == v_pool.shape and k_pool.shape[0] == H
+    assert k_pool.shape[1] % bs == 0, \
+        "pool length must be a multiple of block_size"
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = not _on_tpu()
+    block_q = divisor_block(Lq, block_q)
+    tbl = jnp.asarray(tables, jnp.int32)
+    pos = jnp.asarray(positions, jnp.int32).reshape(B)
+
+    kernel = functools.partial(_paged_kernel, scale=float(scale),
+                               block_q=block_q, block_size=bs, nt=T)
+
+    def _spec(shape, index_map):
+        if _VMEM is not None:
+            return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+        return pl.BlockSpec(shape, index_map)  # pragma: no cover
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, Lq // block_q, T),
+        in_specs=[
+            _spec((1, 1, block_q, D),
+                  lambda b, h, i, j, tbl, pos: (b, h, i, 0)),  # Q tile
+            # k/v: fetch PHYSICAL block tbl[b, j] from the pool —
+            # the index is in units of whole (bs, D) blocks
+            _spec((1, bs, D),
+                  lambda b, h, i, j, tbl, pos: (h, tbl[b, j], 0)),
+            _spec((1, bs, D),
+                  lambda b, h, i, j, tbl, pos: (h, tbl[b, j], 0)),
+        ],
+        out_specs=_spec((1, 1, block_q, D),
+                        lambda b, h, i, j, tbl, pos: (b, h, i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, D), jnp.float32)])
+    _params_cls = getattr(pltpu, "CompilerParams", None) or \
+        pltpu.TPUCompilerParams
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+        interpret=interpret,
+        compiler_params=_params_cls(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")))(tbl, pos, q, k_pool,
+                                                v_pool)
+    return out
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, positions,
+                              block_size, scale=None):
+    """Dense XLA twin of :func:`flash_attention_paged`: gather the pool
+    rows through the same block-table arithmetic, then the exact dense
+    offset-causal attention (same ``-1e30`` constant, fp32 accumulation)
+    — the ``MXNET_PALLAS=0`` lowering and the parity oracle."""
+    B, H, Lq, D = q.shape
+    T = tables.shape[1]
+    bs = int(block_size)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    tbl = jnp.asarray(tables, jnp.int32)
+    pos = jnp.asarray(positions, jnp.int32).reshape(B)
+    # logical row p of sequence b = pool row tbl[b, p // bs]*bs + p % bs
+    idx = (tbl[:, :, None] * bs +
+           jnp.arange(bs, dtype=jnp.int32)[None, None, :]).reshape(
+               B, T * bs)
+    k = jnp.transpose(jnp.take(k_pool, idx, axis=1), (1, 0, 2, 3))
+    v = jnp.transpose(jnp.take(v_pool, idx, axis=1), (1, 0, 2, 3))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (Lq, T * bs), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (Lq, T * bs), 1)
+    qglob = pos[:, None, None] + qpos
+    s = jnp.where((qglob >= kpos[None])[:, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -48,6 +48,21 @@ in-place ``dynamic_update_slice`` on the resident buffers (donation is
 skipped on the CPU backend, matching the training planes' donation
 guards).
 
+On the default PAGED plane (``MXNET_SERVE_PAGED=1``) the cache is a
+single global pool of ``MXNET_SERVE_KV_BLOCK``-token blocks addressed
+through per-slot block tables (:class:`_PagedModelState`): admission
+reserves each request's worst-case block need up front (throttling
+FIFO when the pool runs short — the pool can never exhaust
+mid-flight), completed prefills register their blocks in a
+copy-on-write prefix cache (:class:`_PrefixStore` — an identical
+prompt prefix adopts the shared blocks instead of re-prefilling;
+writes into shared blocks fork first), and prompts prefill in
+``MXNET_SERVE_PREFILL_CHUNK``-token chunks AFTER each tick's decode
+step so long prompts stop spiking co-running streams' inter-token
+latency.  ``paged=False`` (or ``MXNET_SERVE_PAGED=0``) keeps the
+contiguous per-slot plane above, bit-identical streams
+(docs/architecture/decode_engine.md).
+
 ``close(drain=True)`` finishes every admitted AND queued generation
 before the thread exits; ``close(drain=False)`` fails everything fast
 with :class:`~.scheduler.ServeClosed`.
@@ -83,6 +98,10 @@ _H_TTFT = _metrics.histogram(
 _H_ITL = _metrics.histogram(
     "serve_itl_seconds",
     help="generation inter-token latency, gap between samples")
+_H_CHUNKS = _metrics.histogram(
+    "serve_prefill_chunks_per_request",
+    help="chunked-prefill dispatches one admitted request's prompt "
+         "took on the paged decode plane", lo=1, hi=1e4)
 
 __all__ = ["GenerationEngine", "GenerationResult", "TokenStream"]
 
@@ -224,6 +243,217 @@ class _ModelState:
         return d
 
 
+class _BlockPool:
+    """Host-side allocator over the paged KV pool's physical blocks.
+
+    Block 0 is the reserved trash block (zero table entries point at
+    it; non-participating dispatch rows scribble there) and is never
+    allocated.  Every allocated block carries a refcount: a sequence
+    holding it in its table counts one, each prefix-cache pin counts
+    one — a block frees when the last reference drops."""
+
+    def __init__(self, num_blocks):
+        self.num_blocks = int(num_blocks)
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._ref = {}
+        self.hwm = 0
+
+    def capacity(self):
+        return self.num_blocks - 1
+
+    def used(self):
+        return self.capacity() - len(self._free)
+
+    def free_count(self):
+        return len(self._free)
+
+    def refcount(self, b):
+        return self._ref.get(b, 0)
+
+    def alloc(self):
+        """One fresh block at refcount 1, or None when exhausted."""
+        if not self._free:
+            return None
+        b = self._free.pop()
+        self._ref[b] = 1
+        if self.used() > self.hwm:
+            self.hwm = self.used()
+        return b
+
+    def ref(self, b):
+        self._ref[b] += 1
+
+    def deref(self, b):
+        r = self._ref[b] - 1
+        if r <= 0:
+            del self._ref[b]
+            self._free.append(b)
+        else:
+            self._ref[b] = r
+        return r
+
+    def shared(self):
+        """Blocks currently referenced more than once."""
+        return sum(1 for r in self._ref.values() if r > 1)
+
+
+class _PrefixStore:
+    """Copy-on-write prefix cache: exact prompt prefixes -> pinned
+    pool blocks.
+
+    Keys are the token tuples themselves (no hash collisions): a full
+    block j of a completed prefill registers under
+    ``tuple(prompt[:(j+1)*bs])``; a partial tail block under the WHOLE
+    prompt tuple.  Each entry pins one refcount on its block, so
+    shared prefixes survive their registering sequence's retirement.
+    Matching walks full blocks longest-prefix-first and takes the
+    tail only on an exact whole-prompt match — N requests with the
+    same system prompt pay its prefill once.  Entries whose pin is
+    the LAST reference are evictable (LRU) when the pool runs dry."""
+
+    def __init__(self, pool, block_size):
+        self._pool = pool
+        self._bs = int(block_size)
+        self._entries = collections.OrderedDict()  # tokens -> (blk, n)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def match(self, prompt):
+        """Longest shared prefix of ``prompt``: ``(full_blocks, tail)``
+        — physical block ids for whole shared blocks, plus the tail
+        block on an exact whole-prompt match (else None).  Touches the
+        matched entries' LRU position; refcounts are NOT taken (the
+        caller refs what it actually adopts)."""
+        bs = self._bs
+        blocks = []
+        j = 0
+        while (j + 1) * bs <= len(prompt):
+            key = tuple(prompt[:(j + 1) * bs])
+            e = self._entries.get(key)
+            if e is None or e[1] != bs:
+                break
+            self._entries.move_to_end(key)
+            blocks.append(e[0])
+            j += 1
+        tail = None
+        nt = len(prompt) % bs
+        if nt and j == len(prompt) // bs:
+            e = self._entries.get(tuple(prompt))
+            if e is not None and e[1] == nt:
+                self._entries.move_to_end(tuple(prompt))
+                tail = e[0]
+        return blocks, tail
+
+    def register(self, prompt, table_row):
+        """Pin a completed prefill's blocks for future sharing (+1
+        refcount per NEW entry; prefixes already registered — possibly
+        against different physical blocks — are left alone)."""
+        bs = self._bs
+        for j in range(len(prompt) // bs):
+            key = tuple(prompt[:(j + 1) * bs])
+            if key in self._entries:
+                continue
+            b = int(table_row[j])
+            self._pool.ref(b)
+            self._entries[key] = (b, bs)
+        nt = len(prompt) % bs
+        if nt:
+            key = tuple(prompt)
+            if key not in self._entries:
+                b = int(table_row[len(prompt) // bs])
+                self._pool.ref(b)
+                self._entries[key] = (b, nt)
+
+    def evictable(self):
+        """Pins whose block would FREE on eviction (refcount 1)."""
+        return sum(1 for b, _n in self._entries.values()
+                   if self._pool.refcount(b) == 1)
+
+    def evict_one(self):
+        """Drop the least-recently-used pin whose block frees (blocks
+        still held by live sequences stay).  True when a block was
+        reclaimed."""
+        for key, (b, _n) in self._entries.items():
+            if self._pool.refcount(b) == 1:
+                del self._entries[key]
+                self._pool.deref(b)
+                return True
+        return False
+
+
+class _PagedModelState:
+    """Live paged decode batch of one model: slot table + per-slot
+    block tables over the global KV pool + the prefix cache.
+
+    Unlike the contiguous :class:`_ModelState`, this PERSISTS across
+    batch drains — the prefix cache's pinned blocks are the point of
+    keeping it — so ``store.cache_state`` stays attached until the
+    engine closes."""
+
+    paged = True
+
+    def __init__(self, store):
+        self.store = store
+        self.pool = _BlockPool(store.pool_blocks)
+        self.prefix = _PrefixStore(self.pool, store.kv_block)
+        self.pool_k, self.pool_v = store.new_pool()
+        self.tb = store.table_width()
+        self.slots = []                        # _GenRequest or None
+        self.tables = np.zeros((0, self.tb), np.int32)
+        self.lengths = np.zeros(0, np.int32)   # KV frontier per slot
+        self.prog = np.zeros(0, np.int32)      # prompt tokens consumed
+        self.decoding = np.zeros(0, bool)      # prompt done, generating
+        self.chunks_done = np.zeros(0, np.int32)
+        self.next_tok = np.zeros(0, np.int32)
+        self.temps = np.zeros(0, np.float32)
+        self.top_ks = np.zeros(0, np.int32)
+        self.resv = np.zeros(0, np.int32)      # reserved-unallocated
+        self.keys = jnp.zeros((0, 2), jnp.uint32)
+        self.g_used = None                     # pool gauges (engine)
+        self.g_hwm = None
+
+    def active(self):
+        return [i for i, r in enumerate(self.slots) if r is not None]
+
+    def free_slot(self):
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def reserved_total(self):
+        return int(self.resv.sum())
+
+    def describe(self):
+        act = self.active()
+        pool_bytes = 2 * self.pool_k.size * self.pool_k.dtype.itemsize
+        per_block = pool_bytes // self.store.pool_blocks
+        d = {"slots": len(self.slots), "active": len(act),
+             "paged": True,
+             "sample_mode": self.store.sample_mode,
+             "block_size": self.store.kv_block,
+             "prefill_chunk": self.store.prefill_chunk,
+             "pool_blocks": self.pool.capacity(),
+             "pool_blocks_used": self.pool.used(),
+             "pool_blocks_hwm": self.pool.hwm,
+             "pool_blocks_shared": self.pool.shared(),
+             "pool_blocks_reserved": self.reserved_total(),
+             "prefix_entries": len(self.prefix),
+             "cache_mb": round(pool_bytes / 2**20, 3),
+             "block_bytes": per_block,
+             "cache_dtype": str(self.pool_k.dtype)}
+        if act:
+            # the paged memory claim's measurement: pool bytes
+            # actually BACKING the live sequences, per sequence —
+            # shared prefix blocks are paid once, so prefix-heavy
+            # schedules drive this far under the contiguous plane's
+            # cache_bytes_per_slot
+            d["cache_bytes_per_active_seq"] = \
+                (self.pool.used() * per_block) // len(act)
+        return d
+
+
 class GenerationEngine:
     """Continuous-batching autoregressive generation over a
     :class:`~.registry.ModelRegistry`'s generative models.
@@ -263,7 +493,15 @@ class GenerationEngine:
             ("requests", "prefills", "prefill_seqs", "decode_steps",
              "generated_tokens", "finished", "timeouts", "cancelled",
              "errors", "shed", "cache_grows", "slot_grows",
-             "decode_fetch_elems"),
+             "decode_fetch_elems",
+             # paged-plane counters (zero on contiguous engines):
+             # prefix_hits counts admissions that reused shared
+             # blocks, *_blocks/_tokens their sizes; cow_forks the
+             # copy-on-write block duplications; prefill_chunks the
+             # chunk dispatches; shed_pool the requests too large for
+             # the pool
+             "prefix_hits", "prefix_hit_blocks", "prefix_hit_tokens",
+             "cow_forks", "prefill_chunks", "shed_pool"),
             labels=self._mlabels, help="generation engine counter")
         self._g_inflight = _metrics.gauge(
             "serve_gen_inflight", labels=self._mlabels,
@@ -496,6 +734,9 @@ class GenerationEngine:
             while dq:
                 self._fail_request(dq.popleft(), e)
             return
+        if getattr(store, "paged", False):
+            self._admit_paged(model, dq, store)
+            return
         st = self._states.get(model)
         cap = store.max_slots()
         if self._max_active is not None:
@@ -657,9 +898,372 @@ class GenerationEngine:
         self._stats.inc("cache_grows")
         self._note_cache_hwm(st.store.name, st)
 
+    # -- paged plane ---------------------------------------------------
+    def _paged_state(self, model, store):
+        st = self._states.get(model)
+        if st is None:
+            st = self._states[model] = _PagedModelState(store)
+            store.cache_state = st
+            lbl = dict(self._mlabels, model=model)
+            st.g_used = _metrics.gauge(
+                "serve_kv_pool_blocks_used", labels=lbl,
+                help="paged KV pool blocks currently allocated")
+            st.g_hwm = _metrics.gauge(
+                "serve_kv_pool_blocks_hwm", labels=lbl,
+                help="paged KV pool allocation high-water mark")
+        return st
+
+    def _paged_gauges(self, st):
+        st.g_used.set(st.pool.used())
+        st.g_hwm.set(st.pool.hwm)
+
+    def _paged_alloc(self, st):
+        """One fresh pool block, evicting LRU prefix pins if the free
+        list is dry.  Exhaustion raises — admission reservations exist
+        to make that unreachable."""
+        b = st.pool.alloc()
+        while b is None and st.prefix.evict_one():
+            b = st.pool.alloc()
+        if b is None:
+            raise MXNetError(
+                "paged KV pool exhausted (%d blocks) — admission "
+                "reservations should have prevented this"
+                % st.pool.capacity())
+        return b
+
+    def _admit_paged(self, model, dq, store):
+        """Paged admission: no prefill dispatch here — a slot is
+        claimed, its block table seeded from the prefix cache (shared
+        blocks adopted at +1 refcount each), and the prompt's
+        remaining tokens left for the tick loop to chunk through.
+        FIFO, never overtaking: the head request waiting on pool
+        space blocks everyone behind it."""
+        st = self._paged_state(model, store)
+        bs = store.kv_block
+        cap = store.max_slots()
+        if self._max_active is not None:
+            cap = min(cap, self._max_active)
+        admitted = 0
+        while dq:
+            now = time.monotonic()
+            r = dq[0]
+            if r.deadline is not None and now > r.deadline:
+                dq.popleft()
+                self._fail_request(r, ServeTimeout(
+                    "generation request for %r timed out after %.1f ms "
+                    "in queue" % (model, (now - r.t_submit) * 1e3)),
+                    kind="timeouts")
+                continue
+            if len(st.active()) >= cap:
+                break
+            total_blocks = -(-(len(r.prompt) + r.max_tokens) // bs)
+            blocks, tail = st.prefix.match(r.prompt)
+            # a partially-filled last prompt block gets pinned by the
+            # prefix cache at registration, so the first decode write
+            # into it MUST copy-on-write-fork — one allocation past
+            # total_blocks.  A tail HIT already counts its fork target
+            # in total_blocks (the borrowed block is free).
+            fork_extra = int(len(r.prompt) % bs != 0 and tail is None)
+            needed = total_blocks - len(blocks) + fork_extra
+            if total_blocks + fork_extra > st.pool.capacity():
+                # can never fit, even against an empty pool: shed
+                dq.popleft()
+                self._stats.inc("shed_pool")
+                self._stats.inc("shed")
+                self._fail_request(r, ServeOverloaded(
+                    "request needs %d KV blocks, past the paged "
+                    "pool's %d usable blocks — shed"
+                    % (total_blocks + fork_extra, st.pool.capacity())))
+                continue
+            budget = (st.pool.free_count() + st.prefix.evictable() -
+                      st.reserved_total())
+            if needed > budget:
+                break   # wait for retirements; no overtaking
+            dq.popleft()
+            if not r.future.set_running_or_notify_cancel():
+                self._stats.inc("cancelled")
+                continue
+            slot = st.free_slot()
+            if slot is None:
+                need = len(st.active()) + 1
+                self._grow_paged_slots(st, store,
+                                       store.batch_bucket(need))
+                slot = st.free_slot()
+            row = st.tables[slot]
+            row[:] = 0
+            for j, b in enumerate(blocks):
+                row[j] = b
+                st.pool.ref(b)
+            covered = len(blocks) * bs
+            if tail is not None:
+                row[len(blocks)] = tail
+                st.pool.ref(tail)
+                covered = len(r.prompt)
+            if covered:
+                self._stats.inc("prefix_hits")
+                self._stats.inc("prefix_hit_blocks",
+                                len(blocks) + (tail is not None))
+                self._stats.inc("prefix_hit_tokens", covered)
+                _metrics.cached_counter(
+                    "serve_prefix_hit_total",
+                    help="admissions that reused shared paged-KV "
+                         "prefix blocks").inc()
+            # shared tokens skip recomputation, but the LAST prompt
+            # token always reruns: its logits seed the first sample
+            prog = min(covered, len(r.prompt) - 1)
+            st.prog[slot] = prog
+            st.lengths[slot] = prog
+            st.decoding[slot] = False
+            st.chunks_done[slot] = 0
+            st.slots[slot] = r
+            st.next_tok[slot] = 0
+            st.temps[slot] = r.temperature
+            st.top_ks[slot] = r.top_k
+            st.resv[slot] = needed
+            keys = np.array(st.keys, np.uint32)
+            keys[slot] = np.asarray(jax.random.PRNGKey(r.seed))
+            st.keys = jnp.asarray(keys)
+            self._admit_log.append((model, r.seq))
+            admitted += 1
+        if admitted:
+            self._stats.inc("prefill_seqs", admitted)
+            self._note_cache_hwm(model, st)
+            with self._stats_lock:
+                if len(st.active()) > self._max_active_seen:
+                    self._max_active_seen = len(st.active())
+        self._paged_gauges(st)
+
+    def _grow_paged_slots(self, st, store, new_bb):
+        grow = new_bb - len(st.slots)
+        st.slots.extend([None] * grow)
+        st.tables = np.concatenate(
+            [st.tables, np.zeros((grow, st.tb), np.int32)])
+        for name in ("lengths", "prog", "chunks_done", "next_tok",
+                     "top_ks", "resv"):
+            arr = getattr(st, name)
+            setattr(st, name, np.concatenate(
+                [arr, np.zeros(grow, arr.dtype)]))
+        st.decoding = np.concatenate(
+            [st.decoding, np.zeros(grow, bool)])
+        st.temps = np.concatenate(
+            [st.temps, np.zeros(grow, np.float32)])
+        st.keys = jnp.concatenate(
+            [st.keys, jnp.zeros((grow, 2), jnp.uint32)])
+        self._stats.inc("slot_grows")
+
+    def _release_paged_slot(self, st, i):
+        """Drop slot i's block references and bookkeeping (retire and
+        failure paths; the prefix cache's pins keep shared blocks
+        alive past this)."""
+        for j in range(st.tb):
+            b = int(st.tables[i, j])
+            if b:
+                st.pool.deref(b)
+        st.tables[i, :] = 0
+        st.slots[i] = None
+        st.lengths[i] = 0
+        st.prog[i] = 0
+        st.decoding[i] = False
+        st.chunks_done[i] = 0
+        st.next_tok[i] = 0
+        st.temps[i] = 0.0
+        st.top_ks[i] = 0
+        st.resv[i] = 0
+
+    def _paged_tick(self, model, st):
+        """One engine tick of the paged plane: ONE decode step for the
+        generating slots, then ONE prompt chunk for the prefilling
+        slots — long prompts advance prefill_chunk tokens per tick
+        INTERLEAVED with everyone else's decode steps, so a long
+        prefill stops spiking co-running streams' inter-token
+        latency."""
+        dec = [i for i in st.active() if st.decoding[i]]
+        if dec:
+            self._paged_decode_step(model, st, dec)
+        pre = [i for i in st.active() if not st.decoding[i]]
+        if pre:
+            self._paged_prefill_chunk(model, st, pre)
+        if dec or pre:
+            self._paged_gauges(st)
+
+    def _paged_write_ready(self, st, i, positions):
+        """Make slot i's table writable at ``positions``: allocate
+        entries still at 0 and copy-on-write-fork any covering block
+        someone else also references (refcount > 1 — a shared prefix
+        tail, or a block pinned by the prefix cache).  Generation
+        writes past the registered prompt MUST fork; recomputed prompt
+        positions rewrite shared blocks with bit-identical values, so
+        they are exempted by callers passing only new positions."""
+        bs = st.store.kv_block
+        for j in sorted({p // bs for p in positions}):
+            b = int(st.tables[i, j])
+            if b == 0:
+                st.tables[i, j] = self._paged_alloc(st)
+                st.resv[i] = max(0, int(st.resv[i]) - 1)
+            elif st.pool.refcount(b) > 1:
+                nb = self._paged_alloc(st)
+                st.pool_k, st.pool_v = st.store.copy_block(
+                    st.pool_k, st.pool_v, b, nb)
+                st.pool.deref(b)
+                st.tables[i, j] = nb
+                st.resv[i] = max(0, int(st.resv[i]) - 1)
+                self._stats.inc("cow_forks")
+
+    def _paged_dispatch(self, st, tables, toks, pos, val, do, phase):
+        """One unified paged step (decode OR prompt chunk — ``phase``
+        names it for the profiler/traces) + one sampled token per
+        ``do`` row, host-side np result.  Same graph/host sampling
+        split as the contiguous plane's ``_decode_and_sample``."""
+        if st.store.sample_mode == "graph":
+            t0 = time.perf_counter_ns()
+            toks_dev, st.pool_k, st.pool_v, st.keys = \
+                st.store.run_paged_step_sample(
+                    st.pool_k, st.pool_v, tables, toks, pos, val,
+                    st.keys, st.temps, st.top_ks, do)
+            _profiler.record_phase(phase, t0)
+            t0 = time.perf_counter_ns()
+            sampled = self._fetch_decode(toks_dev)
+            _profiler.record_phase("serve_sample", t0)
+            return sampled
+        t0 = time.perf_counter_ns()
+        logits_dev, st.pool_k, st.pool_v = st.store.run_paged_step(
+            st.pool_k, st.pool_v, tables, toks, pos, val)
+        _profiler.record_phase(phase, t0)
+        t0 = time.perf_counter_ns()
+        logits = self._fetch_decode(logits_dev)
+        from .program_store import host_sample
+        toks_out, carry = host_sample(logits, st.keys, st.temps,
+                                      st.top_ks)
+        st.keys = jnp.where(jnp.asarray(do)[:, None], carry, st.keys)
+        sampled = np.asarray(toks_out)
+        _profiler.record_phase("serve_sample", t0)
+        return sampled
+
+    def _paged_decode_step(self, model, st, dec):
+        """Advance every generating slot one token (serve_decode
+        phase).  Slots mid-prefill (and empty slots) ride the dispatch
+        with all-zero tables — their writes land in the trash block
+        and their outputs are discarded."""
+        for i in dec:
+            # the write position this step: COW-fork or allocate first
+            self._paged_write_ready(st, i, [int(st.lengths[i])])
+        n = len(st.slots)
+        tables = np.zeros((n, st.tb), np.int32)
+        toks = np.zeros((n, 1), np.int32)
+        pos = np.zeros((n,), np.int32)
+        val = np.ones((n,), np.int32)
+        do = np.zeros((n,), bool)
+        for i in dec:
+            tables[i] = st.tables[i]
+            toks[i, 0] = st.next_tok[i]
+            pos[i] = st.lengths[i]
+            do[i] = True
+        try:
+            with _tracing.activate_many(
+                    [(st.slots[i].trace, st.slots[i].trace_parent)
+                     for i in dec]):
+                sampled = self._paged_dispatch(st, tables, toks, pos,
+                                               val, do, "serve_decode")
+        except BaseException as e:  # noqa: BLE001 — to the futures
+            exc = e if isinstance(e, MXNetError) \
+                else MXNetError("decode dispatch failed: %r" % (e,))
+            _tracing.flight().record(
+                "error", "decode_dispatch_failed", model=model,
+                error=repr(e), slots=len(dec))
+            for i in dec:
+                r = st.slots[i]
+                self._release_paged_slot(st, i)
+                self._fail_request(r, exc, running=True)
+            return
+        for i in dec:
+            r = st.slots[i]
+            st.lengths[i] += 1
+            tok = int(sampled[i])
+            self._push_token(r, tok)
+            st.next_tok[i] = tok
+            reason = self._finished_reason(r, tok)
+            if reason:
+                self._release_paged_slot(st, i)
+                self._finish(r, reason)
+        self._stats.inc("decode_steps")
+        self._stats.inc("generated_tokens", len(dec))
+
+    def _paged_prefill_chunk(self, model, st, pre):
+        """Advance every prefilling slot one prompt chunk
+        (serve_prefill phase).  Rows finishing their prompt this
+        dispatch sample their first token (the TTFT moment), register
+        their blocks with the prefix cache and flip to decoding."""
+        store = st.store
+        bs = store.kv_block
+        chunk = store.prefill_chunk
+        rows = []
+        for i in pre:
+            r = st.slots[i]
+            p0 = int(st.prog[i])
+            ntok = min(chunk, len(r.prompt) - p0)
+            # blocks covering NEW positions only: recomputed shared
+            # positions rewrite shared blocks with identical values
+            # (same tokens, same prefix) and must not fork
+            fresh = [p for p in range(p0, p0 + ntok)
+                     if st.tables[i, p // bs] == 0]
+            self._paged_write_ready(st, i, fresh)
+            rows.append((i, r, p0, ntok))
+        n = len(st.slots)
+        tables = np.zeros((n, st.tb), np.int32)
+        toks = np.zeros((n, chunk), np.int32)
+        pos = np.zeros((n,), np.int32)
+        val = np.ones((n,), np.int32)
+        do = np.zeros((n,), bool)
+        for i, r, p0, ntok in rows:
+            tables[i] = st.tables[i]
+            toks[i, :ntok] = r.prompt[p0:p0 + ntok]
+            pos[i] = p0
+            val[i] = ntok
+            do[i] = (p0 + ntok == len(r.prompt))
+        try:
+            with _tracing.activate_many(
+                    [(r.trace, r.trace_parent)
+                     for _i, r, _p, _n in rows]):
+                sampled = self._paged_dispatch(
+                    st, tables, toks, pos, val, do, "serve_prefill")
+        except BaseException as e:  # noqa: BLE001 — to the futures
+            exc = e if isinstance(e, MXNetError) \
+                else MXNetError("prefill dispatch failed: %r" % (e,))
+            _tracing.flight().record(
+                "error", "prefill_dispatch_failed", model=model,
+                error=repr(e), requests=len(rows))
+            for i, r, _p0, _ntok in rows:
+                self._release_paged_slot(st, i)
+                self._fail_request(r, exc, running=True)
+            return
+        self._stats.inc("prefills")
+        self._stats.inc("prefill_chunks", len(rows))
+        for i, r, p0, ntok in rows:
+            st.prog[i] = p0 + ntok
+            st.lengths[i] = p0 + ntok
+            st.chunks_done[i] += 1
+            if p0 + ntok < len(r.prompt):
+                continue
+            if _metrics.phase_on():
+                _H_CHUNKS.observe(int(st.chunks_done[i]))
+            st.prefix.register(r.prompt, st.tables[i])
+            tok = int(sampled[i])
+            self._push_token(r, tok)
+            reason = self._finished_reason(r, tok)
+            if reason:
+                self._release_paged_slot(st, i)
+                self._finish(r, reason)
+            else:
+                st.decoding[i] = True
+                st.next_tok[i] = tok
+        self._note_cache_hwm(model, st)
+
     # -- decode --------------------------------------------------------
     def _decode_tick(self):
         for model, st in list(self._states.items()):
+            if getattr(st, "paged", False):
+                self._paged_tick(model, st)
+                continue
             act = st.active()
             if not act:
                 # batch drained: drop the cache (and its memory) until

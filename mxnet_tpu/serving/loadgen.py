@@ -36,7 +36,8 @@ from ..base import MXNetError
 
 __all__ = ["OpenLoopSchedule", "run_loadgen", "latency_protocol",
            "run_gen_loadgen", "generation_protocol",
-           "frontdoor_protocol", "failover_protocol", "swap_protocol",
+           "paged_generation_protocol", "frontdoor_protocol",
+           "failover_protocol", "swap_protocol",
            "observability_protocol"]
 
 
@@ -558,6 +559,9 @@ def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
                for _ in range(max(n_load, n_closed))]
 
     def make_store(registry, **dtype_kwargs):
+        # this protocol measures the CONTIGUOUS decode plane (the
+        # paged plane has its own: paged_generation_protocol)
+        dtype_kwargs.setdefault("paged", False)
         return registry.add_generative_model(
             "m", params, spec, batch_buckets=batch_buckets,
             prompt_buckets=prompt_buckets, kv_block=kv_block,
@@ -669,6 +673,251 @@ def generation_protocol(smoke=False, seed=13, offered_mult=4.0,
     }
     out.update(sides)
     return out
+
+
+def paged_generation_protocol(smoke=False, seed=29, offered_mult=3.0):
+    """The paged-KV decode protocol (CPU-deterministic): block-table
+    attention + copy-on-write prefix sharing + chunked prefill vs the
+    contiguous plane, same weights, same seeded schedules.
+
+    Sides (each engine serves a short unbanked warm schedule first,
+    like :func:`generation_protocol`):
+
+    1. **flat_contig / flat_paged** — prefix-FREE short-prompt
+       schedule on both planes: ``tokens_per_sec_vs_contiguous`` is
+       the "paged costs nothing when nothing is shared" acceptance
+       (>= 0.9x).
+    2. **prefix_contig / prefix_paged** — prefix-HEAVY schedule
+       (every prompt = one shared 96-token system prompt + a unique
+       2-token suffix).  The paged side's peak pool footprint per
+       concurrently-active sequence vs the contiguous side's
+       bytes-per-slot high water is ``seqs_per_kv_byte_vs_contiguous``
+       (the >= 2x concurrency-per-byte acceptance); prefix-hit
+       counters + ``prefill_chunk_savings`` (chunks actually
+       dispatched vs the cold cost of the same schedule) carry the
+       "prefill work provably skipped" evidence.
+    3. **mixed_chunked / mixed_unchunked** — short decode streams with
+       a UNIQUE long prompt injected every 8th request, served with
+       ``prefill_chunk=16`` vs one whole-prompt chunk: the aggregate
+       p99 inter-token latency comparison behind the chunked-prefill
+       acceptance (``itl_p99_chunked_vs_unchunked`` < 1 — long
+       prefills stop spiking co-running streams)."""
+    from ..models.transformer_lm import lm_spec, random_params
+    from .decode_engine import GenerationEngine
+    from .registry import ModelRegistry
+
+    spec = lm_spec(num_layers=2, num_hidden=64, num_heads=4,
+                   vocab_size=128)
+    params = random_params(spec, seed=seed)
+    batch_buckets = (8,)
+    kv_block = 16
+    # L * H * block * dh * fp32 * (k + v): one pool block's bytes
+    dh = spec["num_hidden"] // spec["num_heads"]
+    block_bytes = (spec["num_layers"] * spec["num_heads"] * kv_block *
+                   dh * 4 * 2)
+    # matched geometries: the flat pair compares planes at the SAME
+    # small kv_max (a fat shared kv_max would tax only the paged side,
+    # whose dense twin attends over the whole table width); the long
+    # pairs need headroom for the 98-token prompts
+    cfg_flat = dict(prompt_buckets=(8,), kv_max=32, prefill_chunk=8)
+    cfg_long = dict(prompt_buckets=(8, 112), kv_max=160)
+    n_load = 16 if smoke else 64
+    rs = np.random.RandomState(seed + 1)
+    sys_prompt = list(rs.randint(0, 128, 96))
+    short = [list(rs.randint(0, 128, rs.randint(4, 9)))
+             for _ in range(2 * n_load)]
+    prefix_heavy = [sys_prompt + list(rs.randint(0, 128, 2))
+                    for _ in range(n_load)]
+    longs = [list(rs.randint(0, 128, 98)) for _ in range(n_load)]
+
+    def run_side(schedule, warm_schedule, prompts, cfg, long_every=0,
+                 prime=False, **kwargs):
+        """One engine deployment over the shared seeded schedule;
+        ``long_every=k`` replaces every k-th request with a unique
+        long prompt at max_tokens=2 (the chunked-prefill sides);
+        ``prime=True`` completes one sequential system-prompt request
+        before the warm pass, so a paged side measures the steady
+        prefix-cache regime, not the first-wave miss storm.  Counters
+        are measured-run deltas (warm pass on the same engine — the
+        paged prefix cache deliberately PERSISTS across passes)."""
+        reg = ModelRegistry()
+        kv_max = cfg["kv_max"]
+        store = reg.add_generative_model(
+            "m", params, spec, batch_buckets=batch_buckets,
+            prompt_buckets=cfg["prompt_buckets"], kv_block=kv_block,
+            kv_max=kv_max, warmup_kv_depth=kv_max,
+            **dict({k: v for k, v in cfg.items()
+                    if k not in ("prompt_buckets", "kv_max")},
+                   **kwargs))
+        engine = GenerationEngine(reg)
+
+        def mk_submit(off):
+            # the warm pass draws from the BACK of the prompt list so
+            # a flat side's measured run shares nothing with it
+            def submit(i, mt_):
+                if long_every and i % long_every == long_every - 1:
+                    return engine.submit(
+                        "m", longs[(i + off) % len(longs)],
+                        max_tokens=2)
+                return engine.submit(
+                    "m", prompts[(i + off) % len(prompts)],
+                    max_tokens=mt_)
+            return submit
+
+        try:
+            # batched-path warm-up over BACK-half prompts (the warm
+            # pool, like the warm schedule's offset draw)
+            for f in [engine.submit(
+                    "m", short[(i + n_load) % len(short)],
+                    max_tokens=4)
+                      for i in range(batch_buckets[-1])]:
+                f.result(120)
+            if prime:
+                engine.submit("m", sys_prompt,
+                              max_tokens=2).result(120)
+            run_gen_loadgen(mk_submit(n_load), warm_schedule)
+            warm_stats = engine.stats()
+            side = run_gen_loadgen(mk_submit(0), schedule)
+            stats = engine.stats()
+            side["engine"] = stats
+            side["store"] = store.stats()
+            side["counters"] = {
+                k: stats.get(k, 0) - warm_stats.get(k, 0)
+                for k in ("prefix_hits", "prefix_hit_blocks",
+                          "prefix_hit_tokens", "cow_forks",
+                          "prefill_chunks", "prefill_seqs", "shed",
+                          "shed_pool")}
+        finally:
+            engine.close()
+        return side
+
+    # pacing anchor: closed-loop per-request capacity of the paged
+    # plane on the short prompts (both planes are far faster
+    # open-loop, so every side queues equally)
+    reg = ModelRegistry()
+    reg.add_generative_model(
+        "m", params, spec, batch_buckets=batch_buckets,
+        prompt_buckets=cfg_flat["prompt_buckets"], kv_block=kv_block,
+        kv_max=cfg_flat["kv_max"], warmup_kv_depth=cfg_flat["kv_max"],
+        paged=True, prefill_chunk=cfg_flat["prefill_chunk"])
+    anchor = GenerationEngine(reg)
+    try:
+        anchor.submit("m", short[0], max_tokens=4).result(120)
+        n_closed = 4 if smoke else 8
+        tic = time.perf_counter()
+        for i in range(n_closed):
+            anchor.submit("m", short[i % len(short)],
+                          max_tokens=12).result(120)
+        closed_rps = n_closed / (time.perf_counter() - tic)
+    finally:
+        anchor.close()
+    offered = closed_rps * float(offered_mult)
+    schedule = OpenLoopSchedule(seed, n_load, offered,
+                                gen_tokens=(8, 16))
+    warm_schedule = OpenLoopSchedule(seed + 101, max(8, n_load // 4),
+                                     offered, gen_tokens=(8, 16))
+    # the prefix pair generates 8 tokens/request: the schedule stays
+    # decode-heavy while each sequence's unique block footprint stays
+    # at the "one divergent tail" regime the sharing claim is about
+    prefix_schedule = OpenLoopSchedule(seed, n_load, offered,
+                                       gen_tokens=(8,))
+    prefix_warm = OpenLoopSchedule(seed + 101, max(8, n_load // 4),
+                                   offered, gen_tokens=(8,))
+
+    # 1. prefix-free throughput, matched geometry (warm prompts differ
+    # from measured so nothing shares)
+    flat_contig = run_side(schedule, warm_schedule, short, cfg_flat,
+                           paged=False)
+    flat_paged = run_side(schedule, warm_schedule, short, cfg_flat,
+                          paged=True)
+
+    # 2a. contiguous on the prefix-heavy schedule: its cache high
+    # water is the byte budget the paged side will be halved against
+    prefix_contig = run_side(prefix_schedule, prefix_warm,
+                             prefix_heavy, cfg_long, paged=False)
+    contig_hwm = prefix_contig["engine"].get(
+        "cache_hwm", {}).get("m", {})
+    contig_bytes = int(contig_hwm.get("cache_mb", 0.0) * 2**20)
+    contig_bytes_per_slot = contig_hwm.get("cache_bytes_per_slot")
+
+    # 2b. paged on the SAME schedule with the pool CAPPED at half the
+    # contiguous bytes: >= 2x concurrent sequences per KV byte means
+    # the same peak concurrency fits with zero pool sheds
+    tb = -(-cfg_long["kv_max"] // kv_block)
+    pool_budget = max(tb + 2,
+                      (contig_bytes // 2) // block_bytes
+                      if contig_bytes else tb + 2)
+    prefix_paged = run_side(prefix_schedule, prefix_warm,
+                            prefix_heavy, cfg_long, paged=True,
+                            prime=True, prefill_chunk=16,
+                            pool_blocks=pool_budget)
+
+    # 3. chunked prefill vs one whole-prompt chunk under mixed load
+    mixed_chunked = run_side(schedule, warm_schedule, short, cfg_long,
+                             long_every=8, paged=True,
+                             prefill_chunk=16)
+    mixed_unchunked = run_side(schedule, warm_schedule, short,
+                               cfg_long, long_every=8, paged=True,
+                               prefill_chunk=cfg_long["kv_max"])
+
+    cs = prefix_paged["store"].get("cache_state") or {}
+    paged_bytes = (cs.get("pool_blocks", 0) + 1) * block_bytes
+    max_act_paged = prefix_paged["engine"].get("max_active") or 0
+    max_act_contig = prefix_contig["engine"].get("max_active") or 1
+    hwm_blocks = cs.get("pool_blocks_hwm", 0)
+    paged_bytes_per_seq = (hwm_blocks * block_bytes /
+                           max(1, max_act_paged))
+    # concurrency per byte, paged vs contiguous, at peak
+    seqs_per_byte = (
+        round((max_act_paged / paged_bytes) /
+              (max_act_contig / contig_bytes), 3)
+        if paged_bytes and contig_bytes and max_act_contig else None)
+
+    # prefill work evidence: chunks dispatched vs the cold cost of the
+    # same measured schedule (every prompt chunked from position 0)
+    chunk = prefix_paged["store"].get("prefill_chunk") or 1
+    cold_chunks = sum(
+        -(-len(prefix_heavy[i % len(prefix_heavy)]) // chunk)
+        for i in range(schedule.n))
+    did = prefix_paged["counters"]["prefill_chunks"]
+    savings = (round(1.0 - did / cold_chunks, 4)
+               if cold_chunks else None)
+
+    return {
+        "seed": seed,
+        "spec": spec,
+        "kv_block": kv_block,
+        "kv_max_flat": cfg_flat["kv_max"],
+        "kv_max_long": cfg_long["kv_max"],
+        "batch_buckets": list(batch_buckets),
+        "closed_rps": round(closed_rps, 3),
+        "offered_mult": float(offered_mult),
+        "flat_contig": flat_contig,
+        "flat_paged": flat_paged,
+        "prefix_contig": prefix_contig,
+        "prefix_paged": prefix_paged,
+        "mixed_chunked": mixed_chunked,
+        "mixed_unchunked": mixed_unchunked,
+        "tokens_per_sec_vs_contiguous": (
+            round(flat_paged["tokens_per_sec"] /
+                  flat_contig["tokens_per_sec"], 3)
+            if flat_contig["tokens_per_sec"] else None),
+        "seqs_per_kv_byte_vs_contiguous": seqs_per_byte,
+        "paged_pool_bytes": paged_bytes,
+        "contig_cache_bytes": contig_bytes,
+        "contig_bytes_per_slot": contig_bytes_per_slot,
+        "paged_bytes_per_active_seq": int(paged_bytes_per_seq),
+        "paged_max_active": max_act_paged,
+        "contig_max_active": max_act_contig,
+        "prefill_chunk_savings": savings,
+        "prefill_chunks_dispatched": did,
+        "prefill_chunks_cold": cold_chunks,
+        "itl_p99_chunked_vs_unchunked": (
+            round(mixed_chunked["itl_p99_ms"] /
+                  mixed_unchunked["itl_p99_ms"], 4)
+            if mixed_chunked["itl_p99_ms"] and
+            mixed_unchunked["itl_p99_ms"] else None),
+    }
 
 
 # ---------------------------------------------------------------------------

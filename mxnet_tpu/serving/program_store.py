@@ -747,6 +747,23 @@ class GenerativeProgramStore:
         ``'host'`` keeps the logits-returning decode programs — the
         escape hatch, byte-identical token streams (shared
         :func:`sample_tokens`).
+    paged : bool, optional
+        Paged KV plane (default ``MXNET_SERVE_PAGED``): cache memory
+        becomes a global pool of ``kv_block``-token blocks addressed
+        through per-slot block tables; the decode engine runs unified
+        ``paged_step`` programs (chunked prefill + decode) with
+        copy-on-write prefix sharing instead of the prefill/decode
+        pair over per-slot cache rectangles.  ``paged=False`` is the
+        contiguous escape hatch (bit-identical token streams, pinned
+        by tests/test_paged_decode.py).
+    prefill_chunk : int, optional
+        Chunked-prefill quantum of the paged plane (default
+        ``MXNET_SERVE_PREFILL_CHUNK``; clamped to ``kv_max``).
+    pool_blocks : int, optional
+        Physical block count of the paged pool, including the
+        reserved trash block 0 (default ``MXNET_SERVE_KV_POOL_
+        BLOCKS``; 0 = auto-size for the largest batch bucket at full
+        ``kv_max`` depth).
     max_programs : int, optional
         LRU bound; default is sized to hold every warmable program
         (never smaller than ``MXNET_SERVE_PROGRAM_CACHE``).
@@ -757,6 +774,7 @@ class GenerativeProgramStore:
     def __init__(self, params, spec, name="lm", batch_buckets=None,
                  prompt_buckets=None, kv_block=None, kv_max=None,
                  compute_dtype=None, kv_dtype=None, sample=None,
+                 paged=None, prefill_chunk=None, pool_blocks=None,
                  max_programs=None, device=None):
         from ..models.transformer_lm import lm_spec
         self._spec = lm_spec(**dict(spec))  # validates + canonicalizes
@@ -799,6 +817,33 @@ class GenerativeProgramStore:
                 "largest prompt bucket (%d) exceeds MXNET_SERVE_KV_MAX "
                 "(%d)" % (self._prompt_edges[-1], self.kv_max))
 
+        # paged KV plane: cache memory as a global pool of kv_block-
+        # token blocks addressed through per-slot block tables
+        # (docs/architecture/decode_engine.md).  MXNET_SERVE_PAGED=0
+        # (or paged=False) keeps the contiguous per-slot plane.
+        self.paged = bool(int(get_env("MXNET_SERVE_PAGED"))
+                          if paged is None else paged)
+        chunk = int(prefill_chunk if prefill_chunk is not None
+                    else get_env("MXNET_SERVE_PREFILL_CHUNK"))
+        if chunk < 1:
+            raise MXNetError("prefill_chunk must be >= 1, got %d"
+                             % chunk)
+        self.prefill_chunk = min(chunk, self.kv_max)
+        nb = int(pool_blocks if pool_blocks is not None
+                 else get_env("MXNET_SERVE_KV_POOL_BLOCKS"))
+        if nb <= 0:
+            # auto: the largest batch bucket at full kv_max depth,
+            # plus the reserved trash block 0
+            nb = self._batch_edges[-1] * self.table_width() + 1
+        if self.paged and nb < self.table_width() + 1:
+            raise MXNetError(
+                "paged KV pool of %d blocks cannot hold one full-"
+                "depth sequence (%d blocks + the reserved trash "
+                "block); raise MXNET_SERVE_KV_POOL_BLOCKS"
+                % (nb, self.table_width()))
+        self.pool_blocks = nb
+        self._copy_fn = None   # lazily jitted COW block copy
+
         missing = [k for k in self._required_params() if k not in params]
         if missing:
             raise MXNetError("generative model %r is missing params %s"
@@ -808,10 +853,16 @@ class GenerativeProgramStore:
         self._version = 1
 
         # one warm sweep must fit the LRU or AOT is a lie (the forward
-        # store logs the same hazard; here we just size for it)
-        n_warm = (len(self._batch_edges) * len(self._prompt_edges) +
-                  len(self._batch_edges) *
-                  len({self.kv_bucket(p) for p in self._prompt_edges}))
+        # store logs the same hazard; here we just size for it).  The
+        # paged plane's warm set is per (batch bucket, step length):
+        # one decode (lq=1) and one prefill-chunk program per bucket.
+        if self.paged:
+            n_warm = (len(self._batch_edges) *
+                      len({1, self.prefill_chunk}))
+        else:
+            n_warm = (len(self._batch_edges) * len(self._prompt_edges) +
+                      len(self._batch_edges) *
+                      len({self.kv_bucket(p) for p in self._prompt_edges}))
         if max_programs is None:
             max_programs = max(int(get_env("MXNET_SERVE_PROGRAM_CACHE")),
                                2 * n_warm)
@@ -957,15 +1008,30 @@ class GenerativeProgramStore:
                 "MAX (%d)" % (c, self.kv_max))
         return c
 
+    def table_width(self):
+        """Block-table width of the paged plane: logical blocks needed
+        to address a full kv_max-token sequence."""
+        return -(-self.kv_max // self.kv_block)
+
     def validate_request(self, prompt_len, max_tokens):
         """Reject at submit anything whose cache could outgrow kv_max
-        mid-flight (prompt itself must also fit a prompt bucket)."""
-        self.prompt_bucket(int(prompt_len))
+        mid-flight.  On the contiguous plane the prompt must also fit
+        a prompt bucket; the paged plane chunks prompts, so only the
+        kv_max total and the pool's physical capacity bound it."""
         need = int(prompt_len) + max(1, int(max_tokens))
         if need > self.kv_max:
             raise MXNetError(
                 "prompt_len %d + max_tokens %d exceeds MXNET_SERVE_KV_"
                 "MAX (%d)" % (prompt_len, max_tokens, self.kv_max))
+        if self.paged:
+            blocks = -(-need // self.kv_block)
+            if blocks > self.pool_blocks - 1:
+                raise MXNetError(
+                    "request needs %d KV blocks, past the paged pool's "
+                    "%d usable blocks (MXNET_SERVE_KV_POOL_BLOCKS)"
+                    % (blocks, self.pool_blocks - 1))
+        else:
+            self.prompt_bucket(int(prompt_len))
 
     def new_cache(self, batch, cache_len):
         from ..models.transformer_lm import init_cache
@@ -975,6 +1041,39 @@ class GenerativeProgramStore:
             k = jax.device_put(k, self._device)
             v = jax.device_put(v, self._device)
         return k, v
+
+    def new_pool(self):
+        """Zeroed paged KV pool pair, ``(num_layers, num_heads,
+        pool_blocks * kv_block, head_dim)`` each — block 0 is the
+        reserved trash block zero table entries point at."""
+        from ..models.transformer_lm import init_pool
+        k, v = init_pool(self._spec, self.pool_blocks, self.kv_block,
+                         dtype=self.kv_dtype)
+        if self._device is not None:
+            k = jax.device_put(k, self._device)
+            v = jax.device_put(v, self._device)
+        return k, v
+
+    def copy_block(self, pool_k, pool_v, src, dst):
+        """Copy-on-write fork: duplicate physical block ``src``'s rows
+        into block ``dst`` in both pools (one jitted program, pools
+        donated off-CPU — callers rebind to the outputs)."""
+        fn = self._copy_fn
+        if fn is None:
+            bs = self.kv_block
+
+            def f(pk, pv, s, d):
+                bk = jax.lax.dynamic_slice_in_dim(pk, s * bs, bs, 2)
+                bv = jax.lax.dynamic_slice_in_dim(pv, s * bs, bs, 2)
+                pk = jax.lax.dynamic_update_slice_in_dim(pk, bk,
+                                                         d * bs, 2)
+                pv = jax.lax.dynamic_update_slice_in_dim(pv, bv,
+                                                         d * bs, 2)
+                return pk, pv
+
+            fn = self._copy_fn = jax.jit(
+                f, donate_argnums=cache_donate_argnums((0, 1)))
+        return fn(pool_k, pool_v, np.int32(src), np.int32(dst))
 
     # -- compilation ---------------------------------------------------
     def _sds(self, shape, dtype):
@@ -995,6 +1094,13 @@ class GenerativeProgramStore:
                  int(cache_len), dh)
         return self._sds(shape, self.kv_dtype)
 
+    def _pool_spec(self):
+        s = self._spec
+        dh = s["num_hidden"] // s["num_heads"]
+        shape = (s["num_layers"], s["num_heads"],
+                 self.pool_blocks * self.kv_block, dh)
+        return self._sds(shape, self.kv_dtype)
+
     def _key(self, kind, bb, lb):
         # (kind, batch bucket, length bucket) + the serving dtypes +
         # the dispatch fingerprint (prefill/decode trace through
@@ -1007,10 +1113,65 @@ class GenerativeProgramStore:
                 _pallas_dispatch.fingerprint())
 
     def _compile(self, kind, bb, lb):
-        from ..models.transformer_lm import decode_apply, prefill_apply
+        from ..models.transformer_lm import (decode_apply,
+                                             paged_step_apply,
+                                             prefill_apply)
         tic = time.perf_counter()
         spec = self._spec
         kv = self.kv_dtype
+        if kind in ("paged_step", "paged_step_sample"):
+            # ONE unified step program for the paged plane: lb is the
+            # query length lq (1 = a decode step; prefill_chunk = one
+            # prompt chunk).  Scatter-then-attend over the global pool
+            # through (bb, table_width) block tables; rows not
+            # participating in a dispatch ride with all-zero tables
+            # (writes land in the reserved trash block 0) and their
+            # outputs are discarded host-side.
+            bs = self.kv_block
+            tb = self.table_width()
+            base = (self._param_spec(), self._pool_spec(),
+                    self._pool_spec(),
+                    self._sds((bb, tb), jnp.int32),
+                    self._sds((bb, int(lb)), jnp.int32),
+                    self._sds((bb,), jnp.int32),
+                    self._sds((bb,), jnp.int32))
+            if kind == "paged_step_sample":
+                # in-graph sampling with a per-row enable mask: a
+                # chunk dispatch samples ONLY the rows finishing their
+                # prompt this tick (do_sample), everyone else's PRNG
+                # chain must not advance
+                def fn(params, pool_k, pool_v, tables, tokens,
+                       positions, valid, keys, temps, top_ks,
+                       do_sample):
+                    logits, pk, pv = paged_step_apply(
+                        params, pool_k, pool_v, tables, tokens,
+                        positions, valid, spec, bs)
+                    toks, carry = sample_tokens(logits, keys, temps,
+                                                top_ks)
+                    new_keys = jnp.where(do_sample[:, None], carry,
+                                         keys)
+                    return toks, pk, pv, new_keys
+
+                args = base + (self._sds((bb, 2), jnp.uint32),
+                               self._sds((bb,), jnp.float32),
+                               self._sds((bb,), jnp.int32),
+                               self._sds((bb,), jnp.bool_))
+                compiled = jax.jit(
+                    fn,
+                    donate_argnums=cache_donate_argnums((1, 2, 7))) \
+                    .lower(*args).compile()
+            else:   # paged_step (logits out — the host-sampling hatch)
+                def fn(params, pool_k, pool_v, tables, tokens,
+                       positions, valid):
+                    return paged_step_apply(params, pool_k, pool_v,
+                                            tables, tokens, positions,
+                                            valid, spec, bs)
+
+                compiled = jax.jit(
+                    fn, donate_argnums=cache_donate_argnums((1, 2))) \
+                    .lower(*base).compile()
+            ms = (time.perf_counter() - tic) * 1e3
+            return _Program(compiled, (bb, lb), (), ms)
         if kind == "prefill":
             cache_len = self.kv_bucket(lb)
 
@@ -1106,6 +1267,40 @@ class GenerativeProgramStore:
         should pass ``kv_depth=prompt_max + max_tokens_max``).  Returns
         {(kind, bb, lb): compile_ms}."""
         out = {}
+        if self.paged:
+            # the paged plane's whole program space: one unified step
+            # program per (batch bucket, step length) — lq=1 decode
+            # steps and lq=prefill_chunk prompt chunks.  kv_depth is
+            # moot: the table width is a store constant, so cache
+            # depth never changes the program.  Warmup executes on a
+            # throwaway zero pool with all-zero tables (every write
+            # lands in the trash block).
+            pkind = ("paged_step_sample" if self.sample_mode == "graph"
+                     else "paged_step")
+            tb = self.table_width()
+            for bb in self._batch_edges:
+                for lq in sorted({1, self.prefill_chunk}):
+                    prog = self._acquire(pkind, bb, lq)
+                    out[(pkind, bb, lq)] = prog.compile_ms
+                    if not execute:
+                        continue
+                    pk, pv = self.new_pool()
+                    tbls = np.zeros((bb, tb), np.int32)
+                    toks = np.zeros((bb, lq), np.int32)
+                    pos = np.zeros((bb,), np.int32)
+                    val = np.ones((bb,), np.int32)
+                    if pkind == "paged_step_sample":
+                        jax.block_until_ready(prog.fn(
+                            self._params, pk, pv, tbls, toks, pos, val,
+                            np.zeros((bb, 2), np.uint32),
+                            np.zeros((bb,), np.float32),
+                            np.zeros((bb,), np.int32),
+                            np.zeros((bb,), np.bool_)))
+                    else:
+                        jax.block_until_ready(prog.fn(
+                            self._params, pk, pv, tbls, toks, pos,
+                            val))
+            return out
         cache_buckets = {self.kv_bucket(p) for p in self._prompt_edges}
         if kv_depth is not None:
             top = self.kv_bucket(kv_depth)
@@ -1179,6 +1374,34 @@ class GenerativeProgramStore:
         return prog.fn(self._params, cache_k, cache_v, tokens, lengths,
                        keys, temps, top_ks)
 
+    @hot_path
+    def run_paged_step(self, pool_k, pool_v, tables, tokens,
+                       positions, valid):
+        """Dispatch one logits-out paged step (the host-sampling
+        hatch): ``tokens`` (bb, lq) int32 — lq=1 is a decode step,
+        lq=prefill_chunk a prompt chunk.  Returns ``(logits (bb,
+        vocab) at each row's last valid position, pool_k, pool_v)``;
+        BOTH pools are consumed (donated) — callers rebind."""
+        bb, lq = tokens.shape
+        prog = self._acquire("paged_step", int(bb), int(lq))
+        return prog.fn(self._params, pool_k, pool_v, tables, tokens,
+                       positions, valid)
+
+    @hot_path
+    def run_paged_step_sample(self, pool_k, pool_v, tables, tokens,
+                              positions, valid, keys, temps, top_ks,
+                              do_sample):
+        """Dispatch one paged step with IN-GRAPH sampling: returns
+        ``(tokens (bb,) int32, pool_k, pool_v, new_keys)``.  Rows with
+        ``do_sample`` False keep their PRNG keys (their sampled token
+        is garbage the caller discards); pools and keys are consumed
+        (donated) — callers rebind all three."""
+        bb, lq = tokens.shape
+        prog = self._acquire("paged_step_sample", int(bb), int(lq))
+        return prog.fn(self._params, pool_k, pool_v, tables, tokens,
+                       positions, valid, keys, temps, top_ks,
+                       do_sample)
+
     def pad_prompts(self, prompts):
         """Host-side canonicalization: a list of token id sequences ->
         bucket-shaped ``(tokens (bb, pb) int32, lengths (bb,) int32)``.
@@ -1216,6 +1439,11 @@ class GenerativeProgramStore:
         out["compute_dtype"] = self._compute
         out["kv_dtype"] = str(self.kv_dtype)
         out["sample_mode"] = self.sample_mode
+        out["paged"] = self.paged
+        if self.paged:
+            out["prefill_chunk"] = self.prefill_chunk
+            out["pool_blocks"] = self.pool_blocks
+            out["table_width"] = self.table_width()
         out["weight_bytes"] = _weight_bytes(self._params)
         state = self.cache_state
         if state is not None:

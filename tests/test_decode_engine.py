@@ -37,7 +37,7 @@ def registry():
                              batch_buckets=BATCH_BUCKETS,
                              prompt_buckets=PROMPT_BUCKETS,
                              kv_block=KV_BLOCK, kv_max=KV_MAX,
-                             warmup_kv_depth=KV_MAX)
+                             warmup_kv_depth=KV_MAX, paged=False)
     return reg
 
 

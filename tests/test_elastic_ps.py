@@ -89,6 +89,12 @@ class _Cluster:
         buckets migrate onto it via the versioned plan)."""
         server = ksd.Server()
         threading.Thread(target=server.run, daemon=True).start()
+        # serialize registration: the scheduler assigns ranks in arrival
+        # order, so without this wait two back-to-back add_server calls
+        # race and self.servers[i].rank == i does not hold (the old
+        # dst-store-empty flake in the migration tests — the wrong
+        # Server OBJECT was inspected, not a lost migration)
+        server.wait_registered()
         self.servers.append(server)
         return server
 
@@ -467,6 +473,39 @@ def _pusher(client, keys, n, delta, start_evt):
             for k in keys:
                 client.push(k, np.full(SIZE, delta, np.float32))
     return loop
+
+
+def test_server_rank_follows_bringup_order_deterministic(monkeypatch):
+    """Deterministic regression for the bring-up rank race behind the
+    old ~10% dst-store-empty flake in
+    test_bucket_migration_under_traffic_exactly_once: server rank is
+    assigned in registration ARRIVAL order, so when the first server's
+    registration was slow the second overtook it, cl.servers[i].rank
+    no longer matched i, and the migration asserts inspected the WRONG
+    Server object (the data plane was exactly-once throughout).  The
+    fix is the wait_registered() handshake serialized into add_server;
+    this test forces the adversarial timing — the first server's
+    registration delayed long enough that, unserialized, the second
+    ALWAYS wins the race — and pins rank == creation index."""
+    orig_run = ksd.Server.run
+    delayed = []
+
+    def slow_first_run(self):
+        if not delayed:                 # only the first server is slow
+            delayed.append(self)
+            time.sleep(0.3)
+        orig_run(self)
+
+    monkeypatch.setattr(ksd.Server, "run", slow_first_run)
+    cl = _Cluster(monkeypatch, n_workers=1, n_servers=2)
+    assert [s.rank for s in cl.servers] == [0, 1]
+    # the identity the migration tests rely on: index == routing sid
+    c = cl.client(plan_sizes=_BUCKET_KEYS)
+    for k, sz in _BUCKET_KEYS:
+        c.init(k, np.zeros(sz, np.float32))
+    owner = c.server_for_bucket(0)
+    assert (0, 0) in cl.servers[owner].store
+    cl.finalize()
 
 
 def test_bucket_migration_under_traffic_exactly_once(monkeypatch):

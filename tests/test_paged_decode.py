@@ -1,0 +1,419 @@
+"""Paged decode-plane tests: block-table flash kernel vs the dense
+gather twin (ragged offsets, partial blocks, shared blocks), the paged
+GenerationEngine vs the contiguous plane (greedy AND seeded sampling),
+copy-on-write prefix sharing under divergence, chunked-vs-unchunked
+prefill equality, pool exhaustion throttling, the MXNET_PALLAS=0 /
+paged=False escape hatches, paged telemetry, and the banked
+serving.decode.paged.* bench gates (docs/architecture/decode_engine.md).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.transformer_lm import lm_spec, random_params
+from mxnet_tpu.pallas_ops.flash_attention import pltpu
+from mxnet_tpu.serving import GenerationEngine, ModelRegistry
+
+SPEC = lm_spec(num_layers=2, num_hidden=32, num_heads=4, vocab_size=50)
+PARAMS = random_params(SPEC, seed=3)
+BATCH_BUCKETS = (1, 2, 4)
+KV_BLOCK, KV_MAX = 8, 40
+
+
+def _add_model(reg, **kwargs):
+    # prompt buckets only bound the CONTIGUOUS oracle (the paged plane
+    # chunks prompts); 24 covers the longest comparison prompt
+    kw = dict(batch_buckets=BATCH_BUCKETS, prompt_buckets=(4, 8, 24),
+              kv_block=KV_BLOCK, kv_max=KV_MAX, warmup_kv_depth=KV_MAX)
+    kw.update(kwargs)
+    return reg.add_generative_model("m", PARAMS, SPEC, **kw)
+
+
+@pytest.fixture(scope="module")
+def paged_registry():
+    """One warmed paged registry (bb x {1, chunk} step programs)."""
+    reg = ModelRegistry()
+    _add_model(reg, paged=True, prefill_chunk=8)
+    return reg
+
+
+@pytest.fixture(scope="module")
+def contig_registry():
+    """The contiguous twin of the same model — the oracle of record
+    for every paged-vs-contiguous stream comparison."""
+    reg = ModelRegistry()
+    _add_model(reg, paged=False)
+    return reg
+
+
+def _generate(registry, requests):
+    """Run ``requests`` (list of submit kwargs) through one engine;
+    returns the token streams in order."""
+    eng = GenerationEngine(registry)
+    try:
+        futs = [eng.submit("m", **kw) for kw in requests]
+        return [f.result(180).tokens for f in futs]
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# kernel parity
+# ---------------------------------------------------------------------------
+def _paged_case(seed, B, H, T, D, bs, num_blocks, positions, lq):
+    """One randomized paged attention case: sequences share physical
+    blocks, unused table entries point at the trash block 0, and the
+    pool rows past every frontier hold junk that must never leak."""
+    import jax.numpy as jnp
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(B, H, lq, D).astype(np.float32))
+    k_pool = jnp.asarray(
+        rs.randn(H, num_blocks * bs, D).astype(np.float32))
+    v_pool = jnp.asarray(
+        rs.randn(H, num_blocks * bs, D).astype(np.float32))
+    tables = np.zeros((B, T), np.int32)
+    pos = np.asarray(positions, np.int32)
+    nxt = 1
+    for b in range(B):
+        nb = -(-int(pos[b] + lq) // bs)
+        for j in range(nb):
+            if b > 0 and j == 0:
+                # every sequence after the first SHARES block 0 of
+                # sequence 0 — the prefix-reuse layout
+                tables[b, j] = tables[0, 0]
+            else:
+                tables[b, j] = nxt
+                nxt += 1
+    assert nxt <= num_blocks, "case needs a bigger pool"
+    return q, k_pool, v_pool, jnp.asarray(tables), jnp.asarray(pos)
+
+
+@pytest.mark.skipif(pltpu is None,
+                    reason="pallas TPU backend module unavailable")
+def test_paged_kernel_matches_dense_twin():
+    """flash_attention_paged (interpret mode) vs the gather-based dense
+    twin: ragged per-sequence offsets, partial last blocks, shared
+    physical blocks, decode (lq=1) and chunk (lq=4) query lengths."""
+    from mxnet_tpu.pallas_ops.paged_attention import (
+        flash_attention_paged, paged_attention_reference)
+
+    for seed, lq, positions in ((0, 1, [5, 9, 17]),
+                                (1, 4, [0, 3, 12]),
+                                (2, 8, [8, 1, 15])):
+        q, kp, vp, tbl, pos = _paged_case(
+            seed, B=3, H=2, T=4, D=8, bs=8, num_blocks=12,
+            positions=positions, lq=lq)
+        got = np.asarray(flash_attention_paged(
+            q, kp, vp, tbl, pos, 8, block_q=4, interpret=True))
+        want = np.asarray(paged_attention_reference(
+            q, kp, vp, tbl, pos, 8))
+        assert np.abs(got - want).max() < 2e-6, (seed, lq)
+
+
+def test_paged_reference_matches_contiguous_dense():
+    """The gather twin against THIS repo's oracle of record: gather the
+    pool rows in numpy, then the contiguous dense offset-causal
+    attention must agree — the table arithmetic adds nothing."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _dense_attention
+    from mxnet_tpu.pallas_ops.paged_attention import (
+        paged_attention_reference)
+
+    q, kp, vp, tbl, pos = _paged_case(
+        3, B=2, H=2, T=3, D=8, bs=8, num_blocks=8,
+        positions=[6, 13], lq=2)
+    got = np.asarray(paged_attention_reference(q, kp, vp, tbl, pos, 8))
+    idx = (np.asarray(tbl)[:, :, None] * 8 +
+           np.arange(8)[None, None, :]).reshape(2, -1)
+    k = jnp.asarray(np.asarray(kp)[:, idx].transpose(1, 0, 2, 3))
+    v = jnp.asarray(np.asarray(vp)[:, idx].transpose(1, 0, 2, 3))
+    want = np.asarray(_dense_attention(
+        q, k, v, True, 1.0 / 8 ** 0.5,
+        q_offsets=np.asarray(pos)))
+    assert np.abs(got - want).max() < 2e-6
+
+
+def test_paged_kernel_ignores_trash_and_junk_blocks():
+    """Junk planted in the trash block AND in pool blocks no table
+    references must not perturb the output (masking is in logical
+    position space; unused table entries point at block 0)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.pallas_ops.paged_attention import (
+        paged_attention_reference)
+
+    q, kp, vp, tbl, pos = _paged_case(
+        4, B=2, H=2, T=3, D=8, bs=8, num_blocks=8,
+        positions=[4, 10], lq=1)
+    base = np.asarray(paged_attention_reference(q, kp, vp, tbl, pos, 8))
+    kj, vj = np.asarray(kp).copy(), np.asarray(vp).copy()
+    used = set(np.asarray(tbl).ravel()) - {0}
+    for blk in set(range(8)) - used:  # trash block 0 + unreferenced
+        kj[:, blk * 8:(blk + 1) * 8] = 1e4
+        vj[:, blk * 8:(blk + 1) * 8] = -1e4
+    got = np.asarray(paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kj), jnp.asarray(vj), tbl, pos, 8))
+    assert np.abs(got - base).max() < 2e-6
+
+
+# ---------------------------------------------------------------------------
+# engine: paged plane == contiguous plane
+# ---------------------------------------------------------------------------
+def test_paged_engine_greedy_matches_contiguous(paged_registry,
+                                                contig_registry):
+    """Greedy streams through the paged engine — prompts spanning
+    partial blocks, multiple blocks, and growth across block
+    boundaries — equal the contiguous plane's, token for token."""
+    rs = np.random.RandomState(0)
+    reqs = [dict(tokens=list(rs.randint(0, 50, n)), max_tokens=mt)
+            for n, mt in ((3, 10), (8, 6), (12, 20), (5, 30), (17, 8))]
+    want = _generate(contig_registry, reqs)
+    got = _generate(paged_registry, reqs)
+    assert got == want
+
+
+def test_paged_engine_seeded_sampling_matches_contiguous(
+        paged_registry, contig_registry):
+    """The seeded sampler contract survives the paged plane: identical
+    (seed, temperature, top_k) produce identical streams on both
+    planes (the per-request threefry chain is position-independent)."""
+    rs = np.random.RandomState(1)
+    reqs = [dict(tokens=list(rs.randint(0, 50, 6)), max_tokens=8,
+                 temperature=0.8, top_k=k, seed=s)
+            for k, s in ((0, 5), (3, 5), (10, 11))]
+    want = _generate(contig_registry, reqs)
+    got = _generate(paged_registry, reqs)
+    assert got == want
+
+
+def test_chunked_prefill_matches_unchunked():
+    """prefill_chunk=4 vs prefill_chunk=kv_max (one whole-prompt
+    dispatch): same streams — chunking changes scheduling, never
+    numbers — and the chunked engine provably dispatched more chunks."""
+    rs = np.random.RandomState(2)
+    reqs = [dict(tokens=list(rs.randint(0, 50, n)), max_tokens=6)
+            for n in (13, 7, 20, 3)]
+    outs, chunks = [], []
+    for chunk in (4, KV_MAX):
+        reg = ModelRegistry()
+        _add_model(reg, paged=True, prefill_chunk=chunk)
+        eng = GenerationEngine(reg)
+        try:
+            futs = [eng.submit("m", **kw) for kw in reqs]
+            outs.append([f.result(180).tokens for f in futs])
+            chunks.append(eng.stats()["prefill_chunks"])
+        finally:
+            eng.close()
+    assert outs[0] == outs[1]
+    # 13+7+20+3 tokens at chunk 4 -> 4+2+5+1 chunk rows; unchunked
+    # engines pay one row per prompt
+    assert chunks[0] == 12 and chunks[1] == 4
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing + copy-on-write
+# ---------------------------------------------------------------------------
+def test_prefix_sharing_and_cow_isolation(contig_registry):
+    """A repeated prompt adopts the registered blocks (hit counters,
+    prefill work skipped); a diverging prompt shares only whole
+    matching blocks; decode writes into shared blocks fork (COW), so
+    re-running the original prompt still matches the contiguous
+    oracle after every divergent stream polluted its own copies."""
+    rs = np.random.RandomState(3)
+    P = list(rs.randint(0, 50, 12))          # 1 full block + 4-tail
+    Pdiv = P[:10] + [(P[10] + 1) % 50, (P[11] + 3) % 50]
+    reqs = [dict(tokens=P, max_tokens=6),
+            dict(tokens=Pdiv, max_tokens=6),
+            dict(tokens=P, max_tokens=6)]
+    want = _generate(contig_registry, reqs)
+
+    reg = ModelRegistry()
+    _add_model(reg, paged=True, prefill_chunk=8)
+    eng = GenerationEngine(reg)
+    try:
+        a = eng.submit("m", P, max_tokens=6).result(180)
+        s0 = eng.stats()
+        assert s0["prefix_hits"] == 0
+        b = eng.submit("m", P, max_tokens=6).result(180)
+        s1 = eng.stats()
+        # exact re-prompt: 1 full block + the tail = 12 shared tokens,
+        # and only the LAST prompt token re-runs (its logits seed the
+        # first sample) -> one single-token chunk instead of two
+        assert s1["prefix_hits"] == 1
+        assert s1["prefix_hit_blocks"] - s0["prefix_hit_blocks"] == 2
+        assert s1["prefix_hit_tokens"] - s0["prefix_hit_tokens"] == 12
+        assert s1["prefill_chunks"] - s0["prefill_chunks"] == 1
+        c = eng.submit("m", Pdiv, max_tokens=6).result(180)
+        s2 = eng.stats()
+        # divergent suffix: only the first full block (8 tokens) is
+        # shared; its tail is freshly prefilled
+        assert s2["prefix_hits"] == 2
+        assert s2["prefix_hit_tokens"] - s1["prefix_hit_tokens"] == 8
+        d = eng.submit("m", P, max_tokens=6).result(180)
+        st = eng.stats()
+        # every decode write landing in a shared block forked first
+        assert st["cow_forks"] >= 2
+        cs = reg.gen_store("m").stats()["cache_state"]
+        assert cs["prefix_entries"] >= 2
+    finally:
+        eng.close()
+    assert [a.tokens, c.tokens, d.tokens] == want
+    assert b.tokens == a.tokens
+
+
+# ---------------------------------------------------------------------------
+# pool accounting
+# ---------------------------------------------------------------------------
+def test_pool_exhaustion_throttles_and_completes():
+    """A pool smaller than the offered load: admission reservations
+    throttle (FIFO, no overtaking) instead of exhausting the pool —
+    every stream completes, matches the unconstrained pool, and the
+    high-water mark respects capacity."""
+    rs = np.random.RandomState(4)
+    reqs = [dict(tokens=list(rs.randint(0, 50, 4)), max_tokens=8)
+            for _ in range(6)]
+    reg = ModelRegistry()
+    _add_model(reg, paged=True, prefill_chunk=8)
+    want = _generate(reg, reqs)
+    # tb+1 = 6 blocks -> capacity 5: at most ~one 2-block request plus
+    # its COW headroom in flight at a time
+    small = ModelRegistry()
+    _add_model(small, paged=True, prefill_chunk=8, pool_blocks=6)
+    eng = GenerationEngine(small)
+    try:
+        futs = [eng.submit("m", **kw) for kw in reqs]
+        got = [f.result(180).tokens for f in futs]
+        cs = small.gen_store("m").stats()["cache_state"]
+        assert cs["pool_blocks_hwm"] <= 5
+        assert eng.stats()["shed_pool"] == 0
+    finally:
+        eng.close()
+    assert got == want
+
+
+def test_oversized_request_sheds_at_admission():
+    """A request whose worst-case block need (ceil((prompt+max_tokens)
+    / block) plus the self-registration COW block) exceeds pool
+    capacity sheds with ServeOverloaded instead of deadlocking the
+    admission queue."""
+    from mxnet_tpu.serving import ServeOverloaded
+    reg = ModelRegistry()
+    _add_model(reg, paged=True, prefill_chunk=8, pool_blocks=6)
+    eng = GenerationEngine(reg)
+    try:
+        # 4 + 36 = 40 tokens -> 5 blocks == capacity, but the partial
+        # tail self-registers and needs its fork block: 6 > 5
+        fut = eng.submit("m", [1, 2, 3, 4], max_tokens=36)
+        with pytest.raises(ServeOverloaded):
+            fut.result(60)
+        assert eng.stats()["shed_pool"] == 1
+    finally:
+        eng.close()
+    # the structural invariant is enforced at store construction: a
+    # pool that cannot hold even one full-kv_max sequence is a config
+    # error, not a runtime shed
+    with pytest.raises(MXNetError):
+        _add_model(ModelRegistry(), paged=True, kv_max=80,
+                   pool_blocks=6)
+
+
+# ---------------------------------------------------------------------------
+# escape hatches
+# ---------------------------------------------------------------------------
+def test_paged_escape_hatches_bit_identical(monkeypatch):
+    """MXNET_PALLAS=0 (dense gather twin pinned) reproduces the default
+    routing bit-for-bit, and paged=False pins the contiguous plane —
+    the three configurations agree token-for-token."""
+    rs = np.random.RandomState(5)
+    reqs = [dict(tokens=list(rs.randint(0, 50, n)), max_tokens=10)
+            for n in (6, 11)]
+    streams = {}
+    for tag, env, paged in (("auto", None, True), ("xla", "0", True),
+                            ("contig", None, False)):
+        if env is None:
+            monkeypatch.delenv("MXNET_PALLAS", raising=False)
+        else:
+            monkeypatch.setenv("MXNET_PALLAS", env)
+        reg = ModelRegistry()
+        _add_model(reg, paged=paged, prefill_chunk=8)
+        streams[tag] = _generate(reg, reqs)
+    assert streams["auto"] == streams["xla"] == streams["contig"]
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+def test_paged_telemetry_gauges_counters_and_drop():
+    """The paged plane's observability contract: pool gauges +
+    serve_prefix_hit_total + the chunks-per-request histogram land in
+    the Prometheus exposition; stats()['cache_state'] describes the
+    pool; close() drops the engine's per-instance gauge series."""
+    from mxnet_tpu import metrics
+    reg = ModelRegistry()
+    _add_model(reg, paged=True, prefill_chunk=4)
+    eng = GenerationEngine(reg)
+    try:
+        P = [7, 3, 19, 4, 1, 2, 3, 4, 9]
+        eng.submit("m", P, max_tokens=4).result(180)
+        eng.submit("m", P, max_tokens=4).result(180)
+        text = metrics.registry().render_prometheus()
+        assert "serve_kv_pool_blocks_used{" in text
+        assert "serve_kv_pool_blocks_hwm{" in text
+        assert "serve_prefix_hit_total" in text
+        assert "serve_prefill_chunks_per_request_bucket" in text
+        cs = reg.gen_store("m").stats()["cache_state"]
+        for key in ("pool_blocks", "pool_blocks_used",
+                    "pool_blocks_hwm", "pool_blocks_shared",
+                    "pool_blocks_reserved", "prefix_entries",
+                    "block_bytes", "prefill_chunk"):
+            assert key in cs, key
+        assert cs["pool_blocks_used"] > 0  # prefix pins persist
+        lbl = '{engine="%s",model="m"}' % eng._mlabels["engine"]
+        assert ("serve_kv_pool_blocks_used%s" % lbl) in text
+    finally:
+        eng.close()
+    after = metrics.registry().render_prometheus()
+    assert ("serve_kv_pool_blocks_used%s" % lbl) not in after
+
+
+# ---------------------------------------------------------------------------
+# banked bench gates
+# ---------------------------------------------------------------------------
+def test_banked_paged_rows_hold_the_acceptance():
+    """BENCH_serving_cpu.json carries the serving.decode.paged.* family
+    with the acceptance ratios: >= 0.9x contiguous tokens/sec on a
+    prefix-free schedule, >= 2x concurrent sequences per KV byte on
+    the prefix-heavy schedule (pool capped at HALF the contiguous
+    bytes, same peak concurrency, zero sheds), most prefill chunks
+    skipped via prefix hits, and chunked prefill cutting co-running
+    streams' p99 inter-token latency."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "BENCH_serving_cpu.json")
+    with open(path) as f:
+        out = json.load(f)
+    rows = {r["metric"]: r for r in out["rows"]}
+    flat = rows["serving.decode.paged.flat"]
+    prefix = rows["serving.decode.paged.prefix"]
+    chunked = rows["serving.decode.paged.chunked"]
+    for r in (flat, prefix, chunked):
+        assert r["unit"] == "tokens/sec"
+        assert r["dropped"] == 0
+        assert r["counters"]["shed_pool"] == 0
+    assert flat["tokens_per_sec_vs_contiguous"] >= 0.9
+    # the flat schedule shares nothing: hits must be zero, or the
+    # throughput ratio would be flattered by sharing
+    assert flat["counters"]["prefix_hits"] == 0
+    assert prefix["seqs_per_kv_byte_vs_contiguous"] >= 2.0
+    assert prefix["paged_pool_bytes"] * 2 <= prefix["contig_cache_bytes"]
+    assert prefix["paged_max_active"] >= prefix["contig_max_active"]
+    assert prefix["counters"]["prefix_hits"] > 0
+    assert prefix["prefill_chunk_savings"] >= 0.5
+    assert prefix["prefill_chunks_dispatched"] < \
+        prefix["prefill_chunks_cold"]
+    assert chunked["itl_p99_chunked_vs_unchunked"] < 1.0
+    sm = out["serving"]["decode_paged"]
+    assert sm["tokens_per_sec_vs_contiguous"] >= 0.9
+    assert sm["seqs_per_kv_byte_vs_contiguous"] >= 2.0
+    assert sm["itl_p99_chunked_vs_unchunked"] < 1.0

@@ -274,7 +274,7 @@ def engines():
         reg.add_generative_model("m", PARAMS, SPEC, batch_buckets=BB,
                                  prompt_buckets=PB, kv_block=KVB,
                                  kv_max=KVM, warmup_kv_depth=KVM,
-                                 sample=mode)
+                                 sample=mode, paged=False)
         out[mode] = GenerationEngine(reg)
     yield out
     for eng in out.values():
@@ -352,7 +352,7 @@ def test_bf16_engine_cache_hwm_halved():
         reg = ModelRegistry()
         reg.add_generative_model("m", PARAMS, SPEC, batch_buckets=BB,
                                  prompt_buckets=PB, kv_block=KVB,
-                                 kv_max=KVM, kv_dtype=kv)
+                                 kv_max=KVM, kv_dtype=kv, paged=False)
         eng = GenerationEngine(reg)
         try:
             for f in [eng.submit("m", [5, 9, 2], max_tokens=6)
