@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke kernels-smoke data-smoke obs-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke kernels-smoke data-smoke obs-smoke chaos-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -148,6 +148,21 @@ data-smoke:
 obs-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu \
 		$(PY) -m pytest tests/test_observability.py -q
+
+# serving control-plane chaos campaign (tools/chaos_campaign.py): the
+# composed seeded multi-fault schedule (straggler pair + replica kill
+# + injected-error pair at the serve.dispatch seam) against the full
+# stack — HTTP front door -> autoscaled replicas -> engines — gated on
+# zero lost requests, SLO-bounded recovery and a connected trace for
+# every retried request; plus the SLO-driven autoscaler over seeded
+# diurnal/bursty swings (up AND down, p95 under SLO, fewer
+# replica-seconds than static max-size provisioning) and the rolling
+# weight swap under traffic (zero failures, zero torn reads).
+# MXNET_LOCK_CHECK on: the controller/prober/engine lock discipline is
+# part of the gate; hard timeout like the other smokes
+chaos-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu MXNET_LOCK_CHECK=1 \
+		$(PY) tools/chaos_campaign.py --seed 41
 
 # smoke fit under the profiler -> per-step phase breakdown
 # (data_wait/h2d_stage/compute/metric_fetch) from the dumped trace, so

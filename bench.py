@@ -1025,6 +1025,99 @@ def bench_observability(chip, smoke=False):
     }
 
 
+def bench_serving_control(which, chip, smoke=False):
+    """Control-plane rows (serving/controller.py + replica_set.py, the
+    protocols ``make chaos-smoke`` gates on):
+
+    * ``autoscale_diurnal`` / ``autoscale_bursty`` — the SLO-driven
+      AutoScaler walks a replica set up a seeded shaped swing and back
+      down.  Acceptance: scaled up AND down, queue-wait p95 under the
+      capacity-relative SLO, zero lost requests, and FEWER
+      replica-seconds than static max-size provisioning (the banked
+      ratio is the savings).
+    * ``rolling_swap`` — one rolling ``swap_params`` under a concurrent
+      submit stream: zero failed requests, every response bit-matches
+      exactly one coherent weight set, every live replica +1 version.
+    * ``chaos`` — the composed seeded multi-fault schedule (straggler
+      pair + replica kill + injected-error pair at serve.dispatch)
+      against HTTP front door -> autoscaled replicas -> engines: every
+      gate must hold (faults fired, zero lost, SLO-bounded recovery,
+      connected retry traces)."""
+    from mxnet_tpu.serving.loadgen import (autoscale_protocol,
+                                           chaos_protocol,
+                                           rolling_swap_protocol)
+
+    if which in ("autoscale_diurnal", "autoscale_bursty"):
+        shape = which.split("_", 1)[1]
+        r = autoscale_protocol(smoke=smoke, shape=shape)
+        return {
+            "metric": "serving.control.%s" % which,
+            "value": r["replica_seconds_vs_static"], "unit": "ratio",
+            "vs_baseline": None,
+            "shape": r["shape"],
+            "slo_ms": r["slo_ms"],
+            "p95_ms": r["auto"]["qwait_p95_ms"],
+            "p95_under_slo": r["p95_under_slo"],
+            "scaled_up": r["scaled_up"], "scaled_down": r["scaled_down"],
+            "actions": r["actions"],
+            "n_peak_replicas": r["n_peak_replicas"],
+            "max_replicas": r["max_replicas"],
+            "replica_seconds": r["auto"]["replica_seconds"],
+            "static_replica_seconds": r["static"]["replica_seconds"],
+            "lost": r["auto"]["lost"],
+            "shed": r["auto"].get("shed", 0),
+            "n_requests": r["n_load"],
+            "seed": r["seed"],
+            "note": ("SLO-driven autoscaler over the seeded %s swing vs "
+                     "static max-size provisioning on the same "
+                     "schedule; the ratio < 1 is the replica-seconds "
+                     "saving at a held p95" % shape),
+        }
+    if which == "rolling_swap":
+        r = rolling_swap_protocol(smoke=smoke)
+        return {
+            "metric": "serving.control.rolling_swap",
+            "value": r["n"], "unit": "requests",
+            "vs_baseline": None,
+            "n_requests": r["n"], "n_replicas": r["n_replicas"],
+            "old": r["old"], "new": r["new"],
+            "torn": r["neither"], "failed": r["failed"],
+            "replicas_swapped": r["replicas_swapped"],
+            "versions": {str(k): v for k, v in r["versions"].items()},
+            "retries": r["retries"],
+            "seed": r["seed"],
+            "note": ("one rolling swap_params (drain -> swap -> "
+                     "re-probe per replica) under a concurrent submit "
+                     "stream: zero failures, every response bit-matches "
+                     "old or new weights (torn=0), every replica +1 "
+                     "version"),
+        }
+    r = chaos_protocol(smoke=smoke)
+    return {
+        "metric": "serving.control.chaos",
+        "value": r["recovery_ms"], "unit": "ms",
+        "vs_baseline": None,
+        "gates": r["gates"],
+        "lost": r["summary"]["lost"],
+        "n_requests": r["summary"]["n"],
+        "n_faults": len(r["faults_fired"]),
+        "recovery_ms": r["recovery_ms"],
+        "recovery_slo_ms": r["recovery_slo_ms"],
+        "retries": r["retries"], "failovers": r["failovers"],
+        "retried_traces_connected": r["retried_traces_connected"],
+        "traces_exported": r["traces_exported"],
+        "live_after": r["live_after"],
+        "autoscale_actions": r["autoscale_actions"],
+        "seed": r["seed"],
+        "note": ("composed seeded faults (straggler pair + replica kill "
+                 "+ injected-error pair at serve.dispatch) against the "
+                 "full HTTP -> autoscaled-replicas -> engine stack: "
+                 "every scheduled fault fired, zero lost requests, "
+                 "first post-kill completion inside the recovery SLO, "
+                 "and every retried request kept a connected trace"),
+    }
+
+
 # the generation protocol runs both sides (re-prefill baseline +
 # continuous-batching engine) in one sweep; cache it so the two
 # serving.decode.* rows don't pay it twice
@@ -2159,6 +2252,14 @@ def main():
     # restores baseline within noise)
     guard("serving.observability.overhead", bench_observability, chip,
           smoke)
+    # control-plane rows: the SLO-driven autoscaler vs static
+    # provisioning over seeded diurnal/bursty swings, the rolling
+    # weight swap under traffic, and the composed-fault chaos campaign
+    # (the gates `make chaos-smoke` enforces, banked at full scale)
+    for ctl in ("autoscale_diurnal", "autoscale_bursty",
+                "rolling_swap", "chaos"):
+        guard("serving.control.%s" % ctl, bench_serving_control, ctl,
+              chip, smoke)
     # decode-plane generation rows: continuous batching over the KV
     # cache vs the naive re-prefill-per-token baseline, same seeded
     # open-loop schedule (tokens/sec + TTFT + inter-token latency),

@@ -444,6 +444,60 @@ register_env("MXNET_SERVE_CB_RESET", float, 1.0,
              "breaker admits one half-open trial (the next probe or "
              "request): trial success re-closes the breaker and the "
              "replica rejoins the rotation, failure re-opens it.")
+register_env("MXNET_SERVE_AUTOSCALE", int, 0,
+             "1 starts the serving autoscaler thread when an AutoScaler "
+             "is attached to a ReplicaSet without an explicit start= "
+             "argument (serving/controller.py): each tick it reads the "
+             "metrics registry (windowed queue-wait p95 vs "
+             "MXNET_SERVE_SLO_MS, shed deltas, inflight utilization) "
+             "and grows/shrinks the replica set between "
+             "MXNET_SERVE_MIN_REPLICAS and MXNET_SERVE_MAX_REPLICAS.  "
+             "0 (default) leaves sizing manual; evaluate_once() still "
+             "works for explicitly driven controllers.")
+register_env("MXNET_SERVE_SLO_MS", float, 50.0,
+             "The serving latency SLO target (milliseconds) the "
+             "autoscaler defends: queue-wait p95 over the last tick "
+             "window above this scales up; p95 under half of it (with "
+             "no sheds and low utilization) is the hysteresis band "
+             "that allows scale-down.")
+register_env("MXNET_SERVE_MIN_REPLICAS", int, 1,
+             "Autoscaler floor: the replica set is never shrunk below "
+             "this many replicas, regardless of how idle the signals "
+             "look.")
+register_env("MXNET_SERVE_MAX_REPLICAS", int, 8,
+             "Autoscaler ceiling: the replica set is never grown past "
+             "this many replicas, regardless of queue pressure — the "
+             "overload path beyond it is admission shedding "
+             "(MXNET_SERVE_MAX_INFLIGHT), not more capacity.")
+register_env("MXNET_SERVE_AUTOSCALE_INTERVAL", float, 0.25,
+             "Seconds between autoscaler evaluation ticks (the metric "
+             "window length: each tick judges the histogram/counter "
+             "deltas since the previous tick).")
+register_env("MXNET_SERVE_AUTOSCALE_COOLDOWN", float, 1.0,
+             "Minimum seconds between autoscaler scale ACTIONS (up or "
+             "down).  Ticks keep observing during the cool-down; only "
+             "actions are rate-limited, so one burst cannot slam the "
+             "set from min to max and back within a window.")
+register_env("MXNET_SERVE_SWAP_RATE", float, 0.0,
+             "Rolling weight swap rate limit: seconds to pause between "
+             "finishing one replica's drain→swap→re-probe cycle and "
+             "starting the next (ReplicaSet.swap_params).  0 (default) "
+             "rolls as fast as the drains allow; the roll is still one "
+             "replica at a time.")
+register_env("MXNET_SERVE_SWAP_DRAIN_S", float, 5.0,
+             "Per-replica drain budget (seconds) of the rolling weight "
+             "swap: how long to wait for a rotation-removed replica's "
+             "inflight requests to finish before swapping anyway (the "
+             "store-level swap is atomic per dispatch, so exceeding "
+             "the budget risks nothing worse than a request crossing "
+             "the version boundary between its retries).")
+register_env("MXNET_SERVE_AUTH_TOKEN", str, "",
+             "Bearer token the HTTP front door requires when set: "
+             "requests must carry 'Authorization: Bearer <token>' or "
+             "they get a structured 401 (GET /healthz and GET /metrics "
+             "stay open for probes and scrapers).  Empty (default) "
+             "disables auth.  TLS-less: pair with a trusted network "
+             "or a terminating proxy.")
 register_env("MXNET_TRACE_SAMPLE", float, 1.0,
              "Per-request trace sampling rate in [0, 1] "
              "(mxnet_tpu/tracing.py): each trace minted at the serving "

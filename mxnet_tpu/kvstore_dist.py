@@ -145,6 +145,16 @@ def _wire_counter(name, rpc):
         help="dist-kvstore payload accounting (wire_stats twin)")
 
 
+def _server_wire_counter(sid, rpc):
+    """Per-SERVER bytes-on-wire counter (one series per shard server
+    per rpc direction): the load signal ``rebalance_signal`` windows
+    to spot hot shards — the elastic-PS rebalance sensor."""
+    return _metrics.cached_counter(
+        "kvstore_server_wire_bytes_total",
+        labels={"server": str(sid), "rpc": rpc},
+        help="per-server dist-kvstore payload bytes")
+
+
 def _prof_record(name, start_ns, cat):
     """Report a fault-tolerance span (retry sleep, reconnect) to the
     engine-seam profiler when one is recording — retries show up in the
@@ -1623,12 +1633,14 @@ class WorkerClient:
             # the resend exercises the exactly-once dedup path
             raise _RPCTimeout("fault injected: reply from server %d "
                               "dropped" % sid)
-        self._account(msg, r)
+        self._account(msg, r, sid)
         return r
 
-    def _account(self, msg, reply):
+    def _account(self, msg, reply, sid=None):
         """Bytes-on-wire bookkeeping for one completed RPC (payload
-        bytes: push values sent, pull values received)."""
+        bytes: push values sent, pull values received); ``sid`` also
+        attributes the bytes to the serving shard server — the
+        per-server series ``rebalance_signal`` reads."""
         kind = msg[0]
         if kind == "push":
             n, rpc = codec.wire_nbytes(msg[2]), "push"
@@ -1648,6 +1660,8 @@ class WorkerClient:
         # GET /metrics carries bytes-on-wire beside the serving plane
         _wire_counter("kvstore_wire_bytes_total", rpc).inc(int(n))
         _wire_counter("kvstore_wire_rpcs_total", rpc).inc()
+        if sid is not None:
+            _server_wire_counter(sid, rpc).inc(int(n))
 
     def wire_stats(self):
         """Snapshot of the payload-byte / RPC counters."""
@@ -1772,6 +1786,44 @@ class WorkerClient:
             timeout = float(get_env("MXNET_KVSTORE_DEAD_TIMEOUT"))
         r = self._sched_probe(("membership", timeout))
         return r[1], r[2]
+
+    def rebalance_signal(self):
+        """One WINDOWED sample of the elastic-PS load sensor: this
+        worker's payload bytes per shard server since the previous
+        call, read through the process metrics registry
+        (``kvstore_server_wire_bytes_total{server=...}`` — the same
+        series ``GET /metrics`` scrapes).  Signal plumbing only: the
+        rebalance POLICY stays manual — a driver that decides to act
+        calls :meth:`migrate_bucket` itself, with this dict as its
+        evidence.
+
+        Returns ``{"per_server": {sid: delta_bytes}, "total": int,
+        "imbalance": max/mean or None, "hot": sid, "cold": sid}`` —
+        ``hot``/``cold`` are the busiest and idlest servers of the
+        window (None when the window carried no traffic)."""
+        per_server = {}
+        for sid in range(len(self.servers)):
+            total = 0
+            for rpc in ("push", "pull"):
+                c = _metrics.registry().get(
+                    "kvstore_server_wire_bytes_total",
+                    labels={"server": str(sid), "rpc": rpc})
+                if c is not None:
+                    total += int(c.value)
+            per_server[sid] = total
+        prev = getattr(self, "_rebalance_prev", {})
+        self._rebalance_prev = per_server
+        deltas = {sid: v - prev.get(sid, 0)
+                  for sid, v in per_server.items()}
+        total = sum(deltas.values())
+        imbalance = hot = cold = None
+        if total > 0 and deltas:
+            mean = total / float(len(deltas))
+            hot = max(deltas, key=lambda s: (deltas[s], -s))
+            cold = min(deltas, key=lambda s: (deltas[s], s))
+            imbalance = deltas[hot] / mean if mean else None
+        return {"per_server": deltas, "total": total,
+                "imbalance": imbalance, "hot": hot, "cold": cold}
 
     def migrate_bucket(self, bucket, target_sid):
         """Live shard rebalancing driver: advance the scheduler's

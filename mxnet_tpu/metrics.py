@@ -48,10 +48,10 @@ from .analysis.lockcheck import make_lock
 from .base import MXNetError, get_env
 
 __all__ = ["Counter", "Gauge", "GaugeFn", "Histogram", "CounterDict",
-           "MetricsRegistry", "registry", "counter", "gauge",
-           "histogram", "gauge_fn", "cached_counter", "cached_histogram",
-           "snapshot", "render_prometheus", "phase_on", "drop",
-           "BUCKET_GROWTH", "QUANTILE_REL_ERROR"]
+           "HistogramWindow", "MetricsRegistry", "registry", "counter",
+           "gauge", "histogram", "gauge_fn", "cached_counter",
+           "cached_histogram", "snapshot", "render_prometheus",
+           "phase_on", "drop", "BUCKET_GROWTH", "QUANTILE_REL_ERROR"]
 
 
 def _label_key(labels):
@@ -247,6 +247,57 @@ class Histogram:
             out.append((self.edge(i), cum))
         out.append((float("inf"), total))
         return out
+
+
+class HistogramWindow:
+    """Windowed quantiles over a :class:`Histogram`: deltas between
+    :meth:`tick` calls.
+
+    A cumulative histogram answers "p95 since process start", but a
+    feedback controller (the serving autoscaler) needs "p95 over the
+    LAST interval" — old observations must age out or one burst an hour
+    ago pins the signal forever.  The window keeps the previous
+    ``_scrape_state`` snapshot and each ``tick()`` returns the quantile
+    of only the observations that landed since the previous one (same
+    geometric-midpoint estimate and error bound as
+    ``Histogram.quantile``).  Single-consumer: one window per reader."""
+
+    __slots__ = ("_h", "_counts", "_count", "_sum")
+
+    def __init__(self, hist):
+        self._h = hist
+        self._counts, self._count, self._sum = hist._scrape_state()
+
+    def tick(self):
+        """Advance the window.  Returns ``(count, sum, quantile_fn)``
+        for the observations since the previous tick; ``quantile_fn(q)``
+        is None when the window is empty."""
+        counts, count, total = self._h._scrape_state()
+        # max(0, ...) guards a registry reset() swapping in a fresh
+        # instrument mid-window: a negative delta is a restart, not
+        # traffic
+        d = [max(0, b - a) for a, b in zip(self._counts, counts)]
+        dcount = max(0, count - self._count)
+        dsum = total - self._sum
+        self._counts, self._count, self._sum = counts, count, total
+        h = self._h
+
+        def quantile(q, _d=d, _n=dcount):
+            if not _n:
+                return None
+            rank = q * (_n - 1)
+            cum = 0
+            for i, c in enumerate(_d):
+                cum += c
+                if cum > rank:
+                    if i == 0:
+                        return h.lo
+                    if i == len(_d) - 1:
+                        return h.hi
+                    return math.exp(h._log_lo + (i - 0.5) * h._log_g)
+            return h.hi
+
+        return dcount, dsum, quantile
 
 
 class GaugeFn:

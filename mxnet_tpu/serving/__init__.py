@@ -33,32 +33,47 @@ retiring finished ones.
 arrival schedule, ``faultinject``-style) driving the p50/p99 + QPS bench
 rows on CPU in CI — and, for the decode plane, the tokens/sec + TTFT +
 inter-token-latency generation protocol.
+
+The control plane (docs/architecture/serving.md, control-plane section)
+closes the loop over all of it: :mod:`controller`'s :class:`AutoScaler`
+grows and shrinks a :class:`ReplicaSet` off the metrics registry's
+queue-wait/shed/utilization signals against an SLO target, the replica
+set's ``swap_params`` is a zero-downtime rolling weight swap with
+abort-and-rollback, admission understands priority tiers and per-tenant
+quotas, and :mod:`loadgen`'s ``autoscale_protocol`` /
+``rolling_swap_protocol`` / ``chaos_protocol`` prove the behaviors under
+seeded shaped load and composed fault schedules.
 """
 from .program_store import (GenerativeProgramStore, ProgramStore,
                             bucket_edges, bucket_for, host_sample,
                             sample_tokens)
 from .registry import ModelRegistry
-from .scheduler import (FutureCompleter, ServeClosed, ServeOverloaded,
-                        ServeRequest, ServeTimeout, ServingEngine)
+from .scheduler import (TIERS, FutureCompleter, ServeClosed,
+                        ServeOverloaded, ServeRequest, ServeTimeout,
+                        ServingEngine)
 from .decode_engine import GenerationEngine, GenerationResult, TokenStream
 from .replica_set import (NoLiveReplicas, Replica, ReplicaDied,
                           ReplicaSet)
+from .controller import AutoScaler
 from .frontdoor import HttpClient, HttpFrontDoor
-from .loadgen import (OpenLoopSchedule, failover_protocol,
+from .loadgen import (OpenLoopSchedule, autoscale_protocol,
+                      chaos_protocol, failover_protocol,
                       frontdoor_protocol, generation_protocol,
-                      latency_protocol, run_gen_loadgen, run_loadgen,
-                      swap_protocol)
+                      latency_protocol, rolling_swap_protocol,
+                      run_gen_loadgen, run_loadgen, swap_protocol)
 
 __all__ = [
     "ProgramStore", "GenerativeProgramStore", "bucket_edges", "bucket_for",
     "sample_tokens", "host_sample",
     "ModelRegistry",
     "ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
-    "ServeOverloaded", "FutureCompleter",
+    "ServeOverloaded", "FutureCompleter", "TIERS",
     "GenerationEngine", "GenerationResult", "TokenStream",
     "Replica", "ReplicaSet", "ReplicaDied", "NoLiveReplicas",
+    "AutoScaler",
     "HttpFrontDoor", "HttpClient",
     "OpenLoopSchedule", "run_loadgen", "latency_protocol",
     "run_gen_loadgen", "generation_protocol", "frontdoor_protocol",
-    "failover_protocol", "swap_protocol",
+    "failover_protocol", "swap_protocol", "autoscale_protocol",
+    "rolling_swap_protocol", "chaos_protocol",
 ]

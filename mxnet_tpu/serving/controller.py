@@ -1,0 +1,260 @@
+"""Serving control plane: the SLO-driven autoscaler.
+
+PR 13 built the actuators (an elastic :class:`~.replica_set.ReplicaSet`
+with health-checked failover and hot swap) and PR 14 built the sensors
+(queue-wait histograms, shed counters, inflight gauges in one metrics
+registry).  :class:`AutoScaler` closes the loop: a controller thread
+ticks every ``MXNET_SERVE_AUTOSCALE_INTERVAL`` seconds, reads the
+sensors, and grows or shrinks the replica set so the queue-wait p95
+stays under the SLO target with as few replicas as the load allows.
+
+Signals per tick (all WINDOWED — deltas since the previous tick, via
+:class:`~..metrics.HistogramWindow`; a burst an hour ago must not pin
+the controller's view forever):
+
+* queue-wait p95 of the window vs ``MXNET_SERVE_SLO_MS``;
+* the shed-counter delta (admission control firing means the set is
+  saturated NOW, whatever the latency histogram says);
+* inflight utilization (balancer-tracked inflight over the aggregate
+  engine budget, when the engines are bounded).
+
+State machine (evaluate_once)::
+
+        ┌─────────────── hold ───────────────┐
+        │                                    │
+        ▼   p95 > SLO  or  sheds > 0         │
+    [steady] ─── or util > up_util ──▶ [scale up]───▶ +1 replica
+        │                                  (cooldown gates the NEXT
+        │   p95 < SLO * down_frac           action, not observation)
+        │   and sheds == 0
+        └── and util < down_util ────▶ [scale down]─▶ -1 replica
+
+Hysteresis: the scale-down band (``down_frac`` of the SLO, low
+utilization, zero sheds) is far below the scale-up trigger, and every
+action arms a shared cool-down (``MXNET_SERVE_AUTOSCALE_COOLDOWN``), so
+a diurnal swing walks the set up and back down instead of flapping.
+
+The controller thread is deliberately NON-daemon: close() must join it
+(the test suite's thread-leak gate enforces the discipline), and it
+appears in ``threading.enumerate()`` as ``mxt-serve-autoscale``.
+
+Scale-up builds a replica from the set's registry factory — weight
+loading happens on the controller thread, never on a dispatch path;
+scale-down removes the youngest live replica WITH drain, so downsizing
+under traffic loses nothing.  Replica-seconds are integrated across the
+whole run (``replica_seconds()``): the bench rows compare them against
+static max-size provisioning to price the autoscaler's savings.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .. import metrics as _metrics
+from .. import tracing as _tracing
+from ..base import MXNetError, get_env
+from .scheduler import _H_QWAIT
+
+log = logging.getLogger(__name__)
+
+__all__ = ["AutoScaler"]
+
+
+class AutoScaler:
+    """Closed-loop replica-count controller over a
+    :class:`~.replica_set.ReplicaSet`.
+
+    Parameters
+    ----------
+    rset : ReplicaSet
+        The set to control; it must have been built with a callable
+        ``build_registry`` (growth rebuilds registries from it).
+    slo_ms : float, optional
+        Queue-wait p95 target; default ``MXNET_SERVE_SLO_MS``.
+    min_replicas / max_replicas : int, optional
+        Size bounds; defaults ``MXNET_SERVE_MIN_REPLICAS`` /
+        ``MXNET_SERVE_MAX_REPLICAS``.
+    interval : float, optional
+        Tick period (seconds) of the controller thread; default
+        ``MXNET_SERVE_AUTOSCALE_INTERVAL``.
+    cooldown : float, optional
+        Minimum seconds between scale actions; default
+        ``MXNET_SERVE_AUTOSCALE_COOLDOWN``.
+    down_frac : float
+        Hysteresis: scale down only when the window p95 is under
+        ``slo_ms * down_frac`` (and no sheds, and low utilization).
+    up_util / down_util : float
+        Inflight-utilization thresholds (used only when every engine
+        has a bounded ``max_inflight``).
+    start : bool, optional
+        Start the controller thread.  ``None`` (default) follows
+        ``MXNET_SERVE_AUTOSCALE``; pass ``True``/``False`` to decide
+        explicitly.  An unstarted controller is still fully usable
+        through :meth:`evaluate_once` (tests drive it clock-free).
+    """
+
+    def __init__(self, rset, slo_ms=None, min_replicas=None,
+                 max_replicas=None, interval=None, cooldown=None,
+                 down_frac=0.5, up_util=0.85, down_util=0.35,
+                 start=None):
+        self._rset = rset
+        if slo_ms is None:
+            slo_ms = float(get_env("MXNET_SERVE_SLO_MS"))
+        if min_replicas is None:
+            min_replicas = int(get_env("MXNET_SERVE_MIN_REPLICAS"))
+        if max_replicas is None:
+            max_replicas = int(get_env("MXNET_SERVE_MAX_REPLICAS"))
+        if interval is None:
+            interval = float(get_env("MXNET_SERVE_AUTOSCALE_INTERVAL"))
+        if cooldown is None:
+            cooldown = float(get_env("MXNET_SERVE_AUTOSCALE_COOLDOWN"))
+        self.slo_ms = float(slo_ms)
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.interval = max(0.01, float(interval))
+        self.cooldown = max(0.0, float(cooldown))
+        self.down_frac = float(down_frac)
+        self.up_util = float(up_util)
+        self.down_util = float(down_util)
+        if self.min_replicas > 1 or self.max_replicas > rset.n_replicas():
+            # growth needs the factory; fail at construction, not at
+            # the first scale-up tick inside the controller thread
+            if rset._build is None:
+                raise MXNetError(
+                    "AutoScaler needs a ReplicaSet built with a "
+                    "callable build_registry (growth reloads weights)")
+        self._qwait = _metrics.HistogramWindow(_H_QWAIT)
+        sig = rset.load_signals()
+        self._prev_shed = sig["shed_total"]
+        now = time.monotonic()
+        self._last_action = now - self.cooldown   # first tick may act
+        self._rs_t = now                          # replica-seconds mark
+        self._rs_total = 0.0
+        self._actions = []   # (t_monotonic, "up"/"down", n_after)
+        labels = dict(rset._mlabels)
+        self._g_replicas = _metrics.gauge(
+            "serve_autoscale_replicas", labels=labels,
+            help="replica count the autoscaler is holding")
+        self._g_p95 = _metrics.gauge(
+            "serve_autoscale_qwait_p95_ms", labels=labels,
+            help="windowed queue-wait p95 the last tick judged")
+        self._c_up = _metrics.counter(
+            "serve_autoscale_up_total", labels=labels,
+            help="autoscaler scale-up actions")
+        self._c_down = _metrics.counter(
+            "serve_autoscale_down_total", labels=labels,
+            help="autoscaler scale-down actions")
+        self._g_replicas.set(sig["n_replicas"])
+        self._closed = False
+        self._stop = threading.Event()
+        self._thread = None
+        if start is None:
+            start = bool(int(get_env("MXNET_SERVE_AUTOSCALE")))
+        if start:
+            # non-daemon ON PURPOSE: close() joins it, and the test
+            # suite's leak gate fails any test that forgets to
+            # graft-lint: disable=thread-discipline — stop-event + join live in close()
+            self._thread = threading.Thread(
+                target=self._run, name="mxt-serve-autoscale",
+                daemon=False)
+            self._thread.start()
+
+    # -- controller thread ---------------------------------------------
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.evaluate_once()
+            except BaseException as e:  # noqa: BLE001 — keep ticking
+                # a failed scale action (e.g. the set closed under us)
+                # must not kill the controller; the flight ring keeps
+                # the evidence
+                _tracing.flight().record(
+                    "error", "autoscaler tick failed", error=repr(e))
+
+    def evaluate_once(self, now=None):
+        """One controller tick: window the sensors, apply the state
+        machine, actuate at most one scale step.  Returns a dict of
+        the signals and the action taken (tests and the chaos campaign
+        assert on it)."""
+        if now is None:
+            now = time.monotonic()
+        # integrate replica-seconds at the PRE-action size: the segment
+        # since the last tick ran at that size
+        sig = self._rset.load_signals()
+        self._rs_total += (now - self._rs_t) * sig["n_replicas"]
+        self._rs_t = now
+        count, _, quantile = self._qwait.tick()
+        p95 = quantile(0.95)
+        p95_ms = None if p95 is None else p95 * 1e3
+        shed_delta = sig["shed_total"] - self._prev_shed
+        self._prev_shed = sig["shed_total"]
+        util = None
+        if sig["capacity"]:
+            util = sig["inflight"] / float(sig["capacity"])
+        self._g_p95.set(p95_ms if p95_ms is not None else 0.0)
+        n = sig["n_replicas"]
+        action = "hold"
+        cooled = (now - self._last_action) >= self.cooldown
+        over = ((p95_ms is not None and p95_ms > self.slo_ms)
+                or shed_delta > 0
+                or (util is not None and util > self.up_util))
+        under = ((p95_ms is None or p95_ms < self.slo_ms
+                  * self.down_frac)
+                 and shed_delta == 0
+                 and (util is None or util < self.down_util))
+        if over and n < self.max_replicas and cooled:
+            self._rset.add_replica()
+            self._c_up.inc()
+            action = "up"
+        elif not over and under and n > self.min_replicas and cooled:
+            self._rset.remove_replica(drain=True)
+            self._c_down.inc()
+            action = "down"
+        if action != "hold":
+            n = self._rset.n_replicas()
+            self._last_action = now
+            self._actions.append((now, action, n))
+            self._g_replicas.set(n)
+            log.info("autoscaler: scale %s to %d replicas (p95=%sms "
+                     "slo=%.1fms sheds=%d util=%s)", action, n,
+                     "%.1f" % p95_ms if p95_ms is not None else "-",
+                     self.slo_ms, shed_delta,
+                     "%.2f" % util if util is not None else "-")
+        return {"action": action, "n_replicas": n, "p95_ms": p95_ms,
+                "window_count": count, "shed_delta": shed_delta,
+                "util": util}
+
+    # -- accounting ----------------------------------------------------
+    def replica_seconds(self, now=None):
+        """Replica-seconds integrated since construction (including the
+        still-open segment): the provisioning cost the bench rows
+        compare against static max-size serving."""
+        if now is None:
+            now = time.monotonic()
+        return self._rs_total + \
+            (now - self._rs_t) * self._rset.n_replicas()
+
+    def actions(self):
+        """The scale-action history: (monotonic time, 'up'/'down',
+        replica count after)."""
+        return list(self._actions)
+
+    def close(self, timeout=30.0):
+        """Stop and JOIN the controller thread (idempotent).  The set
+        itself is not closed — the controller only borrows it."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise MXNetError("autoscaler thread failed to stop "
+                                 "within %.0fs" % timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

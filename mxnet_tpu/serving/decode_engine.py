@@ -86,7 +86,7 @@ from .. import tracing as _tracing
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, _uid, get_env, hot_path
 from .scheduler import (FutureCompleter, ServeClosed, ServeOverloaded,
-                        ServeTimeout)
+                        ServeTimeout, TIERS)
 
 # Aggregate generation histograms (process-wide; gated on
 # MXNET_METRICS like every ambient observation seam).  TTFT and ITL
@@ -177,11 +177,12 @@ class TokenStream:
 class _GenRequest:
     __slots__ = ("model", "prompt", "max_tokens", "temperature", "top_k",
                  "seed", "eos_id", "stream", "future", "deadline",
-                 "t_submit", "tokens", "token_times", "seq", "trace",
-                 "trace_parent")
+                 "t_submit", "tokens", "token_times", "seq", "priority",
+                 "tenant", "trace", "trace_parent")
 
     def __init__(self, model, prompt, max_tokens, temperature, top_k,
-                 seed, eos_id, stream, future, deadline, t_submit, seq):
+                 seed, eos_id, stream, future, deadline, t_submit, seq,
+                 priority="batch", tenant=None):
         self.model = model
         self.prompt = prompt
         self.max_tokens = max_tokens
@@ -196,6 +197,8 @@ class _GenRequest:
         self.tokens = []
         self.token_times = []
         self.seq = seq
+        self.priority = priority  # admission tier (scheduler.TIERS)
+        self.tenant = tenant      # quota/metrics key, or None
         # trace context captured on the submitting thread and
         # re-activated around this request's prefill/decode dispatches
         self.trace = None
@@ -465,7 +468,8 @@ class GenerationEngine:
     models.
     """
 
-    def __init__(self, registry, max_active=None, max_inflight=None):
+    def __init__(self, registry, max_active=None, max_inflight=None,
+                 owner_index=None, tenant_quotas=None):
         self._registry = registry
         self._max_active = (int(max_active) if max_active is not None
                             else None)
@@ -473,6 +477,13 @@ class GenerationEngine:
             max_inflight = int(get_env("MXNET_SERVE_MAX_INFLIGHT"))
         self._max_inflight = max(0, int(max_inflight))  # 0 = unbounded
         self._inflight = 0
+        # owning replica index (None = bare engine): every ServeClosed
+        # minted here carries it — see scheduler.ServeClosed
+        self._owner_index = owner_index
+        # per-tenant admission quotas: tenant id -> max inflight TOKENS
+        # (prompt + max_tokens over the tenant's unresolved requests)
+        self._tenant_quotas = dict(tenant_quotas or {})
+        self._tenant_tokens = {}
         self._queue = queue.Queue()
         self._waiting = {}     # model -> deque[_GenRequest]
         self._states = {}      # model -> _ModelState
@@ -520,9 +531,13 @@ class GenerationEngine:
                                         name="mxt-gen", daemon=True)
         self._thread.start()
 
+    def _closed_exc(self, msg):
+        return ServeClosed(msg, replica_index=self._owner_index)
+
     # -- client side ---------------------------------------------------
     def submit(self, model, tokens, max_tokens=16, temperature=0.0,
-               top_k=0, seed=0, eos_id=None, stream=None, timeout=None):
+               top_k=0, seed=0, eos_id=None, stream=None, timeout=None,
+               priority=None, tenant=None):
         """Enqueue one generation request; returns its Future.
 
         ``tokens`` — prompt token ids (non-empty); ``max_tokens`` —
@@ -535,11 +550,23 @@ class GenerationEngine:
         sampling and invariant to batch composition; ``eos_id`` stops
         early; ``stream`` — an optional :class:`TokenStream` receiving
         tokens as they are sampled; ``timeout`` (seconds) bounds
-        time-to-admission."""
+        time-to-admission.
+
+        ``priority`` ("latency"/"batch", default "batch") orders the
+        waiting deque: latency requests admit before batch requests of
+        the same model.  ``tenant`` keys the per-tenant TOKEN quota
+        (constructor ``tenant_quotas``: prompt+max_tokens over the
+        tenant's unresolved requests) — a tenant over budget is shed
+        alone with :class:`ServeOverloaded`."""
         if self._closed:
             # cheap early gate: every post-close submit raises
             # ServeClosed, never a validation error about its payload
-            raise ServeClosed("generation engine is closed")
+            raise self._closed_exc("generation engine is closed")
+        priority = "batch" if priority is None else str(priority)
+        if priority not in TIERS:
+            raise MXNetError("unknown priority tier %r (want one of %s)"
+                             % (priority, "/".join(TIERS)))
+        tenant = None if tenant is None else str(tenant)
         store = self._registry.gen_store(model)
         # coerce EVERY request field up front, mapping coercion errors
         # to MXNetError (the front door's 400 class — a malformed body
@@ -575,10 +602,11 @@ class GenerationEngine:
         if ctx is None:
             owned = _tracing.start_trace("serve.generate", model=model)
             ctx = (owned, owned.root_id)
+        cost = len(prompt) + max_tokens   # the tenant-quota unit
         try:
             with self._submit_lock:
                 if self._closed:
-                    raise ServeClosed("generation engine is closed")
+                    raise self._closed_exc("generation engine is closed")
                 if self._max_inflight \
                         and self._inflight >= self._max_inflight:
                     self._stats.inc("shed")
@@ -586,13 +614,32 @@ class GenerationEngine:
                         "generation engine is at its inflight budget "
                         "(%d); request shed — back off and retry"
                         % self._max_inflight)
+                quota = self._tenant_quotas.get(tenant) \
+                    if tenant is not None else None
+                if quota is not None and \
+                        self._tenant_tokens.get(tenant, 0) + cost > quota:
+                    # only the noisy tenant sheds; other tenants'
+                    # admission is untouched
+                    self._stats.inc("shed")
+                    _metrics.cached_counter(
+                        "serve_tenant_shed_total",
+                        labels={"tenant": tenant},
+                        help="requests shed by per-tenant quota").inc()
+                    raise ServeOverloaded(
+                        "tenant %r is over its inflight token quota "
+                        "(%d); request shed — back off and retry"
+                        % (tenant, quota))
                 self._inflight += 1
+                if tenant is not None:
+                    self._tenant_tokens[tenant] = \
+                        self._tenant_tokens.get(tenant, 0) + cost
                 self._g_inflight.set(self._inflight)
                 req = _GenRequest(
                     model, prompt, max_tokens, temperature,
                     top_k, seed, eos_id, stream, fut,
                     now + timeout if timeout is not None else None,
-                    time.perf_counter(), self._seq)
+                    time.perf_counter(), self._seq,
+                    priority=priority, tenant=tenant)
                 req.trace, req.trace_parent = ctx
                 self._seq += 1
                 self._queue.put(req)
@@ -602,15 +649,30 @@ class GenerationEngine:
             if owned is not None:
                 owned.finish(status=type(e).__name__)
             raise
-        fut.add_done_callback(self._note_resolved)
+        fut.add_done_callback(
+            lambda f, t=tenant, c=cost: self._note_resolved(t, c))
         if owned is not None:
             fut.add_done_callback(_tracing.finish_on_done(owned))
         self._stats.inc("requests")
+        _metrics.cached_counter(
+            "serve_gen_tier_requests_total", labels={"tier": priority},
+            help="generation requests accepted, by priority tier").inc()
+        if tenant is not None:
+            _metrics.cached_counter(
+                "serve_gen_tenant_requests_total",
+                labels={"tenant": tenant},
+                help="generation requests accepted, by tenant").inc()
         return fut
 
-    def _note_resolved(self, _fut):
+    def _note_resolved(self, tenant, cost):
         with self._submit_lock:
             self._inflight -= 1
+            if tenant is not None:
+                left = self._tenant_tokens.get(tenant, 0) - cost
+                if left > 0:
+                    self._tenant_tokens[tenant] = left
+                else:
+                    self._tenant_tokens.pop(tenant, None)
             self._g_inflight.set(self._inflight)
 
     def alive(self):
@@ -624,7 +686,9 @@ class GenerationEngine:
             out["cache_hwm"] = dict(self._cache_hwm)
         with self._submit_lock:
             out["inflight"] = self._inflight
+            out["tenant_tokens"] = dict(self._tenant_tokens)
         out["max_inflight"] = self._max_inflight
+        out["tenant_quotas"] = dict(self._tenant_quotas)
         out["models"] = {m: st.describe()
                          for m, st in dict(self._states).items()}
         return out
@@ -688,7 +752,7 @@ class GenerationEngine:
                 except queue.Empty:
                     break
                 if item is not _STOP:
-                    self._fail_request(item, ServeClosed(
+                    self._fail_request(item, self._closed_exc(
                         "generation engine dispatch loop exited before "
                         "this request could be served"))
             self._fail_all()
@@ -714,8 +778,21 @@ class GenerationEngine:
             if item is _STOP:
                 stop_seen = True
                 continue
-            self._waiting.setdefault(
-                item.model, collections.deque()).append(item)
+            dq = self._waiting.setdefault(item.model,
+                                          collections.deque())
+            if item.priority == TIERS[0]:
+                # each waiting deque is kept [latency..., batch...]:
+                # a latency arrival admits before every parked batch
+                # request (after older latency ones — FIFO holds
+                # within a tier)
+                pos = len(dq)
+                for i, parked in enumerate(dq):
+                    if parked.priority != TIERS[0]:
+                        pos = i
+                        break
+                dq.insert(pos, item)
+            else:
+                dq.append(item)
         return stop_seen
 
     # -- admission (prefill) -------------------------------------------
@@ -1422,8 +1499,11 @@ class GenerationEngine:
 
     def _fail_all(self):
         """close(drain=False): everything waiting or in flight fails
-        fast."""
-        exc = ServeClosed("generation engine closed before completion")
+        fast — with the owning replica named, so the retry layer and
+        the flight recorder see WHICH replica's kill lost the KV
+        state."""
+        exc = self._closed_exc(
+            "generation engine closed before completion")
         for dq in self._waiting.values():
             while dq:
                 self._fail_request(dq.popleft(), exc)

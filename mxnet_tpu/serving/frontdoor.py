@@ -50,6 +50,7 @@ rows ride this).
 """
 from __future__ import annotations
 
+import hmac
 import io
 import json
 import queue
@@ -151,9 +152,45 @@ class _Handler(BaseHTTPRequestHandler):
                 ms = float(h)
         return None if ms is None else max(0.0, ms) / 1e3
 
+    def _tier_tenant(self, payload=None):
+        """Admission metadata from the JSON body (``priority`` /
+        ``tenant``) or — the npz transport's only channel — the
+        ``X-Mxnet-Priority`` / ``X-Mxnet-Tenant`` headers.  Unknown
+        tiers fail in the engine with a structured 400."""
+        priority = tenant = None
+        if payload is not None:
+            priority = payload.get("priority")
+            tenant = payload.get("tenant")
+        if priority is None:
+            priority = self.headers.get("X-Mxnet-Priority") or None
+        if tenant is None:
+            tenant = self.headers.get("X-Mxnet-Tenant") or None
+        return priority, tenant
+
+    def _authorized(self):
+        """Bearer-token gate (``MXNET_SERVE_AUTH_TOKEN``).  No token
+        configured = open door (in-cluster default).  ``/healthz`` and
+        ``/metrics`` stay exempt so balancer probes and scrapers need
+        no credential plumbing.  Failures get a structured 401 the
+        client maps like every other serving error."""
+        tok = self._door.auth_token
+        if not tok or self.path in ("/healthz", "/metrics"):
+            return True
+        h = self.headers.get("Authorization") or ""
+        # constant-time compare: the token must not leak via timing
+        if h.startswith("Bearer ") and hmac.compare_digest(
+                h[len("Bearer "):], tok):
+            return True
+        self._reply(401, {"error": "missing or invalid bearer token "
+                                   "(Authorization: Bearer <token>)",
+                          "kind": "Unauthorized", "retryable": False})
+        return False
+
     # -- routes --------------------------------------------------------
     def do_GET(self):
         try:
+            if not self._authorized():
+                return
             if self.path == "/healthz":
                 alive = self._door.healthy()
                 self._reply(200 if alive else 503, {
@@ -182,6 +219,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         try:
+            if not self._authorized():
+                return
             model, verb = self._split_path()
             if verb == "predict":
                 self._serve_predict(model)
@@ -229,6 +268,7 @@ class _Handler(BaseHTTPRequestHandler):
                 inputs = {k: np.asarray(v)
                           for k, v in payload.get("inputs", {}).items()}
             timeout = self._timeout_s(payload)
+            priority, tenant = self._tier_tenant(payload)
         except MXNetError:
             raise
         except Exception as e:  # noqa: BLE001 — client-caused: 400
@@ -243,6 +283,8 @@ class _Handler(BaseHTTPRequestHandler):
             with _tracing.activate(tr):
                 try:
                     fut = self._door.target.submit(model, timeout=timeout,
+                                                   priority=priority,
+                                                   tenant=tenant,
                                                    **inputs)
                     outs = fut.result(self._door.wait_budget(timeout))
                 except BaseException as e:  # noqa: BLE001 — structured
@@ -279,6 +321,11 @@ class _Handler(BaseHTTPRequestHandler):
                       "eos_id"):
                 if payload.get(k) is not None:
                     kwargs[k] = payload[k]
+            priority, tenant = self._tier_tenant(payload)
+            if priority is not None:
+                kwargs["priority"] = priority
+            if tenant is not None:
+                kwargs["tenant"] = tenant
         except Exception as e:  # noqa: BLE001 — client-caused: 400
             raise MXNetError("invalid request body: %s: %s"
                              % (type(e).__name__, e))
@@ -331,12 +378,18 @@ class HttpFrontDoor:
     :class:`~.decode_engine.GenerationEngine` when the forward target
     is a bare engine.  ``port=0`` binds an ephemeral port
     (``.address`` reports it).  ``max_wait`` bounds how long a handler
-    thread waits on a future with no client deadline."""
+    thread waits on a future with no client deadline.  ``auth_token``
+    (default ``MXNET_SERVE_AUTH_TOKEN``; empty = open) requires
+    ``Authorization: Bearer <token>`` on every route except
+    ``/healthz`` and ``/metrics``."""
 
     def __init__(self, target, host="127.0.0.1", port=0, gen_target=None,
-                 max_wait=300.0):
+                 max_wait=300.0, auth_token=None):
         self.target = target
         self._gen_target = gen_target
+        if auth_token is None:
+            auth_token = get_env("MXNET_SERVE_AUTH_TOKEN") or None
+        self.auth_token = auth_token or None
         self._max_wait = float(max_wait)
         self._server = _Server((host, int(port)), _Handler)
         self._server.frontdoor = self
@@ -487,13 +540,19 @@ class HttpClient:
     only variable (the ``serving.frontdoor.http_overhead`` bench row's
     whole point).  Error replies map back to the serving exception
     classes, so the loadgen's timeout/error classification is
-    transport-invariant."""
+    transport-invariant.  ``auth_token`` (default
+    ``MXNET_SERVE_AUTH_TOKEN``) rides every request as a bearer
+    credential."""
 
-    def __init__(self, address, threads=8, connect_timeout=120.0):
+    def __init__(self, address, threads=8, connect_timeout=120.0,
+                 auth_token=None):
         if isinstance(address, str):
             host, port = address.rsplit(":", 1)
             address = (host.replace("http://", "").strip("/"), int(port))
         self._addr = (address[0], int(address[1]))
+        if auth_token is None:
+            auth_token = get_env("MXNET_SERVE_AUTH_TOKEN") or None
+        self._auth_token = auth_token or None
         self._timeout = float(connect_timeout)
         self._closed = False
         self._close_lock = threading.Lock()
@@ -507,24 +566,36 @@ class HttpClient:
             self._threads.append(t)
 
     # -- public --------------------------------------------------------
-    def submit(self, model, inputs, timeout=None):
+    def submit(self, model, inputs, timeout=None, priority=None,
+               tenant=None):
         """One forward request over npz transport; returns a Future
         resolving to the list of output arrays (bit-exact: no JSON
-        float round-trip)."""
+        float round-trip).  ``priority`` / ``tenant`` ride the
+        ``X-Mxnet-Priority`` / ``X-Mxnet-Tenant`` headers into the
+        engine's tiered admission."""
         buf = io.BytesIO()
         np.savez(buf, **{k: np.asarray(v) for k, v in inputs.items()})
         headers = {"Content-Type": "application/x-npz"}
         if timeout is not None:
             headers["X-Mxnet-Timeout-Ms"] = "%g" % (timeout * 1e3)
+        if priority is not None:
+            headers["X-Mxnet-Priority"] = str(priority)
+        if tenant is not None:
+            headers["X-Mxnet-Tenant"] = str(tenant)
         return self._enqueue("POST", "/v1/models/%s:predict" % model,
                              buf.getvalue(), headers, self._parse_npz)
 
-    def submit_json(self, model, inputs, timeout=None):
+    def submit_json(self, model, inputs, timeout=None, priority=None,
+                    tenant=None):
         """The curl-shaped JSON variant (lists in, lists out)."""
         payload = {"inputs": {k: np.asarray(v).tolist()
                               for k, v in inputs.items()}}
         if timeout is not None:
             payload["timeout_ms"] = timeout * 1e3
+        if priority is not None:
+            payload["priority"] = priority
+        if tenant is not None:
+            payload["tenant"] = tenant
         return self._enqueue(
             "POST", "/v1/models/%s:predict" % model,
             json.dumps(payload).encode("utf-8"),
@@ -610,6 +681,9 @@ class HttpClient:
     # -- worker pool ---------------------------------------------------
     def _enqueue(self, method, path, body, headers, parse,
                  retryable=True):
+        if self._auth_token and "Authorization" not in headers:
+            headers = dict(headers)
+            headers["Authorization"] = "Bearer %s" % self._auth_token
         with self._close_lock:
             if self._closed:
                 raise ServeClosed("HttpClient is closed")

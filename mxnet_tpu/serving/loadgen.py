@@ -38,7 +38,8 @@ __all__ = ["OpenLoopSchedule", "run_loadgen", "latency_protocol",
            "run_gen_loadgen", "generation_protocol",
            "paged_generation_protocol", "frontdoor_protocol",
            "failover_protocol", "swap_protocol",
-           "observability_protocol"]
+           "observability_protocol", "autoscale_protocol",
+           "rolling_swap_protocol", "chaos_protocol"]
 
 
 class OpenLoopSchedule:
@@ -77,6 +78,58 @@ class OpenLoopSchedule:
         self.seed = int(seed)
         self.qps = float(qps)
         self.n = int(n_requests)
+        self.shape = "poisson"
+
+    @classmethod
+    def _modulated(cls, shape, rate_of_t, seed, n_requests, mean_qps,
+                   **kwargs):
+        """Shared non-homogeneous-Poisson generator: draw each gap at
+        the instantaneous rate ``rate_of_t(t)`` (one RandomState, so the
+        same seed replays the same shaped load byte-for-byte)."""
+        sched = cls(seed=seed, n_requests=n_requests, qps=mean_qps,
+                    **kwargs)
+        rs = np.random.RandomState(int(seed) ^ 0x5C4ED)
+        t = 0.0
+        arrivals = np.empty(int(n_requests))
+        for i in range(int(n_requests)):
+            t += rs.exponential(1.0 / max(1e-9, float(rate_of_t(t))))
+            arrivals[i] = t
+        sched.arrivals = arrivals
+        sched.qps = float(n_requests) / float(arrivals[-1])
+        sched.shape = shape
+        return sched
+
+    @classmethod
+    def diurnal(cls, seed=0, n_requests=400, low_qps=10.0,
+                high_qps=100.0, period_s=4.0, **kwargs):
+        """A diurnal swing: the instantaneous rate follows a raised
+        cosine from ``low_qps`` up to ``high_qps`` and back once per
+        ``period_s`` (starting at the trough) — the autoscaler protocol
+        walks a replica set up the ramp and back down it."""
+        span = float(high_qps) - float(low_qps)
+
+        def rate(t):
+            return low_qps + span * 0.5 * (
+                1.0 - np.cos(2.0 * np.pi * t / float(period_s)))
+
+        return cls._modulated("diurnal", rate, seed, n_requests,
+                              (low_qps + high_qps) / 2.0, **kwargs)
+
+    @classmethod
+    def bursty(cls, seed=0, n_requests=400, idle_qps=5.0,
+               burst_qps=100.0, burst_s=1.0, idle_s=2.0, **kwargs):
+        """An on/off burst train: ``burst_qps`` for ``burst_s`` seconds,
+        ``idle_qps`` for ``idle_s``, repeating (burst first).  The
+        step edges are what hysteresis and cooldown exist for — a
+        controller without them flaps a replica on every cycle."""
+        cycle = float(burst_s) + float(idle_s)
+
+        def rate(t):
+            return burst_qps if (t % cycle) < float(burst_s) else idle_qps
+
+        mean = (burst_qps * burst_s + idle_qps * idle_s) / cycle
+        return cls._modulated("bursty", rate, seed, n_requests, mean,
+                              **kwargs)
 
 
 def _drive_schedule(submit, schedule, on_success, settle_s, thread_name):
@@ -1353,4 +1406,451 @@ def swap_protocol(smoke=False, seed=23):
         "version_before": version_before,
         "version_after": version_after,
         "version_increments": version_after - version_before,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Control-plane protocols: autoscaling, rolling swap, chaos campaign.
+# ---------------------------------------------------------------------------
+def autoscale_protocol(smoke=False, seed=31, shape="diurnal",
+                       max_replicas=3):
+    """SLO-driven autoscaling vs static max-size provisioning.
+
+    The data plane is pinned to per-request service (``max_batch=1``)
+    with a PACED dispatch hook: every replica's engine sleeps a fixed
+    ``service_s`` per dispatch (the engine's test seam, on the engine
+    thread — it releases the GIL), modeling a replica-private
+    accelerator.  A compute-bound model cannot prove replica scaling
+    on a small CI host — N engine threads would share the same cores
+    and N replicas would add no capacity; the paced floor makes
+    capacity genuinely linear in the replica count, so one replica's
+    capacity IS the measured closed-loop anchor and the shaped
+    schedules (``OpenLoopSchedule.diurnal`` /
+    ``OpenLoopSchedule.bursty``) overload it deterministically at peak:
+    the peak rate needs more than one replica, the trough fits in one.
+    The SAME seeded schedule is served twice —
+
+    1. **autoscaled**: a 1-replica set under an :class:`~.controller.
+       AutoScaler` (bounded ``max_replicas``), which must walk the set
+       up the ramp and back down it;
+    2. **static**: ``max_replicas`` replicas for the whole run — the
+       provisioning the autoscaler's replica-seconds are priced
+       against.
+
+    The autoscaled side runs with a warm spare pool
+    (``ReplicaSet(spares=max_replicas - 1)``): scale-up joins a
+    prebuilt registry in milliseconds instead of compiling on the
+    controller thread mid-swing.  Spares are idle weights — no engine
+    threads — so the replica-seconds comparison still prices live
+    serving capacity.
+
+    Acceptance (the ``serving.control.autoscale`` bench rows): the
+    autoscaled side's queue-wait p95 stays under the SLO, with zero
+    lost requests and strictly fewer replica-seconds than static
+    max-size provisioning over the same span."""
+    from .. import metrics as _metrics
+    from .controller import AutoScaler
+    from .registry import ModelRegistry
+    from .replica_set import ReplicaSet
+    from .scheduler import _H_QWAIT
+
+    sym, args, pool, feat = _frontdoor_model(seed)
+    n_closed = 20 if smoke else 40
+    cap_inflight = 32
+    # the per-dispatch service floor: ~50 req/s per replica, cheap on
+    # the CPU (the engine thread sleeps, the GIL is free), and long
+    # enough that the 2.2x peak rate is trivially pace-able for the
+    # open-loop submit thread
+    service_s = 0.02
+
+    def build(_i):
+        reg = ModelRegistry()
+        reg.add_model("m", sym, {k: v.copy() for k, v in args.items()},
+                      {}, input_shapes={"data": (1, feat)}, warmup=True)
+        return reg
+
+    def _paced_hook(_model, _reqs):
+        time.sleep(service_s)
+
+    class _PacedSet(ReplicaSet):
+        # every replica — initial, spare-grown, factory-grown — gets
+        # the paced dispatch floor the moment its engine exists
+        def _new_replica(self, index, reg):
+            r = ReplicaSet._new_replica(self, index, reg)
+            r.engine._dispatch_hook = _paced_hook
+            return r
+
+    def make_set(n, spares=0):
+        return _PacedSet(build, n_replicas=n, probe_interval=0.1,
+                         max_delay_ms=2.0, max_batch=1,
+                         max_inflight=cap_inflight, spares=spares)
+
+    # single-replica per-request capacity: the schedule's rate anchor.
+    # np.asarray on the output BLOCKS on the device value — without it
+    # the loop would clock the async dispatch rate, not service
+    probe = make_set(1)
+    try:
+        for _ in range(2):
+            np.asarray(probe.submit("m", data=pool[0]).result(60)[0])
+        closed_qps = _engine_capacity(
+            lambda i: np.asarray(probe.submit(
+                "m", data=pool[i % len(pool)]).result(60)[0]),
+            n_closed)
+    finally:
+        probe.close()
+
+    high = closed_qps * 2.2       # > one replica, < max_replicas
+    low = closed_qps * 0.25       # the trough fits in one
+    duration = 4.0 if smoke else 8.0
+    mean = (low + high) / 2.0
+    n_load = int(min(2500, max(200, mean * duration)))
+    if shape == "diurnal":
+        period = max(duration, n_load / mean)
+        schedule = OpenLoopSchedule.diurnal(
+            seed, n_load, low_qps=low, high_qps=high, period_s=period)
+    elif shape == "bursty":
+        span = max(duration, n_load / mean)
+        schedule = OpenLoopSchedule.bursty(
+            seed, n_load, idle_qps=low, burst_qps=high,
+            burst_s=span / 4.0, idle_s=span / 4.0)
+    else:
+        raise MXNetError("shape must be 'diurnal' or 'bursty', got %r"
+                         % (shape,))
+    # SLO: a generous multiple of the time one replica needs to drain a
+    # full admission window serially — capacity-relative, so the gate
+    # holds on slow CI hosts too
+    slo_ms = max(100.0, 2.5 * cap_inflight * 1e3 / closed_qps)
+
+    def run_side(rset, scaler=None):
+        t0 = time.monotonic()
+        window = _metrics.HistogramWindow(_H_QWAIT)
+        summary = run_loadgen(
+            lambda i, n: rset.submit("m", data=pool[i % len(pool)]),
+            schedule, fetch=True)
+        _, _, quantile = window.tick()
+        p95 = quantile(0.95)
+        summary["qwait_p95_ms"] = (None if p95 is None
+                                   else round(p95 * 1e3, 3))
+        if scaler is not None:
+            # let the controller walk back down before the books close
+            deadline = time.monotonic() + (2.0 if smoke else 4.0)
+            while rset.n_replicas() > 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            summary["replica_seconds"] = round(
+                scaler.replica_seconds(), 3)
+        else:
+            summary["replica_seconds"] = round(
+                rset.n_replicas() * (time.monotonic() - t0), 3)
+        return summary
+
+    # side 1: autoscaled from one replica, spares prebuilt so the
+    # controller's scale-up is instant
+    rset = make_set(1, spares=max_replicas - 1)
+    scaler = AutoScaler(rset, slo_ms=slo_ms, min_replicas=1,
+                        max_replicas=max_replicas, interval=0.05,
+                        cooldown=0.25, start=True)
+    try:
+        for _ in range(2):
+            rset.submit("m", data=pool[0]).result(60)
+        auto = run_side(rset, scaler)
+        actions = [(a, n) for _t, a, n in scaler.actions()]
+    finally:
+        scaler.close()
+        rset.close()
+
+    # side 2: static max-size provisioning, same schedule
+    static = make_set(max_replicas)
+    try:
+        for _ in range(2):
+            static.submit("m", data=pool[0]).result(60)
+        static_sum = run_side(static)
+    finally:
+        static.close()
+
+    n_peak = max([n for _a, n in actions] or [1])
+    return {
+        "seed": seed,
+        "shape": schedule.shape,
+        "closed_loop_qps": round(closed_qps, 2),
+        "low_qps": round(low, 2), "high_qps": round(high, 2),
+        "n_load": n_load,
+        "slo_ms": round(slo_ms, 1),
+        "max_replicas": max_replicas,
+        "auto": auto,
+        "static": static_sum,
+        "actions": actions,
+        "n_peak_replicas": n_peak,
+        "scaled_up": any(a == "up" for a, _n in actions),
+        "scaled_down": any(a == "down" for a, _n in actions),
+        "p95_under_slo": (auto["qwait_p95_ms"] is not None
+                          and auto["qwait_p95_ms"] <= slo_ms),
+        "replica_seconds_vs_static": (
+            round(auto["replica_seconds"] /
+                  static_sum["replica_seconds"], 3)
+            if static_sum["replica_seconds"] else None),
+    }
+
+
+def rolling_swap_protocol(smoke=False, seed=37, n_replicas=3):
+    """Rolling-swap-under-traffic coherence: the replica set's
+    drain -> swap -> re-probe roll under a concurrent submit stream.
+
+    Same bucket-pinned bit-consistency discipline as
+    :func:`swap_protocol`, lifted to N shared-nothing replicas: a
+    submitter thread streams requests through the balancer while the
+    main thread performs ONE rolling ``swap_params``.  Acceptance:
+    ZERO failed requests (the drained replica's share rides the rest of
+    the rotation), every response bit-matches the old or the new
+    weights' reference forward (never a mix — coherent weight sets all
+    the way through the roll), and every live replica's store advanced
+    exactly one version."""
+    from .registry import ModelRegistry
+    from .replica_set import ReplicaSet
+
+    sym, args, pool, feat = _frontdoor_model(seed, feat=128, hidden=256)
+    rs = np.random.RandomState(seed + 7)
+    args2 = {k: np.asarray(v + rs.uniform(0.05, 0.1, v.shape),
+                           np.float32) for k, v in args.items()}
+    n_requests = 120 if smoke else 400
+    x = pool[0]
+
+    def build(_i):
+        reg = ModelRegistry()
+        # single batch bucket: every replica compiles the same program
+        # at the same geometry, so fp32 outputs are bit-comparable
+        # across replicas AND across the swap
+        reg.add_model("m", sym, {k: v.copy() for k, v in args.items()},
+                      {}, input_shapes={"data": (1, feat)},
+                      buckets=(1,), warmup=True)
+        return reg
+
+    rset = ReplicaSet(build, n_replicas=n_replicas, probe_interval=0.1,
+                      max_delay_ms=0)
+    try:
+        ref_old = np.asarray(rset.submit("m", data=x).result(60)[0])
+        futs = []
+        done = [0]
+        done_lock = threading.Lock()
+
+        def on_done(_f):
+            with done_lock:
+                done[0] += 1
+
+        swapped = threading.Event()
+
+        def submitter():
+            for i in range(n_requests):
+                if i == (2 * n_requests) // 3:
+                    swapped.wait(60)
+                f = rset.submit("m", data=x)
+                f.add_done_callback(on_done)
+                futs.append(f)
+                time.sleep(0.001)
+
+        t = threading.Thread(target=submitter,
+                             name="mxt-rollswap-submit")
+        t.start()
+        deadline = time.monotonic() + 60
+        while done[0] < n_requests // 3 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        versions = rset.swap_params("m", args2)
+        swapped.set()
+        t.join(60)
+        ref_new = np.asarray(rset.submit("m", data=x).result(60)[0])
+        counts = {"old": 0, "new": 0, "neither": 0, "failed": 0}
+        for f in futs:
+            try:
+                r = np.asarray(f.result(60)[0])
+            except Exception:  # noqa: BLE001 — the zero-failed gate
+                counts["failed"] += 1
+                continue
+            if np.array_equal(r, ref_old):
+                counts["old"] += 1
+            elif np.array_equal(r, ref_new):
+                counts["new"] += 1
+            else:
+                counts["neither"] += 1
+        stats = rset.stats()
+    finally:
+        rset.close()
+    return {
+        "seed": seed,
+        "n": n_requests,
+        "n_replicas": n_replicas,
+        "old": counts["old"], "new": counts["new"],
+        "neither": counts["neither"], "failed": counts["failed"],
+        "versions": versions,
+        "replicas_swapped": len(versions),
+        "retries": stats["retries"],
+    }
+
+
+def chaos_protocol(smoke=False, seed=41, n_replicas=3,
+                   offered_mult=1.5, recovery_slo_ms=2000.0):
+    """Multi-fault chaos campaign against the full serving stack:
+    ``HttpClient`` -> :class:`~.frontdoor.HttpFrontDoor` ->
+    autoscaled :class:`~.replica_set.ReplicaSet` -> engines.
+
+    One seeded faultinject schedule composes THREE faults at the
+    ``serve.dispatch`` seam mid-run: a ``straggler`` (two slow
+    dispatches), a ``die`` (SIGKILL of whichever replica serves the
+    targeted dispatch), and an ``error`` burst (two severed-connection
+    dispatches).  An :class:`~.controller.AutoScaler` rides along, so
+    the shed/utilization signals may replace the killed capacity.
+
+    Gates (``tools/chaos_campaign.py`` and ``make chaos-smoke`` enforce
+    them): every fault in the schedule fired; ZERO lost requests (every
+    accepted future resolved — structured sheds/timeouts are
+    resolutions); first post-kill completion inside ``recovery_slo_ms``;
+    and retried requests keep CONNECTED traces — with tracing at full
+    sampling, at least one exported trace carries the failed placement
+    AND the successful one under one trace id (a ``serve_retry`` span
+    next to a ``serve_dispatch`` span, or two or more
+    ``serve_dispatch`` spans when the failover re-dispatched) whenever
+    the balancer retried at all."""
+    import json as _json
+    import os
+    import tempfile
+
+    from .. import faultinject
+    from .. import tracing as tracing_mod
+    from .controller import AutoScaler
+    from .frontdoor import HttpClient, HttpFrontDoor
+    from .registry import ModelRegistry
+    from .replica_set import ReplicaSet
+
+    sym, args, pool, feat = _frontdoor_model(seed)
+    n_closed = 20 if smoke else 40
+    n_load = 150 if smoke else 400
+
+    def build(_i):
+        reg = ModelRegistry()
+        reg.add_model("m", sym, {k: v.copy() for k, v in args.items()},
+                      {}, input_shapes={"data": (1, feat)}, warmup=True)
+        return reg
+
+    sink = os.path.join(tempfile.mkdtemp(prefix="mxt_chaos_"),
+                        "traces.jsonl")
+    saved_sample = os.environ.pop("MXNET_TRACE_SAMPLE", None)
+    os.environ["MXNET_TRACE_SAMPLE"] = "1"
+    tracing_mod.set_jsonl_sink(sink)
+    rset = ReplicaSet(build, n_replicas=n_replicas, probe_interval=0.1,
+                      max_delay_ms=2.0, max_inflight=32)
+    scaler = AutoScaler(rset, slo_ms=200.0, min_replicas=n_replicas,
+                        max_replicas=n_replicas + 1, interval=0.1,
+                        cooldown=0.4, start=True)
+    door = HttpFrontDoor(rset)
+    client = HttpClient(door.address, threads=8)
+    kill_t = [None]
+    die_inner = rset._injected_die
+
+    def noting_die(meta):
+        if kill_t[0] is None:
+            kill_t[0] = time.perf_counter()
+        die_inner(meta)
+
+    try:
+        for _ in range(2):
+            client.submit("m", {"data": pool[0]}).result(60)
+        closed_qps = _engine_capacity(
+            lambda i: client.submit(
+                "m", {"data": pool[i % len(pool)]}).result(60), n_closed)
+        min_duration = 4.0 if smoke else 8.0
+        offered = min(closed_qps * float(offered_mult),
+                      n_load / min_duration)
+        schedule = OpenLoopSchedule(seed, n_load, offered, sizes=(1,))
+        # the composed fault schedule, in dispatch order: slow, kill,
+        # sever — one seeded spec, replayable byte-for-byte
+        faults = [
+            {"seam": "serve.dispatch", "kind": "forward",
+             "nth": max(2, int(n_load * 0.15)), "count": 2,
+             "action": "straggler", "seconds": 0.25},
+            {"seam": "serve.dispatch", "kind": "forward",
+             "nth": max(3, int(n_load * 0.35)), "action": "die"},
+            {"seam": "serve.dispatch", "kind": "forward",
+             "nth": max(4, int(n_load * 0.55)), "count": 2,
+             "action": "error"},
+        ]
+        plan = faultinject.install({"seed": seed, "rules": faults})
+        faultinject.register_die_handler("serve.dispatch", noting_die)
+        summary, records = run_loadgen(
+            lambda i, n: client.submit(
+                "m", {"data": pool[i % len(pool)]}, timeout=30.0),
+            schedule, fetch=True, return_records=True)
+        fired = list(plan.log)
+        stats = rset.stats()
+        live_after = rset.live_replicas()
+        actions = [(a, n) for _t, a, n in scaler.actions()]
+    finally:
+        faultinject.install(None)
+        faultinject.register_die_handler("serve.dispatch", None)
+        scaler.close()
+        client.close()
+        door.close()
+        rset.close()
+        tracing_mod.set_jsonl_sink(None)
+        if saved_sample is None:
+            os.environ.pop("MXNET_TRACE_SAMPLE", None)
+        else:
+            os.environ["MXNET_TRACE_SAMPLE"] = saved_sample
+
+    # trace connectivity: parse the JSONL sink; a retried request's
+    # placement attempts are spans of ONE trace — the failed attempt
+    # leaves a serve_retry span, the serving one a serve_dispatch span
+    # (a failover's re-dispatch leaves a second serve_dispatch)
+    traces = []
+    if os.path.exists(sink):
+        with open(sink) as f:
+            for line in f:
+                try:
+                    traces.append(_json.loads(line))
+                except ValueError:
+                    pass
+    http_traces = [t for t in traces if t.get("name") == "http.predict"]
+
+    def _connected_retry(t):
+        names = [s.get("name") for s in t.get("spans", [])]
+        dispatches = sum(1 for n in names if n == "serve_dispatch")
+        return dispatches >= 2 or (dispatches >= 1
+                                   and "serve_retry" in names)
+
+    multi_dispatch = [t for t in http_traces if _connected_retry(t)]
+    recovery_ms = None
+    if kill_t[0] is not None:
+        done_ts = sorted(t_sub + lat for status, lat, t_sub in
+                         (r for r in records if r) if status == "ok")
+        nxt = next((t for t in done_ts if t >= kill_t[0]), None)
+        if nxt is not None:
+            recovery_ms = round((nxt - kill_t[0]) * 1e3, 3)
+    fired_actions = sorted(a for _s, _k, _r, _sid, a in fired)
+    gates = {
+        "all_faults_fired": fired_actions == sorted(
+            f["action"] for f in faults for _ in range(f.get("count", 1))),
+        "zero_lost": summary["lost"] == 0,
+        "recovery_within_slo": (recovery_ms is not None
+                                and recovery_ms <= recovery_slo_ms),
+        "retry_traces_connected": (stats["retries"] == 0
+                                   or len(multi_dispatch) >= 1),
+    }
+    return {
+        "seed": seed,
+        "n_replicas": n_replicas,
+        "closed_loop_qps": round(closed_qps, 2),
+        "offered_mult": float(offered_mult),
+        "summary": summary,
+        "resolved": summary["ok"] + summary["timeouts"] +
+        summary["cancelled"] + summary["errors"] + summary["shed"] -
+        summary["lost"],
+        "faults_fired": fired,
+        "killed": kill_t[0] is not None,
+        "recovery_ms": recovery_ms,
+        "recovery_slo_ms": float(recovery_slo_ms),
+        "retries": stats["retries"],
+        "failovers": stats["failovers"],
+        "live_after": live_after,
+        "autoscale_actions": actions,
+        "traces_exported": len(traces),
+        "retried_traces_connected": len(multi_dispatch),
+        "gates": gates,
+        "passed": all(gates.values()),
     }

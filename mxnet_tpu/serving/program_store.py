@@ -417,6 +417,30 @@ class ProgramStore:
     def version(self):
         return self._version
 
+    def param_snapshot(self):
+        """Opaque handle to the live weight set, for
+        :meth:`restore_params`.  The rolling weight swap captures one
+        per replica before swapping so a failed re-probe can roll the
+        already-swapped replicas back to exactly the weights they
+        served (device-resident, already through the dtype pipeline)."""
+        params, aux, _ = self._live
+        return (params, aux)
+
+    def restore_params(self, snap):
+        """Atomically republish a :meth:`param_snapshot` — the
+        rolling-swap ABORT path.  No dtype pipeline and no signature
+        check (the snapshot came from this store).  Bumps the version
+        like any swap: versions stay monotonic even when the weights
+        roll back, so 'version changed' remains a reliable swap
+        witness."""
+        params, aux = snap
+        with self._lock:
+            self._params = dict(params)
+            self._aux = aux
+            self._version += 1
+            self._live = (self._params, self._aux, self._version)
+        return self._version
+
     # -- geometry ------------------------------------------------------
     @property
     def edges(self):
@@ -953,6 +977,20 @@ class GenerativeProgramStore:
 
     @property
     def version(self):
+        return self._version
+
+    def param_snapshot(self):
+        """Opaque live-weight handle for :meth:`restore_params` (same
+        contract as ``ProgramStore.param_snapshot``)."""
+        with self._lock:
+            return dict(self._params)
+
+    def restore_params(self, snap):
+        """Republish a :meth:`param_snapshot` (rolling-swap abort
+        path); bumps the version."""
+        with self._lock:
+            self._params = dict(snap)
+            self._version += 1
         return self._version
 
     def _required_params(self):

@@ -183,6 +183,32 @@ class ModelRegistry:
             return gstore.swap_params(arg_params)
         raise MXNetError("unknown serving model %r" % name)
 
+    def param_snapshot(self, name):
+        """Opaque handle to model ``name``'s live weight set (forward
+        or generative store), for :meth:`restore_params` — captured by
+        the replica set's rolling swap before each per-replica swap so
+        a failed re-probe can roll back."""
+        with self._lock:
+            store = self._stores.get(name)
+            gstore = self._gen_stores.get(name)
+        if store is not None:
+            return store.param_snapshot()
+        if gstore is not None:
+            return gstore.param_snapshot()
+        raise MXNetError("unknown serving model %r" % name)
+
+    def restore_params(self, name, snap):
+        """Republish a :meth:`param_snapshot` (rolling-swap abort
+        path).  Returns the new — still monotonic — version."""
+        with self._lock:
+            store = self._stores.get(name)
+            gstore = self._gen_stores.get(name)
+        if store is not None:
+            return store.restore_params(snap)
+        if gstore is not None:
+            return gstore.restore_params(snap)
+        raise MXNetError("unknown serving model %r" % name)
+
     def remove_model(self, name):
         with self._lock:
             if self._stores.pop(name, None) is None and \

@@ -36,9 +36,19 @@ training side's parameter servers already are.
   probe successes close it again (a transiently severed replica
   returns).
 
-Hot weight swap fans out: :meth:`ReplicaSet.swap_params` republishes the
-new weights on every live replica's registry (each replica's swap is
-atomic per request — see ``program_store.swap_params``).
+Hot weight swap ROLLS: :meth:`ReplicaSet.swap_params` republishes the
+new weights one replica at a time — take the replica out of rotation
+(while the others carry the traffic), drain its inflight requests, swap,
+re-probe, restore — with abort-and-rollback when a re-probe fails, so a
+bad weight set never takes more than one replica out.  Each replica's
+store-level swap stays atomic per request (``program_store.swap_params``),
+which is what lets a one-replica set swap in place.
+
+The set is ELASTIC: :meth:`ReplicaSet.add_replica` /
+:meth:`ReplicaSet.remove_replica` grow and shrink it under traffic
+(replica indices are monotonic and never reused), which is the actuator
+arm of the serving autoscaler (``serving/controller.py``) —
+:meth:`ReplicaSet.load_signals` is its sensor arm.
 
 Admission control composes: each replica's engine sheds with
 :class:`~.scheduler.ServeOverloaded` at its ``MXNET_SERVE_MAX_INFLIGHT``
@@ -94,17 +104,24 @@ class Replica:
     GenerationEngine over the same registry."""
 
     def __init__(self, index, registry, gen=False, max_delay_ms=None,
-                 max_batch=None, max_inflight=None, breaker=None):
+                 max_batch=None, max_inflight=None, breaker=None,
+                 tenant_quotas=None):
         self.index = int(index)
         self.registry = registry
+        # owner_index: every ServeClosed this replica's engines mint
+        # names the replica, so the retry layer and flight recorder
+        # know exactly which replica died out from under a request
         self.engine = ServingEngine(registry, max_delay_ms=max_delay_ms,
                                     max_batch=max_batch,
-                                    max_inflight=max_inflight)
+                                    max_inflight=max_inflight,
+                                    owner_index=self.index,
+                                    tenant_quotas=tenant_quotas)
         self.gen_engine = None
         if gen:
             from .decode_engine import GenerationEngine
             self.gen_engine = GenerationEngine(
-                registry, max_inflight=max_inflight)
+                registry, max_inflight=max_inflight,
+                owner_index=self.index, tenant_quotas=tenant_quotas)
         if breaker is None:
             # default from the SERVING knobs — the shared
             # CircuitBreaker's own constructor defaults belong to the
@@ -114,6 +131,7 @@ class Replica:
                 reset_after=float(get_env("MXNET_SERVE_CB_RESET")))
         self.breaker = breaker
         self.alive = True
+        self.draining = False       # rolling swap: parked, not dead
         self.inflight = 0           # balancer-tracked, set-lock guarded
         self._life_lock = make_lock("serving.replica")
 
@@ -169,12 +187,22 @@ class ReplicaSet:
         ``MXNET_SERVE_PROBE_INTERVAL``.  ``<= 0`` disables the prober.
     max_delay_ms / max_batch / max_inflight :
         Passed through to every replica's engine(s).
+    spares : int, optional
+        Warm spare-registry pool size.  ``spares`` extra registries are
+        built (weights loaded, programs compiled) at construction;
+        :meth:`add_replica` joins one to the rotation WITHOUT compiling
+        on the caller's thread — the autoscaler's scale-up completes in
+        milliseconds instead of a weight-load.  :meth:`remove_replica`
+        recycles the drained registry back into the pool (up to
+        ``spares``), so a diurnal swing pays the build cost once.
+        Requires a callable ``build_registry``; spare builds see a
+        provisional index (the factory's index argument is advisory).
     """
 
     def __init__(self, build_registry, n_replicas=3, gen=False,
                  retries=None, backoff=None, cb_fails=None, cb_reset=None,
                  probe_interval=None, max_delay_ms=None, max_batch=None,
-                 max_inflight=None):
+                 max_inflight=None, tenant_quotas=None, spares=0):
         if retries is None:
             retries = int(get_env("MXNET_SERVE_RETRIES"))
         if backoff is None:
@@ -188,7 +216,19 @@ class ReplicaSet:
         self._retries = max(0, int(retries))
         self._backoff = max(0.0, float(backoff))
         self._probe_interval = float(probe_interval)
-        if isinstance(build_registry, (list, tuple)):
+        # the factory and engine knobs are KEPT: add_replica() builds
+        # new replicas from them (elastic sizing needs to reload the
+        # weights — replicas share nothing)
+        self._build = None if isinstance(build_registry, (list, tuple)) \
+            else build_registry
+        self._gen = bool(gen)
+        self._cb_fails = int(cb_fails)
+        self._cb_reset = float(cb_reset)
+        self._max_delay_ms = max_delay_ms
+        self._max_batch = max_batch
+        self._max_inflight = max_inflight
+        self._tenant_quotas = tenant_quotas
+        if self._build is None:
             registries = list(build_registry)
         else:
             registries = [build_registry(i) for i in range(n_replicas)]
@@ -198,12 +238,23 @@ class ReplicaSet:
             if not isinstance(reg, ModelRegistry):
                 raise MXNetError("replica %d: build_registry must yield "
                                  "a ModelRegistry, got %r" % (i, reg))
-        self._replicas = [
-            Replica(i, reg, gen=gen, max_delay_ms=max_delay_ms,
-                    max_batch=max_batch, max_inflight=max_inflight,
-                    breaker=CircuitBreaker(fail_threshold=cb_fails,
-                                           reset_after=cb_reset))
-            for i, reg in enumerate(registries)]
+        self._replicas = [self._new_replica(i, reg)
+                          for i, reg in enumerate(registries)]
+        # replica indices are monotonic and NEVER reused across
+        # grow/shrink: metrics labels, flight records and faultinject
+        # sid matches stay unambiguous over the set's whole life
+        self._next_index = len(registries)
+        self._spare_cap = max(0, int(spares))
+        if self._spare_cap and self._build is None:
+            raise MXNetError(
+                "a spare pool needs a callable build_registry "
+                "(spares are prebuilt from the factory)")
+        self._spares = [self._build(self._next_index + k)
+                        for k in range(self._spare_cap)]
+        for k, reg in enumerate(self._spares):
+            if not isinstance(reg, ModelRegistry):
+                raise MXNetError("spare %d: build_registry must yield "
+                                 "a ModelRegistry, got %r" % (k, reg))
         self._lock = make_lock("serving.replica_set")
         # counters live in the process metrics registry (labeled per
         # set); stats() reads THROUGH them.  Per-replica liveness and
@@ -231,6 +282,25 @@ class ReplicaSet:
                                             daemon=True)
             self._prober.start()
 
+    def _new_replica(self, index, reg):
+        return Replica(index, reg, gen=self._gen,
+                       max_delay_ms=self._max_delay_ms,
+                       max_batch=self._max_batch,
+                       max_inflight=self._max_inflight,
+                       tenant_quotas=self._tenant_quotas,
+                       breaker=CircuitBreaker(
+                           fail_threshold=self._cb_fails,
+                           reset_after=self._cb_reset))
+
+    def _replica(self, index):
+        """Replica by its STABLE index (not list position — grow/shrink
+        reorders the list); None when no such replica remains."""
+        with self._lock:
+            for r in self._replicas:
+                if r.index == index:
+                    return r
+        return None
+
     def _note_breaker(self, r):
         """Publish one replica's breaker state + liveness as gauges
         (called on probe sweeps and failure transitions — the scrape's
@@ -257,8 +327,8 @@ class ReplicaSet:
     # -- faultinject ---------------------------------------------------
     def _injected_die(self, meta):
         sid = meta.get("sid")
-        if sid is not None and 0 <= int(sid) < len(self._replicas):
-            r = self._replicas[int(sid)]
+        r = self._replica(int(sid)) if sid is not None else None
+        if r is not None:
             was_alive = r.alive
             r.kill()
             if was_alive:
@@ -275,7 +345,8 @@ class ReplicaSet:
         with self._lock:
             order = sorted(
                 (r for r in self._replicas
-                 if r.alive and r.index not in excluded),
+                 if r.alive and not r.draining
+                 and r.index not in excluded),
                 key=lambda r: (r.inflight, r.index))
         for r in order:
             if r.breaker.allow():
@@ -296,19 +367,131 @@ class ReplicaSet:
     def kill_replica(self, index):
         """Kill one replica (tests / chaos drills); the balancer
         converges to the survivors within one probe interval."""
-        r = self._replicas[index]
+        r = self._replica(index)
+        if r is None:
+            raise MXNetError("no replica with index %r" % (index,))
         was_alive = r.alive
         r.kill()
         if was_alive:
             self._note_death(r.index, "kill_replica")
             self._note_breaker(r)
 
+    # -- elastic sizing ------------------------------------------------
+    def add_replica(self):
+        """Grow the set by one replica (the autoscaler's scale-up arm):
+        take a registry from the warm spare pool if one is ready,
+        otherwise build a fresh one from the constructor's factory —
+        loading its OWN weight copy, outside the set lock — and join it
+        to the rotation.  Returns the new replica's index (monotonic,
+        never reused)."""
+        if self._build is None:
+            raise MXNetError(
+                "this ReplicaSet was built from a fixed registry list; "
+                "pass a callable build_registry to allow growth")
+        with self._lock:
+            if self._closed:
+                raise ServeClosed("replica set is closed")
+            index = self._next_index
+            self._next_index += 1
+            reg = self._spares.pop() if self._spares else None
+        from_pool = reg is not None
+        if reg is None:
+            reg = self._build(index)
+            if not isinstance(reg, ModelRegistry):
+                raise MXNetError("replica %d: build_registry must yield "
+                                 "a ModelRegistry, got %r" % (index, reg))
+        r = self._new_replica(index, reg)
+        with self._lock:
+            closed = self._closed
+            if not closed:
+                self._replicas.append(r)
+        if closed:
+            # close() raced the build: never leak a running replica
+            r.close(drain=False)
+            raise ServeClosed("replica set is closed")
+        self._note_breaker(r)
+        _tracing.flight().record(
+            "replica_added", "replica %d joined" % index, sid=index,
+            from_pool=from_pool, live=self.live_replicas())
+        return index
+
+    def remove_replica(self, index=None, drain=True):
+        """Shrink the set by one replica (the autoscaler's scale-down
+        arm): take it out of rotation, then close it — draining its
+        inflight requests by default, so scale-down under traffic loses
+        nothing.  ``index=None`` removes the youngest live replica.
+        The LAST replica is never removable.  Returns the removed
+        index."""
+        with self._lock:
+            if len(self._replicas) <= 1:
+                raise MXNetError(
+                    "cannot remove the last replica of the set")
+            if index is None:
+                live = [r for r in self._replicas if r.alive]
+                victim = max(live or self._replicas,
+                             key=lambda r: r.index)
+            else:
+                victim = next((r for r in self._replicas
+                               if r.index == index), None)
+                if victim is None:
+                    raise MXNetError("no replica with index %r"
+                                     % (index,))
+            # out of the list first: _pick stops routing to it before
+            # the (possibly slow) drain below
+            self._replicas.remove(victim)
+            was_alive = victim.alive
+        victim.close(drain=drain)
+        # a cleanly drained registry goes back into the warm pool (a
+        # KILLED replica's does not — its death is the point); the next
+        # scale-up reuses the loaded weights and compiled programs
+        with self._lock:
+            if (was_alive and not self._closed
+                    and len(self._spares) < self._spare_cap):
+                self._spares.append(victim.registry)
+        # retire the removed replica's gauges; its index is never
+        # reused, so a stale series would claim a replica that cannot
+        # come back
+        _metrics.drop(dict(self._mlabels, replica=str(victim.index)))
+        _tracing.flight().record(
+            "replica_removed", "replica %d left" % victim.index,
+            sid=victim.index, live=self.live_replicas())
+        return victim.index
+
+    def n_replicas(self):
+        with self._lock:
+            return len(self._replicas)
+
+    def load_signals(self):
+        """One sample of the sensor signals the autoscaler ticks on:
+        replica counts, total balancer-tracked inflight, the aggregate
+        inflight capacity (None when any engine is unbounded) and the
+        cumulative shed count (set-level surfaced sheds plus every
+        replica engine's admission sheds — the controller windows the
+        deltas)."""
+        with self._lock:
+            live = [r for r in self._replicas
+                    if r.alive and not r.draining]
+            n_replicas = len(self._replicas)
+            n_spares = len(self._spares)
+            inflight = sum(r.inflight for r in live)
+        caps = [r.engine._max_inflight for r in live]
+        capacity = sum(caps) if caps and all(caps) else None
+        shed = self._stats.as_dict().get("shed", 0)
+        for r in live:
+            shed += r.engine._stats.as_dict().get("shed", 0)
+        return {"n_replicas": n_replicas, "n_live": len(live),
+                "n_spares": n_spares, "inflight": inflight,
+                "capacity": capacity, "shed_total": shed}
+
     # -- forward requests ----------------------------------------------
-    def submit(self, model, timeout=None, **inputs):
+    def submit(self, model, timeout=None, priority=None, tenant=None,
+               **inputs):
         """Balanced forward submit; returns a Future resolving to the
         output arrays.  ``timeout`` is the END-TO-END deadline: it
         propagates into each attempt's queue budget and bounds the
-        whole retry chain."""
+        whole retry chain.  ``priority`` / ``tenant`` ride through to
+        the chosen replica's engine admission (tier preemption and
+        per-tenant quotas — ``scheduler.ServingEngine.submit``)."""
         fut = Future()
         # trace context: captured here (an HTTP ingress trace, or a
         # fresh mint for bare in-process callers) and re-activated by
@@ -324,6 +507,7 @@ class ReplicaSet:
             "deadline": (time.monotonic() + timeout
                          if timeout is not None else None),
             "attempt": 0, "excluded": set(), "last_exc": None,
+            "priority": priority, "tenant": tenant,
             "trace": ctx[0], "trace_parent": ctx[1],
         }
         if owned is not None:
@@ -346,6 +530,7 @@ class ReplicaSet:
     def _dispatch_traced(self, state):
         t0 = time.perf_counter_ns()
         while True:
+            t_att = time.perf_counter_ns()
             if state["deadline"] is not None \
                     and time.monotonic() > state["deadline"]:
                 self._resolve(state["future"], exc=ServeTimeout(
@@ -366,6 +551,8 @@ class ReplicaSet:
                     remaining = max(0.0,
                                     state["deadline"] - time.monotonic())
                 inner = r.engine.submit(state["model"], timeout=remaining,
+                                        priority=state["priority"],
+                                        tenant=state["tenant"],
                                         **state["inputs"])
             except ServeOverloaded as e:
                 # this replica is at budget — others may have room.
@@ -382,6 +569,10 @@ class ReplicaSet:
                 self._note_breaker(r)
                 state["excluded"].add(r.index)
                 state["last_exc"] = e
+                # the failed attempt leaves a span in the request's
+                # trace (we are inside its activation): a retried
+                # request's trace shows every placement it tried
+                _profiler.record_phase("serve_retry", t_att)
                 if not self._schedule_retry(state):
                     return
                 continue
@@ -572,7 +763,9 @@ class ReplicaSet:
         see ``kind='probe'`` events — and the engine's ``alive()``
         (dispatch loop running, accepting submits) is the liveness
         witness; failures open the breaker, successes close it."""
-        for r in self._replicas:
+        with self._lock:
+            replicas = list(self._replicas)
+        for r in replicas:
             try:
                 faultinject.hook(SEAM, kind="probe", sid=r.index)
                 if not r.alive:
@@ -593,28 +786,138 @@ class ReplicaSet:
             self._note_breaker(r)
 
     # -- management ----------------------------------------------------
-    def swap_params(self, name, arg_params, aux_params=None):
-        """Fan the hot weight swap out to every LIVE replica's registry.
-        Each replica's swap is atomic per request; returns
-        {replica_index: new_version}."""
+    def swap_params(self, name, arg_params, aux_params=None, rate=None,
+                    drain_timeout=None):
+        """Zero-downtime ROLLING hot weight swap.
+
+        One live replica at a time: take it out of rotation (only while
+        the others can carry the traffic — a one-replica set swaps in
+        place, the store swap is atomic per dispatch), wait up to
+        ``drain_timeout`` seconds (``MXNET_SERVE_SWAP_DRAIN_S``) for its
+        inflight requests to finish, swap its registry, re-probe it
+        (the ``serve.dispatch`` seam with ``kind='swap_probe'`` plus an
+        engine liveness check), restore it to rotation, then pause
+        ``rate`` seconds (``MXNET_SERVE_SWAP_RATE``) before the next
+        replica.  A failed re-probe ABORTS the roll: every
+        already-swapped replica is rolled back to the exact weight set
+        it served (``registry.restore_params``) and the abort raises —
+        a bad weight push never takes out more than the replica it was
+        probed on.
+
+        Traffic during the roll sees only coherent weight sets — old or
+        new, never a mix — and never fails for the roll's sake: the
+        drained replica's share is carried by the rest of the rotation.
+        Returns ``{replica_index: new_version}`` over the replicas that
+        were live when the roll started (ones that die mid-roll are
+        skipped); raises :class:`NoLiveReplicas` when there is nothing
+        to swap."""
+        if rate is None:
+            rate = float(get_env("MXNET_SERVE_SWAP_RATE"))
+        if drain_timeout is None:
+            drain_timeout = float(get_env("MXNET_SERVE_SWAP_DRAIN_S"))
+        with self._lock:
+            targets = [r for r in self._replicas if r.alive]
+        if not targets:
+            raise NoLiveReplicas("no live replica to swap %r on" % name)
+        fl = _tracing.flight()
         out = {}
-        for r in self._replicas:
-            if r.alive:
+        swapped = []   # (replica, pre-swap snapshot), for rollback
+        for pos, r in enumerate(targets):
+            if not r.alive:
+                continue   # died mid-roll: the prober's problem, not ours
+            with self._lock:
+                # park only while another replica can serve: _pick
+                # skips draining replicas, so parking the sole survivor
+                # would fail traffic instead of protecting it
+                r.draining = any(o.alive and not o.draining
+                                 and o is not r for o in self._replicas)
+            try:
+                if r.draining:
+                    deadline = time.monotonic() + max(0.0, drain_timeout)
+                    while time.monotonic() < deadline:
+                        with self._lock:
+                            busy = r.inflight
+                        if not busy:
+                            break
+                        time.sleep(0.001)
+                snap = r.registry.param_snapshot(name)
                 out[r.index] = r.registry.swap_params(name, arg_params,
                                                       aux_params)
+                swapped.append((r, snap))
+                self._reprobe(r)
+            except BaseException as e:  # noqa: BLE001 — abort the roll
+                with self._lock:
+                    r.draining = False
+                self._rollback_swap(name, swapped)
+                fl.record("swap_aborted", "rolling swap of %r" % name,
+                          sid=r.index, error=repr(e),
+                          rolled_back=[x.index for x, _ in swapped])
+                raise MXNetError(
+                    "rolling swap of %r aborted at replica %d (%r); "
+                    "every swapped replica was rolled back to the old "
+                    "weights" % (name, r.index, e)) from e
+            with self._lock:
+                r.draining = False
+            fl.record("swap_rolled", "replica %d -> v%s"
+                      % (r.index, out[r.index]), sid=r.index)
+            if rate > 0 and pos + 1 < len(targets):
+                time.sleep(rate)
         if not out:
             raise NoLiveReplicas("no live replica to swap %r on" % name)
+        # the warm pool must follow the roll: a spare joining the
+        # rotation AFTER a successful swap would otherwise serve the
+        # old weights.  Spares have nothing in flight, so this is a
+        # plain publish (best-effort — a spare that cannot take the
+        # weights is dropped from the pool rather than served stale).
+        with self._lock:
+            spares = list(self._spares)
+        for sreg in spares:
+            try:
+                sreg.swap_params(name, arg_params, aux_params)
+            except BaseException as e:  # noqa: BLE001
+                with self._lock:
+                    if sreg in self._spares:
+                        self._spares.remove(sreg)
+                fl.record("swap_spare_dropped",
+                          "spare registry dropped on swap failure",
+                          error=repr(e))
         return out
+
+    def _reprobe(self, r):
+        """Post-swap readiness gate: the swap seam event (seeded
+        schedules fail it deterministically) plus the same liveness
+        witness the prober uses."""
+        faultinject.hook(SEAM, kind="swap_probe", sid=r.index)
+        if not r.alive or not r.engine.alive():
+            raise ReplicaDied("replica %d failed its post-swap re-probe"
+                              % r.index)
+        r.breaker.record_success()
+
+    def _rollback_swap(self, name, swapped):
+        """Abort path: republish each swapped replica's pre-swap
+        snapshot, newest first.  Best-effort per replica — a replica
+        that died after its swap has nothing to roll back."""
+        for r, snap in reversed(swapped):
+            if not r.alive:
+                continue
+            try:
+                r.registry.restore_params(name, snap)
+            except BaseException as e:  # noqa: BLE001 — keep rolling back
+                _tracing.flight().record(
+                    "swap_rollback_failed", "replica %d" % r.index,
+                    sid=r.index, error=repr(e))
 
     def stats(self):
         out = self._stats.as_dict()
         with self._lock:
-            inflight = {r.index: r.inflight for r in self._replicas}
+            replicas = list(self._replicas)
+            inflight = {r.index: r.inflight for r in replicas}
         out["replicas"] = {
             r.index: {"alive": r.alive, "breaker": r.breaker.state,
+                      "draining": r.draining,
                       "inflight": inflight[r.index],
                       "engine": r.engine.stats()}
-            for r in self._replicas}
+            for r in replicas}
         out["live"] = self.live_replicas()
         return out
 
@@ -633,7 +936,10 @@ class ReplicaSet:
         # handler exists to avoid)
         if faultinject.die_handler(SEAM) is self._injected_die:
             faultinject.register_die_handler(SEAM, None)
-        for r in self._replicas:
+        with self._lock:
+            replicas = list(self._replicas)
+            self._spares = []   # registries only — nothing to join
+        for r in replicas:
             r.close(drain=drain)
         # retire this set's labeled series (incl. per-replica gauges)
         _metrics.drop(self._mlabels)

@@ -60,7 +60,7 @@ _H_BATCH = _metrics.histogram(
     help="rows coalesced into one serving dispatch")
 
 __all__ = ["ServingEngine", "ServeRequest", "ServeTimeout", "ServeClosed",
-           "ServeOverloaded", "FutureCompleter"]
+           "ServeOverloaded", "FutureCompleter", "TIERS"]
 
 _STOP = object()
 
@@ -132,7 +132,18 @@ class ServeTimeout(MXNetError):
 
 
 class ServeClosed(MXNetError):
-    """The engine is shut down (or shutting down without drain)."""
+    """The engine is shut down (or shutting down without drain).
+
+    ``replica_index`` names the owning replica when the engine belongs
+    to a :class:`~.replica_set.ReplicaSet` (``None`` for bare engines):
+    the flight recorder and the replica set's retry layer both want to
+    know WHICH replica died out from under an in-flight request."""
+
+    def __init__(self, msg, replica_index=None):
+        if replica_index is not None:
+            msg = "%s [replica %d]" % (msg, int(replica_index))
+        super().__init__(msg)
+        self.replica_index = replica_index
 
 
 class ServeOverloaded(MXNetError):
@@ -142,19 +153,29 @@ class ServeOverloaded(MXNetError):
     collapse; clients should back off and retry."""
 
 
+# Admission priority tiers, highest first.  "latency" requests preempt
+# "batch" ones at bucket formation (the engine serves the oldest parked
+# latency request before any batch request); FIFO order holds WITHIN a
+# (model, tier) stream, never across tiers.
+TIERS = ("latency", "batch")
+
+
 class ServeRequest:
     """One queued inference request (internal; clients hold the Future)."""
 
     __slots__ = ("model", "inputs", "n", "future", "deadline", "t_submit",
-                 "trace", "trace_parent")
+                 "priority", "tenant", "trace", "trace_parent")
 
-    def __init__(self, model, inputs, n, future, deadline, t_submit):
+    def __init__(self, model, inputs, n, future, deadline, t_submit,
+                 priority="batch", tenant=None):
         self.model = model
         self.inputs = inputs      # dict name -> np.ndarray (canonical)
         self.n = n                # rows
         self.future = future
         self.deadline = deadline  # monotonic seconds, or None
         self.t_submit = t_submit
+        self.priority = priority  # one of TIERS
+        self.tenant = tenant      # quota/metrics key, or None
         # the request's trace context, captured on the submitting
         # thread (tracing.current_context) and re-activated by the
         # engine thread around its dispatch — the cross-thread span
@@ -174,8 +195,18 @@ class ServingEngine:
     """
 
     def __init__(self, registry, max_delay_ms=None, max_batch=None,
-                 max_inflight=None):
+                 max_inflight=None, owner_index=None, tenant_quotas=None):
         self._registry = registry
+        # which ReplicaSet replica owns this engine (None = bare): every
+        # ServeClosed the engine mints carries it, so the retry layer
+        # and the flight recorder know which replica failed the request
+        self._owner_index = owner_index
+        # per-tenant admission quotas: tenant id -> max inflight ROWS
+        # for that tenant; a submit that would exceed its tenant's
+        # budget is shed alone — the noisy tenant backs off, everyone
+        # else keeps being served
+        self._tenant_quotas = dict(tenant_quotas or {})
+        self._tenant_rows = {}
         if max_delay_ms is None:
             max_delay_ms = float(get_env("MXNET_SERVE_MAX_DELAY_MS"))
         self._max_delay = max(0.0, float(max_delay_ms)) / 1e3
@@ -212,8 +243,12 @@ class ServingEngine:
                                         name="mxt-serve", daemon=True)
         self._thread.start()
 
+    def _closed_exc(self, msg):
+        return ServeClosed(msg, replica_index=self._owner_index)
+
     # -- client side ---------------------------------------------------
-    def submit(self, model, timeout=None, **inputs):
+    def submit(self, model, timeout=None, priority=None, tenant=None,
+               **inputs):
         """Enqueue one request; returns its Future.
 
         ``timeout`` (seconds) bounds time-in-queue: an expired request
@@ -228,18 +263,31 @@ class ServingEngine:
         — under sustained overload the queue would otherwise grow
         without bound and every request would time out (the loadgen's
         collapse phase); shedding keeps the accepted requests' latency
-        flat and gives clients a structured back-off signal."""
+        flat and gives clients a structured back-off signal.
+
+        ``priority`` ("latency" or "batch", default "batch") picks the
+        admission tier: latency requests preempt batch requests at
+        bucket formation.  ``tenant`` names the submitting tenant for
+        quota accounting and per-tenant metrics; with a quota
+        configured (constructor ``tenant_quotas``), a tenant over its
+        inflight-row budget is shed alone with
+        :class:`ServeOverloaded`."""
         if self._closed:
             # cheap early gate so EVERY post-close submit raises
             # ServeClosed — not a validation error about its payload
-            raise ServeClosed("serving engine is closed")
+            raise self._closed_exc("serving engine is closed")
+        priority = "batch" if priority is None else str(priority)
+        if priority not in TIERS:
+            raise MXNetError("unknown priority tier %r (want one of %s)"
+                             % (priority, "/".join(TIERS)))
+        tenant = None if tenant is None else str(tenant)
         store = self._registry.store(model)
         canon, n = store.canon_inputs(inputs)
         fut = Future()
         now = time.monotonic()
         req = ServeRequest(model, canon, n, fut,
                            now + timeout if timeout is not None else None,
-                           now)
+                           now, priority=priority, tenant=tenant)
         # trace context: an ingress trace already active on this thread
         # (HTTP handler, replica-set dispatch) is captured onto the
         # request; a bare in-process submit mints its own and finishes
@@ -253,7 +301,7 @@ class ServingEngine:
         try:
             with self._submit_lock:
                 if self._closed:
-                    raise ServeClosed("serving engine is closed")
+                    raise self._closed_exc("serving engine is closed")
                 if self._max_inflight \
                         and self._inflight >= self._max_inflight:
                     self._stats.inc("shed")
@@ -261,7 +309,25 @@ class ServingEngine:
                         "serving engine is at its inflight budget (%d); "
                         "request shed — back off and retry"
                         % self._max_inflight)
+                quota = self._tenant_quotas.get(tenant) \
+                    if tenant is not None else None
+                if quota is not None \
+                        and self._tenant_rows.get(tenant, 0) + n > quota:
+                    # the noisy tenant sheds alone: everyone else's
+                    # admission is untouched
+                    self._stats.inc("shed")
+                    _metrics.cached_counter(
+                        "serve_tenant_shed_total",
+                        labels={"tenant": tenant},
+                        help="requests shed by per-tenant quota").inc()
+                    raise ServeOverloaded(
+                        "tenant %r is over its inflight row quota (%d); "
+                        "request shed — back off and retry"
+                        % (tenant, quota))
                 self._inflight += 1
+                if tenant is not None:
+                    self._tenant_rows[tenant] = \
+                        self._tenant_rows.get(tenant, 0) + n
                 self._g_inflight.set(self._inflight)
                 self._queue.put(req)
         except (ServeClosed, ServeOverloaded) as e:
@@ -274,18 +340,32 @@ class ServingEngine:
             raise
         # exactly one resolution per accepted request (result, error or
         # cancel) ends its inflight accounting
-        fut.add_done_callback(self._note_resolved)
+        fut.add_done_callback(
+            lambda f, t=tenant, rows=n: self._note_resolved(t, rows))
         if _metrics.phase_on():
             fut.add_done_callback(
                 lambda f, t=now: _H_LATENCY.observe(time.monotonic() - t))
         if owned is not None:
             fut.add_done_callback(_tracing.finish_on_done(owned))
         self._stats.inc("requests")
+        _metrics.cached_counter(
+            "serve_tier_requests_total", labels={"tier": priority},
+            help="forward requests accepted, by priority tier").inc()
+        if tenant is not None:
+            _metrics.cached_counter(
+                "serve_tenant_requests_total", labels={"tenant": tenant},
+                help="forward requests accepted, by tenant").inc()
         return fut
 
-    def _note_resolved(self, _fut):
+    def _note_resolved(self, tenant, rows):
         with self._submit_lock:
             self._inflight -= 1
+            if tenant is not None:
+                left = self._tenant_rows.get(tenant, 0) - rows
+                if left > 0:
+                    self._tenant_rows[tenant] = left
+                else:
+                    self._tenant_rows.pop(tenant, None)
             self._g_inflight.set(self._inflight)
 
     def alive(self):
@@ -303,7 +383,9 @@ class ServingEngine:
             out["max_rows_in_batch"] = self._max_rows
         with self._submit_lock:
             out["inflight"] = self._inflight
+            out["tenant_rows"] = dict(self._tenant_rows)
         out["max_inflight"] = self._max_inflight
+        out["tenant_quotas"] = dict(self._tenant_quotas)
         out["models"] = self._registry.stats()
         rollup = {}
         for m in out["models"].values():
@@ -378,7 +460,7 @@ class ServingEngine:
         for r in inflight:
             # double-resolution of an already-served request is
             # harmless: the completer swallows InvalidStateError
-            self._resolve(r.future, exc=ServeClosed(
+            self._resolve(r.future, exc=self._closed_exc(
                 "serving engine dispatch loop exited before this "
                 "request could be served"))
         while True:
@@ -391,7 +473,7 @@ class ServingEngine:
                     return
             if head is _STOP:
                 continue
-            self._resolve(head.future, exc=ServeClosed(
+            self._resolve(head.future, exc=self._closed_exc(
                 "serving engine dispatch loop exited before this "
                 "request could be served"))
 
@@ -415,7 +497,7 @@ class ServingEngine:
         if self._closed and not getattr(self, "_drain_on_stop", True):
             # close(drain=False): queued work ahead of the STOP
             # sentinel fails fast instead of being served out
-            self._resolve(head.future, exc=ServeClosed(
+            self._resolve(head.future, exc=self._closed_exc(
                 "serving engine closed before dispatch"))
             self._inflight_reqs = ()
             return True
@@ -428,7 +510,7 @@ class ServingEngine:
             # fail-fast semantics apply to the whole collected batch,
             # not just heads taken after the flag flipped
             for r in reqs:
-                self._resolve(r.future, exc=ServeClosed(
+                self._resolve(r.future, exc=self._closed_exc(
                     "serving engine closed before dispatch"))
         else:
             self._dispatch_batch(head.model, reqs, rows)
@@ -439,8 +521,31 @@ class ServingEngine:
         return True
 
     def _take(self):
-        """Next request: pending deque first (oldest parked), else block
-        on the queue (close() unblocks via the _STOP sentinel)."""
+        """Next request, latency tier first.
+
+        New arrivals are drained behind the parked set (preserving
+        arrival order), then the OLDEST latency-tier request anywhere in
+        the backlog is served before any batch-tier request: latency
+        traffic preempts batch traffic at bucket formation instead of
+        queueing behind it.  FIFO order still holds within each
+        (model, tier) stream.  With no backlog, block on the queue
+        (close() unblocks via the _STOP sentinel)."""
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                # re-queue the sentinel: nothing can be submitted after
+                # close() latched, so it stays last and the drained
+                # backlog is served out first
+                self._queue.put(item)
+                break
+            self._pending.append(item)
+        for i, r in enumerate(self._pending):
+            if r.priority == TIERS[0]:
+                del self._pending[i]
+                return r
         if self._pending:
             return self._pending.popleft()
         return self._queue.get()
@@ -456,22 +561,25 @@ class ServingEngine:
             return [], 0, False
         reqs = [head]
         rows = head.n
-        # same-model requests already parked keep their arrival order;
-        # once one doesn't fit, NOTHING younger of that model may join
-        # past it (everything later in pending — and everything still in
-        # the queue — is younger), or batches would reorder the
-        # per-model FIFO
+        # batches never mix models OR tiers: a latency bucket stays
+        # small and dispatches on its own clock instead of absorbing
+        # batch-tier rows.  Within the head's (model, tier) stream,
+        # parked requests keep their arrival order; once one doesn't
+        # fit, NOTHING younger of that stream may join past it
+        # (everything later in pending — and everything still in the
+        # queue — is younger), or batches would reorder the stream FIFO
+        stream = (head.model, head.priority)
         keep = collections.deque()
         blocked = False
         while self._pending:
             r = self._pending.popleft()
-            if r.model == head.model and not blocked \
+            if (r.model, r.priority) == stream and not blocked \
                     and rows + r.n <= cap and rows < cap:
                 reqs.append(r)
                 rows += r.n
             else:
                 keep.append(r)
-                if r.model == head.model:
+                if (r.model, r.priority) == stream:
                     blocked = True
         self._pending = keep
         if blocked:
@@ -496,13 +604,14 @@ class ServingEngine:
             if item is _STOP:
                 stop = True
                 break
-            if item.model == head.model and rows + item.n <= cap:
+            if (item.model, item.priority) == stream \
+                    and rows + item.n <= cap:
                 reqs.append(item)
                 rows += item.n
             else:
                 self._pending.append(item)
-                if item.model == head.model:
-                    break  # same model but over cap: flush now
+                if (item.model, item.priority) == stream:
+                    break  # same stream but over cap: flush now
         return reqs, rows, stop
 
     @hot_path
@@ -601,7 +710,7 @@ class ServingEngine:
             if head is _STOP:
                 continue
             if not drain:
-                self._resolve(head.future, exc=ServeClosed(
+                self._resolve(head.future, exc=self._closed_exc(
                     "serving engine closed before dispatch"))
                 continue
             self._inflight_reqs = (head,)
@@ -621,19 +730,20 @@ class ServingEngine:
             return [], 0, False
         reqs = [head]
         rows = head.n
+        stream = (head.model, head.priority)
         keep = collections.deque()
-        # same FIFO discipline as _collect: a same-model request that
+        # same FIFO discipline as _collect: a same-stream request that
         # didn't fit blocks every younger one from joining this batch
         blocked = False
         while self._pending:
             r = self._pending.popleft()
-            if r.model == head.model and not blocked \
+            if (r.model, r.priority) == stream and not blocked \
                     and rows + r.n <= cap:
                 reqs.append(r)
                 rows += r.n
             else:
                 keep.append(r)
-                if r.model == head.model:
+                if (r.model, r.priority) == stream:
                     blocked = True
         while True:
             try:
@@ -642,13 +752,13 @@ class ServingEngine:
                 break
             if item is _STOP:
                 continue
-            if item.model == head.model and not blocked \
+            if (item.model, item.priority) == stream and not blocked \
                     and rows + item.n <= cap:
                 reqs.append(item)
                 rows += item.n
             else:
                 keep.append(item)
-                if item.model == head.model:
+                if (item.model, item.priority) == stream:
                     blocked = True
         self._pending = keep
         return reqs, rows, False

@@ -688,3 +688,40 @@ def test_straggler_actually_sleeps():
     assert faultinject.hook("server.recv", kind="push") is None
     assert time.perf_counter() - t0 >= 0.05
     faultinject.install(None)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the elastic-PS rebalance load signal (plumbing only)
+# ---------------------------------------------------------------------------
+def test_rebalance_signal_windows_per_server_load(monkeypatch):
+    """``rebalance_signal`` reads the per-server wire-byte series out
+    of the process metrics registry, WINDOWED per call, and names the
+    hot and cold server.  The policy stays manual: the test (the
+    driver) migrates the hot bucket itself and the next window flips
+    the signal to the new owner."""
+    cl = _Cluster(monkeypatch, n_workers=1, n_servers=2)
+    for srv in cl.servers:
+        srv._handle_command("async_mode", b"")
+    c = cl.client(plan_sizes=_BUCKET_KEYS)
+    for k, sz in _BUCKET_KEYS:
+        c.init(k, np.zeros(sz, np.float32))
+    src = c.server_for_bucket(0)
+    dst = 1 - src
+    c.rebalance_signal()               # arm the window
+    one = np.ones(SIZE, np.float32)
+    for _ in range(10):
+        for k, _sz in _BUCKET_KEYS:
+            c.push(k, one)
+    sig = c.rebalance_signal()
+    assert sig["total"] > 0
+    assert sig["hot"] == src and sig["cold"] == dst
+    assert sig["per_server"][dst] == 0
+    assert sig["imbalance"] is not None and sig["imbalance"] > 1.0
+    # act on the evidence (manually — the signal never migrates)
+    c.migrate_bucket(0, dst)
+    for _ in range(10):
+        for k, _sz in _BUCKET_KEYS:
+            c.push(k, one)
+    sig2 = c.rebalance_signal()
+    assert sig2["hot"] == dst and sig2["per_server"][src] == 0
+    cl.finalize()

@@ -1,0 +1,152 @@
+"""Serving control-plane chaos campaign: composed faults, one seed.
+
+Drives the full serving stack — HTTP front door -> autoscaled
+shared-nothing replicas -> continuous-batching engines — through the
+seeded control-plane protocols in ``mxnet_tpu.serving.loadgen``:
+
+* ``chaos`` — the composed multi-fault schedule: a straggler pair, a
+  replica SIGKILL and an injected-error pair at the ``serve.dispatch``
+  faultinject seam, all from ONE seeded spec, under open-loop load
+  with an AutoScaler attached and tracing at full sampling.
+  Gates: every scheduled fault fired; ZERO lost requests; the first
+  post-kill completion lands inside the recovery SLO; and every
+  retried request keeps a CONNECTED trace (its failed placement and
+  the attempt that served it are spans of one trace id).
+* ``autoscale`` — the SLO-driven autoscaler walks a replica set up a
+  seeded diurnal (and bursty) swing and back down.  Gates: it scaled
+  up AND back down, queue-wait p95 held under the capacity-relative
+  SLO, zero lost requests, and it spent FEWER replica-seconds than
+  static max-size provisioning over the same schedule.
+* ``swap`` — the zero-downtime rolling weight swap under a concurrent
+  submit stream.  Gates: zero failed requests, every response
+  bit-matches exactly one coherent weight set (old or new, never a
+  mix), every live replica's store advanced exactly one version.
+
+Deterministic: fault schedules, arrival times and request contents all
+derive from ``--seed`` (faultinject-style).  Exit 1 when any gate
+fails; ``--json`` dumps every scenario's full result dict.
+
+Usage::
+
+    python tools/chaos_campaign.py [--seed 41] [--full] [--json]
+        [--scenario all|chaos|autoscale|swap]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def run_chaos(args):
+    from mxnet_tpu.serving.loadgen import chaos_protocol
+    r = chaos_protocol(smoke=not args.full, seed=args.seed)
+    print("chaos (seed %d): %d requests, %d retries, recovery %sms "
+          "(slo %.0fms), %d traces, survivors %r"
+          % (r["seed"], r["summary"]["n"], r["retries"],
+             r["recovery_ms"], r["recovery_slo_ms"],
+             r["traces_exported"], r["live_after"]))
+    failures = ["chaos: gate %r failed" % g
+                for g, ok in sorted(r["gates"].items()) if not ok]
+    return r, failures
+
+
+def run_autoscale(args, shape):
+    from mxnet_tpu.serving.loadgen import autoscale_protocol
+    r = autoscale_protocol(smoke=not args.full, seed=args.seed,
+                           shape=shape)
+    print("autoscale/%s (seed %d): peak %d replicas, actions %r, "
+          "p95 %sms (slo %.0fms), replica-seconds %.2f vs static %.2f"
+          % (shape, r["seed"], r["n_peak_replicas"], r["actions"],
+             r["auto"]["qwait_p95_ms"], r["slo_ms"],
+             r["auto"]["replica_seconds"],
+             r["static"]["replica_seconds"]))
+    failures = []
+    if not r["scaled_up"]:
+        failures.append("never scaled up")
+    if not r["scaled_down"]:
+        failures.append("never scaled back down")
+    if not r["p95_under_slo"]:
+        failures.append("queue-wait p95 %sms blew the %.0fms SLO"
+                        % (r["auto"]["qwait_p95_ms"], r["slo_ms"]))
+    if r["auto"]["lost"]:
+        failures.append("%d lost requests" % r["auto"]["lost"])
+    ratio = r["replica_seconds_vs_static"]
+    if ratio is None or ratio >= 1.0:
+        failures.append("replica-seconds ratio %r not under static "
+                        "provisioning" % (ratio,))
+    return r, ["autoscale/%s: %s" % (shape, m) for m in failures]
+
+
+def run_swap(args):
+    from mxnet_tpu.serving.loadgen import rolling_swap_protocol
+    r = rolling_swap_protocol(smoke=not args.full, seed=args.seed)
+    print("rolling swap (seed %d): %d requests -> %d old + %d new, "
+          "%d torn, %d failed, %d replicas swapped"
+          % (r["seed"], r["n"], r["old"], r["new"], r["neither"],
+             r["failed"], r["replicas_swapped"]))
+    failures = []
+    if r["failed"]:
+        failures.append("%d requests failed during the roll"
+                        % r["failed"])
+    if r["neither"]:
+        failures.append("%d responses matched NEITHER weight set "
+                        "(torn read)" % r["neither"])
+    if r["old"] + r["new"] != r["n"]:
+        failures.append("accounting: %d old + %d new != %d requests"
+                        % (r["old"], r["new"], r["n"]))
+    if r["replicas_swapped"] != r["n_replicas"]:
+        failures.append("only %d of %d replicas swapped"
+                        % (r["replicas_swapped"], r["n_replicas"]))
+    if any(v != 2 for v in r["versions"].values()):
+        failures.append("store versions %r did not all advance to 2"
+                        % (r["versions"],))
+    return r, ["swap: %s" % m for m in failures]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=41)
+    p.add_argument("--full", action="store_true",
+                   help="full-length runs (CI uses smoke)")
+    p.add_argument("--json", action="store_true",
+                   help="dump every scenario's result dict")
+    p.add_argument("--scenario", default="all",
+                   choices=("all", "chaos", "autoscale", "swap"))
+    args = p.parse_args(argv)
+
+    results, failures = {}, []
+    if args.scenario in ("all", "chaos"):
+        results["chaos"], f = run_chaos(args)
+        failures += f
+    if args.scenario in ("all", "autoscale"):
+        for shape in ("diurnal", "bursty"):
+            results["autoscale_%s" % shape], f = run_autoscale(
+                args, shape)
+            failures += f
+    if args.scenario in ("all", "swap"):
+        results["swap"], f = run_swap(args)
+        failures += f
+
+    if args.json:
+        print(json.dumps(results, indent=1, default=str))
+    if failures:
+        print("chaos-campaign: FAIL")
+        for msg in failures:
+            print("  - " + msg)
+        return 1
+    print("chaos-campaign: all gates hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
