@@ -98,10 +98,11 @@ frontdoor-smoke:
 # bytes/slot), in-graph vs host sampling byte-identical streams and
 # the zero-logits-fetch pin
 decode-smoke:
-	timeout -k 10 600 env JAX_PLATFORMS=cpu \
+	timeout -k 10 1200 env JAX_PLATFORMS=cpu \
 		$(PY) -m pytest tests/test_decode_engine.py \
 		tests/test_paged_decode.py \
-		tests/test_quant_serving.py -q -m quick
+		tests/test_quant_serving.py \
+		tests/test_spec_decode.py -q -m quick
 
 # one-SPMD-step-program gate under 8 fake host devices: numerical
 # equivalence (dp8 vs single device, dp2xmp2 vs dp4, closed-form SGD),

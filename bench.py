@@ -1126,6 +1126,9 @@ _GEN_PROTOCOL_CACHE = {}
 # same for the paged-KV protocol's six sides / three banked rows
 _PAGED_PROTOCOL_CACHE = {}
 
+# and the speculative-decoding protocol's six sides / three banked rows
+_SPEC_PROTOCOL_CACHE = {}
+
 
 def bench_serving_decode_paged(which, chip, smoke=False):
     """Paged-KV decode rows: block-table attention + copy-on-write
@@ -1219,6 +1222,100 @@ def bench_serving_decode_paged(which, chip, smoke=False):
                      "with decode steps) vs one whole-prompt dispatch "
                      "— co-running streams' p99 inter-token latency "
                      "(acceptance: ratio < 1)"),
+        })
+    return row
+
+
+def bench_serving_decode_spec(which, chip, smoke=False):
+    """Speculative-decoding + int8-KV decode rows: a draft model
+    proposes K tokens per tick, the target verifies them in ONE
+    in-graph call (serving/loadgen.py spec_generation_protocol), same
+    weights, same seeded open-loop schedule as the non-speculative
+    denominator.  CPU-deterministic.  Acceptance: ``greedy`` and
+    ``sampled`` run <= 0.6x target steps per emitted token with the
+    draft-friendly draft; the protocol's adversarial side (banked on
+    every row) holds >= 0.95x base tokens/sec when acceptance
+    collapses (the MXNET_SERVE_SPEC=auto fallback); ``int8`` pins the
+    quantised KV pool at <= 0.3x fp32 pool bytes per token."""
+    from mxnet_tpu.serving.loadgen import spec_generation_protocol
+
+    r = _SPEC_PROTOCOL_CACHE.get(bool(smoke))
+    if r is None:
+        r = spec_generation_protocol(smoke=smoke)
+        _SPEC_PROTOCOL_CACHE[bool(smoke)] = r
+    side = {"greedy": r["spec_greedy"], "sampled": r["spec_sampled"],
+            "int8": r["paged_int8"]}[which]
+    base = r["base_sampled"] if which == "sampled" else r["base"]
+    metric = ("serving.decode.paged_int8" if which == "int8"
+              else "serving.decode.spec.%s" % which)
+    row = {"metric": metric,
+           "value": side["tokens_per_sec"], "unit": "tokens/sec",
+           "vs_baseline": None,
+           "ttft_p50_ms": side["ttft_p50_ms"],
+           "ttft_p99_ms": side["ttft_p99_ms"],
+           "itl_mean_ms": side["itl_mean_ms"],
+           "itl_p99_ms": side["itl_p99_ms"],
+           "qps_achieved": side["qps_achieved"],
+           "n_requests": side["n"],
+           "tokens": side["tokens"],
+           "dropped": side["timeouts"] + side["errors"] +
+           side["cancelled"],
+           "offered_mult": r["offered_mult"],
+           "kv_block": r["kv_block"],
+           "kv_max": r["kv_max"],
+           "counters": side.get("counters"),
+           "base_tokens_per_sec": base["tokens_per_sec"],
+           "base_steps_per_token": base["steps_per_token"],
+           "seed": r["seed"]}
+    if which in ("greedy", "sampled"):
+        adv = r["spec_adversarial"]
+        row.update({
+            "spec_k": r["spec_k"],
+            "steps_per_token": side["steps_per_token"],
+            "steps_per_token_vs_base":
+                r["steps_per_token_vs_base_%s" % which],
+            "tokens_per_sec_vs_base":
+                r["tokens_per_sec_vs_base_%s" % which],
+            "acceptance_rate": side["acceptance_rate"],
+            "adversarial_tokens_per_sec_vs_base":
+                r["tokens_per_sec_vs_base_adversarial"],
+            "adversarial_acceptance_rate": adv["acceptance_rate"],
+            "adversarial_fallback_steps":
+                adv["counters"]["spec_fallback_steps"],
+            "draft_pool_bytes":
+                side.get("model", {}).get("draft_pool_bytes"),
+            "note": ("draft-friendly draft (target weights + 3%% "
+                     "relative noise) proposing K=%d per tick, "
+                     "verified by ONE target call: target steps per "
+                     "emitted token <= 0.6x the non-speculative side "
+                     "on the same seeded schedule (%s decoding); the "
+                     "adversarial side (independent random draft, "
+                     "acceptance collapses) banks the "
+                     "MXNET_SERVE_SPEC=auto graceful-degradation "
+                     "acceptance >= 0.95x base tokens/sec"
+                     % (r["spec_k"],
+                        "greedy" if which == "greedy"
+                        else "seeded top-k sampling")),
+        })
+    else:
+        cs = side.get("cache_state", {})
+        fp_cs = base.get("cache_state", {})
+        row.update({
+            "kv_dtype": cs.get("cache_dtype"),
+            "pool_bytes": cs.get("pool_bytes"),
+            "pool_bytes_used": cs.get("pool_bytes_used"),
+            "pool_bytes_per_token": cs.get("pool_bytes_per_token"),
+            "fp32_pool_bytes_per_token":
+                fp_cs.get("pool_bytes_per_token"),
+            "pool_bytes_per_token_vs_fp32":
+                r["pool_bytes_per_token_vs_fp32"],
+            "tokens_per_sec_vs_fp32":
+                r["tokens_per_sec_vs_base_int8"],
+            "note": ("int8 paged KV pool (per-(block, head) scale "
+                     "pools beside the code pool, dequant inside the "
+                     "attention kernel): <= 0.3x fp32 pool bytes per "
+                     "token from stats()['cache_state'] at matched "
+                     "tokens/sec on the same seeded schedule"),
         })
     return row
 
@@ -2284,6 +2381,18 @@ def main():
           "prefix", chip, smoke)
     guard("serving.decode.paged.chunked", bench_serving_decode_paged,
           "chunked", chip, smoke)
+    # speculative-decoding rows: draft-proposed K-token windows
+    # verified by one in-graph target call vs the plain paged plane on
+    # matched seeded schedules (<= 0.6x target steps per emitted token
+    # draft-friendly, >= 0.95x base tokens/sec when the adversarial
+    # draft collapses acceptance), plus the int8 paged KV pool
+    # (<= 0.3x fp32 pool bytes per token)
+    guard("serving.decode.spec.greedy", bench_serving_decode_spec,
+          "greedy", chip, smoke)
+    guard("serving.decode.spec.sampled", bench_serving_decode_spec,
+          "sampled", chip, smoke)
+    guard("serving.decode.paged_int8", bench_serving_decode_spec,
+          "int8", chip, smoke)
     # transformer MFU headline (flash attention + the fused Pallas
     # kernels end-to-end through Module.fit) + the remat batch-scaling
     # row; CPU-deterministic protocol, banked as BENCH_transformer_cpu
@@ -2435,6 +2544,25 @@ def _assemble_out(rows, chip, smoke, t0):
             "itl_p99_chunked_vs_unchunked":
                 r.get("itl_p99_chunked_vs_unchunked"),
         })
+    for mode in ("greedy", "sampled"):
+        r = by_metric.get("serving.decode.spec.%s" % mode)
+        if r and r.get("unit") not in ("error", "skipped"):
+            serving["decode_spec_%s" % mode] = {
+                "tokens_per_sec": r["value"],
+                "steps_per_token_vs_base":
+                    r.get("steps_per_token_vs_base"),
+                "acceptance_rate": r.get("acceptance_rate"),
+                "adversarial_tokens_per_sec_vs_base":
+                    r.get("adversarial_tokens_per_sec_vs_base"),
+            }
+    r = by_metric.get("serving.decode.paged_int8")
+    if r and r.get("unit") not in ("error", "skipped"):
+        serving["decode_paged_int8"] = {
+            "tokens_per_sec": r["value"],
+            "pool_bytes_per_token_vs_fp32":
+                r.get("pool_bytes_per_token_vs_fp32"),
+            "tokens_per_sec_vs_fp32": r.get("tokens_per_sec_vs_fp32"),
+        }
 
     out = {
         "metric": "resnet50_train_images_per_sec",

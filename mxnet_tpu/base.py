@@ -350,12 +350,18 @@ register_env("MXNET_SERVE_KV_MAX", int, 1024,
              "mid-flight.")
 register_env("MXNET_SERVE_KV_DTYPE", str, "float32",
              "KV-cache element dtype on the serving decode plane "
-             "('float32' or 'bfloat16').  bfloat16 halves cache bytes "
-             "per slot — the same cache memory budget holds 2x the "
-             "concurrent sequences — while attention over the cache "
-             "accumulates fp32 in both the offset flash kernel and "
-             "its dense XLA twin; decode parity is pinned at relaxed "
-             "tolerance (tests/test_quant_serving.py).")
+             "('float32', 'bfloat16' or 'int8').  bfloat16 halves "
+             "cache bytes per slot — the same cache memory budget "
+             "holds 2x the concurrent sequences.  'int8' (paged plane "
+             "only, MXNET_SERVE_PAGED=1) stores pool blocks as int8 "
+             "codes with per-(block, head) fp32 absmax scales riding "
+             "as a parallel donated scale pool — ~4x fewer cache "
+             "bytes per token than fp32, dequantized on-tile inside "
+             "the paged flash kernel AND identically in its dense "
+             "twin.  Attention over the cache accumulates fp32 on "
+             "every path; decode parity is pinned at relaxed "
+             "tolerance (tests/test_quant_serving.py, "
+             "tests/test_spec_decode.py).")
 register_env("MXNET_SERVE_PAGED", int, 1,
              "Paged KV cache on the serving decode plane ('1', "
              "default): cache memory is a global pool of "
@@ -392,6 +398,31 @@ register_env("MXNET_SERVE_SAMPLE", str, "graph",
              "(slots,) token vector); 'host' is the escape hatch — "
              "logits-out decode programs plus the SAME jitted sampler "
              "on the fetched logits, byte-identical token streams.")
+register_env("MXNET_SERVE_SPEC", str, "auto",
+             "Speculative decoding on the paged decode plane "
+             "(serving/decode_engine.py): 'auto' (default) turns it "
+             "on for any generative model that has a draft attached "
+             "via registry.add_draft_model AND runs paged in-graph "
+             "sampling (MXNET_SERVE_PAGED=1, MXNET_SERVE_SAMPLE="
+             "graph), and ADAPTS — when the rolling acceptance EMA "
+             "collapses below the floor the engine falls back to "
+             "plain decode ticks (probing speculation periodically "
+             "so a friendlier workload re-engages it); '1'/'force' "
+             "always drafts regardless of acceptance; '0' disables "
+             "even with a draft registered.  The draft proposes "
+             "MXNET_SERVE_SPEC_K tokens per tick, the target "
+             "verifies all K+1 positions in ONE program call with "
+             "the accept/reject rule in-graph — token streams stay "
+             "distribution-identical to non-speculative decoding "
+             "(greedy: byte-identical), speedup comes only from "
+             "fewer target-model steps.")
+register_env("MXNET_SERVE_SPEC_K", int, 4,
+             "Draft tokens proposed per speculative-decoding tick "
+             "(the target verifies K+1 positions per program call).  "
+             "Larger K amortizes more target steps when acceptance "
+             "is high but wastes draft steps when it collapses; the "
+             "verify program shape is lq=K+1, warmed at "
+             "add_draft_model time.")
 register_env("MXNET_SERVE_INT8_GRANULARITY", str, "row",
              "Scale granularity of int8 weight-only serving "
              "quantization (pallas_ops/dequant_matmul.quantize_int8): "
@@ -496,8 +527,30 @@ register_env("MXNET_SERVE_AUTH_TOKEN", str, "",
              "requests must carry 'Authorization: Bearer <token>' or "
              "they get a structured 401 (GET /healthz and GET /metrics "
              "stay open for probes and scrapers).  Empty (default) "
-             "disables auth.  TLS-less: pair with a trusted network "
-             "or a terminating proxy.")
+             "disables auth; pair with MXNET_SERVE_TLS_CERT/_KEY (or "
+             "a terminating proxy) so the token never crosses the "
+             "wire in cleartext.")
+register_env("MXNET_SERVE_TLS_CERT", str, "",
+             "Path to a PEM certificate chain for the HTTP front "
+             "door: set together with MXNET_SERVE_TLS_KEY to wrap "
+             "the stdlib server socket in TLS (ssl.SSLContext, "
+             "PROTOCOL_TLS_SERVER) — the front door's url becomes "
+             "https:// and HttpClient speaks TLS to it.  Empty "
+             "(default) serves plain HTTP.  Setting only one of the "
+             "pair is a configuration error.")
+register_env("MXNET_SERVE_TLS_KEY", str, "",
+             "Path to the PEM private key matching "
+             "MXNET_SERVE_TLS_CERT (may be the same file when the "
+             "key is appended to the cert).  Both set = TLS on; "
+             "both empty = plain HTTP.")
+register_env("MXNET_SERVE_TLS_VERIFY", str, "1",
+             "How HttpClient verifies the front door's TLS "
+             "certificate: '1' (default) uses the system trust "
+             "store; '0' disables verification (self-signed dev "
+             "certs — the connection is still encrypted but not "
+             "authenticated); a path verifies against that CA/cert "
+             "PEM file (the self-signed round-trip test pins its "
+             "own cert this way).")
 register_env("MXNET_TRACE_SAMPLE", float, 1.0,
              "Per-request trace sampling rate in [0, 1] "
              "(mxnet_tpu/tracing.py): each trace minted at the serving "

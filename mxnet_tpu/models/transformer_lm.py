@@ -18,8 +18,8 @@ next-token targets of the same shape.
 from .. import symbol as sym
 
 __all__ = ["get_symbol", "lm_spec", "random_params", "init_cache",
-           "init_pool", "prefill_apply", "decode_apply",
-           "paged_step_apply", "quantize_lm_params",
+           "init_pool", "init_scale_pool", "prefill_apply",
+           "decode_apply", "paged_step_apply", "quantize_lm_params",
            "lm_matmul_weights"]
 
 
@@ -143,6 +143,18 @@ def init_pool(spec, num_blocks, block_size, dtype="float32"):
     shape = (spec["num_layers"], spec["num_heads"],
              int(num_blocks) * int(block_size), dh)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def init_scale_pool(spec, num_blocks):
+    """Per-(layer, head, physical block) fp32 absmax scale pools for
+    the int8 paged KV plane — a ``(num_layers, num_heads, num_blocks)``
+    pair of ones, carried as donated state beside the int8 code pools
+    of :func:`init_pool`.  Ones match the ``quantize_int8`` empty-block
+    convention (absmax 0 → scale 1.0), and zero codes dequantize to
+    zero under any scale."""
+    import jax.numpy as jnp
+    shape = (spec["num_layers"], spec["num_heads"], int(num_blocks))
+    return (jnp.ones(shape, jnp.float32), jnp.ones(shape, jnp.float32))
 
 
 def lm_matmul_weights(spec):
@@ -336,7 +348,8 @@ def decode_apply(params, cache_k, cache_v, tokens, lengths, spec):
 
 
 def paged_step_apply(params, pool_k, pool_v, tables, tokens, positions,
-                     valid, spec, block_size):
+                     valid, spec, block_size, scales=None,
+                     all_logits=False):
     """One PAGED step — the unified prefill-chunk/decode graph of the
     paged KV plane (docs/architecture/decode_engine.md).
 
@@ -359,7 +372,24 @@ def paged_step_apply(params, pool_k, pool_v, tables, tokens, positions,
     pool_v)``.  Rows whose table is all zeros (non-participating slots
     in a fused dispatch) read/write only the trash block and yield
     garbage logits — callers discard them.  Params may be bf16 or int8
-    ``QuantizedWeight`` pairs like :func:`prefill_apply`."""
+    ``QuantizedWeight`` pairs like :func:`prefill_apply`.
+
+    ``scales`` — a ``(scale_k, scale_v)`` pair from
+    :func:`init_scale_pool` — selects the INT8 pool layout: the pools
+    hold int8 codes with per-(layer, head, physical block) fp32 absmax
+    scales, the cache update becomes a block requantization (dequantize
+    each affected block, overlay the fresh fp32 rows, re-pick its
+    absmax scale, re-encode — pure JAX, shared verbatim by the kernel
+    and dense-twin routes), attention dequantizes through the
+    ``kv_scales`` door, and the return gains the updated scale pools:
+    ``(logits, pool_k, pool_v, scale_k, scale_v)``.  Affected blocks
+    must be uniquely owned by their row (the engine's copy-on-write
+    write-ready pass guarantees it); trash-block collisions between pad
+    rows are harmless garbage.
+
+    ``all_logits=True`` returns logits for EVERY chunk row —
+    ``(B, Lq, vocab)`` fp32 — instead of only the last valid position
+    (the speculative-verify program reads all K+1 positions)."""
     import jax.numpy as jnp
     from ..ops.attention import sdp_attention_paged
     from ..ops.nn import _ln_fc, _rms_fc
@@ -380,6 +410,56 @@ def paged_step_apply(params, pool_k, pool_v, tables, tokens, positions,
     # attended: every real query's mask stops at its own frontier)
     dest = jnp.where(r[None, :] < valid[:, None], dest,
                      p % bs).reshape(-1)                    # (B*Lq,)
+    int8_kv = scales is not None
+    if int8_kv:
+        scale_k, scale_v = scales
+        T = tables.shape[1]
+        # static bound on blocks a row's write can touch: worst case
+        # the chunk starts on a block's last row
+        A = (Lq + bs - 2) // bs + 1
+        first_log = positions // bs                         # (B,)
+        aff_log = first_log[:, None] + \
+            jnp.arange(A, dtype=jnp.int32)[None, :]
+        last_log = (positions + valid - 1) // bs
+        aff_ok = (aff_log <= last_log[:, None]) & (aff_log < T)
+        phys = jnp.where(
+            aff_ok,
+            tables[jnp.arange(B)[:, None], jnp.clip(aff_log, 0, T - 1)],
+            0)                                              # (B, A)
+        phys_flat = phys.reshape(-1)                        # (B*A,)
+        ws_rows = (phys_flat[:, None] * bs +
+                   jnp.arange(bs, dtype=jnp.int32)[None, :]).reshape(-1)
+        # overlay index of fresh token (b, r) inside the gathered
+        # working set; pad rows target the appended dummy row
+        loc = (jnp.arange(B, dtype=jnp.int32)[:, None] * (A * bs)
+               + (p // bs - first_log[:, None]) * bs + p % bs)
+        loc = jnp.where(r[None, :] < valid[:, None], loc,
+                        B * A * bs).reshape(-1)             # (B*Lq,)
+
+        def requant_write(pool_i, scale_i, fresh):
+            """Requantize the affected blocks of one layer's pool.
+            pool_i (H, R, dh) int8 codes, scale_i (H, NB) fp32, fresh
+            (B*Lq, H, dh) fp32 rows → updated (pool_i, scale_i)."""
+            old = jnp.transpose(pool_i[:, ws_rows, :],
+                                (1, 0, 2)).astype(jnp.float32)
+            sc = jnp.repeat(scale_i[:, phys_flat], bs, axis=1)
+            ws = old * jnp.transpose(sc)[:, :, None]    # (B*A*bs, H, dh)
+            ws = jnp.concatenate(
+                [ws, jnp.zeros((1, H, dh), jnp.float32)], axis=0)
+            ws = ws.at[loc].set(fresh)[:-1]
+            blk = ws.reshape(B * A, bs, H, dh)
+            absmax = jnp.max(jnp.abs(blk), axis=(1, 3))     # (B*A, H)
+            # quantize_int8's convention: scale=absmax/127, empty → 1.0
+            new_sc = jnp.where(absmax > 0, absmax / 127.0,
+                               jnp.float32(1.0))
+            codes = jnp.clip(jnp.rint(blk / new_sc[:, None, :, None]),
+                             -127, 127).astype(jnp.int8)
+            pool_i = pool_i.at[:, ws_rows, :].set(
+                jnp.transpose(codes.reshape(B * A * bs, H, dh),
+                              (1, 0, 2)))
+            scale_i = scale_i.at[:, phys_flat].set(jnp.transpose(new_sc))
+            return pool_i, scale_i
+
     x = _embed(params["embed_weight"], tokens)              # (B, Lq, D)
     for i in range(L):
         bp = _block_params(params, i)
@@ -392,16 +472,32 @@ def paged_step_apply(params, pool_k, pool_v, tables, tokens, positions,
 
         q, k, v = (heads(bp[t]) for t in
                    ("q_weight", "k_weight", "v_weight"))
-        # advanced-index scatter: (layer, :, rows, :) puts the indexed
-        # dimension first, so updates arrive as (B*Lq, H, dh)
-        kT = jnp.transpose(k.astype(cdt), (0, 2, 1, 3)).reshape(
-            B * Lq, H, dh)
-        vT = jnp.transpose(v.astype(cdt), (0, 2, 1, 3)).reshape(
-            B * Lq, H, dh)
-        pool_k = pool_k.at[i, :, dest, :].set(kT)
-        pool_v = pool_v.at[i, :, dest, :].set(vT)
-        att = sdp_attention_paged(q.astype(cdt), pool_k[i], pool_v[i],
-                                  tables, positions, bs)
+        if int8_kv:
+            kT = jnp.transpose(k, (0, 2, 1, 3)).reshape(
+                B * Lq, H, dh).astype(jnp.float32)
+            vT = jnp.transpose(v, (0, 2, 1, 3)).reshape(
+                B * Lq, H, dh).astype(jnp.float32)
+            pk_i, sk_i = requant_write(pool_k[i], scale_k[i], kT)
+            pv_i, sv_i = requant_write(pool_v[i], scale_v[i], vT)
+            pool_k = pool_k.at[i].set(pk_i)
+            pool_v = pool_v.at[i].set(pv_i)
+            scale_k = scale_k.at[i].set(sk_i)
+            scale_v = scale_v.at[i].set(sv_i)
+            att = sdp_attention_paged(q, pool_k[i], pool_v[i], tables,
+                                      positions, bs,
+                                      kv_scales=(scale_k[i],
+                                                 scale_v[i]))
+        else:
+            # advanced-index scatter: (layer, :, rows, :) puts the
+            # indexed dimension first, so updates arrive as (B*Lq, H, dh)
+            kT = jnp.transpose(k.astype(cdt), (0, 2, 1, 3)).reshape(
+                B * Lq, H, dh)
+            vT = jnp.transpose(v.astype(cdt), (0, 2, 1, 3)).reshape(
+                B * Lq, H, dh)
+            pool_k = pool_k.at[i, :, dest, :].set(kT)
+            pool_v = pool_v.at[i, :, dest, :].set(vT)
+            att = sdp_attention_paged(q.astype(cdt), pool_k[i],
+                                      pool_v[i], tables, positions, bs)
         att = jnp.transpose(att, (0, 2, 1, 3)).reshape(-1, D)
         x = x + _mm(att.astype(x.dtype), bp["proj_weight"]).reshape(
             B, Lq, D)
@@ -409,6 +505,14 @@ def paged_step_apply(params, pool_k, pool_v, tables, tokens, positions,
         x = x + _ffn(f, bp).reshape(B, Lq, D)
     h = _ln_fc({"axis": -1, "eps": 1e-5}, x, params["final_ln_gamma"],
                params["final_ln_beta"])
-    last = h[jnp.arange(B), valid - 1]                      # (B, D)
-    logits = _mm(last, params["pred_weight"]) + params["pred_bias"]
+    if all_logits:
+        logits = (_mm(h.reshape(-1, D), params["pred_weight"]) +
+                  params["pred_bias"]).reshape(B, Lq,
+                                               spec["vocab_size"])
+    else:
+        last = h[jnp.arange(B), valid - 1]                  # (B, D)
+        logits = _mm(last, params["pred_weight"]) + params["pred_bias"]
+    if int8_kv:
+        return (logits.astype(jnp.float32), pool_k, pool_v,
+                scale_k, scale_v)
     return logits.astype(jnp.float32), pool_k, pool_v

@@ -38,15 +38,27 @@ __all__ = ["flash_attention_paged", "paged_attention_reference"]
 _NEG = -1e30  # flash_attention._NEG: shared mask constant for parity
 
 
-def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
-                  l_ref, acc_ref, *, scale, block_q, block_size, nt):
+def _paged_kernel(*refs, scale, block_q, block_size, nt, int8):
     """One (batch, head, q-block, logical-block) grid cell.
 
     ``tbl_ref``/``pos_ref`` are the scalar-prefetch operands (SMEM);
     the k dimension walks logical blocks j — the index maps already
     dereferenced ``tbl_ref[b, j]``, so ``k_ref``/``v_ref`` hold the
-    PHYSICAL tile.  Masking happens in logical position space."""
+    PHYSICAL tile.  Masking happens in logical position space.
+
+    ``int8`` adds two more scalar-prefetch operands — per-(head,
+    physical block) fp32 absmax scales for the K and V pools — and the
+    tile loads dequantize on-tile (``codes * sk_ref[h, tbl_ref[b, ki]]``)
+    before the unchanged fp32 online softmax."""
+    if int8:
+        (tbl_ref, pos_ref, sk_ref, sv_ref, q_ref, k_ref, v_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (tbl_ref, pos_ref, q_ref, k_ref, v_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+        sk_ref = sv_ref = None
     b = pl.program_id(0)
+    h = pl.program_id(1)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     ofs = pos_ref[b]
@@ -67,6 +79,10 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
         q = q_ref[0, 0].astype(jnp.float32)     # (BQ, D)
         kb = k_ref[0].astype(jnp.float32)       # (BS, D)
         vb = v_ref[0].astype(jnp.float32)
+        if int8:
+            phys = tbl_ref[b, ki]               # SMEM scalar read
+            kb = kb * sk_ref[h, phys]
+            vb = vb * sv_ref[h, phys]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (BQ, BS)
@@ -95,7 +111,7 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
 
 def flash_attention_paged(q, k_pool, v_pool, tables, positions,
                           block_size, scale=None, block_q=128,
-                          interpret=None):
+                          interpret=None, kv_scales=None):
     """Offset-causal flash attention against a PAGED KV pool.
 
     q: (B, H, Lq, D) — query row r of sequence b sits at global
@@ -105,6 +121,13 @@ def flash_attention_paged(q, k_pool, v_pool, tables, positions,
     (entries past a sequence's frontier must point at a valid block —
     conventionally the reserved trash block 0 — their keys are masked
     either way); positions: (B,) int32 frontiers.
+
+    ``kv_scales`` — a ``(scale_k, scale_v)`` pair of ``(H, num_blocks)``
+    fp32 per-(head, physical block) absmax scales — selects the int8
+    pool layout: the pools hold int8 codes and every K/V tile is
+    dequantized ON-TILE (``codes * scale[h, tbl[b, j]]``) before the
+    unchanged fp32 online softmax, so accumulation numerics match the
+    dense twin exactly on identically-dequantized values.
 
     The tables/positions ride as scalar-prefetch operands so BlockSpec
     index maps can gather physical tiles; blocks a sequence cannot see
@@ -127,30 +150,39 @@ def flash_attention_paged(q, k_pool, v_pool, tables, positions,
     block_q = divisor_block(Lq, block_q)
     tbl = jnp.asarray(tables, jnp.int32)
     pos = jnp.asarray(positions, jnp.int32).reshape(B)
+    int8 = kv_scales is not None
 
     kernel = functools.partial(_paged_kernel, scale=float(scale),
-                               block_q=block_q, block_size=bs, nt=T)
+                               block_q=block_q, block_size=bs, nt=T,
+                               int8=int8)
 
     def _spec(shape, index_map):
         if _VMEM is not None:
             return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
         return pl.BlockSpec(shape, index_map)  # pragma: no cover
 
+    if int8:
+        sk = jnp.asarray(kv_scales[0], jnp.float32)
+        sv = jnp.asarray(kv_scales[1], jnp.float32)
+        scalars = (tbl, pos, sk, sv)
+        q_map = lambda b, h, i, j, tbl, pos, sk, sv: (b, h, i, 0)
+        kv_map = lambda b, h, i, j, tbl, pos, sk, sv: (h, tbl[b, j], 0)
+    else:
+        scalars = (tbl, pos)
+        q_map = lambda b, h, i, j, tbl, pos: (b, h, i, 0)
+        kv_map = lambda b, h, i, j, tbl, pos: (h, tbl[b, j], 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(scalars),
         grid=(B, H, Lq // block_q, T),
         in_specs=[
-            _spec((1, 1, block_q, D),
-                  lambda b, h, i, j, tbl, pos: (b, h, i, 0)),  # Q tile
+            _spec((1, 1, block_q, D), q_map),  # Q tile
             # k/v: fetch PHYSICAL block tbl[b, j] from the pool —
             # the index is in units of whole (bs, D) blocks
-            _spec((1, bs, D),
-                  lambda b, h, i, j, tbl, pos: (h, tbl[b, j], 0)),
-            _spec((1, bs, D),
-                  lambda b, h, i, j, tbl, pos: (h, tbl[b, j], 0)),
+            _spec((1, bs, D), kv_map),
+            _spec((1, bs, D), kv_map),
         ],
-        out_specs=_spec((1, 1, block_q, D),
-                        lambda b, h, i, j, tbl, pos: (b, h, i, 0)),
+        out_specs=_spec((1, 1, block_q, D), q_map),
         scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, D), jnp.float32)])
@@ -163,17 +195,19 @@ def flash_attention_paged(q, k_pool, v_pool, tables, positions,
         interpret=interpret,
         compiler_params=_params_cls(
             dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary")))(tbl, pos, q, k_pool,
+                                 "arbitrary")))(*scalars, q, k_pool,
                                                 v_pool)
     return out
 
 
 def paged_attention_reference(q, k_pool, v_pool, tables, positions,
-                              block_size, scale=None):
+                              block_size, scale=None, kv_scales=None):
     """Dense XLA twin of :func:`flash_attention_paged`: gather the pool
     rows through the same block-table arithmetic, then the exact dense
     offset-causal attention (same ``-1e30`` constant, fp32 accumulation)
-    — the ``MXNET_PALLAS=0`` lowering and the parity oracle."""
+    — the ``MXNET_PALLAS=0`` lowering and the parity oracle.
+    ``kv_scales`` dequantizes int8 pools through the SAME per-(head,
+    physical block) scale arithmetic as the kernel."""
     B, H, Lq, D = q.shape
     T = tables.shape[1]
     bs = int(block_size)
@@ -187,6 +221,18 @@ def paged_attention_reference(q, k_pool, v_pool, tables, positions,
                B, T * bs)
     k = jnp.transpose(jnp.take(k_pool, idx, axis=1), (1, 0, 2, 3))
     v = jnp.transpose(jnp.take(v_pool, idx, axis=1), (1, 0, 2, 3))
+    if kv_scales is not None:
+        # per-(head, physical block) dequant, identical to the kernel's
+        # on-tile multiply: scale[h, tbl[b, j]] covers pool rows
+        # j*bs..j*bs+bs-1 of that gathered block
+        sck = jnp.transpose(jnp.repeat(
+            jnp.asarray(kv_scales[0], jnp.float32)[:, tbl], bs, axis=2),
+            (1, 0, 2))                                   # (B, H, T*bs)
+        scv = jnp.transpose(jnp.repeat(
+            jnp.asarray(kv_scales[1], jnp.float32)[:, tbl], bs, axis=2),
+            (1, 0, 2))
+        k = k.astype(jnp.float32) * sck[..., None]
+        v = v.astype(jnp.float32) * scv[..., None]
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     qpos = jax.lax.broadcasted_iota(jnp.int32, (Lq, T * bs), 0)

@@ -102,6 +102,28 @@ _H_CHUNKS = _metrics.histogram(
     "serve_prefill_chunks_per_request",
     help="chunked-prefill dispatches one admitted request's prompt "
          "took on the paged decode plane", lo=1, hi=1e4)
+_H_SPEC = _metrics.histogram(
+    "serve_spec_emitted_per_step",
+    help="tokens emitted per speculative verify step (1..K+1: "
+         "accepted draft tokens + the bonus/corrected token; "
+         "acceptance rate is (emitted-1) over the proposal window)",
+    lo=1, hi=64)
+
+# MXNET_SERVE_SPEC=auto's graceful-degradation policy: when the rolling
+# acceptance EMA falls below the floor (the draft is fighting the
+# target — adversarial prompts, mismatched domains), the engine stops
+# paying for drafts and serves plain decode steps, PROBING one
+# speculative tick every _SPEC_PROBE_EVERY ticks so a recovered draft
+# re-engages.  Probes catch the draft's KV frontier up in
+# prefill_chunk-sized teacher-forced dispatches, so a probe costs a few
+# draft calls, not one per skipped token — and FAILED probes back off
+# exponentially (doubling the cadence up to _SPEC_PROBE_MAX; recovery
+# resets it), so a persistently hostile workload converges to
+# near-zero speculation overhead instead of paying a fixed probe tax.
+_SPEC_EMA_DECAY = 0.75
+_SPEC_EMA_FLOOR = 0.125
+_SPEC_PROBE_EVERY = 128
+_SPEC_PROBE_MAX = 2048
 
 __all__ = ["GenerationEngine", "GenerationResult", "TokenStream"]
 
@@ -396,11 +418,15 @@ class _PagedModelState:
 
     paged = True
 
-    def __init__(self, store):
+    def __init__(self, store, draft=None, spec_k=0):
         self.store = store
         self.pool = _BlockPool(store.pool_blocks)
         self.prefix = _PrefixStore(self.pool, store.kv_block)
         self.pool_k, self.pool_v = store.new_pool()
+        # int8 plane: the per-(layer, head, block) fp32 absmax scale
+        # pools ride beside the code pools through every dispatch
+        self.scales = (store.new_scale_pool() if store.kv_int8
+                       else None)
         self.tb = store.table_width()
         self.slots = []                        # _GenRequest or None
         self.tables = np.zeros((0, self.tb), np.int32)
@@ -415,6 +441,35 @@ class _PagedModelState:
         self.keys = jnp.zeros((0, 2), jnp.uint32)
         self.g_used = None                     # pool gauges (engine)
         self.g_hwm = None
+        self.g_bytes = None
+        # speculative decoding: the draft model's OWN pool arrays ride
+        # the target's block tables (one allocator, two KV planes) —
+        # dlen is the draft's per-slot KV frontier, dkeys its
+        # independent per-slot PRNG chains
+        self.draft = draft
+        self.spec_k = int(spec_k)
+        if draft is not None:
+            self.dpool_k, self.dpool_v = draft.new_pool()
+            self.dscales = (draft.new_scale_pool() if draft.kv_int8
+                            else None)
+            self.dlen = np.zeros(0, np.int32)
+            # host-resident between spec ticks: admission writes
+            # single rows, and only a spec tick's sampler needs the
+            # device copy (it converts back when it finishes)
+            self.dkeys = np.zeros((0, 2), np.uint32)
+            # auto-mode degradation state: rolling acceptance EMA +
+            # the probe countdown while speculating is suspended
+            self.spec_ema = 1.0
+            self.spec_probe = _SPEC_PROBE_EVERY
+            self.spec_probe_every = _SPEC_PROBE_EVERY
+            self.spec_forced = False
+
+    def spec_mirror(self):
+        """Whether prefill chunks mirror into the draft KV plane:
+        always while speculating, skipped while the auto-mode fallback
+        has speculation suspended (probe catch-up rebuilds the draft
+        KV from the prompt when needed)."""
+        return self.spec_forced or self.spec_ema >= _SPEC_EMA_FLOOR
 
     def active(self):
         return [i for i, r in enumerate(self.slots) if r is not None]
@@ -430,7 +485,14 @@ class _PagedModelState:
 
     def describe(self):
         act = self.active()
+        # dtype-aware pool bytes: int8 code pools carry their fp32
+        # scale pools — a block is only decodable as codes+scale, so
+        # the memory claim counts both (the PR-12 weight_bytes
+        # discipline applied to the KV plane)
         pool_bytes = 2 * self.pool_k.size * self.pool_k.dtype.itemsize
+        if self.scales is not None:
+            pool_bytes += 2 * self.scales[0].size * \
+                self.scales[0].dtype.itemsize
         per_block = pool_bytes // self.store.pool_blocks
         d = {"slots": len(self.slots), "active": len(act),
              "paged": True,
@@ -444,8 +506,20 @@ class _PagedModelState:
              "pool_blocks_reserved": self.reserved_total(),
              "prefix_entries": len(self.prefix),
              "cache_mb": round(pool_bytes / 2**20, 3),
+             "pool_bytes": pool_bytes,
+             "pool_bytes_used": self.pool.used() * per_block,
+             "pool_bytes_per_token":
+                 per_block / self.store.kv_block,
              "block_bytes": per_block,
              "cache_dtype": str(self.pool_k.dtype)}
+        if self.draft is not None:
+            dbytes = 2 * self.dpool_k.size * self.dpool_k.dtype.itemsize
+            if self.dscales is not None:
+                dbytes += 2 * self.dscales[0].size * \
+                    self.dscales[0].dtype.itemsize
+            d["spec_k"] = self.spec_k
+            d["draft_pool_bytes"] = dbytes
+            d["spec_acceptance_ema"] = round(float(self.spec_ema), 4)
         if act:
             # the paged memory claim's measurement: pool bytes
             # actually BACKING the live sequences, per sequence —
@@ -512,7 +586,14 @@ class GenerationEngine:
              # chunk dispatches; shed_pool the requests too large for
              # the pool
              "prefix_hits", "prefix_hit_blocks", "prefix_hit_tokens",
-             "cow_forks", "prefill_chunks", "shed_pool"),
+             "cow_forks", "prefill_chunks", "shed_pool",
+             # speculative decoding (zero without a draft attached):
+             # spec_steps counts verify dispatches (each is ONE target
+             # step emitting 1..K+1 tokens), spec_proposed/spec_
+             # accepted the draft tokens offered/accepted, spec_draft_
+             # steps the draft micro-dispatches (catch-up + proposal)
+             "spec_steps", "spec_proposed", "spec_accepted",
+             "spec_draft_steps", "spec_fallback_steps"),
             labels=self._mlabels, help="generation engine counter")
         self._g_inflight = _metrics.gauge(
             "serve_gen_inflight", labels=self._mlabels,
@@ -691,6 +772,17 @@ class GenerationEngine:
         out["tenant_quotas"] = dict(self._tenant_quotas)
         out["models"] = {m: st.describe()
                          for m, st in dict(self._states).items()}
+        # the KV memory claims as measurable evidence (the PR-12
+        # weight_bytes discipline): dtype-aware cache/pool BYTES per
+        # model — int8 pools count codes + scale pools together
+        out["cache_state"] = {
+            m: {k: d[k] for k in ("cache_dtype", "cache_mb",
+                                  "pool_bytes", "pool_bytes_used",
+                                  "pool_bytes_per_token", "block_bytes",
+                                  "cache_bytes_per_slot",
+                                  "cache_bytes_per_active_seq",
+                                  "draft_pool_bytes") if k in d}
+            for m, d in out["models"].items()}
         return out
 
     def close(self, drain=True, timeout=120.0):
@@ -979,7 +1071,30 @@ class GenerationEngine:
     def _paged_state(self, model, store):
         st = self._states.get(model)
         if st is None:
-            st = self._states[model] = _PagedModelState(store)
+            # speculative decoding gate, resolved ONCE at state
+            # creation: a draft attached via registry.add_draft_model
+            # + in-graph sampling + MXNET_SERVE_SPEC != 0.  Attach
+            # drafts before the model's first request — a draft added
+            # under traffic is picked up at the next engine (or the
+            # next state, once the engine restarts).
+            draft, spec_k = None, 0
+            spec = str(get_env("MXNET_SERVE_SPEC") or "auto").lower()
+            if spec not in ("0", "off", "false") \
+                    and store.sample_mode == "graph":
+                draft = getattr(self._registry, "draft_store",
+                                lambda _m: None)(model)
+                if draft is not None:
+                    # the window the draft's verify programs were
+                    # warmed for (add_draft_model's spec_k)
+                    spec_k = int(getattr(
+                        draft, "spec_k",
+                        int(get_env("MXNET_SERVE_SPEC_K"))))
+            st = self._states[model] = _PagedModelState(
+                store, draft=draft, spec_k=spec_k)
+            if draft is not None:
+                # auto (default) degrades to plain decode when the
+                # rolling acceptance collapses; on/force always drafts
+                st.spec_forced = spec in ("1", "on", "force", "always")
             store.cache_state = st
             lbl = dict(self._mlabels, model=model)
             st.g_used = _metrics.gauge(
@@ -988,11 +1103,16 @@ class GenerationEngine:
             st.g_hwm = _metrics.gauge(
                 "serve_kv_pool_blocks_hwm", labels=lbl,
                 help="paged KV pool allocation high-water mark")
+            st.g_bytes = _metrics.gauge(
+                "serve_kv_pool_bytes_used", labels=lbl,
+                help="dtype-aware bytes backing the allocated paged "
+                     "KV pool blocks (int8 counts codes + scales)")
         return st
 
     def _paged_gauges(self, st):
         st.g_used.set(st.pool.used())
         st.g_hwm.set(st.pool.hwm)
+        st.g_bytes.set(st.pool.used() * st.describe()["block_bytes"])
 
     def _paged_alloc(self, st):
         """One fresh pool block, evicting LRU prefix pins if the free
@@ -1098,8 +1218,35 @@ class GenerationEngine:
             st.top_ks[slot] = r.top_k
             st.resv[slot] = needed
             keys = np.array(st.keys, np.uint32)
-            keys[slot] = np.asarray(jax.random.PRNGKey(r.seed))
+            if 0 <= r.seed < 2 ** 32:
+                # byte-identical to jax.random.PRNGKey(seed) for
+                # 32-bit seeds, without paying a threefry dispatch
+                # on the admission hot path
+                keys[slot] = (0, r.seed)
+            else:
+                keys[slot] = np.asarray(jax.random.PRNGKey(r.seed))
             st.keys = jnp.asarray(keys)
+            if st.draft is not None:
+                # the draft's KV frontier starts at the shared-prefix
+                # coverage like the target's (its pool was mirrored
+                # when those blocks were first prefilled), and its
+                # PRNG chain is an independent fold of the request
+                # seed — target and draft draws never correlate.
+                # While the auto-mode fallback has the mirror off, the
+                # adopted blocks' draft rows are unwritten: claim NO
+                # coverage so a probe's catch-up rebuilds from the
+                # prompt instead of trusting garbage
+                st.dlen[slot] = prog if st.spec_mirror() else 0
+                # salted threefry key derived on HOST: the draft's
+                # constant hi word can never equal a target key's, so
+                # the chains stay decorrelated — the jax.random
+                # fold_in this replaces cost a threefry dispatch plus
+                # a device round-trip PER ADMISSION, charged even
+                # while the fallback regime never drafts at all
+                st.dkeys[slot] = (
+                    np.uint32(0x5bec5bec),
+                    np.uint32(r.seed & 0xffffffff)
+                    ^ np.uint32(0x9e3779b9))
             self._admit_log.append((model, r.seq))
             admitted += 1
         if admitted:
@@ -1126,6 +1273,12 @@ class GenerationEngine:
             [st.temps, np.zeros(grow, np.float32)])
         st.keys = jnp.concatenate(
             [st.keys, jnp.zeros((grow, 2), jnp.uint32)])
+        if st.draft is not None:
+            st.dlen = np.concatenate(
+                [st.dlen, np.zeros(grow, np.int32)])
+            st.dkeys = np.concatenate(
+                [np.array(st.dkeys, np.uint32),
+                 np.zeros((grow, 2), np.uint32)])
         self._stats.inc("slot_grows")
 
     def _release_paged_slot(self, st, i):
@@ -1146,6 +1299,8 @@ class GenerationEngine:
         st.temps[i] = 0.0
         st.top_ks[i] = 0
         st.resv[i] = 0
+        if st.draft is not None:
+            st.dlen[i] = 0
 
     def _paged_tick(self, model, st):
         """One engine tick of the paged plane: ONE decode step for the
@@ -1156,7 +1311,10 @@ class GenerationEngine:
         latency."""
         dec = [i for i in st.active() if st.decoding[i]]
         if dec:
-            self._paged_decode_step(model, st, dec)
+            if st.draft is not None and self._spec_active(st):
+                self._paged_spec_step(model, st, dec)
+            else:
+                self._paged_decode_step(model, st, dec)
         pre = [i for i in st.active() if not st.decoding[i]]
         if pre:
             self._paged_prefill_chunk(model, st, pre)
@@ -1179,8 +1337,26 @@ class GenerationEngine:
                 st.resv[i] = max(0, int(st.resv[i]) - 1)
             elif st.pool.refcount(b) > 1:
                 nb = self._paged_alloc(st)
-                st.pool_k, st.pool_v = st.store.copy_block(
-                    st.pool_k, st.pool_v, b, nb)
+                if st.scales is None:
+                    st.pool_k, st.pool_v = st.store.copy_block(
+                        st.pool_k, st.pool_v, b, nb)
+                else:
+                    # int8: codes and per-block scales fork together
+                    st.pool_k, st.pool_v, sk, sv = st.store.copy_block(
+                        st.pool_k, st.pool_v, b, nb, scales=st.scales)
+                    st.scales = (sk, sv)
+                if st.draft is not None:
+                    # the draft plane shares the block TABLES, so its
+                    # pool must fork the same physical block
+                    if st.dscales is None:
+                        st.dpool_k, st.dpool_v = st.draft.copy_block(
+                            st.dpool_k, st.dpool_v, b, nb)
+                    else:
+                        (st.dpool_k, st.dpool_v, dsk,
+                         dsv) = st.draft.copy_block(
+                            st.dpool_k, st.dpool_v, b, nb,
+                            scales=st.dscales)
+                        st.dscales = (dsk, dsv)
                 st.pool.deref(b)
                 st.tables[i, j] = nb
                 st.resv[i] = max(0, int(st.resv[i]) - 1)
@@ -1193,18 +1369,28 @@ class GenerationEngine:
         split as the contiguous plane's ``_decode_and_sample``."""
         if st.store.sample_mode == "graph":
             t0 = time.perf_counter_ns()
-            toks_dev, st.pool_k, st.pool_v, st.keys = \
-                st.store.run_paged_step_sample(
-                    st.pool_k, st.pool_v, tables, toks, pos, val,
-                    st.keys, st.temps, st.top_ks, do)
+            out = st.store.run_paged_step_sample(
+                st.pool_k, st.pool_v, tables, toks, pos, val,
+                st.keys, st.temps, st.top_ks, do, scales=st.scales)
+            if st.scales is None:
+                toks_dev, st.pool_k, st.pool_v, st.keys = out
+            else:
+                toks_dev, st.pool_k, st.pool_v, sk, sv, st.keys = out
+                st.scales = (sk, sv)
             _profiler.record_phase(phase, t0)
             t0 = time.perf_counter_ns()
             sampled = self._fetch_decode(toks_dev)
             _profiler.record_phase("serve_sample", t0)
             return sampled
         t0 = time.perf_counter_ns()
-        logits_dev, st.pool_k, st.pool_v = st.store.run_paged_step(
-            st.pool_k, st.pool_v, tables, toks, pos, val)
+        out = st.store.run_paged_step(
+            st.pool_k, st.pool_v, tables, toks, pos, val,
+            scales=st.scales)
+        if st.scales is None:
+            logits_dev, st.pool_k, st.pool_v = out
+        else:
+            logits_dev, st.pool_k, st.pool_v, sk, sv = out
+            st.scales = (sk, sv)
         _profiler.record_phase(phase, t0)
         t0 = time.perf_counter_ns()
         logits = self._fetch_decode(logits_dev)
@@ -1265,6 +1451,286 @@ class GenerationEngine:
         self._stats.inc("decode_steps")
         self._stats.inc("generated_tokens", len(dec))
 
+    def _spec_active(self, st):
+        """The MXNET_SERVE_SPEC=auto degradation gate, checked once
+        per tick: speculate while the rolling acceptance EMA holds,
+        otherwise serve plain decode steps (identical token streams —
+        greedy is byte-identical either way, seeded draws stay
+        distribution-identical) and probe a speculative tick on an
+        exponential-backoff cadence to notice recovery."""
+        if st.spec_forced or st.spec_ema >= _SPEC_EMA_FLOOR:
+            st.spec_probe_every = _SPEC_PROBE_EVERY
+            st.spec_probe = _SPEC_PROBE_EVERY
+            return True
+        st.spec_probe -= 1
+        if st.spec_probe <= 0:
+            # this probe's verdict lands in the EMA before the next
+            # tick re-checks the gate: a recovered draft re-engages
+            # (and resets the cadence above), a still-hostile one
+            # waits twice as long for the next probe
+            st.spec_probe_every = min(2 * st.spec_probe_every,
+                                      _SPEC_PROBE_MAX)
+            st.spec_probe = st.spec_probe_every
+            return True
+        self._stats.inc("spec_fallback_steps")
+        return False
+
+    def _spec_catch_up(self, st, dec, gap):
+        """Teacher-forced chunked catch-up of the draft KV frontier:
+        after fallback ticks (or a mid-stream draft lag > 1) the gap
+        between the target's frontier and the draft's can span many
+        tokens — replaying them one micro-step each would cost a draft
+        dispatch per skipped token.  The tokens are all KNOWN (already
+        emitted), so feed them through the draft's logits-discarded
+        prefill-mirror program in ``prefill_chunk``-sized dispatches
+        (per-row ``valid`` masks ragged gaps), exactly like the prompt
+        mirror.  Leaves every slot at gap 0."""
+        draft = st.draft
+        n = len(st.slots)
+        chunk = draft.prefill_chunk
+        done = 0
+        maxgap = max(gap[i] for i in dec)
+        while done < maxgap:
+            tables = np.zeros((n, st.tb), np.int32)
+            toks = np.zeros((n, chunk), np.int32)
+            pos = np.zeros((n,), np.int32)
+            val = np.ones((n,), np.int32)
+            for i in dec:
+                rem = gap[i] - done
+                if rem <= 0:
+                    continue
+                r = st.slots[i]
+                take = min(chunk, rem)
+                base = int(st.dlen[i]) + done
+                plen = len(r.prompt)
+                for c in range(take):
+                    # a lazily-mirrored slot catches up from inside
+                    # its prompt; past plen the replay is the emitted
+                    # stream (idx L-1 at most — index len(tokens)-2)
+                    idx = base + c
+                    toks[i, c] = (r.prompt[idx] if idx < plen
+                                  else r.tokens[idx - plen])
+                tables[i] = st.tables[i]
+                pos[i] = base
+                val[i] = take
+            dout = draft.run_paged_step(
+                st.dpool_k, st.dpool_v, tables, toks, pos, val,
+                scales=st.dscales)
+            if st.dscales is None:
+                _, st.dpool_k, st.dpool_v = dout
+            else:
+                _, st.dpool_k, st.dpool_v, dsk, dsv = dout
+                st.dscales = (dsk, dsv)
+            self._stats.inc("spec_draft_steps")
+            done += chunk
+        for i in dec:
+            st.dlen[i] += gap[i]
+            gap[i] = 0
+
+    def _spec_propose(self, st, dec, win):
+        """Draft micro-steps of one speculative tick: first catch each
+        slot's draft KV frontier up to the target's (re-feeding
+        already-emitted tokens with ``do_sample`` off — the draft's
+        PRNG chain must not advance on catch-up rows), then sample
+        ``win[i]`` proposal tokens.  Returns ``(props, prop_q)``:
+        per-slot proposal token lists and the DEVICE-resident
+        ``(slots, K, vocab)`` proposal distributions the verify
+        program consumes — distributions never cross to the host."""
+        draft = st.draft
+        n = len(st.slots)
+        K = st.spec_k
+        plen = {i: len(st.slots[i].prompt) for i in dec}
+        gap = {i: int(st.lengths[i]) - int(st.dlen[i]) for i in dec}
+        if max(gap.values()) > 1:
+            # a fallback stretch left the draft far behind: chunked
+            # teacher-forced catch-up instead of one micro-step per
+            # skipped token (gap stays <= 1 in steady speculation —
+            # exactly the full-accept bonus token)
+            self._spec_catch_up(st, dec, gap)
+        steps = {i: gap[i] + win[i] for i in dec}
+        total = max(steps.values())
+        props = {i: [] for i in dec}
+        q_rows = []
+        for t in range(total):
+            tables = np.zeros((n, st.tb), np.int32)
+            toks = np.zeros((n, 1), np.int32)
+            pos = np.zeros((n,), np.int32)
+            val = np.ones((n,), np.int32)
+            do = np.zeros((n,), bool)
+            live = []
+            for i in dec:
+                if t >= steps[i]:
+                    continue
+                r = st.slots[i]
+                idx = int(st.dlen[i]) + t  # token index fed this step
+                L = int(st.lengths[i])
+                if idx < plen[i]:
+                    # inside the prompt: a lazily-mirrored slot's
+                    # catch-up (mirror skipped during fallback)
+                    tok = r.prompt[idx]
+                elif idx <= L:
+                    # emitted history (idx == L is next_tok: the last
+                    # emitted token, r.tokens[-1])
+                    tok = r.tokens[idx - plen[i]]
+                else:
+                    tok = props[i][idx - L - 1]
+                tables[i] = st.tables[i]
+                toks[i, 0] = tok
+                pos[i] = idx
+                do[i] = t >= gap[i]
+                live.append(i)
+            out = draft.run_paged_step_sample_p(
+                st.dpool_k, st.dpool_v, tables, toks, pos, val,
+                st.dkeys, st.temps, st.top_ks, do, scales=st.dscales)
+            if st.dscales is None:
+                t_dev, q_dev, st.dpool_k, st.dpool_v, st.dkeys = out
+            else:
+                (t_dev, q_dev, st.dpool_k, st.dpool_v, dsk, dsv,
+                 st.dkeys) = out
+                st.dscales = (dsk, dsv)
+            sampled = self._fetch_decode(t_dev)
+            q_rows.append(q_dev)
+            for i in live:
+                if t >= gap[i]:
+                    props[i].append(int(sampled[i]))
+            self._stats.inc("spec_draft_steps", len(live))
+        # the sampler returned advanced keys on device; pull them
+        # back (np.array: asarray of a device buffer is read-only)
+        # so admissions between spec ticks stay numpy-only
+        st.dkeys = np.array(st.dkeys, np.uint32)
+        for i in dec:
+            st.dlen[i] += steps[i]   # draft frontier = L + win[i]
+        if not q_rows:
+            return props, jnp.zeros(
+                (n, K, draft._spec["vocab_size"]), jnp.float32)
+        # device-side gather: slot i's K proposal distributions are
+        # micro-steps gap[i]..gap[i]+win[i]-1 (rows past win[i] are
+        # clamped garbage the verify's per-slot `valid` masks off)
+        qs = jnp.stack(q_rows, axis=1)          # (n, S, vocab)
+        g = np.zeros((n,), np.int32)
+        for i in dec:
+            g[i] = gap[i]
+        idx = np.minimum(
+            g[:, None] + np.arange(K, dtype=np.int32)[None, :],
+            len(q_rows) - 1)
+        return props, qs[np.arange(n)[:, None], idx]
+
+    def _paged_spec_step(self, model, st, dec):
+        """One speculative decode tick: the draft proposes up to
+        ``spec_k`` tokens per generating slot, the target verifies all
+        K+1 positions in ONE dispatch with the accept/reject rule
+        in-graph, and each slot emits 1..K+1 tokens.  Rejected
+        proposals roll back by table arithmetic alone — ``lengths``
+        just doesn't advance past the emitted count, and pool rows
+        beyond the frontier are junk the paged kernels never read
+        (rewritten by later steps; no pool copies)."""
+        K = st.spec_k
+        win = {}
+        for i in dec:
+            r = st.slots[i]
+            # never propose past the request's budget: the verify step
+            # emits at most remaining tokens (window + bonus)
+            win[i] = max(0, min(K, r.max_tokens - len(r.tokens) - 1))
+            L = int(st.lengths[i])
+            # the verify writes positions L..L+W: COW-fork or allocate
+            # first (the draft micro-steps write the same blocks)
+            self._paged_write_ready(st, i,
+                                    list(range(L, L + win[i] + 1)))
+        n = len(st.slots)
+        try:
+            with _tracing.activate_many(
+                    [(st.slots[i].trace, st.slots[i].trace_parent)
+                     for i in dec]):
+                props, prop_q = self._spec_propose(st, dec, win)
+                tables = np.zeros((n, st.tb), np.int32)
+                vtoks = np.zeros((n, K + 1), np.int32)
+                pos = np.zeros((n,), np.int32)
+                val = np.ones((n,), np.int32)
+                do = np.zeros((n,), bool)
+                for i in dec:
+                    tables[i] = st.tables[i]
+                    vtoks[i, 0] = st.next_tok[i]
+                    for j, tok in enumerate(props[i]):
+                        vtoks[i, 1 + j] = tok
+                    pos[i] = st.lengths[i]
+                    val[i] = win[i] + 1
+                    do[i] = True
+                t0 = time.perf_counter_ns()
+                out = st.store.run_paged_verify(
+                    st.pool_k, st.pool_v, tables, vtoks, pos, val,
+                    prop_q, st.keys, st.temps, st.top_ks, do,
+                    scales=st.scales)
+                if st.scales is None:
+                    out_dev, ne_dev, st.pool_k, st.pool_v, \
+                        st.keys = out
+                else:
+                    (out_dev, ne_dev, st.pool_k, st.pool_v, sk, sv,
+                     st.keys) = out
+                    st.scales = (sk, sv)
+                _profiler.record_phase("serve_decode", t0)
+                t0 = time.perf_counter_ns()
+                out_toks = self._fetch_decode(out_dev)
+                n_emit = self._fetch_decode(ne_dev)
+                _profiler.record_phase("serve_sample", t0)
+        except BaseException as e:  # noqa: BLE001 — to the futures
+            exc = e if isinstance(e, MXNetError) \
+                else MXNetError("speculative dispatch failed: %r"
+                                % (e,))
+            _tracing.flight().record(
+                "error", "spec_dispatch_failed", model=model,
+                error=repr(e), slots=len(dec))
+            for i in dec:
+                r = st.slots[i]
+                self._release_paged_slot(st, i)
+                self._fail_request(r, exc, running=True)
+            return
+        emitted = 0
+        proposed = 0
+        accepted = 0
+        for i in dec:
+            r = st.slots[i]
+            ne = int(n_emit[i])
+            proposed += win[i]
+            accepted += ne - 1
+            if _metrics.phase_on():
+                _H_SPEC.observe(ne)
+            for j in range(ne):
+                tok = int(out_toks[i, j])
+                self._push_token(r, tok)
+                st.lengths[i] += 1
+                emitted += 1
+                st.next_tok[i] = tok
+                reason = self._finished_reason(r, tok)
+                if reason:
+                    # mid-window EOS: the remaining accepted tokens
+                    # are discarded with the slot
+                    self._release_paged_slot(st, i)
+                    self._finish(r, reason)
+                    break
+            else:
+                # draft KV is valid only while its tokens match the
+                # accepted stream: clamp to the new frontier after a
+                # rejection (full accept leaves a 1-token catch-up gap
+                # for the bonus token)
+                st.dlen[i] = min(int(st.dlen[i]), int(st.lengths[i]))
+        self._stats.inc("decode_steps")
+        self._stats.inc("spec_steps")
+        self._stats.inc("spec_proposed", proposed)
+        self._stats.inc("spec_accepted", accepted)
+        self._stats.inc("generated_tokens", emitted)
+        if proposed:
+            st.spec_ema = (_SPEC_EMA_DECAY * st.spec_ema +
+                           (1.0 - _SPEC_EMA_DECAY) *
+                           (accepted / proposed))
+        _metrics.cached_counter(
+            "serve_spec_proposed_total",
+            help="draft tokens offered to speculative verify").inc(
+                proposed)
+        _metrics.cached_counter(
+            "serve_spec_accept_total",
+            help="draft tokens accepted by speculative verify").inc(
+                accepted)
+
     def _paged_prefill_chunk(self, model, st, pre):
         """Advance every prefilling slot one prompt chunk
         (serve_prefill phase).  Rows finishing their prompt this
@@ -1303,6 +1769,24 @@ class GenerationEngine:
                      for _i, r, _p, _n in rows]):
                 sampled = self._paged_dispatch(
                     st, tables, toks, pos, val, do, "serve_prefill")
+                if st.draft is not None and st.spec_mirror():
+                    # mirror the chunk into the draft's KV plane
+                    # (logits unfetched, discarded): same tables, same
+                    # tokens — the draft pool ends bit-deterministic
+                    # with the prompt, so prefix-shared blocks are
+                    # valid draft KV for every adopter.  While the
+                    # auto-mode fallback has speculation suspended the
+                    # mirror is skipped (zero draft cost per tick); a
+                    # probe's catch-up rebuilds the draft KV from the
+                    # prompt instead
+                    dout = st.draft.run_paged_step(
+                        st.dpool_k, st.dpool_v, tables, toks, pos,
+                        val, scales=st.dscales)
+                    if st.dscales is None:
+                        _, st.dpool_k, st.dpool_v = dout
+                    else:
+                        _, st.dpool_k, st.dpool_v, dsk, dsv = dout
+                        st.dscales = (dsk, dsv)
         except BaseException as e:  # noqa: BLE001 — to the futures
             exc = e if isinstance(e, MXNetError) \
                 else MXNetError("prefill dispatch failed: %r" % (e,))
@@ -1318,6 +1802,8 @@ class GenerationEngine:
         for i, r, p0, ntok in rows:
             st.prog[i] = p0 + ntok
             st.lengths[i] = p0 + ntok
+            if st.draft is not None and st.spec_mirror():
+                st.dlen[i] = p0 + ntok
             st.chunks_done[i] += 1
             if p0 + ntok < len(r.prompt):
                 continue

@@ -381,18 +381,47 @@ class HttpFrontDoor:
     thread waits on a future with no client deadline.  ``auth_token``
     (default ``MXNET_SERVE_AUTH_TOKEN``; empty = open) requires
     ``Authorization: Bearer <token>`` on every route except
-    ``/healthz`` and ``/metrics``."""
+    ``/healthz`` and ``/metrics``.  ``tls_cert`` / ``tls_key``
+    (defaults ``MXNET_SERVE_TLS_CERT`` / ``MXNET_SERVE_TLS_KEY``) wrap
+    the listening socket in TLS — both PEM paths or neither (one
+    without the other is a config error, not silent plaintext);
+    ``.url`` reports the scheme."""
 
     def __init__(self, target, host="127.0.0.1", port=0, gen_target=None,
-                 max_wait=300.0, auth_token=None):
+                 max_wait=300.0, auth_token=None, tls_cert=None,
+                 tls_key=None):
         self.target = target
         self._gen_target = gen_target
         if auth_token is None:
             auth_token = get_env("MXNET_SERVE_AUTH_TOKEN") or None
         self.auth_token = auth_token or None
+        if tls_cert is None:
+            tls_cert = get_env("MXNET_SERVE_TLS_CERT") or None
+        if tls_key is None:
+            tls_key = get_env("MXNET_SERVE_TLS_KEY") or None
+        if bool(tls_cert) != bool(tls_key):
+            raise MXNetError(
+                "TLS needs BOTH a certificate and a key (set "
+                "MXNET_SERVE_TLS_CERT and MXNET_SERVE_TLS_KEY "
+                "together); refusing a half-configured endpoint")
+        self.tls = bool(tls_cert)
         self._max_wait = float(max_wait)
         self._server = _Server((host, int(port)), _Handler)
         self._server.frontdoor = self
+        if self.tls:
+            import ssl
+            try:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(tls_cert, tls_key)
+                self._server.socket = ctx.wrap_socket(
+                    self._server.socket, server_side=True)
+            except MXNetError:
+                raise
+            except Exception as e:
+                self._server.server_close()
+                raise MXNetError("failed to arm TLS on the front "
+                                 "door: %s: %s"
+                                 % (type(e).__name__, e)) from e
         # /stats snapshot cache: one stats-tree walk per
         # MXNET_SERVE_STATS_TTL_MS window no matter how many pollers
         # (replies carry age_ms); /healthz's model listing shares it
@@ -413,7 +442,8 @@ class HttpFrontDoor:
 
     @property
     def url(self):
-        return "http://%s:%d" % self.address
+        return "%s://%s:%d" % ((("https",) if self.tls else ("http",))
+                               + self.address)
 
     def healthy(self):
         alive = getattr(self.target, "alive", None)
@@ -542,14 +572,25 @@ class HttpClient:
     classes, so the loadgen's timeout/error classification is
     transport-invariant.  ``auth_token`` (default
     ``MXNET_SERVE_AUTH_TOKEN``) rides every request as a bearer
-    credential."""
+    credential.  ``tls`` turns the connections into TLS (inferred from
+    an ``https://`` address string, e.g. a TLS front door's ``.url``);
+    ``tls_verify`` (default ``MXNET_SERVE_TLS_VERIFY``) is ``"1"`` for
+    the system trust store, ``"0"`` to skip verification, or a PEM
+    path pinning the accepted CA/certificate (how a client trusts a
+    self-signed front door without disabling verification)."""
 
     def __init__(self, address, threads=8, connect_timeout=120.0,
-                 auth_token=None):
+                 auth_token=None, tls=None, tls_verify=None):
         if isinstance(address, str):
+            if tls is None and address.startswith("https://"):
+                tls = True
             host, port = address.rsplit(":", 1)
-            address = (host.replace("http://", "").strip("/"), int(port))
+            address = (host.replace("https://", "")
+                       .replace("http://", "").strip("/"), int(port))
         self._addr = (address[0], int(address[1]))
+        self._tls = bool(tls)
+        self._ssl_ctx = self._tls_context(tls_verify) if self._tls \
+            else None
         if auth_token is None:
             auth_token = get_env("MXNET_SERVE_AUTH_TOKEN") or None
         self._auth_token = auth_token or None
@@ -679,6 +720,41 @@ class HttpClient:
         self.close()
 
     # -- worker pool ---------------------------------------------------
+    @staticmethod
+    def _tls_context(verify):
+        """Client-side SSL context from the verify knob: ``"1"`` =
+        system trust store, ``"0"`` = no verification (lab use),
+        anything else = a PEM path pinning the accepted certificate
+        chain (the self-signed deployment's knob)."""
+        import ssl
+        if verify is None:
+            verify = get_env("MXNET_SERVE_TLS_VERIFY")
+        verify = str(verify if verify is not None else "1") or "1"
+        if verify == "0":
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+        if verify == "1":
+            return ssl.create_default_context()
+        try:
+            return ssl.create_default_context(cafile=verify)
+        except Exception as e:
+            raise MXNetError(
+                "MXNET_SERVE_TLS_VERIFY=%r is neither 0/1 nor a "
+                "readable PEM: %s: %s"
+                % (verify, type(e).__name__, e)) from e
+
+    def _connect(self):
+        """One fresh connection honoring the TLS mode."""
+        import http.client
+        if self._tls:
+            return http.client.HTTPSConnection(
+                *self._addr, timeout=self._timeout,
+                context=self._ssl_ctx)
+        return http.client.HTTPConnection(*self._addr,
+                                          timeout=self._timeout)
+
     def _enqueue(self, method, path, body, headers, parse,
                  retryable=True):
         if self._auth_token and "Authorization" not in headers:
@@ -740,8 +816,7 @@ class HttpClient:
                 if retryable:
                     for attempt in (0, 1):
                         if conn is None:
-                            conn = http.client.HTTPConnection(
-                                *self._addr, timeout=self._timeout)
+                            conn = self._connect()
                         try:
                             conn.request(method, path, body=body,
                                          headers=headers)
@@ -761,8 +836,7 @@ class HttpClient:
                     # FRESH connection — no stale-keepalive failure
                     # mode, and never a retransmit the server might
                     # have already admitted
-                    c2 = http.client.HTTPConnection(
-                        *self._addr, timeout=self._timeout)
+                    c2 = self._connect()
                     try:
                         c2.request(method, path, body=body,
                                    headers=headers)

@@ -36,8 +36,8 @@ from ..base import MXNetError
 
 __all__ = ["OpenLoopSchedule", "run_loadgen", "latency_protocol",
            "run_gen_loadgen", "generation_protocol",
-           "paged_generation_protocol", "frontdoor_protocol",
-           "failover_protocol", "swap_protocol",
+           "paged_generation_protocol", "spec_generation_protocol",
+           "frontdoor_protocol", "failover_protocol", "swap_protocol",
            "observability_protocol", "autoscale_protocol",
            "rolling_swap_protocol", "chaos_protocol"]
 
@@ -970,6 +970,234 @@ def paged_generation_protocol(smoke=False, seed=29, offered_mult=3.0):
                   mixed_unchunked["itl_p99_ms"], 4)
             if mixed_chunked["itl_p99_ms"] and
             mixed_unchunked["itl_p99_ms"] else None),
+    }
+
+
+def spec_generation_protocol(smoke=False, seed=31, offered_mult=3.0):
+    """The speculative-decoding bench protocol (CPU-deterministic):
+    draft-assisted decode vs the plain paged engine, same weights,
+    same seeded open-loop schedule.
+
+    Sides (each engine serves a warm pass first, on the same engine —
+    the adversarial side's acceptance EMA deliberately collapses
+    during warm-up so the measured run sees the steady fallback
+    regime):
+
+    1. **base / base_sampled** — the non-speculative paged plane,
+       greedy and seeded-sampling; the denominators.
+    2. **spec_greedy / spec_sampled** — a DRAFT-FRIENDLY draft (the
+       target's weights plus 3% relative noise — high but non-trivial
+       acceptance, both accept and reject paths exercised) attached
+       via ``add_draft_model``: ``steps_per_token_vs_base`` is the
+       headline acceptance (target program calls per emitted token
+       <= 0.6x), with the acceptance rate reported alongside.
+    3. **spec_adversarial** — an INDEPENDENT random draft that never
+       agrees with the target: acceptance collapses, the
+       ``MXNET_SERVE_SPEC=auto`` fallback engages, and
+       ``tokens_per_sec_vs_base`` is the graceful-degradation
+       acceptance (>= 0.95x — speculation must never fall off a
+       cliff).
+    4. **paged_int8** — the int8 KV pool (codes + per-(block, head)
+       scale pools) on the plain paged engine:
+       ``pool_bytes_per_token_vs_fp32`` (<= 0.3x) from
+       ``stats()['cache_state']`` plus its own throughput ratio."""
+    from ..models.transformer_lm import lm_spec, random_params
+    from .decode_engine import GenerationEngine
+    from .registry import ModelRegistry
+
+    spec = lm_spec(num_layers=2, num_hidden=64, num_heads=4,
+                   vocab_size=128)
+    params = random_params(spec, seed=seed)
+    # draft-friendly draft: the target's weights + 3% relative noise
+    # (random weights share no structure, so an independent draft
+    # can't agree with the target — the perturbed twin is the
+    # deterministic CPU stand-in for a distilled draft)
+    rs_d = np.random.RandomState(seed + 7)
+    friendly = {
+        k: v + np.asarray(0.03 * (float(np.std(v)) or 1.0) *
+                          rs_d.standard_normal(v.shape), v.dtype)
+        for k, v in params.items()}
+    adv_spec = lm_spec(num_layers=1, num_hidden=32, num_heads=2,
+                       vocab_size=128)
+    adv_params = random_params(adv_spec, seed=seed + 9)
+    batch_buckets = (8,)
+    kv_block = 16
+    spec_k = 4
+    cfg = dict(prompt_buckets=(8,), kv_max=64, prefill_chunk=8)
+    # full-mode windows must be seconds, not fractions of one: the
+    # adversarial acceptance is a tokens/sec RATIO on the same host,
+    # and sub-second measured windows put +/-15% host noise on it
+    n_load = 16 if smoke else 96
+    rs = np.random.RandomState(seed + 1)
+    prompts = [list(rs.randint(0, 128, rs.randint(4, 9)))
+               for _ in range(2 * n_load)]
+
+    def build_side(draft, temperature, kv_dtype="float32"):
+        """Construct, prime and warm one engine; measurement is a
+        separate step so sides can interleave measured passes."""
+        reg = ModelRegistry()
+        reg.add_generative_model(
+            "m", params, spec, batch_buckets=batch_buckets,
+            kv_block=kv_block, warmup_kv_depth=cfg["kv_max"],
+            paged=True, sample="graph", kv_dtype=kv_dtype, **cfg)
+        if draft == "friendly":
+            reg.add_draft_model("m", friendly, spec, spec_k=spec_k)
+        elif draft == "adversarial":
+            reg.add_draft_model("m", adv_params, adv_spec,
+                                spec_k=spec_k)
+        engine = GenerationEngine(reg)
+
+        def mk_submit(off):
+            def submit(i, mt_):
+                return engine.submit(
+                    "m", prompts[(i + off) % len(prompts)],
+                    max_tokens=mt_, temperature=temperature,
+                    top_k=(8 if temperature else 0), seed=1000 + i)
+            return submit
+
+        for f in [engine.submit("m", prompts[(i + n_load)
+                                             % len(prompts)],
+                                max_tokens=4,
+                                temperature=temperature)
+                  for i in range(batch_buckets[-1])]:
+            f.result(120)
+        run_gen_loadgen(mk_submit(n_load), warm_schedule)
+        return engine, mk_submit
+
+    def measure(engine, mk_submit):
+        """One measured pass with per-pass counter deltas."""
+        before = engine.stats()
+        cand = run_gen_loadgen(mk_submit(0), schedule)
+        stats = engine.stats()
+        cand["counters"] = {
+            k: stats.get(k, 0) - before.get(k, 0)
+            for k in ("decode_steps", "generated_tokens",
+                      "spec_steps", "spec_proposed",
+                      "spec_accepted", "spec_draft_steps",
+                      "spec_fallback_steps")}
+        cand["cache_state"] = stats["cache_state"].get("m", {})
+        cand["model"] = stats["models"].get("m", {})
+        return cand
+
+    def best(cand, side):
+        return cand if side is None or cand["tokens_per_sec"] > \
+            side["tokens_per_sec"] else side
+
+    def finish(side):
+        c = side["counters"]
+        side["steps_per_token"] = (
+            round(c["decode_steps"] / c["generated_tokens"], 4)
+            if c["generated_tokens"] else None)
+        side["acceptance_rate"] = (
+            round(c["spec_accepted"] / c["spec_proposed"], 4)
+            if c["spec_proposed"] else None)
+        return side
+
+    def run_side(draft, temperature, kv_dtype="float32"):
+        # best-of-2 measured passes: the banked acceptance is a
+        # tokens/sec RATIO between sides, and a single sub-second
+        # makespan carries +/-10% host noise — take each side's
+        # best pass so the ratio reads engine capacity, not which
+        # side drew the noisier window (counters are per-pass
+        # deltas, so the kept evidence matches the kept pass)
+        engine, mk_submit = build_side(draft, temperature, kv_dtype)
+        try:
+            side = None
+            for _ in range(2):
+                side = best(measure(engine, mk_submit), side)
+        finally:
+            engine.close()
+        return finish(side)
+
+    # pacing anchor: closed-loop per-request capacity of the plain
+    # paged plane (every side queues equally past it)
+    reg = ModelRegistry()
+    reg.add_generative_model(
+        "m", params, spec, batch_buckets=batch_buckets,
+        kv_block=kv_block, warmup_kv_depth=cfg["kv_max"], paged=True,
+        sample="graph", **cfg)
+    anchor = GenerationEngine(reg)
+    try:
+        anchor.submit("m", prompts[0], max_tokens=4).result(120)
+        n_closed = 4 if smoke else 8
+        tic = time.perf_counter()
+        for i in range(n_closed):
+            anchor.submit("m", prompts[i % len(prompts)],
+                          max_tokens=12).result(120)
+        closed_rps = n_closed / (time.perf_counter() - tic)
+    finally:
+        anchor.close()
+    offered = closed_rps * float(offered_mult)
+    schedule = OpenLoopSchedule(seed, n_load, offered,
+                                gen_tokens=(12, 24))
+    warm_schedule = OpenLoopSchedule(seed + 101, max(8, n_load // 3),
+                                     offered, gen_tokens=(12, 24))
+
+    # base and adversarial INTERLEAVE their measured passes (both
+    # engines warm, alternating A/B pairs ~1s apart): the graceful-
+    # degradation acceptance is a ratio of two sub-second makespans,
+    # and running the sides in separate time windows (tens of
+    # seconds apart, as the other sides do) lets host drift land on
+    # one side only — single-pass spread on this host is +/-30%,
+    # far above the 5% the gate has to resolve.  An idle engine
+    # parks its loop thread on an empty queue, so the bystander
+    # side costs the measured one nothing.
+    base_engine, base_mk = build_side(None, 0.0)
+    try:
+        adv_engine, adv_mk = build_side("adversarial", 0.0)
+        try:
+            base = spec_adv = None
+            for _ in range(2 if smoke else 3):
+                base = best(measure(base_engine, base_mk), base)
+                spec_adv = best(measure(adv_engine, adv_mk),
+                                spec_adv)
+        finally:
+            adv_engine.close()
+    finally:
+        base_engine.close()
+    base = finish(base)
+    spec_adv = finish(spec_adv)
+    spec_greedy = run_side("friendly", 0.0)
+    base_sampled = run_side(None, 0.7)
+    spec_sampled = run_side("friendly", 0.7)
+    paged_int8 = run_side(None, 0.0, kv_dtype="int8")
+
+    def ratio(a, b, digits=4):
+        return round(a / b, digits) if a is not None and b else None
+
+    return {
+        "seed": seed,
+        "spec": spec,
+        "draft_spec": adv_spec,
+        "spec_k": spec_k,
+        "kv_block": kv_block,
+        "kv_max": cfg["kv_max"],
+        "batch_buckets": list(batch_buckets),
+        "closed_rps": round(closed_rps, 3),
+        "offered_mult": float(offered_mult),
+        "base": base,
+        "base_sampled": base_sampled,
+        "spec_greedy": spec_greedy,
+        "spec_sampled": spec_sampled,
+        "spec_adversarial": spec_adv,
+        "paged_int8": paged_int8,
+        "steps_per_token_vs_base_greedy": ratio(
+            spec_greedy["steps_per_token"], base["steps_per_token"]),
+        "steps_per_token_vs_base_sampled": ratio(
+            spec_sampled["steps_per_token"],
+            base_sampled["steps_per_token"]),
+        "tokens_per_sec_vs_base_greedy": ratio(
+            spec_greedy["tokens_per_sec"], base["tokens_per_sec"], 3),
+        "tokens_per_sec_vs_base_sampled": ratio(
+            spec_sampled["tokens_per_sec"],
+            base_sampled["tokens_per_sec"], 3),
+        "tokens_per_sec_vs_base_adversarial": ratio(
+            spec_adv["tokens_per_sec"], base["tokens_per_sec"], 3),
+        "tokens_per_sec_vs_base_int8": ratio(
+            paged_int8["tokens_per_sec"], base["tokens_per_sec"], 3),
+        "pool_bytes_per_token_vs_fp32": ratio(
+            paged_int8["cache_state"].get("pool_bytes_per_token"),
+            base["cache_state"].get("pool_bytes_per_token")),
     }
 
 

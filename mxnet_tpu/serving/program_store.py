@@ -57,7 +57,8 @@ def _cache_event(event):
 from ..pallas_ops.dequant_matmul import QuantizedWeight, quantize_int8
 
 __all__ = ["ProgramStore", "GenerativeProgramStore", "bucket_edges",
-           "bucket_for", "sample_tokens", "host_sample"]
+           "bucket_for", "sample_tokens", "sample_tokens_p",
+           "spec_verify", "host_sample"]
 
 log = logging.getLogger(__name__)
 
@@ -165,6 +166,122 @@ def sample_tokens(logits, keys, temps, top_ks):
 # (jax re-specializes per logits shape; the decode engine calls it on
 # the fetched (slots, vocab) matrix)
 host_sample = jax.jit(sample_tokens)
+
+
+def _masked_dist(logits, temps, top_ks):
+    """The categorical distribution :func:`sample_tokens` draws from,
+    as explicit probabilities over ``(S, V)`` rows: temperature + top-k
+    masked softmax (``jax.random.categorical`` over masked ``z`` IS
+    ``softmax(z)``); greedy rows (``temps <= 0``) are one-hot at the
+    argmax.  The speculative plane's shared density: the draft's
+    proposal distribution q and the target's acceptance distribution p
+    both come from THIS function on their respective logits, so the
+    rejection rule compares exactly the densities the two samplers
+    use."""
+    logits = jnp.asarray(logits, jnp.float32)
+    n_vocab = logits.shape[-1]
+    temps = jnp.asarray(temps, jnp.float32)
+    top_ks = jnp.asarray(top_ks, jnp.int32)
+    z = logits / jnp.maximum(temps, 1e-6)[:, None]
+    k = jnp.clip(jnp.where(top_ks <= 0, n_vocab, top_ks), 1, n_vocab)
+    kth = jnp.take_along_axis(-jnp.sort(-z, axis=-1),
+                              (k - 1)[:, None], axis=-1)
+    z = jnp.where(z >= kth, z, -jnp.inf)
+    probs = jax.nn.softmax(z, axis=-1)
+    onehot = jax.nn.one_hot(jnp.argmax(logits, axis=-1), n_vocab,
+                            dtype=jnp.float32)
+    return jnp.where((temps <= 0.0)[:, None], onehot, probs)
+
+
+def sample_tokens_p(logits, keys, temps, top_ks):
+    """:func:`sample_tokens` that ALSO returns the per-slot proposal
+    distribution ``q (S, V)`` the token was drawn from — the draft
+    model's sampling step in speculative decoding (the verify program
+    needs q(d) for the acceptance test ``u * q(d) <= p(d)``).  Returns
+    ``(tokens, new_keys, q)``; token/key behavior is byte-identical to
+    :func:`sample_tokens`."""
+    toks, carry = sample_tokens(logits, keys, temps, top_ks)
+    return toks, carry, _masked_dist(logits, temps, top_ks)
+
+
+def spec_verify(logits_all, prop_toks, prop_q, keys, temps, top_ks,
+                valid):
+    """In-graph speculative accept/reject (standard rejection-sampling
+    rule) over one verify step's logits.
+
+    logits_all: (B, K+1, V) fp32 — the target's logits at the K+1
+    verified positions (row j conditions on the prompt + the first j
+    draft tokens); prop_toks: (B, K) int32 draft proposals; prop_q:
+    (B, K, V) fp32 — the draft's proposal distribution for each
+    proposal (:func:`sample_tokens_p`); keys: (B, 2) uint32 per-slot
+    threefry chains; valid: (B,) int32 — row b verifies
+    ``valid[b] - 1`` proposals (``1 <= valid <= K+1``; a row's window
+    shrinks near its token budget).
+
+    Per slot: greedy rows (``temps <= 0``) accept the longest prefix of
+    proposals matching the target argmax and emit the target argmax at
+    the first mismatch — byte-identical to non-speculative greedy
+    decoding.  Sampled rows draw one uniform per position off the
+    slot's key chain and accept proposal j iff ``u_j * q_j(d_j) <=
+    p_j(d_j)``; the first rejection resamples from the corrected
+    residual ``max(p - q, 0)`` (renormalized; p itself when the
+    residual vanishes, i.e. q covers p), and a fully-accepted window
+    draws the bonus token directly from p — the classic proof gives
+    token streams DISTRIBUTION-identical to sampling from p alone.
+
+    Returns ``(out_toks (B, K+1) int32, n_emit (B,) int32, new_keys
+    (B, 2))``: row b emits ``out_toks[b, :n_emit[b]]`` (accepted
+    proposals + the corrected/bonus token), ``1 <= n_emit <= valid``."""
+    logits_all = jnp.asarray(logits_all, jnp.float32)
+    B, K1, V = logits_all.shape
+    K = K1 - 1
+    prop_toks = jnp.asarray(prop_toks, jnp.int32)
+    prop_q = jnp.asarray(prop_q, jnp.float32)
+    keys = jnp.asarray(keys, jnp.uint32)
+    temps = jnp.asarray(temps, jnp.float32)
+    top_ks = jnp.asarray(top_ks, jnp.int32)
+    valid = jnp.asarray(valid, jnp.int32)
+    # per-slot chain: carry + K accept draws + 1 resample draw (one
+    # split per verify keeps the chain counter-based like sample_tokens)
+    allk = jax.vmap(lambda kk: jax.random.split(kk, K + 2))(keys)
+    carry, res_keys = allk[:, 0], allk[:, K + 1]
+    p_full = _masked_dist(
+        logits_all.reshape(B * K1, V), jnp.repeat(temps, K1),
+        jnp.repeat(top_ks, K1)).reshape(B, K1, V)
+    greedy_all = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+    rows = jnp.arange(B)
+    if K:
+        acc_keys = allk[:, 1:K + 1].reshape(B * K, 2)
+        u = jax.vmap(jax.random.uniform)(acc_keys).reshape(B, K)
+        pd = jnp.take_along_axis(p_full[:, :K], prop_toks[..., None],
+                                 -1)[..., 0]
+        qd = jnp.take_along_axis(prop_q, prop_toks[..., None],
+                                 -1)[..., 0]
+        acc = jnp.where((temps <= 0.0)[:, None],
+                        prop_toks == greedy_all[:, :K],
+                        u * qd <= pd)
+        acc = acc & (jnp.arange(K, dtype=jnp.int32)[None, :] + 1 <
+                     valid[:, None])
+        a = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+    else:  # pragma: no cover - K=0 degenerates to a plain sample
+        a = jnp.zeros((B,), jnp.int32)
+    p_a = p_full[rows, a]                                   # (B, V)
+    q_ext = jnp.concatenate(
+        [prop_q, jnp.zeros((B, 1, V), jnp.float32)], axis=1)
+    # the bonus position (full accept, a == valid-1) has no proposal:
+    # its residual is p itself
+    q_a = jnp.where((a >= valid - 1)[:, None], 0.0, q_ext[rows, a])
+    res = jnp.maximum(p_a - q_a, 0.0)
+    tot = jnp.sum(res, axis=-1, keepdims=True)
+    res = jnp.where(tot > 0.0, res / jnp.where(tot > 0.0, tot, 1.0),
+                    p_a)
+    sampled = jax.vmap(jax.random.categorical)(
+        res_keys, jnp.log(jnp.maximum(res, 1e-30))).astype(jnp.int32)
+    corrected = jnp.where(temps <= 0.0, greedy_all[rows, a], sampled)
+    out = jnp.concatenate([prop_toks, jnp.zeros((B, 1), jnp.int32)],
+                          axis=1)
+    out = out.at[rows, a].set(corrected)
+    return out, (a + 1).astype(jnp.int32), carry
 
 
 class _Program:
@@ -816,9 +933,14 @@ class GenerativeProgramStore:
             self._compute = c
         kv = str(kv_dtype if kv_dtype is not None
                  else get_env("MXNET_SERVE_KV_DTYPE") or "float32")
-        if kv not in ("float32", "bfloat16"):
-            raise MXNetError("kv_dtype must be 'float32' or 'bfloat16', "
-                             "got %r" % kv)
+        if kv not in ("float32", "bfloat16", "int8"):
+            raise MXNetError("kv_dtype must be 'float32', 'bfloat16' or "
+                             "'int8', got %r" % kv)
+        # int8 KV: pool blocks hold int8 codes with per-(layer, head,
+        # block) fp32 absmax scales riding as a parallel donated scale
+        # pool — a paged-plane feature (the contiguous plane has no
+        # block granularity to hang the scales on)
+        self.kv_int8 = kv == "int8"
         self.kv_dtype = jnp.dtype(kv)
         sm = str(sample if sample is not None
                  else get_env("MXNET_SERVE_SAMPLE") or "graph").lower()
@@ -847,6 +969,11 @@ class GenerativeProgramStore:
         # (or paged=False) keeps the contiguous per-slot plane.
         self.paged = bool(int(get_env("MXNET_SERVE_PAGED"))
                           if paged is None else paged)
+        if self.kv_int8 and not self.paged:
+            raise MXNetError(
+                "kv_dtype='int8' needs the paged KV plane (the scales "
+                "are per pool block); set MXNET_SERVE_PAGED=1 or use "
+                "'float32'/'bfloat16' on the contiguous plane")
         chunk = int(prefill_chunk if prefill_chunk is not None
                     else get_env("MXNET_SERVE_PREFILL_CHUNK"))
         if chunk < 1:
@@ -867,6 +994,7 @@ class GenerativeProgramStore:
                 % (nb, self.table_width()))
         self.pool_blocks = nb
         self._copy_fn = None   # lazily jitted COW block copy
+        self._copy_fn8 = None  # its int8 codes+scales twin
 
         missing = [k for k in self._required_params() if k not in params]
         if missing:
@@ -1092,14 +1220,52 @@ class GenerativeProgramStore:
             v = jax.device_put(v, self._device)
         return k, v
 
-    def copy_block(self, pool_k, pool_v, src, dst):
+    def new_scale_pool(self):
+        """Per-(layer, head, physical block) fp32 absmax scale pools
+        for the int8 paged plane — a ``(num_layers, num_heads,
+        pool_blocks)`` pair of ones riding beside :meth:`new_pool`'s
+        int8 code pools as donated program state."""
+        from ..models.transformer_lm import init_scale_pool
+        sk, sv = init_scale_pool(self._spec, self.pool_blocks)
+        if self._device is not None:
+            sk = jax.device_put(sk, self._device)
+            sv = jax.device_put(sv, self._device)
+        return sk, sv
+
+    def copy_block(self, pool_k, pool_v, src, dst, scales=None):
         """Copy-on-write fork: duplicate physical block ``src``'s rows
         into block ``dst`` in both pools (one jitted program, pools
-        donated off-CPU — callers rebind to the outputs)."""
+        donated off-CPU — callers rebind to the outputs).  With
+        ``scales`` (the int8 plane's ``(scale_k, scale_v)`` pools) the
+        per-block scales fork WITH the codes — a block is only
+        decodable as codes+scale together — and the return grows to
+        ``(pool_k, pool_v, scale_k, scale_v)``."""
+        bs = self.kv_block
+        if scales is not None:
+            fn = getattr(self, "_copy_fn8", None)
+            if fn is None:
+                def f8(pk, pv, sk, sv, s, d):
+                    bk = jax.lax.dynamic_slice_in_dim(pk, s * bs, bs, 2)
+                    bv = jax.lax.dynamic_slice_in_dim(pv, s * bs, bs, 2)
+                    pk = jax.lax.dynamic_update_slice_in_dim(pk, bk,
+                                                             d * bs, 2)
+                    pv = jax.lax.dynamic_update_slice_in_dim(pv, bv,
+                                                             d * bs, 2)
+                    ssk = jax.lax.dynamic_slice_in_dim(sk, s, 1, 2)
+                    ssv = jax.lax.dynamic_slice_in_dim(sv, s, 1, 2)
+                    sk = jax.lax.dynamic_update_slice_in_dim(sk, ssk,
+                                                             d, 2)
+                    sv = jax.lax.dynamic_update_slice_in_dim(sv, ssv,
+                                                             d, 2)
+                    return pk, pv, sk, sv
+
+                fn = self._copy_fn8 = jax.jit(
+                    f8, donate_argnums=cache_donate_argnums((0, 1, 2,
+                                                             3)))
+            return fn(pool_k, pool_v, scales[0], scales[1],
+                      np.int32(src), np.int32(dst))
         fn = self._copy_fn
         if fn is None:
-            bs = self.kv_block
-
             def f(pk, pv, s, d):
                 bk = jax.lax.dynamic_slice_in_dim(pk, s * bs, bs, 2)
                 bv = jax.lax.dynamic_slice_in_dim(pv, s * bs, bs, 2)
@@ -1139,6 +1305,11 @@ class GenerativeProgramStore:
                  self.pool_blocks * self.kv_block, dh)
         return self._sds(shape, self.kv_dtype)
 
+    def _scale_spec(self):
+        s = self._spec
+        return self._sds((s["num_layers"], s["num_heads"],
+                          self.pool_blocks), jnp.float32)
+
     def _key(self, kind, bb, lb):
         # (kind, batch bucket, length bucket) + the serving dtypes +
         # the dispatch fingerprint (prefill/decode trace through
@@ -1157,56 +1328,126 @@ class GenerativeProgramStore:
         tic = time.perf_counter()
         spec = self._spec
         kv = self.kv_dtype
-        if kind in ("paged_step", "paged_step_sample"):
+        if kind in ("paged_step", "paged_step_sample",
+                    "paged_step_sample_p", "paged_verify"):
             # ONE unified step program for the paged plane: lb is the
             # query length lq (1 = a decode step; prefill_chunk = one
-            # prompt chunk).  Scatter-then-attend over the global pool
-            # through (bb, table_width) block tables; rows not
-            # participating in a dispatch ride with all-zero tables
-            # (writes land in the reserved trash block 0) and their
-            # outputs are discarded host-side.
+            # prompt chunk; spec_k+1 = a speculative verify).  Scatter-
+            # then-attend over the global pool through (bb, table_width)
+            # block tables; rows not participating in a dispatch ride
+            # with all-zero tables (writes land in the reserved trash
+            # block 0) and their outputs are discarded host-side.  On
+            # the int8 plane every kind gains the two donated scale
+            # pools right after the code pools, in arguments AND
+            # returns.
             bs = self.kv_block
             tb = self.table_width()
-            base = (self._param_spec(), self._pool_spec(),
-                    self._pool_spec(),
-                    self._sds((bb, tb), jnp.int32),
-                    self._sds((bb, int(lb)), jnp.int32),
+            int8 = self.kv_int8
+            pools = ((self._pool_spec(), self._pool_spec(),
+                      self._scale_spec(), self._scale_spec())
+                     if int8 else
+                     (self._pool_spec(), self._pool_spec()))
+            npool = len(pools)
+            base = (self._param_spec(),) + pools + (
+                self._sds((bb, tb), jnp.int32),
+                self._sds((bb, int(lb)), jnp.int32),
+                self._sds((bb,), jnp.int32),
+                self._sds((bb,), jnp.int32))
+            samp = (self._sds((bb, 2), jnp.uint32),
+                    self._sds((bb,), jnp.float32),
                     self._sds((bb,), jnp.int32),
-                    self._sds((bb,), jnp.int32))
-            if kind == "paged_step_sample":
+                    self._sds((bb,), jnp.bool_))
+            pool_donate = tuple(range(1, 1 + npool))
+
+            def step(params, pls, tables, tokens, positions, valid,
+                     all_logits=False):
+                # paged_step_apply with the pool tuple threaded through
+                # the fp/int8 layouts uniformly: returns (logits,
+                # new_pool_tuple)
+                if int8:
+                    out = paged_step_apply(
+                        params, pls[0], pls[1], tables, tokens,
+                        positions, valid, spec, bs,
+                        scales=(pls[2], pls[3]), all_logits=all_logits)
+                else:
+                    out = paged_step_apply(
+                        params, pls[0], pls[1], tables, tokens,
+                        positions, valid, spec, bs,
+                        all_logits=all_logits)
+                return out[0], tuple(out[1:])
+
+            if kind in ("paged_step_sample", "paged_step_sample_p"):
                 # in-graph sampling with a per-row enable mask: a
                 # chunk dispatch samples ONLY the rows finishing their
                 # prompt this tick (do_sample), everyone else's PRNG
-                # chain must not advance
-                def fn(params, pool_k, pool_v, tables, tokens,
-                       positions, valid, keys, temps, top_ks,
-                       do_sample):
-                    logits, pk, pv = paged_step_apply(
-                        params, pool_k, pool_v, tables, tokens,
-                        positions, valid, spec, bs)
-                    toks, carry = sample_tokens(logits, keys, temps,
-                                                top_ks)
+                # chain must not advance.  The _p variant additionally
+                # emits the proposal distribution q — the draft model's
+                # step in speculative decoding.
+                with_q = kind == "paged_step_sample_p"
+
+                def fn(params, *rest):
+                    pls = rest[:npool]
+                    (tables, tokens, positions, valid, keys, temps,
+                     top_ks, do_sample) = rest[npool:]
+                    logits, new_pools = step(params, pls, tables,
+                                             tokens, positions, valid)
+                    if with_q:
+                        toks, carry, q = sample_tokens_p(
+                            logits, keys, temps, top_ks)
+                    else:
+                        toks, carry = sample_tokens(logits, keys,
+                                                    temps, top_ks)
                     new_keys = jnp.where(do_sample[:, None], carry,
                                          keys)
-                    return toks, pk, pv, new_keys
+                    head = (toks, q) if with_q else (toks,)
+                    return head + new_pools + (new_keys,)
 
-                args = base + (self._sds((bb, 2), jnp.uint32),
-                               self._sds((bb,), jnp.float32),
-                               self._sds((bb,), jnp.int32),
-                               self._sds((bb,), jnp.bool_))
+                args = base + samp
                 compiled = jax.jit(
-                    fn,
-                    donate_argnums=cache_donate_argnums((1, 2, 7))) \
+                    fn, donate_argnums=cache_donate_argnums(
+                        pool_donate + (len(base),))) \
+                    .lower(*args).compile()
+            elif kind == "paged_verify":
+                # speculative verify: all lb=K+1 positions' logits stay
+                # in-graph, the rejection rule runs beside them
+                # (spec_verify), and the host fetch is two small
+                # integer vectors — never logits.  tokens[:, 0] is the
+                # slot's pending next token, tokens[:, 1:] the K draft
+                # proposals; prop_q is the draft's (bb, K, vocab)
+                # proposal distribution from paged_step_sample_p.
+                K = int(lb) - 1
+
+                def fn(params, *rest):
+                    pls = rest[:npool]
+                    (tables, tokens, positions, valid, prop_q, keys,
+                     temps, top_ks, do_sample) = rest[npool:]
+                    logits_all, new_pools = step(params, pls, tables,
+                                                 tokens, positions,
+                                                 valid, all_logits=True)
+                    out, n_emit, carry = spec_verify(
+                        logits_all, tokens[:, 1:], prop_q, keys,
+                        temps, top_ks, valid)
+                    new_keys = jnp.where(do_sample[:, None], carry,
+                                         keys)
+                    return (out, n_emit) + new_pools + (new_keys,)
+
+                args = base + (self._sds((bb, K, spec["vocab_size"]),
+                                         jnp.float32),) + samp
+                compiled = jax.jit(
+                    fn, donate_argnums=cache_donate_argnums(
+                        pool_donate + (len(base) + 1,))) \
                     .lower(*args).compile()
             else:   # paged_step (logits out — the host-sampling hatch)
-                def fn(params, pool_k, pool_v, tables, tokens,
-                       positions, valid):
-                    return paged_step_apply(params, pool_k, pool_v,
-                                            tables, tokens, positions,
-                                            valid, spec, bs)
+                def fn(params, *rest):
+                    pls = rest[:npool]
+                    tables, tokens, positions, valid = rest[npool:]
+                    logits, new_pools = step(params, pls, tables,
+                                             tokens, positions, valid)
+                    return (logits,) + new_pools
 
                 compiled = jax.jit(
-                    fn, donate_argnums=cache_donate_argnums((1, 2))) \
+                    fn,
+                    donate_argnums=cache_donate_argnums(pool_donate)) \
                     .lower(*base).compile()
             ms = (time.perf_counter() - tic) * 1e3
             return _Program(compiled, (bb, lb), (), ms)
@@ -1315,29 +1556,12 @@ class GenerativeProgramStore:
             # lands in the trash block).
             pkind = ("paged_step_sample" if self.sample_mode == "graph"
                      else "paged_step")
-            tb = self.table_width()
             for bb in self._batch_edges:
                 for lq in sorted({1, self.prefill_chunk}):
                     prog = self._acquire(pkind, bb, lq)
                     out[(pkind, bb, lq)] = prog.compile_ms
-                    if not execute:
-                        continue
-                    pk, pv = self.new_pool()
-                    tbls = np.zeros((bb, tb), np.int32)
-                    toks = np.zeros((bb, lq), np.int32)
-                    pos = np.zeros((bb,), np.int32)
-                    val = np.ones((bb,), np.int32)
-                    if pkind == "paged_step_sample":
-                        jax.block_until_ready(prog.fn(
-                            self._params, pk, pv, tbls, toks, pos, val,
-                            np.zeros((bb, 2), np.uint32),
-                            np.zeros((bb,), np.float32),
-                            np.zeros((bb,), np.int32),
-                            np.zeros((bb,), np.bool_)))
-                    else:
-                        jax.block_until_ready(prog.fn(
-                            self._params, pk, pv, tbls, toks, pos,
-                            val))
+                    if execute:
+                        self._exec_paged_zeros(pkind, prog, bb, lq)
             return out
         cache_buckets = {self.kv_bucket(p) for p in self._prompt_edges}
         if kv_depth is not None:
@@ -1373,6 +1597,59 @@ class GenerativeProgramStore:
                     else:
                         jax.block_until_ready(
                             prog.fn(self._params, ck, cv, toks, lens))
+        return out
+
+    def _exec_paged_zeros(self, kind, prog, bb, lq):
+        """Execute one paged program once on a throwaway zero pool with
+        all-zero tables (every write lands in the trash block): the
+        one-time XLA executable setup must not land inside a served
+        request."""
+        pools = self.new_pool()
+        if self.kv_int8:
+            pools = pools + self.new_scale_pool()
+        tbls = np.zeros((bb, self.table_width()), np.int32)
+        toks = np.zeros((bb, lq), np.int32)
+        pos = np.zeros((bb,), np.int32)
+        val = np.ones((bb,), np.int32)
+        samp = (np.zeros((bb, 2), np.uint32),
+                np.zeros((bb,), np.float32),
+                np.zeros((bb,), np.int32),
+                np.zeros((bb,), np.bool_))
+        if kind == "paged_verify":
+            q = np.zeros((bb, lq - 1, self._spec["vocab_size"]),
+                         np.float32)
+            args = (self._params,) + pools + (tbls, toks, pos, val,
+                                              q) + samp
+        elif kind in ("paged_step_sample", "paged_step_sample_p"):
+            args = (self._params,) + pools + (tbls, toks, pos,
+                                              val) + samp
+        else:
+            args = (self._params,) + pools + (tbls, toks, pos, val)
+        jax.block_until_ready(prog.fn(*args))
+
+    def warm_spec_programs(self, spec_k, draft=False, execute=True):
+        """Warm the speculative-decoding program kinds ahead of
+        traffic: the TARGET's verify programs (lq = spec_k + 1), or —
+        ``draft=True`` — the DRAFT's proposal programs (lq=1
+        ``paged_step_sample_p``) plus its logits-discarded
+        prefill-mirror chunks (lq = prefill_chunk ``paged_step``).
+        ``registry.add_draft_model`` warms both sides, so attaching a
+        draft never compiles inside a served request.  Returns
+        {(kind, bb, lq): compile_ms}."""
+        if not self.paged:
+            raise MXNetError(
+                "speculative decoding needs the paged plane (store %r "
+                "has paged=False)" % self.name)
+        kinds = ([("paged_step_sample_p", 1),
+                  ("paged_step", self.prefill_chunk)] if draft
+                 else [("paged_verify", int(spec_k) + 1)])
+        out = {}
+        for bb in self._batch_edges:
+            for kind, lq in kinds:
+                prog = self._acquire(kind, bb, lq)
+                out[(kind, bb, lq)] = prog.compile_ms
+                if execute:
+                    self._exec_paged_zeros(kind, prog, bb, lq)
         return out
 
     # -- execution -----------------------------------------------------
@@ -1412,33 +1689,90 @@ class GenerativeProgramStore:
         return prog.fn(self._params, cache_k, cache_v, tokens, lengths,
                        keys, temps, top_ks)
 
+    def _pool_args(self, pool_k, pool_v, scales):
+        """The pool-argument tuple of one paged dispatch: the int8
+        plane threads its donated scale pools right after the code
+        pools (and gets them back in the same slots of the return)."""
+        if self.kv_int8:
+            if scales is None:
+                raise MXNetError(
+                    "int8 paged store %r needs its (scale_k, scale_v) "
+                    "pools on every dispatch" % self.name)
+            return (pool_k, pool_v, scales[0], scales[1])
+        return (pool_k, pool_v)
+
     @hot_path
     def run_paged_step(self, pool_k, pool_v, tables, tokens,
-                       positions, valid):
+                       positions, valid, scales=None):
         """Dispatch one logits-out paged step (the host-sampling
-        hatch): ``tokens`` (bb, lq) int32 — lq=1 is a decode step,
-        lq=prefill_chunk a prompt chunk.  Returns ``(logits (bb,
-        vocab) at each row's last valid position, pool_k, pool_v)``;
-        BOTH pools are consumed (donated) — callers rebind."""
+        hatch and the draft's prefill mirror): ``tokens`` (bb, lq)
+        int32 — lq=1 is a decode step, lq=prefill_chunk a prompt chunk.
+        Returns ``(logits (bb, vocab) at each row's last valid
+        position, pool_k, pool_v)`` — int8 stores take and return the
+        scale pools too, ``(logits, pool_k, pool_v, scale_k,
+        scale_v)``.  The pools are consumed (donated) — callers
+        rebind."""
         bb, lq = tokens.shape
         prog = self._acquire("paged_step", int(bb), int(lq))
-        return prog.fn(self._params, pool_k, pool_v, tables, tokens,
-                       positions, valid)
+        return prog.fn(self._params,
+                       *(self._pool_args(pool_k, pool_v, scales) +
+                         (tables, tokens, positions, valid)))
 
     @hot_path
     def run_paged_step_sample(self, pool_k, pool_v, tables, tokens,
                               positions, valid, keys, temps, top_ks,
-                              do_sample):
+                              do_sample, scales=None):
         """Dispatch one paged step with IN-GRAPH sampling: returns
-        ``(tokens (bb,) int32, pool_k, pool_v, new_keys)``.  Rows with
-        ``do_sample`` False keep their PRNG keys (their sampled token
-        is garbage the caller discards); pools and keys are consumed
-        (donated) — callers rebind all three."""
+        ``(tokens (bb,) int32, pool_k, pool_v, new_keys)`` (int8
+        stores: ``(tokens, pool_k, pool_v, scale_k, scale_v,
+        new_keys)``).  Rows with ``do_sample`` False keep their PRNG
+        keys (their sampled token is garbage the caller discards);
+        pools and keys are consumed (donated) — callers rebind."""
         bb, lq = tokens.shape
         prog = self._acquire("paged_step_sample", int(bb), int(lq))
-        return prog.fn(self._params, pool_k, pool_v, tables, tokens,
-                       positions, valid, keys, temps, top_ks,
-                       do_sample)
+        return prog.fn(self._params,
+                       *(self._pool_args(pool_k, pool_v, scales) +
+                         (tables, tokens, positions, valid, keys,
+                          temps, top_ks, do_sample)))
+
+    @hot_path
+    def run_paged_step_sample_p(self, pool_k, pool_v, tables, tokens,
+                                positions, valid, keys, temps, top_ks,
+                                do_sample, scales=None):
+        """The DRAFT model's proposal step: one lq=1 paged step with
+        in-graph sampling that also returns the proposal distribution.
+        Returns ``(tokens (bb,), q (bb, vocab), pool_k, pool_v,
+        new_keys)`` (int8: scale pools before new_keys).  ``q`` should
+        stay device-resident — the verify program consumes it directly,
+        the host never fetches a distribution."""
+        bb, lq = tokens.shape
+        prog = self._acquire("paged_step_sample_p", int(bb), int(lq))
+        return prog.fn(self._params,
+                       *(self._pool_args(pool_k, pool_v, scales) +
+                         (tables, tokens, positions, valid, keys,
+                          temps, top_ks, do_sample)))
+
+    @hot_path
+    def run_paged_verify(self, pool_k, pool_v, tables, tokens,
+                         positions, valid, prop_q, keys, temps,
+                         top_ks, do_sample, scales=None):
+        """The TARGET model's speculative verify: ``tokens`` (bb, K+1)
+        holds each slot's pending next token followed by its K draft
+        proposals, ``prop_q`` (bb, K, vocab) the draft's proposal
+        distributions (device-resident from
+        :meth:`run_paged_step_sample_p`), ``valid`` = per-slot window
+        + 1.  All K+1 positions run in ONE program; accept/reject and
+        the corrected resample happen in-graph (``spec_verify``).
+        Returns ``(out_toks (bb, K+1), n_emit (bb,), pool_k, pool_v,
+        new_keys)`` (int8: scale pools before new_keys) — row b emits
+        ``out_toks[b, :n_emit[b]]``.  Pools and keys are consumed
+        (donated) — callers rebind."""
+        bb, lq = tokens.shape
+        prog = self._acquire("paged_verify", int(bb), int(lq))
+        return prog.fn(self._params,
+                       *(self._pool_args(pool_k, pool_v, scales) +
+                         (tables, tokens, positions, valid, prop_q,
+                          keys, temps, top_ks, do_sample)))
 
     def pad_prompts(self, prompts):
         """Host-side canonicalization: a list of token id sequences ->

@@ -42,6 +42,7 @@ class ModelRegistry:
     def __init__(self):
         self._stores = {}
         self._gen_stores = {}
+        self._drafts = {}       # target name -> draft GenerativeProgramStore
         self._lock = make_lock("serving.registry")
 
     def add_model(self, name, symbol, arg_params, aux_params=None,
@@ -132,6 +133,68 @@ class ModelRegistry:
                 raise
         return store
 
+    def add_draft_model(self, target_name, params, spec, spec_k=None,
+                        warmup=True, compute_dtype=None, device=None):
+        """Attach a small DRAFT LM to generative model ``target_name``
+        for speculative decoding (``MXNET_SERVE_SPEC``).
+
+        The draft gets its own :class:`GenerativeProgramStore` with the
+        target's pool geometry COPIED (``kv_block``, ``kv_max``,
+        ``pool_blocks``, ``prefill_chunk``, batch buckets, ``kv_dtype``,
+        paged + in-graph sampling) so the decode engine can drive both
+        planes through the same block tables — the draft holds its own
+        pool arrays but shares the target's block allocator.  Warms the
+        speculative program kinds on BOTH sides (the draft's lq=1
+        proposal + prefill-mirror chunks, the target's lq=spec_k+1
+        verify), so attaching a draft never compiles inside a served
+        request.  ``spec_k`` defaults to ``MXNET_SERVE_SPEC_K``.
+        Returns the draft store."""
+        target = self.gen_store(target_name)
+        if not target.paged or target.sample_mode != "graph":
+            raise MXNetError(
+                "speculative decoding needs model %r on the paged "
+                "plane with in-graph sampling (paged=True, "
+                "sample='graph'); got paged=%s sample=%r"
+                % (target_name, target.paged, target.sample_mode))
+        if spec_k is None:
+            spec_k = int(get_env("MXNET_SERVE_SPEC_K"))
+        if spec_k < 1:
+            raise MXNetError("spec_k must be >= 1, got %d" % spec_k)
+        if compute_dtype is None:
+            compute_dtype = get_env("MXNET_SERVE_DTYPE") or None
+        draft = GenerativeProgramStore(
+            params, spec, name="%s.draft" % target_name,
+            batch_buckets=target._batch_edges,
+            prompt_buckets=target._prompt_edges,
+            kv_block=target.kv_block, kv_max=target.kv_max,
+            compute_dtype=compute_dtype,
+            kv_dtype=str(target.kv_dtype), sample="graph",
+            paged=True, prefill_chunk=target.prefill_chunk,
+            pool_blocks=target.pool_blocks, device=device)
+        # the engine reads the attached window size off the draft —
+        # the verify programs are warmed for exactly this lq
+        draft.spec_k = spec_k
+        with self._lock:
+            if target_name in self._drafts:
+                raise MXNetError("model %r already has a draft attached"
+                                 % target_name)
+            self._drafts[target_name] = draft
+        if warmup:
+            try:
+                draft.warm_spec_programs(spec_k, draft=True)
+                target.warm_spec_programs(spec_k)
+            except BaseException:
+                with self._lock:
+                    self._drafts.pop(target_name, None)
+                raise
+        return draft
+
+    def draft_store(self, name):
+        """Generative model ``name``'s attached draft store, or None
+        when no draft is registered (the engine's spec gate)."""
+        with self._lock:
+            return self._drafts.get(name)
+
     def load_generative_checkpoint(self, name, prefix, epoch, spec,
                                    **kwargs):
         """Register a generative model from a ``save_checkpoint``
@@ -211,6 +274,7 @@ class ModelRegistry:
 
     def remove_model(self, name):
         with self._lock:
+            self._drafts.pop(name, None)
             if self._stores.pop(name, None) is None and \
                     self._gen_stores.pop(name, None) is None:
                 raise MXNetError("unknown serving model %r" % name)

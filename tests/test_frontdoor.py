@@ -622,3 +622,92 @@ def test_banked_frontdoor_rows_hold_the_acceptance():
     assert fo["value"] is not None and fo["value"] >= 2.0 / 3.0
     assert fo["recovery_ms"] is not None
     assert len(fo["live_after"]) == fo["n_replicas"] - 1
+
+
+# ---------------------------------------------------------------------------
+# TLS front door
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tls_pair(tmp_path_factory):
+    """Self-signed cert + key for 127.0.0.1 (SAN-pinned so a client
+    verifying against the cert itself passes hostname checks)."""
+    import shutil
+    import subprocess
+    openssl = shutil.which("openssl")
+    if openssl is None:
+        pytest.skip("no openssl binary to mint a test certificate")
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        [openssl, "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "2",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+def test_tls_round_trip_self_signed(tls_pair):
+    """The satellite's TLS pin: a front door armed with a self-signed
+    cert serves https (scheme in .url), an HttpClient pinning that
+    cert round-trips npz forwards bit-exactly, and the verify="0"
+    escape hatch also connects."""
+    cert, key = tls_pair
+    reg = _registry()
+    eng = ServingEngine(reg, max_delay_ms=0)
+    x = np.arange(FEAT, dtype=np.float32).reshape(1, FEAT) / FEAT
+    try:
+        want = eng.submit("m", data=x.copy()).result(60)
+        with HttpFrontDoor(eng, tls_cert=cert, tls_key=key) as fd:
+            assert fd.tls and fd.url.startswith("https://")
+            # PEM-pinned verification (the self-signed deployment)
+            with HttpClient(fd.url, threads=2, tls_verify=cert) as cl:
+                got = cl.submit("m", {"data": x.copy()}).result(60)
+                np.testing.assert_array_equal(got[0], want[0])
+                code, payload = cl.healthz()
+                assert code == 200 and payload["models"] == ["m"]
+            # verification disabled (lab hatch) still talks TLS
+            with HttpClient(fd.url, threads=1, tls_verify="0") as cl:
+                got = cl.submit("m", {"data": x.copy()}).result(60)
+                np.testing.assert_array_equal(got[0], want[0])
+    finally:
+        eng.close()
+
+
+def test_tls_default_verify_rejects_self_signed(tls_pair):
+    """MXNET_SERVE_TLS_VERIFY's default ("1", system trust store) must
+    REJECT the self-signed cert — trust is opt-in via the PEM pin, not
+    granted to whoever answers the port."""
+    import ssl
+    cert, key = tls_pair
+    reg = _registry()
+    eng = ServingEngine(reg, max_delay_ms=0)
+    x = np.zeros((1, FEAT), np.float32)
+    try:
+        with HttpFrontDoor(eng, tls_cert=cert, tls_key=key) as fd:
+            with HttpClient(fd.url, threads=1, tls_verify="1") as cl:
+                with pytest.raises(ssl.SSLError):
+                    cl.submit("m", {"data": x}).result(60)
+    finally:
+        eng.close()
+
+
+def test_tls_half_config_raises(tls_pair, monkeypatch):
+    """Cert without key (either argument or env) is a config error —
+    never silent plaintext on an endpoint the operator asked to arm."""
+    cert, _key = tls_pair
+    reg = _registry()
+    eng = ServingEngine(reg, max_delay_ms=0)
+    try:
+        with pytest.raises(MXNetError):
+            HttpFrontDoor(eng, tls_cert=cert)
+        monkeypatch.setenv("MXNET_SERVE_TLS_KEY", "/nope/key.pem")
+        monkeypatch.delenv("MXNET_SERVE_TLS_CERT", raising=False)
+        with pytest.raises(MXNetError):
+            HttpFrontDoor(eng)
+        # an unreadable pair fails loudly too (and releases the port)
+        monkeypatch.setenv("MXNET_SERVE_TLS_CERT", "/nope/cert.pem")
+        with pytest.raises(MXNetError):
+            HttpFrontDoor(eng)
+    finally:
+        eng.close()
