@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke kernels-smoke data-smoke obs-smoke chaos-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke mesh-smoke kernels-smoke data-smoke obs-smoke chaos-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -113,6 +113,17 @@ spmd-smoke:
 	timeout -k 10 420 env JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m pytest tests/test_spmd_step.py -q
+
+# collectives-kvstore gate under 8 fake host devices: dist_mesh
+# push/pull closed forms, the SAME-Module.fit-script PS/mesh parity,
+# bucket-reduce bit-exactness vs the fused step, live overlap >= 1.3x
+# barrier under injected collective latency, the dist_mesh program-
+# cache key, launch.py --mesh end-to-end (multi-process leg skips on
+# CPU jaxlib), and the banked >= 1.5x-vs-PS / >= 1.3x-vs-barrier pins
+mesh-smoke:
+	timeout -k 10 420 env JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m pytest tests/test_dist_mesh.py -q
 
 # Pallas kernel plane + remat policy gate, deterministic on CPU: every
 # kernel's REAL body runs in interpret mode (fused softmax/xent, RMSNorm,

@@ -591,7 +591,8 @@ def bench_imperative_dispatch(op_name, chip, smoke=False):
             "cache_evictions": st["evictions"]}
 
 
-def _kvstore_step_rate(mode, sizes, steps, warmup, delay_s):
+def _kvstore_step_rate(mode, sizes, steps, warmup, delay_s,
+                       kv_name="dist_async"):
     """One in-process PS cluster (scheduler+server threads + this
     process as the worker) driven through full training-shaped
     push+pull+flush steps, with ``delay_s`` of injected latency on
@@ -602,6 +603,8 @@ def _kvstore_step_rate(mode, sizes, steps, warmup, delay_s):
     mode: 'serial_fp32' (pipeline off — the PR-2 blocking
     per-parameter push-then-pull baseline), 'fp32' (async pipeline +
     bucketing), '2bit' (pipeline + bucketing + 2-bit compression).
+    ``kv_name`` picks the store ('dist_async' default; 'dist_sync' is
+    the bulk-synchronous PS baseline the dist_mesh row compares to).
     Returns (steps_per_sec, payload_bytes_per_step)."""
     import socket
     import threading
@@ -632,7 +635,7 @@ def _kvstore_step_rate(mode, sizes, steps, warmup, delay_s):
         sched.start()
         server = threading.Thread(target=ksd.run_server, daemon=True)
         server.start()
-        kv = kvs.create("dist_async")
+        kv = kvs.create(kv_name)
         if mode == "2bit":
             kv.set_gradient_compression({"type": "2bit",
                                          "threshold": 0.5})
@@ -739,6 +742,113 @@ def bench_kvstore_push_pull(mode, chip, smoke=False):
                        "real wire the byte reduction is the win" % (
                            delay * 1e3))
     return row
+
+
+def _dist_mesh_step_rate(sizes, steps, warmup, delay_s, overlap,
+                         bucket_bytes):
+    """Training-shaped push+pull+flush steps through the collectives
+    kvstore (``create('dist_mesh')``), with ``delay_s`` of injected
+    latency on every per-bucket collective (the ``mesh.collective``
+    faultinject seam — DCN-ish all-reduce RTT, so overlap is measurable
+    on one CPU host).  ``overlap=False`` swaps in the barrier launcher:
+    collectives run serially in submit order, paying
+    ``n_buckets x delay`` where the overlapped plane pays ~one delay.
+    Returns (steps_per_sec, n_buckets)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import faultinject
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.parallel.mesh_reduce import MeshCollectiveLauncher
+
+    managed = {"MXNET_KVSTORE_BUCKET_BYTES": str(bucket_bytes)}
+    saved = {k: os.environ.get(k) for k in managed}
+    os.environ.update(managed)
+    try:
+        kv = kvs.create("dist_mesh")
+        kv._launcher = MeshCollectiveLauncher(overlap=overlap)
+        rs = np.random.RandomState(0)
+        arrays = [mx.nd.array(rs.uniform(-1, 1, (n,)).astype("float32"))
+                  for n in sizes]
+        keys = list(range(len(sizes)))
+        prios = [-k for k in keys]
+        for k, a in zip(keys, arrays):
+            kv.init(k, a)
+        outs = [mx.nd.zeros((n,)) for n in sizes]
+        n_buckets = len(set(kv._plan.bucket_of(k) for k in keys))
+        faultinject.install({"rules": [
+            {"seam": "mesh.collective", "nth": 1, "count": "inf",
+             "action": "delay", "seconds": delay_s}]})
+        try:
+            def step():
+                kv.push(keys, arrays, priority=prios)
+                kv.pull(keys, outs, priority=prios)
+                kv.flush()
+
+            for _ in range(warmup):
+                step()
+            tic = time.perf_counter()
+            for _ in range(steps):
+                step()
+            dt = time.perf_counter() - tic
+        finally:
+            faultinject.install(None)
+        kv.close()
+        return steps / dt, n_buckets
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def bench_kvstore_dist_mesh(mode, chip, smoke=False):
+    """Collectives-vs-PS data plane (docs/architecture/dist_mesh.md):
+    the same training-shaped step schedule under the same injected
+    latency budget, through the two wires the ``kvstore=`` string picks
+    between.  CPU-deterministic.
+
+    'fp32': ``dist_mesh`` (overlapped bucket collectives, pull is a
+    local replica copy) vs the ``dist_sync`` parameter server (push RPC
+    + pull RPC per bucket, latency on every server-received message).
+    'overlap': overlapped vs barrier collective launch at the same
+    per-collective delay — the bucketed-reduction overlap win in
+    isolation."""
+    if smoke:
+        sizes = [8192] * 6
+        steps, warmup, delay = 3, 1, 0.01
+    else:
+        sizes = [8192] * 12
+        steps, warmup, delay = 6, 1, 0.01
+    bucket_bytes = 64 * 1024          # 32KB keys -> 2 per bucket
+    if mode == "overlap":
+        rate, n_buckets = _dist_mesh_step_rate(
+            sizes, steps, warmup, delay, True, bucket_bytes)
+        barrier, _ = _dist_mesh_step_rate(
+            sizes, steps, warmup, delay, False, bucket_bytes)
+        return {"metric": "kvstore.dist_mesh.overlap",
+                "value": round(rate, 2), "unit": "steps/sec",
+                "vs_baseline": None,
+                "barrier_steps_per_sec": round(barrier, 2),
+                "speedup_vs_barrier": round(rate / barrier, 3)
+                if barrier else None,
+                "injected_collective_delay_ms": delay * 1e3,
+                "n_params": len(sizes), "n_buckets": n_buckets}
+    rate, n_buckets = _dist_mesh_step_rate(
+        sizes, steps, warmup, delay, True, bucket_bytes)
+    ps, _ = _kvstore_step_rate("fp32", sizes, steps, warmup, delay,
+                               kv_name="dist_sync")
+    return {"metric": "kvstore.dist_mesh.fp32",
+            "value": round(rate, 2), "unit": "steps/sec",
+            "vs_baseline": None,
+            "ps_steps_per_sec": round(ps, 2),
+            "speedup_vs_ps": round(rate / ps, 3) if ps else None,
+            "injected_latency_ms": delay * 1e3,
+            "n_params": len(sizes), "n_buckets": n_buckets,
+            "note": ("same schedule, same injected latency: the PS "
+                     "pays it per server-received RPC (push and pull "
+                     "legs), the mesh per bucket collective — "
+                     "overlapped, with the pull leg gone entirely "
+                     "(local replica copy)")}
 
 
 def _staleness_run(mode, steps, delay_s, sizes):
@@ -2312,6 +2422,13 @@ def main():
           smoke)
     guard("kvstore.push_pull.2bit", bench_kvstore_push_pull, "2bit", chip,
           smoke)
+    # collectives-vs-PS data plane + overlap-vs-barrier reduction
+    # (CPU-deterministic injected-latency protocol; acceptance-pinned
+    # by tests/test_dist_mesh.py against the banked artifact)
+    guard("kvstore.dist_mesh.fp32", bench_kvstore_dist_mesh, "fp32",
+          chip, smoke)
+    guard("kvstore.dist_mesh.overlap", bench_kvstore_dist_mesh,
+          "overlap", chip, smoke)
     # elastic-async PS rows: sync vs bounded-staleness async under one
     # injected straggler (CPU-deterministic seeded protocol)
     for st_mode in ("sync", "s0", "s4"):

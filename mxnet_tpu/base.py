@@ -604,6 +604,56 @@ register_env("MXNET_AUTO_RESUME", str, "",
              "workers so a restarted process picks up the mid-epoch "
              "frontier without the training script threading it by "
              "hand.  Empty disables.")
+register_env("MXNET_MESH_COORDINATOR", str, "",
+             "host:port of the jax.distributed coordinator for the "
+             "dist_mesh collectives backend.  tools/launch.py --mesh N "
+             "exports it (plus MXNET_MESH_NUM_PROCESSES / "
+             "MXNET_MESH_PROCESS_ID) to every spawned process; "
+             "parallel.mesh.distributed_init_from_env() reads the "
+             "triple and boots this process into the one global mesh.  "
+             "Empty means single-process (the 8-fake-device CI shape).")
+register_env("MXNET_MESH_NUM_PROCESSES", int, 0,
+             "Process census for jax.distributed.initialize under "
+             "tools/launch.py --mesh; 0 (unset) means single-process.")
+register_env("MXNET_MESH_PROCESS_ID", int, 0,
+             "This process's stable rank under tools/launch.py --mesh; "
+             "a crashed worker restarted by --auto-resume supervision "
+             "re-exports the SAME id so it rejoins its old mesh slot.")
+register_env("MXNET_MESH_REDUCE", str, "bucket",
+             "Gradient-reduction variant for the dist_mesh one-program "
+             "path: 'bucket' (default) compiles the reduce-per-bucket "
+             "step (grad program + one collective per "
+             "MXNET_KVSTORE_BUCKET_BYTES bucket + apply program) so "
+             "tail-layer communication overlaps head-layer work; "
+             "'fused' keeps the single fused train step (one in-graph "
+             "psum at step end).  A program-cache key field, so both "
+             "variants coexist compiled.")
+register_env("MXNET_MESH_OVERLAP", bool, True,
+             "Whether dist_mesh bucket collectives launch concurrently "
+             "(overlapped, default) or serialize behind one another "
+             "(barrier semantics — the measurable-baseline escape "
+             "hatch bench row kvstore.dist_mesh.overlap compares "
+             "against).")
+register_env("MXNET_KVSTORE_REBALANCE", bool, False,
+             "Arm the automatic load-driven PS rebalance trigger: the "
+             "rank-0 dist worker samples rebalance_signal() every "
+             "MXNET_KVSTORE_REBALANCE_INTERVAL seconds and migrates "
+             "one hot bucket to the coldest server whenever imbalance "
+             "exceeds MXNET_KVSTORE_REBALANCE_THRESHOLD (the manual "
+             "migrate_bucket handshake, now closed-loop).")
+register_env("MXNET_KVSTORE_REBALANCE_THRESHOLD", float, 2.0,
+             "Hot-server imbalance ratio (hottest server's windowed "
+             "push bytes over the mean) above which the rebalance "
+             "trigger migrates a bucket; <= 1.0 would thrash and is "
+             "clamped to 1.1.")
+register_env("MXNET_KVSTORE_REBALANCE_INTERVAL", float, 2.0,
+             "Seconds between rebalance-trigger evaluations (each one "
+             "reads the per-server wire-byte counters from the metrics "
+             "registry and migrates at most one bucket).")
+register_env("MXNET_KVSTORE_REBALANCE_MIN_BYTES", int, 1 << 20,
+             "Minimum windowed push traffic (bytes across all servers) "
+             "before the rebalance trigger acts — keeps idle or "
+             "drained clusters from migrating on noise.")
 
 
 def hot_path(fn):

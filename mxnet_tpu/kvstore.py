@@ -29,7 +29,7 @@ from . import optimizer as opt
 from .base import MXNetError
 from .ndarray import NDArray
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "KVStoreMesh", "create"]
 
 
 def _ctx_group_sum(vals):
@@ -319,6 +319,13 @@ class KVStoreDist(KVStore):
                 int(get_env("MXNET_KVSTORE_MAX_STALENESS")) >= 0
             if self._rank == 0 and not self._elastic:
                 self._client.send_command("async_mode", b"")
+        # closed-loop shard rebalancing (kvstore_rebalance.py): rank 0
+        # samples the per-server byte sensor and migrates hot buckets —
+        # plan deltas are global, so exactly one worker runs the policy
+        self._rebalance = None
+        if self._rank == 0 and get_env("MXNET_KVSTORE_REBALANCE"):
+            from .kvstore_rebalance import RebalanceTrigger
+            self._rebalance = RebalanceTrigger(self._client, start=True)
         import atexit
         atexit.register(self.close)
 
@@ -495,6 +502,8 @@ class KVStoreDist(KVStore):
     def close(self):
         if not self._closed:
             self._closed = True
+            if self._rebalance is not None:
+                self._rebalance.close()
             # runs from atexit too: a dead peer/scheduler must not raise or
             # hang here — but healthy stragglers get the FULL barrier
             # timeout before rank0 may stop the servers
@@ -523,6 +532,134 @@ class KVStoreDist(KVStore):
                 pass
 
 
+class KVStoreMesh(KVStore):
+    """Collectives-backed kvstore (``create('dist_mesh')``): the PS wire
+    replaced by mesh all-reduce (docs/architecture/dist_mesh.md).
+
+    The classic API keeps its shape — ``init``/``push``/``pull`` — but
+    the data plane is the one PAPER.md's multi-machine story wants on
+    TPU: every process holds a full replica, ``push`` coalesces
+    gradients into the deterministic ``kvstore_codec.BucketPlan``
+    layout and launches one collective per READY bucket immediately
+    (overlapped daemon threads unless MXNET_MESH_OVERLAP=0), and
+    ``pull`` is a local copy off the replicated store — no wire at all.
+    Collectives resolve at ``flush()`` (Module flushes before every
+    forward, like the PS pipeline), then the updater runs locally on
+    the reduced gradients in deterministic submit order.
+
+    Under ``Module.fit`` this store is only the fallback data plane:
+    module routing sends ``kvstore='dist_mesh'`` down the one-SPMD-step
+    fast path, where the reduction is the in-graph per-bucket collective
+    of ``reduce_mode='bucket'`` (parallel/spmd.py) and this object is
+    never constructed.  Multi-process runs (tools/launch.py --mesh)
+    boot jax.distributed from the MXNET_MESH_* env at construction."""
+
+    def __init__(self):
+        from .parallel.mesh import distributed_init_from_env
+        # must precede the base constructor: rank/size read
+        # jax.process_index()/process_count(), which are only global
+        # after jax.distributed boots from the launch env
+        try:
+            distributed_init_from_env()
+        except RuntimeError:
+            # devices already initialized locally (the script or a
+            # prior store won the race); stay single-process
+            pass
+        super().__init__("dist_mesh")
+        from .kvstore_codec import BucketPlan
+        from .parallel.mesh_reduce import MeshCollectiveLauncher
+        self._plan = BucketPlan()
+        self._launcher = MeshCollectiveLauncher()
+        self._pending = {}     # key -> [merged grad, ...] awaiting reduce
+        self._inflight = []    # [(keys tuple)] parallel to launcher order
+
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            flat_size = 1
+            for d in vv.shape:
+                flat_size *= int(d)
+            # same deterministic layout as the PS wire plan — keyed in
+            # init order, identical on every process of the job
+            self._plan.add(k, flat_size)
+            self._store[k] = vv.copy()
+
+    def _members(self, k):
+        bucket = self._plan.bucket_of(k)
+        return [k] if bucket is None else self._plan.members(bucket)
+
+    def _submit_round(self, keys):
+        """Pop one pending gradient per member key and launch the
+        bucket's collective."""
+        grads = [self._pending[k].pop(0) for k in keys]
+        for k in keys:
+            if not self._pending[k]:
+                del self._pending[k]
+        bucket_id = self._plan.bucket_of(keys[0])
+        if bucket_id is None:
+            bucket_id = "solo:%s" % (keys[0],)
+        self._inflight.append(tuple(keys))
+        self._launcher.submit(bucket_id, grads, self._reduce_bucket)
+
+    @staticmethod
+    def _reduce_bucket(bucket_id, grads):
+        from .parallel.mesh_reduce import process_sum
+        return [NDArray(process_sum(g._data)) for g in grads]
+
+    def push(self, key, value, priority=0):
+        """Push (device-summed, optionally compressed) gradients; each
+        bucket's cross-process reduce launches as soon as every member
+        key of the bucket has a pending gradient — tail buckets overlap
+        earlier ones.  Completion (and the updater) lands at
+        ``flush``/``pull``."""
+        for k, v, _ in self._by_priority(*self._normalize(key, value),
+                                         priority=priority):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            merged = _ctx_group_sum(list(vals))
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            # lossy compression applies to this worker's contribution
+            # BEFORE the wire, like the PS push path
+            merged = self._maybe_compress(k, merged)
+            self._pending.setdefault(k, []).append(merged)
+            members = self._members(k)
+            if all(self._pending.get(m) for m in members):
+                self._submit_round(members)
+
+    def _drain(self):
+        """Force-launch partial buckets, join every collective and run
+        the updater over the reduced gradients in submit order."""
+        while self._pending:
+            k = next(iter(self._pending))
+            members = [m for m in self._members(k) if m in self._pending]
+            self._submit_round(members)
+        rounds, self._inflight = self._inflight, []
+        results = self._launcher.drain()
+        for keys, reduced in zip(rounds, results):
+            for k, g in zip(keys, reduced):
+                if self._updater is not None:
+                    self._updater(k, g, self._store[k])
+                else:
+                    self._store[k] += g
+
+    def pull(self, key, out=None, priority=0):
+        """Resolve outstanding collectives, then copy the replicated
+        store locally — the pull leg of the PS round trip is gone."""
+        self._drain()
+        super().pull(key, out=out, priority=priority)
+
+    def flush(self, *_, **__):
+        self._drain()
+
+    def barrier(self):
+        self._drain()
+        nd.waitall()
+
+    def close(self):
+        self._drain()
+
+
 def create(name="local"):
     """Factory (reference kvstore.cc:17-45): 'local', 'device', 'dist_sync',
     'dist_async', 'dist_device_sync' are all accepted; device placement and
@@ -533,14 +670,20 @@ def create(name="local"):
     'dist_sync' arms the servers' bulk-synchronous merge; 'dist_async'
     arms the elastic bounded-staleness async plane (updater per push,
     version-vector staleness gating, live membership + shard
-    rebalancing — docs/architecture/elastic_ps.md)."""
+    rebalancing — docs/architecture/elastic_ps.md).  'dist_mesh' is the
+    collectives backend: no DMLC environment at all — reduction rides
+    XLA collectives over the (possibly multi-process) device mesh, and
+    Module routes it down the one-SPMD-step fast path
+    (docs/architecture/dist_mesh.md)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "device", "local_allreduce_cpu",
              "local_allreduce_device", "dist_sync", "dist_async",
-             "dist_device_sync", "dist_sync_device", "dist")
+             "dist_device_sync", "dist_sync_device", "dist", "dist_mesh")
     if name not in valid:
         raise MXNetError("unknown kvstore type %r" % name)
+    if name == "dist_mesh":
+        return KVStoreMesh()
     if "dist" in name:
         import os
         role = os.environ.get("DMLC_ROLE", "worker")

@@ -621,8 +621,14 @@ class Module(BaseModule):
                 not self.for_training or self._grad_req != "write"):
             return None
         kv_type = kvstore.type if hasattr(kvstore, "type") else kvstore
-        if kv_type is not None and (not isinstance(kv_type, str) or
-                                    "dist" in kv_type):
+        if kv_type is not None and not isinstance(kv_type, str):
+            return None
+        # dist_mesh IS the one-program path: its reduction is the
+        # in-graph collective, so the same fit script swaps PS for
+        # collectives by string (docs/architecture/dist_mesh.md).  The
+        # ps-backed dist_* types keep the classic kvstore loop.
+        if kv_type is not None and "dist" in kv_type and \
+                kv_type != "dist_mesh":
             return None
         if len(set(self._work_load_list)) > 1:
             return None
@@ -656,10 +662,15 @@ class Module(BaseModule):
         same way)."""
         from ..parallel.dp import DataParallelTrainer
         from ..parallel.mesh import mesh_for_contexts
+        kv = getattr(self, "_kvstore_arg", None)
+        kv_type = kv.type if hasattr(kv, "type") else kv
+        mesh_backend = kv_type == "dist_mesh"
         try:
             # THE mesh factory (parallel/mesh.py): one place constructs
-            # every module-level mesh, one place grows multi-host axes
-            mesh = mesh_for_contexts(self._context)
+            # every module-level mesh, one place grows multi-host axes —
+            # dist_mesh spans every process's devices of a
+            # jax.distributed launch
+            mesh = mesh_for_contexts(self._context, multihost=mesh_backend)
         except Exception:
             return None
         if self._symbol.has_custom_ops():
@@ -682,7 +693,13 @@ class Module(BaseModule):
                 optimizer=optimizer,
                 compute_dtype=self._compute_dtype,
                 fixed_params=tuple(self._fixed_param_names),
-                share_state_with=share_from)
+                share_state_with=share_from,
+                # dist_mesh: reduce-per-bucket overlapped collectives
+                # (MXNET_MESH_REDUCE=fused restores the one-psum step)
+                # and ZeRO-1 sharded optimizer state
+                reduce_mode=(str(get_env("MXNET_MESH_REDUCE"))
+                             if mesh_backend else "fused"),
+                shard_optimizer_state=mesh_backend)
         except Exception as e:
             self.logger.warning("fused fast path unavailable (%s); "
                                 "using executor group", e)

@@ -57,7 +57,7 @@ class DataParallelTrainer:
                  optimizer="sgd", optimizer_params=None, initializer=None,
                  batch_axis="dp", dtype="float32", compute_dtype=None,
                  fixed_params=(), share_state_with=None,
-                 shard_optimizer_state=False):
+                 shard_optimizer_state=False, reduce_mode="fused"):
         """``compute_dtype='bfloat16'`` enables mixed precision: parameters
         and optimizer state stay fp32 (master weights), the traced forward/
         backward runs in bf16 on the MXU, and gradients emerge fp32 through
@@ -70,7 +70,15 @@ class DataParallelTrainer:
         and XLA all-gathers the new weights, cutting optimizer-state HBM
         by the dp degree (1/8 on a v5e-8; for Adam that is 2x params'
         worth of memory back).  Numerically identical to the replicated
-        path (tests/test_parallel.py asserts parity)."""
+        path (tests/test_parallel.py asserts parity).
+
+        ``reduce_mode='bucket'`` (the dist_mesh data plane): the step
+        compiles as grad program + one collective per
+        MXNET_KVSTORE_BUCKET_BYTES bucket + apply program, and
+        ``step()`` launches bucket reduces through
+        :class:`..parallel.mesh_reduce.MeshCollectiveLauncher`
+        (overlapped unless MXNET_MESH_OVERLAP=0) instead of relying on
+        the fused step's single end-of-backward psum."""
         self.symbol = symbol
         self.mesh = mesh if mesh is not None else local_mesh(batch_axis)
         self.batch_axis = batch_axis
@@ -78,6 +86,7 @@ class DataParallelTrainer:
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype else None)
         self._zero1 = bool(shard_optimizer_state)
+        self._reduce_mode = reduce_mode
 
         shapes = dict(data_shapes)
         if label_shapes:
@@ -251,10 +260,18 @@ class DataParallelTrainer:
             optimizer=self.optimizer,
             fixed_params=tuple(sorted(self._fixed)),
             shard_optimizer_state=self._zero1,
-            param_shardings=shardings)
+            param_shardings=shardings,
+            reduce_mode=self._reduce_mode,
+            batch_axis=self.batch_axis)
         self._rng_at_eval = self._program.rng_at_eval
         self._train_step = self._program.train_step
         self._predict_step = self._program.predict_step
+        # reduce_mode may have been downgraded (Custom-op graphs keep
+        # the fused single-psum step)
+        self._reduce_mode = self._program.reduce_mode
+        if self._program.reduce_mode == "bucket":
+            from .mesh_reduce import MeshCollectiveLauncher
+            self._launcher = MeshCollectiveLauncher()
 
     # ------------------------------------------------------------------
     def _shard_batch(self, batch):
@@ -334,15 +351,45 @@ class DataParallelTrainer:
         lrs, wds = self._host_hyper()
         from .. import engine as _engine
         t_ns = time.perf_counter_ns()
-        self.params, self.opt_state, self.aux, outs, rng_next = \
-            _engine.get().dispatch(
-                "fused_train_step", self._train_step, self.params,
-                self.opt_state, self.aux, batch, lrs, wds, rng)
+        if self._reduce_mode == "bucket":
+            self.params, self.opt_state, self.aux, outs, rng_next = \
+                self._step_bucketed(batch, lrs, wds, rng)
+        else:
+            self.params, self.opt_state, self.aux, outs, rng_next = \
+                _engine.get().dispatch(
+                    "fused_train_step", self._train_step, self.params,
+                    self.opt_state, self.aux, batch, lrs, wds, rng)
         # spmd_step attributes the sharded-program dispatch inside the
         # fit loop's "compute" phase (nested span; excluded from pct)
         profiler.record_phase("spmd_step", t_ns)
         self._rng_dev = rng_next
         return outs
+
+    def _step_bucketed(self, batch, lrs, wds, rng):
+        """Reduce-per-bucket step: grad program, then one collective per
+        bucket launched through the overlap engine (tail buckets' reduces
+        run while earlier ones are still in flight), then the apply
+        program on the reduced grads.  Everything stays async XLA
+        dispatch — no host sync."""
+        from .. import engine as _engine
+        eng = _engine.get()
+        program = self._program
+        grads, new_aux, outs, rng_use, rng_next = eng.dispatch(
+            "mesh_grad_step", program.grad_step, self.params, self.aux,
+            batch, rng)
+        results = self._launcher.launch(
+            [(i, tuple(grads[n] for n in names))
+             for i, names in enumerate(program.buckets)],
+            lambda i, payload: eng.dispatch(
+                "mesh_bucket_reduce", program.bucket_reduces[i], *payload))
+        reduced = {}
+        for names, res in zip(program.buckets, results):
+            for n, g in zip(names, res):
+                reduced[n] = g
+        new_params, new_opt = eng.dispatch(
+            "mesh_apply_step", program.apply_step, self.params,
+            self.opt_state, reduced, lrs, wds, rng_use)
+        return new_params, new_opt, new_aux, outs, rng_next
 
     def _carry_rng(self):
         """Device-resident PRNG key threaded through the compiled step

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import hashlib
 from collections import OrderedDict
+from math import prod
 
 import jax
 import jax.numpy as jnp
@@ -118,11 +119,14 @@ class StepProgram:
     __slots__ = ("key", "symbol", "train_step", "predict_step",
                  "rng_at_eval", "param_names", "aux_names", "arg_shapes",
                  "aux_shapes", "data_names", "label_names", "donated",
-                 "trace_counts")
+                 "trace_counts", "reduce_mode", "grad_step", "apply_step",
+                 "buckets", "bucket_reduces")
 
     def __init__(self, key, symbol, train_step, predict_step, rng_at_eval,
                  param_names, aux_names, arg_shapes, aux_shapes,
-                 data_names, label_names, donated, trace_counts):
+                 data_names, label_names, donated, trace_counts,
+                 reduce_mode="fused", grad_step=None, apply_step=None,
+                 buckets=None, bucket_reduces=None):
         self.key = key
         # strong reference: identity-keyed entries (graphs that cannot
         # serialize fall back to ("id", id(symbol)) in the cache key)
@@ -145,11 +149,22 @@ class StepProgram:
         # (the executable-cache entry count is polluted by fastpath
         # bookkeeping and can exceed the true trace count)
         self.trace_counts = trace_counts
+        # reduce-per-bucket variant (reduce_mode='bucket'): the step is
+        # split into grad_step -> one collective per BucketPlan bucket
+        # -> apply_step so the host (parallel/mesh_reduce.py) can launch
+        # tail buckets' reduces while earlier work is still in flight.
+        # 'fused' programs keep these None and train via train_step.
+        self.reduce_mode = reduce_mode
+        self.grad_step = grad_step
+        self.apply_step = apply_step
+        self.buckets = buckets              # tuple[tuple[param name]]
+        self.bucket_reduces = bucket_reduces  # one jitted fn per bucket
 
 
 def _build_program(key, symbol, mesh, data_shapes, label_shapes, dtype,
                    compute_dtype, optimizer, fixed_params, zero1,
-                   param_shardings, remat_policy=None):
+                   param_shardings, remat_policy=None,
+                   reduce_mode="fused", batch_axis="dp"):
     """Trace + jit the fused step for one cache key (the program body
     formerly private to ``DataParallelTrainer._compile``)."""
     from ..executor import shape_overrides
@@ -277,6 +292,104 @@ def _build_program(key, symbol, mesh, data_shapes, label_shapes, dtype,
         outs, _ = trace(args, _cast(aux), rng, False)
         return outs
 
+    # -- reduce-per-bucket variant (reduce_mode='bucket') -------------------
+    # The fused step's gradient psum is one barrier at step end; the
+    # bucket variant splits the step so communication pipelines:
+    #   grad_step     per-dp-shard PARTIAL grads (vmap over the shard
+    #                 axis, no cross-shard reduction — each leaf lands
+    #                 (dp, *shape) sharded on axis 0)
+    #   bucket_reduces[i]  sum over the shard axis for one BucketPlan
+    #                 bucket — THE collective, one program per bucket,
+    #                 launched host-side in backward production order
+    #   apply_step    the in-graph optimizer update on reduced grads
+    #                 (ZeRO-1 pinning identical to the fused step)
+    grad_step = apply_step = buckets = bucket_reduces = None
+    if reduce_mode == "bucket":
+        from ..kvstore_codec import BucketPlan
+        dp = int(mesh.shape[batch_axis])
+        gspec = {n: NamedSharding(mesh,
+                                  P(batch_axis, *tuple(param_shardings[n].spec)))
+                 for n in param_names}
+
+        def grad_fn(params, aux, batch, rng):
+            trace_counts["train"] += 1
+            rng_use, rng_next = jax.random.split(rng)
+            shards = {k: v.reshape((dp, v.shape[0] // dp) + v.shape[1:])
+                      for k, v in batch.items()}
+
+            def per_shard(shard_batch):
+                def f(ps):
+                    args = _cast(dict(shard_batch))
+                    args.update(_cast(ps))
+                    outs, new_aux = trace(args, _cast(aux), rng_use, True)
+                    new_aux = {k: v.astype(aux[k].dtype)
+                               for k, v in new_aux.items()}
+                    return outs, new_aux
+                if remat_policy is not None:
+                    f = jax.checkpoint(f, policy=remat_policy)
+                outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
+                cots = tuple(jnp.ones_like(o) for o in outs)
+                return vjp(cots)[0], new_aux, outs
+
+            grads, new_aux, outs = jax.vmap(per_shard)(shards)
+            grads = {n: (jax.lax.with_sharding_constraint(g, gspec[n])
+                         if g is not None else None)
+                     for n, g in grads.items()}
+            # moving stats: mean of the per-shard local statistics
+            # (DDP-local-BN semantics; the fused step computes global
+            # batch statistics instead)
+            new_aux = {k: v.mean(0).astype(aux[k].dtype)
+                       for k, v in new_aux.items()}
+            outs = tuple(o.reshape((o.shape[0] * o.shape[1],) + o.shape[2:])
+                         for o in outs)
+            return grads, new_aux, outs, rng_use, rng_next
+
+        def apply_fn(params, opt_state, grads, lrs, wds, rng_use):
+            new_params, new_opt = {}, {}
+            for idx, name in enumerate(param_names):
+                if name in fixed or grads.get(name) is None:
+                    new_params[name] = params[name]
+                    new_opt[name] = opt_state[name]
+                else:
+                    w, s = opt_update(params[name], grads[name],
+                                      opt_state[name], lrs[idx], wds[idx],
+                                      jax.random.fold_in(rng_use,
+                                                         (1 << 20) + idx))
+                    if pin_shardings is not None:
+                        w = jax.lax.with_sharding_constraint(
+                            w, pin_shardings[name])
+                    new_params[name] = w
+                    new_opt[name] = s
+            return new_params, new_opt
+
+        # deterministic bucket layout over the backward PRODUCTION order
+        # (reversed forward parameter order: tail-layer grads exist
+        # first) — same greedy coalescing as the PS wire plan
+        plan = BucketPlan()
+        groups = OrderedDict()
+        for name in reversed(param_names):
+            if name in fixed:
+                continue
+            b = plan.add(name, max(1, prod(arg_shapes[name])))
+            groups.setdefault(("solo", name) if b is None else ("b", b),
+                              []).append(name)
+        buckets = tuple(tuple(v) for v in groups.values())
+
+        def make_reduce(names):
+            outs = tuple(param_shardings[n] for n in names)
+
+            def reduce_bucket(*gs):
+                return tuple(
+                    jax.lax.with_sharding_constraint(g.sum(0), sh)
+                    for g, sh in zip(gs, outs))
+            return jax.jit(reduce_bucket)
+
+        bucket_reduces = tuple(make_reduce(b) for b in buckets)
+        donate_bucket = () if symbol.has_custom_ops() else (0, 1, 2)
+        grad_step = jax.jit(grad_fn, donate_argnums=(
+            () if symbol.has_custom_ops() else (1,)))
+        apply_step = jax.jit(apply_fn, donate_argnums=donate_bucket)
+
     # pure_callback (Custom op) + donated buffers deadlock: the callback
     # can block forever materializing an input whose buffer was donated
     # to the next step already in flight.  Trade the in-place param
@@ -291,7 +404,10 @@ def _build_program(key, symbol, mesh, data_shapes, label_shapes, dtype,
         param_names=param_names, aux_names=aux_names,
         arg_shapes=arg_shapes, aux_shapes=aux_shapes,
         data_names=data_names, label_names=label_names,
-        donated=bool(donate), trace_counts=trace_counts)
+        donated=bool(donate), trace_counts=trace_counts,
+        reduce_mode=reduce_mode, grad_step=grad_step,
+        apply_step=apply_step, buckets=buckets,
+        bucket_reduces=bucket_reduces)
 
 
 # ---------------------------------------------------------------------------
@@ -373,19 +489,32 @@ def _cache_size():
 def get_step_program(symbol, mesh, data_shapes, label_shapes=None,
                      dtype="float32", compute_dtype=None, optimizer=None,
                      fixed_params=(), shard_optimizer_state=False,
-                     param_shardings=None):
+                     param_shardings=None, reduce_mode="fused",
+                     batch_axis="dp"):
     """The one SPMD step program for this training setup.
 
     Returns the cached :class:`StepProgram` for (symbol, mesh, shapes,
     dtype, optimizer statics, sharding rules), compiling it on first
     use.  ``param_shardings`` maps parameter names to NamedShardings
-    (tensor-parallel rules); omitted names are replicated.  With
-    ``MXNET_SPMD=0`` the program is built privately (never cached or
-    shared) — the pre-sharing behavior.
+    (tensor-parallel rules); omitted names are replicated.
+    ``reduce_mode='bucket'`` compiles the reduce-per-bucket step variant
+    (grad program + one collective per ``MXNET_KVSTORE_BUCKET_BYTES``
+    bucket + apply program — the dist_mesh overlapped data plane); the
+    mode and the bucket-layout knobs are cache-key fields, so both
+    variants of one setup coexist compiled.  With ``MXNET_SPMD=0`` the
+    program is built privately (never cached or shared) — the
+    pre-sharing behavior.
     """
     if optimizer is None:
         raise ValueError("get_step_program requires an optimizer with an "
                          "in-graph equivalent (parallel/ingraph_opt.py)")
+    if reduce_mode not in ("fused", "bucket"):
+        raise ValueError("reduce_mode must be 'fused' or 'bucket', got %r"
+                         % (reduce_mode,))
+    if reduce_mode == "bucket" and symbol.has_custom_ops():
+        # pure_callback does not vmap over the shard axis; Custom-op
+        # graphs keep the fused single-psum step
+        reduce_mode = "fused"
     if param_shardings is None:
         replicated = NamedSharding(mesh, P())
         param_shardings = {n: replicated
@@ -396,19 +525,24 @@ def get_step_program(symbol, mesh, data_shapes, label_shapes=None,
     # the Pallas dispatch fingerprint (which op lowerings route to
     # kernels) — a flipped knob gets its own program, never a stale hit
     remat_name = _remat.env_policy_name()
+    reduce_key = ("fused",) if reduce_mode == "fused" else \
+        ("bucket", batch_axis,
+         int(get_env("MXNET_KVSTORE_BUCKET_BYTES")),
+         int(get_env("MXNET_KVSTORE_BIGARRAY_BOUND")))
     key = ("spmd_step", _symbol_fingerprint(symbol), mesh_fingerprint(mesh),
            _shapes_key(data_shapes), _shapes_key(label_shapes),
            str(dtype), str(compute_dtype) if compute_dtype else None,
            ingraph_fingerprint(optimizer), fixed,
            bool(shard_optimizer_state), _shardings_key(param_shardings),
            bool(symbol.has_custom_ops()), remat_name,
-           _pallas_dispatch.fingerprint())
+           _pallas_dispatch.fingerprint(), reduce_key)
 
     def build():
         return _build_program(key, symbol, mesh, data_shapes, label_shapes,
                               dtype, compute_dtype, optimizer, fixed,
                               bool(shard_optimizer_state), param_shardings,
-                              remat_policy=_remat.resolve(remat_name))
+                              remat_policy=_remat.resolve(remat_name),
+                              reduce_mode=reduce_mode, batch_axis=batch_axis)
 
     if not spmd_enabled():
         return build()

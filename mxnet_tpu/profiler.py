@@ -36,14 +36,17 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
 # loop's ``compute`` phase: its span against compute shows how much of
 # compute is the one-program dispatch vs frontend packing/metric glue.
 PHASES = ("data_wait", "data_next", "h2d_stage", "compute",
-          "metric_fetch", "spmd_step")
+          "metric_fetch", "spmd_step", "comm_overlap")
 
 # Phases that overlap (h2d_stage: stager thread concurrent with
 # compute) or nest inside (spmd_step: within compute; data_next: the
-# pipeline consumer seam inside the fit loop's data_wait) another
-# phase — reported, but excluded from the step-percentage denominator
-# so the breakdown still sums to 100%.
-_NON_ADDITIVE_PHASES = frozenset(["h2d_stage", "spmd_step", "data_next"])
+# pipeline consumer seam inside the fit loop's data_wait; comm_overlap:
+# the dist_mesh bucket-collective submit→drain window inside spmd_step
+# — parallel/mesh_reduce.py) another phase — reported, but excluded
+# from the step-percentage denominator so the breakdown still sums to
+# 100%.
+_NON_ADDITIVE_PHASES = frozenset(["h2d_stage", "spmd_step", "data_next",
+                                  "comm_overlap"])
 
 # The serving engine's scheduler-cycle phases (serving/scheduler.py):
 # ``serve_wait`` (engine blocked on the request queue), ``serve_batch``

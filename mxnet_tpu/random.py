@@ -5,6 +5,12 @@ global seed (``src/resource.cc:96-177``, ``mx.random.seed``).  JAX RNG is
 functional (explicit keys), so this module is the bridge: a process-global key
 that every imperative sampling op splits from.  Compiled executors thread keys
 explicitly (SURVEY.md §7 'hard parts': RNG).
+
+The key is materialized LAZILY: building it eagerly at import would run a
+jax computation, and ``jax.distributed.initialize`` refuses to run after
+the first computation — ``import mxnet_tpu`` must stay legal before a
+multi-process mesh boots (tools/launch.py --mesh workers,
+``parallel.mesh.distributed_init_from_env``).
 """
 from __future__ import annotations
 
@@ -16,7 +22,7 @@ __all__ = ["seed", "next_key", "current_seed"]
 
 _lock = threading.Lock()
 _seed = [0]
-_key = [jax.random.key(0)]
+_key = [None]          # jax.random.key(_seed[0]), built on first use
 _generation = [0]
 
 
@@ -51,5 +57,7 @@ def generation():
 def next_key():
     """Split and return a fresh PRNG key (thread-safe)."""
     with _lock:
+        if _key[0] is None:
+            _key[0] = jax.random.key(_seed[0])
         _key[0], sub = jax.random.split(_key[0])
         return sub

@@ -725,3 +725,173 @@ def test_rebalance_signal_windows_per_server_load(monkeypatch):
     sig2 = c.rebalance_signal()
     assert sig2["hot"] == dst and sig2["per_server"][src] == 0
     cl.finalize()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: automatic load-driven rebalance (kvstore_rebalance.py closes
+# the sensor->migrate loop the previous test drives by hand)
+# ---------------------------------------------------------------------------
+from mxnet_tpu.kvstore_rebalance import RebalanceTrigger
+
+# ~2400B per key with the 4096B client plan: every key is its own
+# migratable fusion bucket, so ownership can actually spread
+_REBAL_KEYS = [(k, 600) for k in range(4)]
+
+
+def _rebal_cluster(monkeypatch):
+    cl = _Cluster(monkeypatch, n_workers=1, n_servers=2)
+    for srv in cl.servers:
+        srv._handle_command("async_mode", b"")
+    c = cl.client(plan_sizes=_REBAL_KEYS)
+    for k, sz in _REBAL_KEYS:
+        c.init(k, np.zeros(sz, np.float32))
+    return cl, c
+
+
+def _owners(c):
+    n = len(c.servers)
+    return {b: c.plan.owner_of(b, n)
+            for b, _ in c.plan.layout() if isinstance(b, int)}
+
+
+def test_rebalance_trigger_converges_then_holds(monkeypatch):
+    """Skewed traffic (a fixed key set that initially all lands on one
+    server) drives the closed loop: one bucket migrates per tick until
+    the windowed imbalance drops under the threshold, then the plan is
+    STABLE — further windows of the same traffic decide 'hold', and the
+    final ownership is the balanced split (the anti-thrash pin: the
+    controller converges instead of oscillating)."""
+    cl, c = _rebal_cluster(monkeypatch)
+    trig = RebalanceTrigger(c, threshold=1.5, interval=9, min_bytes=1)
+    start = _owners(c)
+    hot0 = max(set(start.values()),
+               key=lambda s: sum(1 for v in start.values() if v == s))
+    keys = [k for k, _ in _REBAL_KEYS]
+    grads = {k: np.ones(sz, np.float32) for k, sz in _REBAL_KEYS}
+    c.rebalance_signal()                       # arm the window
+    decisions = []
+    for _tick in range(8):
+        for _ in range(3):                     # one window of traffic
+            for k in keys:
+                c.push(k, grads[k])
+        decisions.append(trig.evaluate_once()["action"])
+    # converged: the last windows all held, and ownership is balanced
+    assert decisions[-3:] == ["hold"] * 3, decisions
+    final = _owners(c)
+    per = [sum(1 for v in final.values() if v == s) for s in (0, 1)]
+    assert per == [2, 2], (start, final, decisions)
+    # exactly the migrations the initial skew required, all hot->cold
+    need = sum(1 for v in start.values() if v == hot0) - 2
+    assert len(trig.actions) == need, (trig.actions, start)
+    assert all(src == hot0 and dst == 1 - hot0
+               for _b, src, dst, _v in trig.actions)
+    # and the moved state is really on the new owner
+    for b, _src, dst, _v in trig.actions:
+        for k in c.plan.members(b):
+            assert (k, 0) in cl.servers[dst].store
+    trig.close()
+    cl.finalize()
+
+
+def test_rebalance_trigger_holds_on_balanced_and_tiny_traffic(monkeypatch):
+    """The hold gates: balanced traffic never migrates, sub-min_bytes
+    windows never migrate (imbalance on noise is not evidence), and a
+    hot server holding a single bucket is left alone — moving its only
+    bucket just relabels the hot spot."""
+    cl, c = _rebal_cluster(monkeypatch)
+    keys = [k for k, _ in _REBAL_KEYS]
+    grads = {k: np.ones(sz, np.float32) for k, sz in _REBAL_KEYS}
+    # lay the plan out 2-2 by hand (the crc32 hash happens to pile all
+    # four buckets onto one server) so balanced traffic IS balanced load
+    buckets = sorted(_owners(c))
+    for b in buckets[:2]:
+        if _owners(c)[b] != 0:
+            c.migrate_bucket(b, 0)
+    for b in buckets[2:]:
+        if _owners(c)[b] != 1:
+            c.migrate_bucket(b, 1)
+    assert sorted(_owners(c).values()) == [0, 0, 1, 1]
+    trig = RebalanceTrigger(c, threshold=1.5, min_bytes=1)
+    c.rebalance_signal()
+    for _ in range(3):
+        for k in keys:                    # uniform traffic, 2-2 plan
+            c.push(k, grads[k])
+    assert trig.evaluate_once()["action"] == "hold"
+    assert trig.actions == []
+    # tiny window: below min_bytes no migration regardless of skew
+    big = RebalanceTrigger(c, threshold=1.5, min_bytes=1 << 30)
+    c.rebalance_signal()
+    for k in c.plan.members(buckets[0]):  # maximally skewed...
+        c.push(k, grads[k])
+    assert big.evaluate_once()["action"] == "hold"   # ...but tiny
+    # one-bucket hot server: drain server 0 down to a single bucket,
+    # then skew every push onto it — the policy must not relabel
+    c.migrate_bucket(buckets[1], 1)
+    owners = _owners(c)
+    assert sum(1 for v in owners.values() if v == 0) == 1
+    lone = next(b for b, s in owners.items() if s == 0)
+    c.rebalance_signal()
+    for _ in range(3):
+        for k in c.plan.members(lone):
+            c.push(k, grads[k])
+    out = trig.evaluate_once()
+    assert out["action"] == "hold" and out["signal"]["hot"] == 0
+    assert trig.actions == []
+    trig.close()
+    big.close()
+    cl.finalize()
+
+
+def test_rebalance_threshold_floor_and_thread_discipline():
+    """<=1.0 thresholds are clamped (some server is always 'hotter than
+    the mean' — an un-floored threshold would migrate every tick
+    forever), and the interval thread is stop-event + join disciplined:
+    close() leaves no live controller thread behind."""
+
+    class _Still:
+        plan = codec.BucketPlan(bucket_bytes=4096)
+        servers = [0, 1]
+        calls = []
+
+        def rebalance_signal(self):
+            self.calls.append(time.monotonic())
+            return {"imbalance": None, "total": 0, "hot": None,
+                    "cold": None, "per_server": {}}
+
+        def migrate_bucket(self, b, dst):  # pragma: no cover
+            raise AssertionError("hold window must not migrate")
+
+    assert RebalanceTrigger(_Still(), threshold=0.5,
+                            min_bytes=0).threshold == 1.1
+    trig = RebalanceTrigger(_Still(), threshold=2.0, interval=0.02,
+                            min_bytes=0, start=True)
+    _wait_until(lambda: len(_Still.calls) >= 2,
+                what="controller ticks")
+    assert trig._thread.is_alive() and not trig._thread.daemon
+    trig.close()
+    assert not trig._thread.is_alive()
+    trig.close()                               # idempotent
+
+
+def test_rebalance_armed_by_env_on_rank0(monkeypatch):
+    """MXNET_KVSTORE_REBALANCE=1 arms the controller on the rank-0
+    worker of a dist kvstore and close() tears it down with the
+    store."""
+    cl = _Cluster(monkeypatch, n_workers=1, n_servers=2)
+    monkeypatch.setenv("MXNET_KVSTORE_REBALANCE", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_REBALANCE_INTERVAL", "0.05")
+    kv = mx.create_kvstore("dist_async")
+    try:
+        assert kv._rebalance is not None
+        assert kv._rebalance._thread.is_alive()
+    finally:
+        kv.close()
+    assert not kv._rebalance._thread.is_alive()
+    # and OFF by default: no controller unless the knob asks for one
+    monkeypatch.setenv("MXNET_KVSTORE_REBALANCE", "0")
+    cl2 = _Cluster(monkeypatch, n_workers=1, n_servers=1)
+    kv2 = mx.create_kvstore("dist_async")
+    try:
+        assert kv2._rebalance is None
+    finally:
+        kv2.close()

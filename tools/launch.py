@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Launch a distributed (parameter-server) job.
+"""Launch a distributed (parameter-server or mesh-collectives) job.
 
 Reference: ``tools/launch.py`` + dmlc-core tracker — spawns 1 scheduler,
 S servers and W workers with ``DMLC_*`` env vars, over ssh/mpi/sge/yarn.
@@ -7,8 +7,20 @@ This launcher implements the ``local`` cluster mode (the one the reference
 nightly suite uses: N processes on one host through the same env protocol);
 remote launchers belong to the cluster layer, not the framework.
 
+``--mesh N`` is the collectives analogue: N processes booted through
+``jax.distributed.initialize`` into ONE global device mesh (no
+scheduler, no servers, no ``DMLC_*`` at all — any PS role vars
+inherited from the parent environment are scrubbed so a mesh worker
+never carries a stale PS rank).  Each process gets the
+``MXNET_MESH_{COORDINATOR,NUM_PROCESSES,PROCESS_ID}`` triple;
+``parallel.mesh.distributed_init_from_env()`` (called by
+``create('dist_mesh')`` and mesh worker scripts) reads it.  Supervision
+and ``--auto-resume`` work like the PS modes — a crashed process is
+relaunched with its SAME stable process id.
+
 Usage:
     python tools/launch.py -n 4 -s 2 python train.py ...
+    python tools/launch.py --mesh 2 python train.py ...
 """
 from __future__ import annotations
 
@@ -115,13 +127,86 @@ def launch_local(num_workers, num_servers, command, env=None,
     return rc
 
 
+def mesh_env(base, coordinator, num_processes, process_id):
+    """Environment for one mesh process: the MXNET_MESH_* triple set and
+    every ``DMLC_*`` variable SCRUBBED.
+
+    The scrub is the coherence fix for restart supervision: a launcher
+    (or test harness) that previously ran a PS job leaves
+    ``DMLC_ROLE``/``DMLC_PS_ROOT_URI`` in the environment, and a mesh
+    worker inheriting them would re-enter the parameter-server path on
+    ``create('dist_*')`` — or, restarted under ``--auto-resume``, rejoin
+    with a stale PS rank.  Mesh processes carry mesh identity only."""
+    e = {k: v for k, v in base.items() if not k.startswith("DMLC_")}
+    e.update({
+        "MXNET_MESH_COORDINATOR": coordinator,
+        "MXNET_MESH_NUM_PROCESSES": str(num_processes),
+        "MXNET_MESH_PROCESS_ID": str(process_id),
+    })
+    return e
+
+
+def launch_mesh(num_processes, command, env=None, auto_resume=None,
+                max_restarts=0):
+    """Spawn N processes of one jax.distributed mesh; returns or-ed rcs.
+
+    Same polling supervision as :func:`launch_local`, with one mesh
+    twist: a relaunched process re-exports its ORIGINAL
+    ``MXNET_MESH_PROCESS_ID`` (ranks are mesh coordinates, not a queue),
+    so an ``--auto-resume`` restart rejoins the same slot it crashed
+    out of."""
+    base = dict(os.environ)
+    if env:
+        base.update(env)
+    if auto_resume:
+        base["MXNET_AUTO_RESUME"] = str(auto_resume)
+    coordinator = "127.0.0.1:%d" % _free_port()
+
+    def spawn(pid):
+        return subprocess.Popen(
+            command, env=mesh_env(base, coordinator, num_processes, pid))
+
+    restarts_left = [max_restarts] * num_processes
+    pending = dict(enumerate(spawn(i) for i in range(num_processes)))
+    final_rc = {}
+    while pending:
+        progressed = False
+        for i, w in list(pending.items()):
+            wrc = w.poll()
+            if wrc is None:
+                continue
+            progressed = True
+            if wrc != 0 and restarts_left[i] > 0:
+                restarts_left[i] -= 1
+                print("mesh process %d exited rc=%d; relaunching as "
+                      "process_id=%d (%d restart(s) left)%s"
+                      % (i, wrc, i, restarts_left[i],
+                         ", auto-resume armed" if auto_resume else ""),
+                      file=sys.stderr)
+                pending[i] = spawn(i)
+            else:
+                final_rc[i] = wrc
+                del pending[i]
+        if pending and not progressed:
+            time.sleep(0.2)
+    rc = 0
+    for wrc in final_rc.values():
+        rc |= wrc
+    return rc
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="Launch a distributed job (reference tools/launch.py).")
-    parser.add_argument("-n", "--num-workers", required=True, type=int)
+    parser.add_argument("-n", "--num-workers", type=int,
+                        help="PS mode: number of worker processes")
     parser.add_argument("-s", "--num-servers", type=int,
                         help="0 skips the PS cluster (worker "
                              "supervision only)")
+    parser.add_argument("--mesh", type=int, metavar="N",
+                        help="collectives mode: boot N processes via "
+                             "jax.distributed into one global mesh "
+                             "(no PS cluster; DMLC_* scrubbed)")
     parser.add_argument("--launcher", default="local",
                         choices=["local", "ssh", "mpi", "sge", "yarn"])
     parser.add_argument("--auto-resume", default=None, metavar="PREFIX",
@@ -136,12 +221,22 @@ def main():
                              "recovery)")
     parser.add_argument("command", nargs="+")
     args, unknown = parser.parse_known_args()
-    if args.num_servers is None:
-        args.num_servers = args.num_workers
     if args.launcher != "local":
         sys.exit("launcher %r is a cluster-infrastructure concern; this "
                  "tree ships the local tracker (same env protocol)"
                  % args.launcher)
+    if args.mesh is not None:
+        if args.num_workers or args.num_servers:
+            sys.exit("--mesh replaces -n/-s: one flag picks the "
+                     "PS-or-collectives topology")
+        sys.exit(launch_mesh(args.mesh, args.command + unknown,
+                             auto_resume=args.auto_resume,
+                             max_restarts=args.max_restarts))
+    if args.num_workers is None:
+        sys.exit("one of -n (PS mode) or --mesh (collectives mode) "
+                 "is required")
+    if args.num_servers is None:
+        args.num_servers = args.num_workers
     sys.exit(launch_local(args.num_workers, args.num_servers,
                           args.command + unknown,
                           auto_resume=args.auto_resume,
