@@ -1,7 +1,7 @@
 # Local entry points for the CI stages defined in ci.yaml.
 PY ?= python
 
-.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke mesh-smoke kernels-smoke data-smoke obs-smoke chaos-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck
+.PHONY: test quick build dist convergence dist-smoke elastic-smoke serve-smoke frontdoor-smoke decode-smoke spmd-smoke mesh-smoke kernels-smoke data-smoke obs-smoke chaos-smoke step-profile ci-quick ci-full docs bench hygiene lint lockcheck racecheck
 
 # fail if any binary / scratch artifact is tracked (ci.yaml per-change
 # `hygiene` stage; the lazy builder regenerates *.so)
@@ -27,6 +27,17 @@ lockcheck:
 	timeout -k 10 300 env MXNET_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
 		$(PY) -m pytest tests/test_input_staging.py \
 		tests/test_kvstore_codec.py -q
+
+# happens-before data-race detector (analysis/racecheck.py) armed over
+# the serving/PS concurrency planes: an unsynchronized write racing
+# any access of a tracked field raises DataRaceError naming both
+# threads and stacks.  The explorer's own suite (seeded cooperative
+# schedules, the PR-16 rank-race fixture) runs first.
+racecheck:
+	timeout -k 10 420 env MXNET_RACE_CHECK=1 JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/test_racecheck.py \
+		tests/test_decode_engine.py tests/test_frontdoor.py \
+		tests/test_elastic_ps.py -q -m 'not slow'
 
 quick:
 	$(PY) -m pytest tests/ -m quick -q

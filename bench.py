@@ -1135,6 +1135,34 @@ def bench_observability(chip, smoke=False):
     }
 
 
+def bench_racecheck_overhead(chip, smoke=False):
+    """Race-detector cost row (serving/loadgen.py
+    racecheck_overhead_protocol): closed-loop capacity of the same
+    forward engine with the happens-before detector off (the shipping
+    default — structurally zero-cost, spy-pinned by
+    tests/test_racecheck.py) vs armed at runtime.  The armed ratio is
+    what the ``make racecheck`` CI stage pays; banking it keeps the
+    claim measured rather than asserted."""
+    from mxnet_tpu.serving.loadgen import racecheck_overhead_protocol
+
+    r = racecheck_overhead_protocol(smoke=smoke)
+    return {
+        "metric": "serving.observability.racecheck_overhead",
+        "value": r["qps_armed_vs_off"], "unit": "ratio",
+        "vs_baseline": None,
+        "off_closed_qps": r["off_closed_qps"],
+        "armed_closed_qps": r["armed_closed_qps"],
+        "n_requests": r["n_closed"],
+        "seed": r["seed"],
+        "note": ("MXNET_RACE_CHECK off vs armed on one engine; the OFF "
+                 "side is the zero-cost contract (plain dict/"
+                 "SimpleNamespace/Lock, unpatched stdlib — spy-pinned "
+                 "by tests/test_racecheck.py), the armed ratio is the "
+                 "CI-stage price (docs/architecture/"
+                 "static_analysis.md)"),
+    }
+
+
 def bench_serving_control(which, chip, smoke=False):
     """Control-plane rows (serving/controller.py + replica_set.py, the
     protocols ``make chaos-smoke`` gates on):
@@ -2466,6 +2494,10 @@ def main():
     # restores baseline within noise)
     guard("serving.observability.overhead", bench_observability, chip,
           smoke)
+    # race-detector cost row: MXNET_RACE_CHECK off (zero-cost,
+    # spy-pinned) vs armed at runtime on the same engine
+    guard("serving.observability.racecheck_overhead",
+          bench_racecheck_overhead, chip, smoke)
     # control-plane rows: the SLO-driven autoscaler vs static
     # provisioning over seeded diurnal/bursty swings, the rolling
     # weight swap under traffic, and the composed-fault chaos campaign

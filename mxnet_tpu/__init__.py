@@ -16,6 +16,13 @@ if _os.environ.get("JAX_PLATFORMS"):
 
 from . import base
 from .base import MXNetError
+
+# arm the happens-before race detector BEFORE any engine/serving module
+# allocates locks or threads, so every make_lock seam and stdlib
+# primitive created below is instrumented (no-op unless
+# MXNET_RACE_CHECK=1)
+from .analysis import racecheck as _racecheck
+_racecheck.maybe_install()
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
     num_devices
 from . import engine

@@ -14,13 +14,20 @@ This package makes them mechanical:
 * ``lockcheck`` — a lightweight dynamic lock-order race detector wired
   into the engine/kvstore/stager lock allocation seams, active under
   ``MXNET_LOCK_CHECK=1``.
+* ``racecheck`` — the happens-before data-race detector
+  (``MXNET_RACE_CHECK=1``): vector clocks over the queue / event /
+  future / thread / ``make_lock`` seams plus ``shared_state()``
+  tracked fields.
+* ``schedules`` — the deterministic schedule explorer
+  (``MXNET_SCHED_SEED`` / ``MXNET_SCHED_EXPLORE``): seeded PCT-style
+  cooperative scheduling over the same seams.
 
 The static-analysis modules are stdlib-only so ``tools/lint.py`` can
 load them without importing the package (and therefore without jax);
 keep parent-relative imports (``from ..base import ...``) out of them
-and out of this ``__init__`` — ``lockcheck`` is the only module allowed
-to touch the runtime, which is why everything here is re-exported
-lazily.
+and out of this ``__init__`` — the dynamic trio ``lockcheck`` /
+``racecheck`` / ``schedules`` are the only modules allowed to touch
+the runtime, which is why everything here is re-exported lazily.
 """
 
 _LAZY = {
@@ -28,6 +35,8 @@ _LAZY = {
     "checkers": ".checkers",
     "manifest": ".manifest",
     "lockcheck": ".lockcheck",
+    "racecheck": ".racecheck",
+    "schedules": ".schedules",
 }
 
 __all__ = ["hot_path"] + sorted(_LAZY)

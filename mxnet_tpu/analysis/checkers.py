@@ -665,6 +665,310 @@ class SpanChecker:
         return False
 
 
+# ---------------------------------------------------------------------------
+# Rule 6: unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+class SharedMutationChecker:
+    """Thread run-loop bodies write ``self.*`` only under a lock or
+    through a ``shared_state()`` container."""
+
+    rule = "unguarded-shared-mutation"
+
+    def check(self, ctx, relpath, tree, lines):
+        for q, fn in _functions_with_qualnames(tree):
+            name = q.rsplit(".", 1)[-1]
+            if not _RUN_LOOP.match(name):
+                continue
+            args = fn.args.args
+            if not args or args[0].arg != "self":
+                continue    # a free function owns its locals
+            yield from self._stmts(relpath, q, fn.body, held=False)
+
+    def _stmts(self, relpath, q, body, held):
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef, ast.Lambda)):
+                continue    # nested defs run on other call stacks
+            h = held
+            if isinstance(st, ast.With) and any(
+                    _lockish_expr(item.context_expr)
+                    for item in st.items):
+                h = True
+            if not h:
+                yield from self._targets(relpath, q, st)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub and isinstance(sub, list):
+                    yield from self._stmts(relpath, q, sub, h)
+            for hd in getattr(st, "handlers", ()):
+                yield from self._stmts(relpath, q, hd.body, h)
+
+    def _targets(self, relpath, q, st):
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        else:
+            return
+        for t in targets:
+            for leaf in ast.walk(t):
+                field = self._self_field_store(leaf)
+                if field:
+                    yield _V(self.rule, relpath, leaf,
+                             "run-loop %s writes self.%s outside any "
+                             "'with <lock>' block; the public API reads "
+                             "it from other threads — hold the seam "
+                             "lock, or move the field into a "
+                             "racecheck.shared_state() container"
+                             % (q, field))
+
+    def _self_field_store(self, node):
+        """'field' for a ``self.field`` / ``self.field[...]`` store.
+        Only Store-context nodes count: in ``self._reg.rank = x`` the
+        inner ``self._reg`` is a Load — the write goes THROUGH the
+        container (the blessed shared_state pattern), not to it."""
+        if not isinstance(getattr(node, "ctx", None), ast.Store):
+            return None
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        return None
+
+
+def _lockish_expr(node):
+    d = _dotted(node)
+    if d is None and isinstance(node, ast.Call):
+        d = _dotted(node.func)
+    if not d:
+        return False
+    return bool(_LOCKISH.search(d.rsplit(".", 1)[-1]))
+
+
+# ---------------------------------------------------------------------------
+# Rule 7: atomic-publish
+# ---------------------------------------------------------------------------
+class AtomicPublishChecker:
+    """Manifest snapshot fields are published by ONE reference
+    assignment in their blessed publishers and never mutated in
+    place."""
+
+    rule = "atomic-publish"
+
+    def check(self, ctx, relpath, tree, lines):
+        entries = [(f, set(allowed)) for p, f, allowed
+                   in ctx.atomic_publish if p == relpath]
+        if not entries:
+            return
+        assigned = set()
+        for q, fn in _functions_with_qualnames(tree):
+            name = q.rsplit(".", 1)[-1]
+            for field, allowed in entries:
+                for v in self._check_fn(relpath, q, name, fn, field,
+                                        allowed, assigned):
+                    yield v
+        for field, _allowed in entries:
+            if field not in assigned:
+                yield _V(self.rule, relpath, 1,
+                         "manifest.ATOMIC_PUBLISH names %s::self.%s but "
+                         "nothing in the file assigns it (update the "
+                         "manifest)" % (relpath, field))
+
+    def _check_fn(self, relpath, q, name, fn, field, allowed, assigned):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if isinstance(node, ast.Assign):
+                hit = [t for t in node.targets
+                       if self._is_field(t, field)]
+                if not hit:
+                    if any(self._tuple_contains(t, field)
+                           for t in node.targets):
+                        yield _V(self.rule, relpath, node,
+                                 "self.%s must be published by ONE "
+                                 "reference assignment; a tuple-unpack "
+                                 "target tears the snapshot for "
+                                 "concurrent readers" % field)
+                    continue
+                assigned.add(field)
+                if name != "__init__" and q not in allowed:
+                    yield _V(self.rule, relpath, node,
+                             "self.%s is published outside its blessed "
+                             "publisher%s (%s); route the swap through "
+                             "%s so every reader sees one coherent "
+                             "snapshot"
+                             % (field, "s" if len(allowed) != 1 else "",
+                                ", ".join(sorted(allowed)) or "__init__",
+                                ", ".join(sorted(allowed)) or "__init__"))
+            elif isinstance(node, ast.AugAssign) and \
+                    self._is_field(node.target, field):
+                assigned.add(field)
+                yield _V(self.rule, relpath, node,
+                         "augmented assignment to published field "
+                         "self.%s is a read-modify-write tear; build "
+                         "the new snapshot and publish it with one "
+                         "reference assignment" % field)
+            elif isinstance(node, (ast.Subscript,)) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    self._is_field(node.value, field):
+                yield _V(self.rule, relpath, node,
+                         "in-place item write to published field "
+                         "self.%s mutates the snapshot concurrent "
+                         "readers hold; copy, modify, republish" % field)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _m.MUTATOR_METHODS and \
+                    self._is_field(node.func.value, field):
+                yield _V(self.rule, relpath, node,
+                         "self.%s.%s() mutates the published snapshot "
+                         "in place; copy, modify, republish with one "
+                         "reference assignment"
+                         % (field, node.func.attr))
+
+    def _is_field(self, node, field):
+        return (isinstance(node, ast.Attribute) and node.attr == field
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    def _tuple_contains(self, t, field):
+        return isinstance(t, (ast.Tuple, ast.List)) and any(
+            self._is_field(el, field) for el in ast.walk(t))
+
+
+# ---------------------------------------------------------------------------
+# Rule 8: future-discipline
+# ---------------------------------------------------------------------------
+class FutureChecker:
+    """Future resolution is cancel-race guarded and never happens while
+    holding a seam lock."""
+
+    rule = "future-discipline"
+
+    _GUARDS = frozenset(["InvalidStateError", "Exception",
+                         "BaseException"])
+
+    def check(self, ctx, relpath, tree, lines):
+        for _q, fn in _functions_with_qualnames(tree):
+            yield from self._walk(relpath, fn.body, guarded=False,
+                                  locked=False,
+                                  safe=self._safe_receivers(fn))
+
+    def _safe_receivers(self, fn):
+        """Receivers whose resolution cannot lose a cancel race even
+        without a try/except: the function called
+        ``<recv>.set_running_or_notify_cancel()`` (once that returns
+        True the future is RUNNING and ``cancel()`` can no longer
+        succeed), or ``<recv>`` is a Future *constructed in this
+        function* (no other thread holds a reference yet, so nothing
+        can cancel it before it escapes)."""
+        safe = set()
+
+        def visit(node):
+            for ch in ast.iter_child_nodes(node):
+                if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                    continue
+                if (isinstance(ch, ast.Call)
+                        and isinstance(ch.func, ast.Attribute)
+                        and ch.func.attr == "set_running_or_notify_cancel"):
+                    recv = _dotted(ch.func.value)
+                    if recv:
+                        safe.add(recv)
+                if (isinstance(ch, ast.Assign)
+                        and isinstance(ch.value, ast.Call)
+                        and _terminal(ch.value.func) == "Future"):
+                    for tgt in ch.targets:
+                        if isinstance(tgt, ast.Name):
+                            safe.add(tgt.id)
+                visit(ch)
+
+        visit(fn)
+        return frozenset(safe)
+
+    def _walk(self, relpath, body, guarded, locked, safe):
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue    # nested defs get their own walk
+            if isinstance(st, ast.Try):
+                g = guarded or self._guarding(st)
+                yield from self._walk(relpath, st.body, g, locked,
+                                      safe)
+                for h in st.handlers:
+                    yield from self._walk(relpath, h.body, guarded,
+                                          locked, safe)
+                yield from self._walk(relpath, st.orelse, guarded,
+                                      locked, safe)
+                yield from self._walk(relpath, st.finalbody, guarded,
+                                      locked, safe)
+                continue
+            lk = locked
+            if isinstance(st, ast.With) and any(
+                    _lockish_expr(item.context_expr)
+                    for item in st.items):
+                lk = True
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub and isinstance(sub, list):
+                    yield from self._walk(relpath, sub, guarded, lk,
+                                          safe)
+            for node in self._own_calls(st):
+                yield from self._check_call(relpath, node, guarded,
+                                            lk, safe)
+
+    def _own_calls(self, st):
+        """Calls in this statement's own expressions — sub-statements
+        are walked separately with their own guard state, and nested
+        defs/lambdas run on other call stacks."""
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.stmt)):
+                    continue
+                if isinstance(child, ast.Call):
+                    yield child
+                yield from walk(child)
+        yield from walk(st)
+
+    def _check_call(self, relpath, node, guarded, locked, safe):
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("set_result", "set_exception")):
+            return
+        recv = _dotted(f.value) or "<future>"
+        if locked:
+            yield _V(self.rule, relpath, node,
+                     "%s.%s() while holding a seam lock runs completion "
+                     "callbacks (and waiter wake-ups) under the lock; "
+                     "resolve after releasing it" % (recv, f.attr))
+        if not guarded and recv not in safe:
+            yield _V(self.rule, relpath, node,
+                     "%s.%s() without a cancel-race guard: a caller "
+                     "cancelling between done() and resolution raises "
+                     "InvalidStateError on the completer thread — wrap "
+                     "in try/except InvalidStateError, call "
+                     "set_running_or_notify_cancel() first, or route "
+                     "through the _resolve helper" % (recv, f.attr))
+
+    def _guarding(self, st):
+        for h in st.handlers:
+            if h.type is None:
+                return True     # bare except
+            types = h.type.elts if isinstance(h.type, ast.Tuple) \
+                else [h.type]
+            for t in types:
+                if _terminal(t) in self._GUARDS:
+                    return True
+        return False
+
+
+_RUN_LOOP = re.compile(_m.RUN_LOOP_NAME_RE)
+
 ALL_CHECKERS = (EnvKnobChecker, DonationChecker, HostSyncChecker,
-                ThreadChecker, SpanChecker)
+                ThreadChecker, SpanChecker, SharedMutationChecker,
+                AtomicPublishChecker, FutureChecker)
 RULES = tuple(c.rule for c in ALL_CHECKERS) + ("bad-suppression",)

@@ -64,7 +64,8 @@ class LintContext:
     fixture registries/manifests through the keyword overrides."""
 
     def __init__(self, root=None, registry=None, documented=None,
-                 hot_paths=None, span_entry_points=None):
+                 hot_paths=None, span_entry_points=None,
+                 atomic_publish=None):
         from . import manifest as _m
         self.root = root
         self.base_relpath = _BASE_RELPATH
@@ -73,6 +74,8 @@ class LintContext:
             tuple(hot_paths)
         self.span_entry_points = _m.SPAN_ENTRY_POINTS \
             if span_entry_points is None else tuple(span_entry_points)
+        self.atomic_publish = _m.ATOMIC_PUBLISH \
+            if atomic_publish is None else tuple(atomic_publish)
         if registry is not None:
             self.registry = dict(registry)
         elif root is not None:

@@ -213,10 +213,19 @@ class CheckedLock:
 def make_lock(name, rlock=False):
     """Allocate a lock at a checked seam: a plain
     ``threading.Lock``/``RLock`` normally, a :class:`CheckedLock` under
-    ``MXNET_LOCK_CHECK=1``.  ``name`` appears in detector reports."""
+    ``MXNET_LOCK_CHECK=1``.  ``name`` appears in detector reports.
+
+    While the happens-before race detector (``MXNET_RACE_CHECK=1``) or
+    a cooperative schedule (``analysis.schedules``) is live, the lock
+    is additionally wrapped in a ``racecheck.SeamLock`` so every
+    acquire/release is a synchronization edge and a yield point; with
+    neither armed the wrap is a no-op returning the lock unchanged."""
     if not enabled():
-        return threading.RLock() if rlock else threading.Lock()
-    return CheckedLock(name, rlock=rlock)
+        inner = threading.RLock() if rlock else threading.Lock()
+    else:
+        inner = CheckedLock(name, rlock=rlock)
+    from . import racecheck
+    return racecheck.wrap_lock(inner, name, rlock=rlock)
 
 
 def check_owned(lock, what):
@@ -226,6 +235,10 @@ def check_owned(lock, what):
     a no-op (one isinstance check) for plain locks, so seams may call
     it unconditionally."""
     inner = getattr(lock, "_lock", lock)  # Condition -> its lock
+    if not isinstance(inner, CheckedLock):
+        # racecheck.SeamLock -> its inner; CheckedLock keeps ITS raw
+        # lock in ._inner too, so only unwrap when not already there
+        inner = getattr(inner, "_inner", inner)
     if not isinstance(inner, CheckedLock):
         return
     if not inner._is_owned():

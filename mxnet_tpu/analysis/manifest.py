@@ -83,3 +83,37 @@ SPAN_EMITTERS = frozenset([
 # component, case-insensitive regex).
 # ---------------------------------------------------------------------------
 LOCKISH_NAME_RE = r"(?i)(^|_)(lock|locked|mutex|sem|sema|cv|cond|condition)s?$"
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-mutation rule: function names that ARE thread
+# run-loops (the bodies threads execute concurrently with the public
+# API).  A direct ``self.<field> = ...`` in one of these outside a
+# ``with <lock>`` block is a write racing every caller-side read;
+# route it through a lock or a ``racecheck.shared_state()`` container.
+# ---------------------------------------------------------------------------
+RUN_LOOP_NAME_RE = (r"(?i)^(run|_run|_worker|_serve|_accept|"
+                    r"[a-z0-9_]*_loop)$")
+
+# ---------------------------------------------------------------------------
+# atomic-publish rule: fields that are multi-value SNAPSHOTS published
+# by one reference assignment (the swap_params pattern).  Entries are
+# (path, field, allowed publisher qualnames); assigning the field
+# anywhere but ``__init__``/the listed publishers, unpacking it as a
+# tuple target, or mutating it in place tears the snapshot for
+# concurrent readers.
+# ---------------------------------------------------------------------------
+ATOMIC_PUBLISH = (
+    ("mxnet_tpu/serving/program_store.py", "_live",
+     ("ProgramStore.swap_params", "ProgramStore.restore_params")),
+    ("mxnet_tpu/serving/program_store.py", "_params",
+     ("ProgramStore.swap_params", "ProgramStore.restore_params",
+      "GenerativeProgramStore.swap_params",
+      "GenerativeProgramStore.restore_params")),
+)
+
+# Method names that mutate their receiver in place (atomic-publish
+# flags these on a published field: build a new object and republish).
+MUTATOR_METHODS = frozenset([
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "sort", "reverse",
+])

@@ -1,0 +1,732 @@
+"""Happens-before data-race detection (``MXNET_RACE_CHECK=1``).
+
+``lockcheck`` catches lock-*order* bugs; this module catches the bug
+class that hid the PR-16 rank-assignment flake for seven PRs: *data
+races* — two threads touching the same field with no synchronization
+ordering the accesses, where any interleaving is legal and the wrong
+one only shows up one run in ten.
+
+The detector is a vector-clock happens-before checker in the
+ThreadSanitizer / FastTrack tradition, scaled to the repo's actual
+seams instead of every memory access:
+
+* every tracked thread carries a vector clock (``{tid: epoch}``);
+* synchronization edges are harvested by monkeypatching the primitives
+  the codebase already routes everything through — ``queue.Queue``
+  put/get, ``threading.Event`` set/wait, ``concurrent.futures.Future``
+  resolve/result, ``Thread`` start/join — plus every lock allocated
+  through ``analysis.lockcheck.make_lock`` (which returns a
+  :class:`SeamLock` wrapper while the detector is armed);
+* *shared variables* are the fields placed in a :func:`shared_state`
+  container (adopted at the engine / scheduler / replica-set /
+  pipeline / block-pool / membership seams) and the entries of a
+  :func:`shared_map`.  A write that is not happens-before-ordered
+  against a previous access (or a read against a previous write)
+  raises :class:`DataRaceError` **at the second access**, naming both
+  threads, both stacks and the field — no lucky interleaving needed.
+
+Zero cost off: with ``MXNET_RACE_CHECK`` unset nothing is patched,
+``shared_state`` returns a plain ``types.SimpleNamespace``,
+``shared_map`` returns a plain ``dict`` and ``make_lock`` returns a
+plain ``threading.Lock`` (spy-pinned by tests/test_racecheck.py).
+
+The same instrumentation points double as the *yield points* of the
+deterministic schedule explorer (``analysis.schedules``): when a
+cooperative schedule is active, every patched primitive asks the
+scheduler before proceeding.  Install/uninstall is refcounted so the
+detector and the explorer can arm independently.
+
+Known blind spots (docs/architecture/static_analysis.md):
+``queue.SimpleQueue`` (C implementation, unpatchable),
+``concurrent.futures.wait``/``as_completed`` (private waiters), raw
+``threading.Lock`` objects not allocated through ``make_lock``, and
+plain attributes never adopted into ``shared_state``.  Queue edges are
+*accumulated* per queue (every get joins every earlier put), which is
+conservative: it can only miss races, never invent them.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue_mod
+import threading
+import traceback
+import types
+import weakref
+from concurrent.futures import CancelledError, Future
+from concurrent.futures import TimeoutError as _FutTimeout
+
+from ..base import MXNetError, get_env
+
+__all__ = ["DataRaceError", "armed", "install", "uninstall",
+           "maybe_install", "shared_state", "shared_map", "wrap_lock",
+           "SeamLock", "reset"]
+
+
+class DataRaceError(MXNetError):
+    """Two threads accessed a shared field without a happens-before
+    edge between the accesses (at least one a write)."""
+
+
+# ---------------------------------------------------------------------------
+# Vector clocks.  All detector bookkeeping is guarded by _meta, a RAW
+# lock that is never itself tracked (the checker cannot race or
+# deadlock on itself).  Thread identity is the Thread *object* (ids are
+# reused), mapped to a small unique int.
+# ---------------------------------------------------------------------------
+_meta = threading.Lock()
+_armed = False
+_patch_refs = 0
+_orig = {}
+
+_tids = weakref.WeakKeyDictionary()      # Thread -> int
+_states = weakref.WeakKeyDictionary()    # Thread -> _ThreadState
+_tid_counter = itertools.count(1)
+
+_HB_ATTR = "_mxt_hb_vc"        # sync-object release clock attribute
+_FINAL_ATTR = "_mxt_hb_final"  # dead thread's final clock
+_START_ATTR = "_mxt_hb_start"  # clock snapshot a child inherits
+
+
+class _ThreadState:
+    __slots__ = ("tid", "vc")
+
+    def __init__(self, tid):
+        self.tid = tid
+        self.vc = {tid: 1}
+
+
+def _join(dst, src):
+    for t, c in src.items():
+        if dst.get(t, 0) < c:
+            dst[t] = c
+
+
+def _cur_thread():
+    """Current Thread object WITHOUT fabricating a ``_DummyThread``.
+
+    During ``Thread._bootstrap_inner`` the child fires
+    ``self._started.set()`` BEFORE registering itself in
+    ``threading._active``; ``threading.current_thread()`` would then
+    invent a ``_DummyThread`` whose ``__init__`` itself calls
+    ``Event.set`` — re-entering this instrumentation while ``_meta``
+    is held.  Returning ``None`` for unregistered (bootstrapping or
+    foreign C) threads makes the hooks skip that one access instead.
+    """
+    return threading._active.get(threading.get_ident())
+
+
+def _ts_locked(thread=None):
+    t = thread if thread is not None else _cur_thread()
+    if t is None:
+        return None
+    st = _states.get(t)
+    if st is None:
+        tid = _tids.get(t)
+        if tid is None:
+            tid = _tids[t] = next(_tid_counter)
+        st = _states[t] = _ThreadState(tid)
+    return st
+
+
+def _publish(obj):
+    """Release edge: merge the current thread's clock into ``obj``'s
+    release clock, then tick (later accesses are NOT ordered before a
+    subsequent acquire)."""
+    if not _armed:
+        return
+    with _meta:
+        st = _ts_locked()
+        if st is None:
+            return
+        vc = getattr(obj, _HB_ATTR, None)
+        if vc is None:
+            vc = {}
+            try:
+                setattr(obj, _HB_ATTR, vc)
+            except AttributeError:   # __slots__ object: untrackable
+                return
+        _join(vc, st.vc)
+        st.vc[st.tid] = st.vc.get(st.tid, 1) + 1
+
+
+def _acquire_edge(obj):
+    """Acquire edge: join ``obj``'s release clock into the current
+    thread's clock."""
+    if not _armed:
+        return
+    vc = getattr(obj, _HB_ATTR, None)
+    if vc:
+        with _meta:
+            st = _ts_locked()
+            if st is not None:
+                _join(st.vc, vc)
+
+
+def reset():
+    """Forget every thread clock (test isolation after an intentional
+    race)."""
+    with _meta:
+        _states.clear()
+
+
+# ---------------------------------------------------------------------------
+# Tracked shared state
+# ---------------------------------------------------------------------------
+def _here(skip=3):
+    return traceback.extract_stack(limit=16)[:-skip]
+
+
+def _fmt_stack(frames):
+    return "".join(traceback.format_list(frames)) or "  <no frames>\n"
+
+
+class _Access:
+    __slots__ = ("tid", "clock", "thread_name", "frames")
+
+    def __init__(self, tid, clock, thread_name, frames):
+        self.tid = tid
+        self.clock = clock
+        self.thread_name = thread_name
+        self.frames = frames
+
+
+class _Var:
+    __slots__ = ("write", "reads")
+
+    def __init__(self):
+        self.write = None       # _Access of last write
+        self.reads = {}         # tid -> _Access since last write
+
+
+def _race(name, field, kind_now, now, kind_then, then):
+    return DataRaceError(
+        "data race on %s.%s: %s by thread %r is unordered against an "
+        "earlier %s by thread %r (no lock / queue / event / future / "
+        "join edge between them).\n"
+        "--- this %s (thread %r) ---\n%s"
+        "--- earlier %s (thread %r) ---\n%s"
+        % (name, field, kind_now, now.thread_name, kind_then,
+           then.thread_name, kind_now, now.thread_name,
+           _fmt_stack(now.frames), kind_then, then.thread_name,
+           _fmt_stack(then.frames)))
+
+
+def _check_access_locked(name, vars_, field, write):
+    """Happens-before check of one access (caller holds _meta)."""
+    t = _cur_thread()
+    st = _ts_locked(t)
+    if st is None:
+        return
+    var = vars_.get(field)
+    if var is None:
+        var = vars_[field] = _Var()
+    me = _Access(st.tid, st.vc.get(st.tid, 1), t.name, _here(skip=3))
+    w = var.write
+    if w is not None and w.tid != st.tid \
+            and st.vc.get(w.tid, 0) < w.clock:
+        raise _race(name, field, "write" if write else "read", me,
+                    "write", w)
+    if write:
+        for r in var.reads.values():
+            if r.tid != st.tid and st.vc.get(r.tid, 0) < r.clock:
+                raise _race(name, field, "write", me, "read", r)
+        var.write = me
+        var.reads = {}
+    else:
+        var.reads[st.tid] = me
+
+
+def _tracking():
+    """Should shared_state()/shared_map() return tracked containers?
+    True while the detector is armed OR a cooperative schedule is
+    active (the explorer wants the yield points even without race
+    checking)."""
+    if _armed:
+        return True
+    from . import schedules
+    return schedules.active()
+
+
+def _sched():
+    from . import schedules
+    s = schedules._ACTIVE
+    return s
+
+
+class _TrackedState:
+    """Attribute container whose every read/write is a yield point and
+    (when armed) a happens-before-checked access."""
+
+    __slots__ = ("_mxt_name", "_mxt_fields", "_mxt_vars")
+
+    def __init__(self, name, fields):
+        object.__setattr__(self, "_mxt_name", name)
+        object.__setattr__(self, "_mxt_fields", dict(fields))
+        object.__setattr__(self, "_mxt_vars", {})
+
+    def __getattr__(self, key):
+        if key.startswith("_mxt_"):
+            raise AttributeError(key)
+        fields = self._mxt_fields
+        if key not in fields:
+            raise AttributeError("%s has no shared field %r"
+                                 % (self._mxt_name, key))
+        s = _sched()
+        if s is not None:
+            s.yield_point("state.read:%s.%s" % (self._mxt_name, key))
+        if _armed:
+            with _meta:
+                _check_access_locked(self._mxt_name, self._mxt_vars,
+                                     key, write=False)
+        return fields[key]
+
+    def __setattr__(self, key, value):
+        fields = self._mxt_fields
+        if key not in fields:
+            raise AttributeError(
+                "%s has no shared field %r (declare every field at "
+                "shared_state() construction)" % (self._mxt_name, key))
+        s = _sched()
+        if s is not None:
+            s.yield_point("state.write:%s.%s" % (self._mxt_name, key))
+        if _armed:
+            with _meta:
+                _check_access_locked(self._mxt_name, self._mxt_vars,
+                                     key, write=True)
+        fields[key] = value
+
+    def __repr__(self):
+        return "<shared_state %r %r>" % (self._mxt_name,
+                                         self._mxt_fields)
+
+
+def shared_state(name, **fields):
+    """Declare a bundle of cross-thread fields.  Off (detector unarmed,
+    no cooperative schedule active): a plain ``SimpleNamespace`` —
+    attribute access costs exactly a plain attribute.  On: a tracked
+    container; every access is a scheduler yield point and a
+    happens-before-checked shared access."""
+    if not _tracking():
+        return types.SimpleNamespace(**fields)
+    return _TrackedState(name, fields)
+
+
+class _TrackedMap(dict):
+    """A dict tracked as ONE shared variable (coarse: any lookup is a
+    read, any mutation a write — key-granular tracking would add cost
+    for no extra repo coverage)."""
+
+    __slots__ = ("_mxt_name", "_mxt_vars")
+
+    def __init__(self, name, init=None):
+        dict.__init__(self, init or {})
+        self._mxt_name = name
+        self._mxt_vars = {}
+
+    def _on(self, write):
+        s = _sched()
+        if s is not None:
+            s.yield_point("map.%s:%s" % ("write" if write else "read",
+                                         self._mxt_name))
+        if _armed:
+            with _meta:
+                _check_access_locked(self._mxt_name, self._mxt_vars,
+                                     "<entries>", write=write)
+
+    def __getitem__(self, k):
+        self._on(False)
+        return dict.__getitem__(self, k)
+
+    def __setitem__(self, k, v):
+        self._on(True)
+        dict.__setitem__(self, k, v)
+
+    def __delitem__(self, k):
+        self._on(True)
+        dict.__delitem__(self, k)
+
+    def __contains__(self, k):
+        self._on(False)
+        return dict.__contains__(self, k)
+
+    def get(self, k, default=None):
+        self._on(False)
+        return dict.get(self, k, default)
+
+    def pop(self, k, *default):
+        self._on(True)
+        return dict.pop(self, k, *default)
+
+    def setdefault(self, k, default=None):
+        self._on(True)
+        return dict.setdefault(self, k, default)
+
+    def items(self):
+        self._on(False)
+        return dict.items(self)
+
+    def values(self):
+        self._on(False)
+        return dict.values(self)
+
+    def keys(self):
+        self._on(False)
+        return dict.keys(self)
+
+    def copy(self):
+        self._on(False)
+        return dict(dict.items(self))
+
+
+def shared_map(name, init=None):
+    """Dict counterpart of :func:`shared_state` (plain ``dict`` when
+    nothing is armed)."""
+    if not _tracking():
+        return dict(init or {})
+    return _TrackedMap(name, init)
+
+
+# ---------------------------------------------------------------------------
+# SeamLock: the make_lock wrapper while the detector / explorer is on
+# ---------------------------------------------------------------------------
+class SeamLock:
+    """Wraps the lock ``make_lock`` would otherwise return.  Acquire
+    joins the lock's release clock (HB edge) and is a cooperative
+    yield/block point under a strict schedule; release publishes the
+    holder's clock *before* the lock is actually dropped, so the next
+    acquirer is ordered after everything the holder did."""
+
+    def __init__(self, inner, name, rlock=False):
+        self._inner = inner
+        self.name = name
+        self._rlock = rlock
+        self._owner = None      # Thread (bookkeeping by holder only)
+        self._count = 0
+
+    def acquire(self, blocking=True, timeout=-1):
+        me = threading.current_thread()
+        s = _sched()
+        if s is not None and getattr(s, "strict", False) and blocking \
+                and self._owner not in (None, me):
+            # cooperative block: wait for the floor until the holder
+            # (another controlled task) releases; retry handles an
+            # uncontrolled thread stealing in between
+            while True:
+                s.block_until(
+                    lambda: self._owner in (None, me),
+                    tag="lock:%s" % self.name)
+                if self._inner.acquire(False):
+                    ok = True
+                    break
+        else:
+            if s is not None:
+                s.yield_point("lock:%s" % self.name)
+            if timeout is None or timeout < 0:
+                ok = self._inner.acquire(blocking)
+            else:
+                ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if self._count == 0:
+                self._owner = me
+            self._count += 1
+            _acquire_edge(self)
+        return ok
+
+    def release(self):
+        _publish(self)
+        if self._count <= 1:
+            self._count = 0
+            self._owner = None
+        else:
+            self._count -= 1
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return "<SeamLock %r>" % (self.name,)
+
+
+def wrap_lock(inner, name, rlock=False):
+    """Called by ``lockcheck.make_lock``: wrap ``inner`` while the
+    detector or a schedule is live, return it untouched otherwise."""
+    if _armed or _sched() is not None:
+        return SeamLock(inner, name, rlock=rlock)
+    return inner
+
+
+# ---------------------------------------------------------------------------
+# stdlib instrumentation (installed only while armed / exploring)
+# ---------------------------------------------------------------------------
+def _strict_sched():
+    s = _sched()
+    if s is not None and getattr(s, "strict", False) \
+            and s.controls_current():
+        return s
+    return None
+
+
+def _q_put(self, item, block=True, timeout=None):
+    s = _sched()
+    if s is not None:
+        if getattr(s, "strict", False) and s.controls_current() \
+                and self.maxsize > 0:
+            while True:
+                s.block_until(lambda: self.qsize() < self.maxsize,
+                              tag="queue.put")
+                try:
+                    _publish(self)
+                    return _orig["q_put"](self, item, block=False)
+                except _queue_mod.Full:
+                    continue
+        s.yield_point("queue.put")
+    _publish(self)
+    return _orig["q_put"](self, item, block, timeout)
+
+
+def _q_get(self, block=True, timeout=None):
+    s = _strict_sched()
+    if s is not None and block:
+        while True:
+            ok = s.block_until(lambda: self.qsize() > 0,
+                               timeout=timeout, tag="queue.get")
+            if not ok:
+                raise _queue_mod.Empty
+            try:
+                item = _orig["q_get"](self, False)
+                break
+            except _queue_mod.Empty:
+                continue
+    else:
+        s2 = _sched()
+        if s2 is not None:
+            s2.yield_point("queue.get")
+        item = _orig["q_get"](self, block, timeout)
+    _acquire_edge(self)
+    return item
+
+
+def _ev_set(self):
+    s = _sched()
+    if s is not None:
+        s.yield_point("event.set")
+    _publish(self)
+    return _orig["ev_set"](self)
+
+
+def _ev_wait(self, timeout=None):
+    s = _strict_sched()
+    if s is not None:
+        s.block_until(self.is_set, timeout=timeout, tag="event.wait")
+        ok = self.is_set()
+    else:
+        s2 = _sched()
+        if s2 is not None:
+            s2.yield_point("event.wait")
+        ok = _orig["ev_wait"](self, timeout)
+    if ok:
+        _acquire_edge(self)
+    return ok
+
+
+def _ev_is_set(self):
+    # a True is_set() IS an edge (Event's internal lock orders it);
+    # treating it as one keeps stop-flag polling loops race-clean
+    ok = _orig["ev_is_set"](self)
+    if ok:
+        _acquire_edge(self)
+    return ok
+
+
+def _fut_set_result(self, result):
+    s = _sched()
+    if s is not None:
+        s.yield_point("future.set_result")
+    _publish(self)
+    return _orig["f_set_result"](self, result)
+
+
+def _fut_set_exception(self, exc):
+    s = _sched()
+    if s is not None:
+        s.yield_point("future.set_exception")
+    _publish(self)
+    return _orig["f_set_exc"](self, exc)
+
+
+def _fut_result(self, timeout=None):
+    s = _strict_sched()
+    if s is not None:
+        if not s.block_until(self.done, timeout=timeout,
+                             tag="future.result"):
+            raise _FutTimeout()
+        timeout = 0
+    try:
+        out = _orig["f_result"](self, timeout)
+    except (CancelledError, _FutTimeout):
+        raise
+    except BaseException:
+        # the stored exception: set by the resolver -> ordered
+        _acquire_edge(self)
+        raise
+    _acquire_edge(self)
+    return out
+
+
+def _thread_start(self):
+    if not getattr(self, "_mxt_wrapped", False):
+        self._mxt_wrapped = True
+        if _armed:
+            with _meta:
+                st = _ts_locked()
+                if st is not None:
+                    setattr(self, _START_ATTR, dict(st.vc))
+                    st.vc[st.tid] = st.vc.get(st.tid, 1) + 1
+        s = _sched()
+        spawned = s is not None and s.on_spawn(self)
+        orig_run = self.run
+
+        def _run():
+            if _armed:
+                start_vc = getattr(self, _START_ATTR, None)
+                if start_vc:
+                    with _meta:
+                        st0 = _ts_locked()
+                        if st0 is not None:
+                            _join(st0.vc, start_vc)
+            try:
+                if spawned:
+                    s.attach_current()
+                orig_run()
+            finally:
+                if _armed:
+                    with _meta:
+                        st2 = _ts_locked()
+                        if st2 is not None:
+                            setattr(self, _FINAL_ATTR, dict(st2.vc))
+                if spawned:
+                    s.on_exit_current()
+
+        self.run = _run
+    out = _orig["t_start"](self)
+    s2 = _sched()
+    if s2 is not None:
+        s2.yield_point("thread.start")
+    return out
+
+
+def _thread_join(self, timeout=None):
+    s = _strict_sched()
+    if s is not None and self is not _cur_thread():
+        # wait on the TASK state (flips synchronously at cooperative
+        # exit), then a real join for the post-run wind-down: a plain
+        # is_alive() predicate would false-deadlock, since nothing
+        # re-evaluates predicates after the last thread's real death
+        ok = s.block_until(lambda: s.task_done(self),
+                           timeout=timeout, tag="thread.join")
+        _orig["t_join"](self, 10.0 if ok else 0)
+    else:
+        s2 = _sched()
+        if s2 is not None:
+            s2.yield_point("thread.join")
+        _orig["t_join"](self, timeout)
+    if not self.is_alive():
+        final = getattr(self, _FINAL_ATTR, None)
+        if _armed and final:
+            with _meta:
+                stj = _ts_locked()
+                if stj is not None:
+                    _join(stj.vc, final)
+
+
+def _time_sleep(secs):
+    s = _strict_sched()
+    if s is not None:
+        s.block_until(lambda: False, timeout=max(float(secs), 0.0),
+                      tag="time.sleep")
+        return None
+    s2 = _sched()
+    if s2 is not None:
+        s2.yield_point("time.sleep")
+    return _orig["sleep"](secs)
+
+
+_PATCHES = (
+    (_queue_mod.Queue, "put", "q_put", _q_put),
+    (_queue_mod.Queue, "get", "q_get", _q_get),
+    (threading.Event, "set", "ev_set", _ev_set),
+    (threading.Event, "wait", "ev_wait", _ev_wait),
+    (threading.Event, "is_set", "ev_is_set", _ev_is_set),
+    (Future, "set_result", "f_set_result", _fut_set_result),
+    (Future, "set_exception", "f_set_exc", _fut_set_exception),
+    (Future, "result", "f_result", _fut_result),
+    (threading.Thread, "start", "t_start", _thread_start),
+    (threading.Thread, "join", "t_join", _thread_join),
+)
+
+
+def ensure_patched():
+    """Refcounted install of the seam patches (detector arm + each
+    schedule activation both hold a reference)."""
+    global _patch_refs
+    with _meta:
+        _patch_refs += 1
+        if _patch_refs > 1:
+            return
+        import time as _time
+        _orig["sleep"] = _time.sleep
+        _time.sleep = _time_sleep
+        for owner, attr, key, repl in _PATCHES:
+            _orig[key] = getattr(owner, attr)
+            setattr(owner, attr, repl)
+
+
+def release_patched():
+    global _patch_refs
+    with _meta:
+        if _patch_refs == 0:
+            return
+        _patch_refs -= 1
+        if _patch_refs:
+            return
+        import time as _time
+        _time.sleep = _orig.pop("sleep")
+        for owner, attr, key, _repl in _PATCHES:
+            setattr(owner, attr, _orig.pop(key))
+
+
+def armed():
+    """Is the happens-before detector live?"""
+    return _armed
+
+
+def install():
+    """Arm the detector (idempotent)."""
+    global _armed
+    if _armed:
+        return
+    ensure_patched()
+    _armed = True
+
+
+def uninstall():
+    """Disarm and restore the stdlib (idempotent)."""
+    global _armed
+    if not _armed:
+        return
+    _armed = False
+    release_patched()
+    reset()
+
+
+def maybe_install():
+    """Arm iff ``MXNET_RACE_CHECK=1`` (called once at package
+    import)."""
+    if get_env("MXNET_RACE_CHECK"):
+        install()

@@ -1,0 +1,468 @@
+"""Deterministic schedule exploration (``MXNET_SCHED_EXPLORE=N`` /
+``MXNET_SCHED_SEED``).
+
+The happens-before detector (``analysis.racecheck``) finds *unordered*
+accesses; this module finds *ordering* bugs — code where every access
+is properly synchronized but the protocol is wrong under some legal
+interleaving (the PR-16 rank race: registration order vs creation
+order).  Instead of praying that CI hits the bad interleaving,
+:func:`explore` replays a test body under N **seeded** schedules and
+a failing schedule prints its seed and replays bit-identically.
+
+Two modes:
+
+* **strict** (default) — a cooperative scheduler in the PCT tradition:
+  every controlled thread gets a seeded random priority, exactly one
+  thread holds the floor at a time, and every instrumented seam
+  (queue/event/future/lock/sleep/thread ops plus every
+  ``shared_state`` access) is a yield point where the scheduler may
+  preempt (seeded priority-change points).  Blocking ops become
+  cooperative waits with *virtual* time: ``time.sleep`` and wait
+  timeouts cost zero wall-clock, and when every controlled thread is
+  blocked with no timeout pending the scheduler raises
+  :class:`SchedDeadlock` naming each thread and what it waits on.
+  Strict mode is bit-identical per seed; use it on sandboxed fixtures
+  whose threads it fully controls (a thread native-blocking outside
+  the seams — e.g. ``Condition.wait`` or a socket — stalls the floor
+  until the real-time watchdog poisons the run).
+
+* **jitter** (``strict=False``) — for tests over the real engine /
+  socket planes the cooperative scheduler cannot fully own: each
+  thread gets a seeded per-thread perturbation stream (keyed by its
+  name, so the stream does not depend on interleaving) and yield
+  points become occasional sub-millisecond sleeps.  Reproducible *in
+  distribution* rather than bit-identical; armed on
+  ``test_bucket_migration_under_traffic_exactly_once``.
+
+The instrumentation layer is shared with racecheck (refcounted
+install); activating a schedule does NOT arm race checking and vice
+versa.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+import zlib
+
+from ..base import MXNetError, get_env
+
+__all__ = ["explore", "run_schedule", "active", "ScheduleFailure",
+           "SchedDeadlock", "SchedStuck"]
+
+
+class ScheduleFailure(MXNetError):
+    """A seeded schedule made the body fail; carries the seed so the
+    failure replays bit-identically."""
+
+    def __init__(self, seed, cause):
+        self.seed = seed
+        MXNetError.__init__(
+            self, "schedule seed=%d failed: %s: %s\n"
+            "replay exactly this interleaving with MXNET_SCHED_SEED=%d"
+            % (seed, type(cause).__name__, cause, seed))
+
+
+class SchedDeadlock(MXNetError):
+    """Every controlled thread is blocked with no virtual timeout
+    pending."""
+
+
+class SchedStuck(MXNetError):
+    """The real-time watchdog fired: some controlled thread blocked
+    OUTSIDE the instrumented seams (native lock/socket/condition), so
+    the cooperative floor cannot advance."""
+
+
+_ACTIVE = None                 # the live scheduler (module-global so
+                               # racecheck's patches find it)
+
+
+def active():
+    return _ACTIVE is not None
+
+
+def _cur():
+    """Current Thread WITHOUT fabricating a ``_DummyThread``: a
+    bootstrapping thread fires ``_started.set()`` before it is in
+    ``threading._active``, and ``current_thread()`` would recurse
+    through ``_DummyThread.__init__`` -> ``Event.set`` -> here.
+    ``None`` means "not a thread the scheduler can own"."""
+    return threading._active.get(threading.get_ident())
+
+
+class _Task:
+    __slots__ = ("thread", "name", "index", "prio", "gate", "pred",
+                 "deadline", "timed_out", "alive", "tag")
+
+    def __init__(self, thread, index, prio):
+        self.thread = thread
+        self.name = thread.name
+        self.index = index
+        self.prio = prio
+        self.gate = threading.Semaphore(0)
+        self.pred = None        # None = runnable
+        self.deadline = None    # virtual-time wait bound
+        self.timed_out = False
+        self.alive = True
+        self.tag = ""
+
+
+class _Coop:
+    """Strict cooperative scheduler: one floor token, seeded PCT
+    priorities, virtual time."""
+
+    strict = True
+
+    def __init__(self, seed, change_prob=0.15, record=False):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._change_prob = change_prob
+        self._lk = threading.Lock()   # raw: never a yield point
+        self._tasks = {}              # Thread -> _Task
+        self._index = 0
+        self._vnow = 0.0
+        self._step = 0
+        self._poison = None
+        self.trace = [] if record else None
+
+    # -- registration ---------------------------------------------------
+    def controls_current(self):
+        return _cur() in self._tasks
+
+    def register_main(self):
+        with self._lk:
+            t = _Task(threading.current_thread(), self._index,
+                      self._rng.random())
+            self._index += 1
+            self._tasks[t.thread] = t
+
+    def on_spawn(self, thread):
+        """Parent-side registration of a child thread (priority drawn
+        HERE, in deterministic step order).  Returns True when the
+        child is controlled."""
+        me = _cur()
+        with self._lk:
+            if me not in self._tasks or self._poison is not None:
+                return False
+            t = _Task(thread, self._index, self._rng.random())
+            self._index += 1
+            self._tasks[thread] = t
+        return True
+
+    def attach_current(self):
+        """First statement of a controlled child: wait to be
+        scheduled."""
+        t = self._tasks.get(_cur())
+        if t is None:
+            return
+        t.gate.acquire()
+        self._raise_poison(t)
+
+    def task_done(self, thread):
+        """Has ``thread``'s task exited the cooperative world?  Unlike
+        ``Thread.is_alive()`` this flips SYNCHRONOUSLY inside
+        ``on_exit_current`` — a joiner's wake predicate must use this,
+        because nobody re-evaluates predicates after the last thread's
+        real death."""
+        t = self._tasks.get(thread)
+        return t is None or not t.alive
+
+    def on_exit_current(self):
+        t = self._tasks.get(_cur())
+        if t is None:
+            return
+        with self._lk:
+            t.alive = False
+            self._handoff_locked(t)
+
+    # -- core -----------------------------------------------------------
+    def _raise_poison(self, t):
+        if self._poison is not None:
+            raise self._poison
+
+    def _note(self, t, tag):
+        self._step += 1
+        if self.trace is not None:
+            # task INDEX, not thread name: auto-generated names carry a
+            # process-global counter and would differ across replays
+            self.trace.append((self._step, t.index, tag))
+
+    def _runnable_locked(self):
+        out = []
+        for t in self._tasks.values():
+            if not t.alive:
+                continue
+            if t.pred is not None:
+                try:
+                    ok = t.pred()
+                except BaseException:
+                    ok = True      # wake it; it re-raises in place
+                if not ok:
+                    continue
+                t.pred = None
+                t.deadline = None
+            out.append(t)
+        return out
+
+    def _choose_locked(self, cands):
+        return max(cands, key=lambda x: (x.prio, -x.index))
+
+    def _advance_time_locked(self, cur):
+        """No task is runnable: jump virtual time to the earliest
+        deadline, or poison with a deadlock report."""
+        timed = [t for t in self._tasks.values()
+                 if t.alive and t.pred is not None
+                 and t.deadline is not None]
+        if not timed:
+            waiting = ", ".join(
+                "%s waits on %s" % (t.name, t.tag or "?")
+                for t in self._tasks.values()
+                if t.alive and t.pred is not None)
+            self._poison_locked(SchedDeadlock(
+                "schedule seed=%d deadlocked: every controlled thread "
+                "is blocked with no timeout pending (%s)"
+                % (self.seed, waiting or "none waiting?")))
+            raise self._poison
+        self._vnow = min(t.deadline for t in timed)
+        for t in timed:
+            if t.deadline <= self._vnow:
+                t.timed_out = True
+                t.pred = None
+                t.deadline = None
+
+    def _poison_locked(self, exc):
+        if self._poison is None:
+            self._poison = exc
+        for t in self._tasks.values():
+            t.gate.release()
+
+    def _handoff_locked(self, cur):
+        """Pass the floor from ``cur`` (yielding, blocking or dying)
+        to the chosen next task.  Returns the chosen task."""
+        while True:
+            cands = self._runnable_locked()
+            if cands:
+                nxt = self._choose_locked(cands)
+                if nxt is not cur:
+                    nxt.gate.release()
+                return nxt
+            if not any(t.alive for t in self._tasks.values()):
+                return None
+            self._advance_time_locked(cur)
+
+    def yield_point(self, tag=""):
+        t = self._tasks.get(_cur())
+        if t is None:
+            return
+        self._raise_poison(t)
+        with self._lk:
+            self._note(t, tag)
+            if self._rng.random() < self._change_prob:
+                # PCT priority-change point: demote below everyone
+                t.prio = self._rng.random() - 1.0
+            nxt = self._handoff_locked(t)
+            if nxt is t:
+                return
+        t.gate.acquire()
+        self._raise_poison(t)
+
+    def block_until(self, pred, timeout=None, tag=""):
+        """Cooperatively block until ``pred()`` (evaluated under the
+        scheduler) holds; ``timeout`` is VIRTUAL seconds.  Returns
+        False on timeout.  Uncontrolled threads fall back to a real
+        polling wait."""
+        t = self._tasks.get(_cur())
+        if t is None:
+            deadline = (time.monotonic() + timeout) \
+                if timeout is not None else None
+            orig_sleep = _orig_sleep()
+            while not pred():
+                if deadline is not None and time.monotonic() > deadline:
+                    return False
+                orig_sleep(0.001)
+            return True
+        while True:
+            self._raise_poison(t)
+            with self._lk:
+                self._note(t, tag)
+                if pred():
+                    return True
+                t.pred = pred
+                t.tag = tag
+                t.deadline = (self._vnow + timeout) \
+                    if timeout is not None else None
+                nxt = self._handoff_locked(t)
+                if nxt is t:
+                    # chosen immediately (pred flipped or timeout)
+                    if t.timed_out:
+                        t.timed_out = False
+                        return False
+                    continue
+            t.gate.acquire()
+            self._raise_poison(t)
+            if t.timed_out:
+                t.timed_out = False
+                return False
+
+    # -- lifecycle ------------------------------------------------------
+    def drain(self, real_timeout=20.0):
+        """Cooperatively wait for every other controlled task to exit
+        (bodies must close/join what they start; this catches the
+        stragglers between the last join and thread death)."""
+        me = _cur()
+        others = [t for th, t in self._tasks.items() if th is not me]
+
+        def all_done():
+            return all(not t.alive for t in others)
+
+        self.block_until(all_done, timeout=real_timeout,
+                         tag="drain")
+        if not all_done():
+            raise SchedStuck(
+                "schedule seed=%d: controlled thread(s) still alive "
+                "after the body returned: %s"
+                % (self.seed, ", ".join(t.name for t in others
+                                        if t.alive)))
+        for t in others:        # real wind-down: microseconds
+            t.thread.join(5.0)
+
+    def shutdown(self):
+        with self._lk:
+            if self._poison is None:
+                self._poison = SchedStuck(
+                    "schedule seed=%d is shut down" % self.seed)
+            for t in self._tasks.values():
+                t.gate.release()
+            self._tasks.clear()
+
+
+class _Jitter:
+    """Seeded perturbation for tests the cooperative scheduler cannot
+    fully own: every thread gets its own deterministic delay stream
+    keyed by (seed, thread name) — independent of interleaving — and
+    yield points become occasional tiny sleeps."""
+
+    strict = False
+
+    def __init__(self, seed, prob=0.25, max_ms=2.0):
+        self.seed = seed
+        self._prob = prob
+        self._max_s = max_ms / 1000.0
+        self._local = threading.local()
+        self.trace = None
+
+    def controls_current(self):
+        return True
+
+    def _rng(self):
+        r = getattr(self._local, "rng", None)
+        if r is None:
+            t = _cur()
+            if t is None:
+                return None        # bootstrapping thread: no stream yet
+            key = zlib.crc32(t.name.encode("utf-8", "replace"))
+            r = self._local.rng = random.Random(self.seed ^ key)
+        return r
+
+    def yield_point(self, tag=""):
+        r = self._rng()
+        if r is not None and r.random() < self._prob:
+            _orig_sleep()(r.random() * self._max_s)
+
+    def block_until(self, pred, timeout=None, tag=""):
+        # jitter never virtualizes waits; callers fall through to the
+        # original blocking op
+        raise AssertionError("block_until is strict-mode only")
+
+    def on_spawn(self, thread):
+        return False
+
+    def attach_current(self):
+        pass
+
+    def on_exit_current(self):
+        pass
+
+    def register_main(self):
+        pass
+
+    def drain(self, real_timeout=0.0):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+def _orig_sleep():
+    from . import racecheck
+    return racecheck._orig.get("sleep", time.sleep)
+
+
+def run_schedule(body, seed, strict=True, record=False,
+                 watchdog=60.0, change_prob=0.15):
+    """Run ``body()`` under ONE seeded schedule.  Returns the recorded
+    trace (``record=True``, strict mode) or None.  A body failure is
+    re-raised as :class:`ScheduleFailure` carrying the seed."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise MXNetError("a schedule is already active (explore() "
+                         "does not nest)")
+    from . import racecheck
+    sched = _Coop(seed, change_prob=change_prob, record=record) \
+        if strict else _Jitter(seed)
+    racecheck.ensure_patched()
+    _ACTIVE = sched
+    dog = None
+    try:
+        if strict and watchdog:
+            def _bite():
+                with sched._lk:
+                    sched._poison_locked(SchedStuck(
+                        "schedule seed=%d: watchdog fired after %.0fs "
+                        "of no progress — a controlled thread is "
+                        "blocked outside the instrumented seams"
+                        % (seed, watchdog)))
+            dog = threading.Timer(watchdog, _bite)
+            dog.daemon = True
+            dog.start()
+        sched.register_main()
+        try:
+            body()
+            sched.drain()
+        except BaseException as e:
+            raise ScheduleFailure(seed, e) from e
+        return sched.trace
+    finally:
+        # deactivate BEFORE touching the watchdog: dog.cancel() fires
+        # Event.set, and a live poisoned scheduler would re-raise from
+        # this finally, masking the ScheduleFailure in flight
+        _ACTIVE = None
+        if dog is not None:
+            dog.cancel()
+        sched.shutdown()
+        racecheck.release_patched()
+
+
+def explore(body, n=None, seed=None, strict=True, record=False,
+            watchdog=60.0, base_seed=0):
+    """Replay ``body`` under seeded schedules.
+
+    ``seed`` pins ONE schedule; else ``MXNET_SCHED_SEED`` (>= 0) pins
+    one; else ``n`` (default ``MXNET_SCHED_EXPLORE``, min 1) schedules
+    run with seeds ``base_seed .. base_seed+n-1``.  The first failing
+    schedule raises :class:`ScheduleFailure` naming its seed; that
+    seed replays the interleaving bit-identically (strict mode).
+    Returns the list of per-schedule traces (``record=True``)."""
+    if seed is not None:
+        seeds = [int(seed)]
+    else:
+        pinned = int(get_env("MXNET_SCHED_SEED"))
+        if pinned >= 0:
+            seeds = [pinned]
+        else:
+            if n is None:
+                n = int(get_env("MXNET_SCHED_EXPLORE"))
+            seeds = [base_seed + i for i in range(max(1, int(n)))]
+    return [run_schedule(body, s, strict=strict, record=record,
+                         watchdog=watchdog) for s in seeds]

@@ -276,6 +276,28 @@ register_env("MXNET_LOCK_CHECK", bool, False,
              "cycle (potential deadlock) or on guarded shared state "
              "mutated without its lock held.  Debug/CI aid; off by "
              "default.")
+register_env("MXNET_RACE_CHECK", bool, False,
+             "Happens-before data-race detection (analysis/"
+             "racecheck.py): per-thread vector clocks over the queue/"
+             "event/future/thread/make_lock seams plus shared_state() "
+             "tracked fields; an access unordered against an earlier "
+             "conflicting access raises DataRaceError naming both "
+             "threads, stacks and the field.  Debug/CI aid (make "
+             "racecheck); off by default — hot paths pay zero cost "
+             "when unset.")
+register_env("MXNET_SCHED_SEED", int, -1,
+             "Pin the deterministic schedule explorer (analysis/"
+             "schedules.py) to ONE seeded interleaving: a test body "
+             "under schedules.explore() replays exactly the schedule "
+             "this seed generated (a failing schedule prints it).  "
+             "Negative (default) = not pinned.")
+register_env("MXNET_SCHED_EXPLORE", int, 0,
+             "Number of distinct seeded PCT-style schedules "
+             "schedules.explore() replays a test body under (priority "
+             "preemption at every queue/event/future/lock/"
+             "shared_state yield point).  0/1 = a single schedule; "
+             "CI arms it on the interleaving-sensitive protocol "
+             "tests.")
 register_env("MXNET_SERVE_BUCKETS", str, "1,2,4,8,16,32",
              "Comma-separated batch-size bucket edges of the serving "
              "program store (serving/program_store.py): a request of n "

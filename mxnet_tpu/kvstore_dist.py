@@ -82,7 +82,7 @@ import numpy as np
 from . import faultinject
 from . import kvstore_codec as codec
 from . import metrics as _metrics
-from .analysis import lockcheck
+from .analysis import lockcheck, racecheck
 from .base import MXNetError, atomic_write, get_env
 
 _AUTHKEY = b"mxnet_tpu_ps"
@@ -583,7 +583,9 @@ class Server:
         self._moved = {}         # wire key -> plan version it left under
         self._migrating = set()  # keys frozen by an in-flight transfer
         self.stop_event = threading.Event()
-        self.rank = None
+        # rank lives in a shared_state container so MXNET_RACE_CHECK=1
+        # sees every access (off: a plain SimpleNamespace, zero cost)
+        self._reg = racecheck.shared_state("kvstore.server", rank=None)
         # set once the scheduler has assigned this server's rank.  Rank
         # follows registration ARRIVAL order, so a launcher spinning
         # several servers back-to-back must wait_registered() between
@@ -605,6 +607,15 @@ class Server:
         # order is always self.lock -> _disk_lock, never the reverse
         self._disk_lock = threading.Lock()
         self._disk_gen = 0
+
+    @property
+    def rank(self):
+        """Scheduler-assigned rank; ``None`` until registration
+        completes.  The only happens-before edge publishing it is the
+        ``registered`` event (``wait_registered``) — under
+        ``MXNET_RACE_CHECK=1`` a cross-thread read that skipped that
+        edge raises ``DataRaceError`` (the PR-16 bring-up race)."""
+        return self._reg.rank
 
     # -- snapshots ----------------------------------------------------------
     def _snap_path(self):
@@ -1060,7 +1071,7 @@ class Server:
         recover = int(recover) if recover is not None else None
         sched = _connect(_root_addr())
         sched.send(("register_server", self.listener.address, recover))
-        _, self.rank = sched.recv()
+        _, self._reg.rank = sched.recv()
         self.registered.set()
         # restore BEFORE serving: in-flight pulls that retry against the
         # rejoined server must see the recovered state, not an empty

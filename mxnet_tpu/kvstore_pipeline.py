@@ -42,7 +42,7 @@ import threading
 import time
 
 from . import metrics as _metrics
-from .analysis import lockcheck
+from .analysis import lockcheck, racecheck
 from .base import MXNetError, get_env, hot_path
 
 __all__ = ["CommOp", "CommPipeline"]
@@ -112,7 +112,11 @@ class CommPipeline:
         self._outstanding = 0
         self._errors = []
         self._counter = itertools.count()
-        self._stopped = False
+        # lifecycle flag in a racecheck container (plain SimpleNamespace
+        # with the detector off): every access is under _cv's lock, and
+        # MXNET_RACE_CHECK=1 flags any future path that skips it
+        self._life = racecheck.shared_state("kvstore.pipeline",
+                                            stopped=False)
         self._epoch_t0 = None       # first submit since last flush
         self._epoch_ops = 0
         self._threads = []
@@ -128,7 +132,7 @@ class CommPipeline:
         """Enqueue; returns the op (its ``done`` event is the
         completion handle)."""
         with self._cv:
-            if self._stopped:
+            if self._life.stopped:
                 raise MXNetError("kvstore pipeline is closed")
             op._order = next(self._counter)
             if self._epoch_t0 is None:
@@ -167,7 +171,7 @@ class CommPipeline:
 
     def close(self):
         with self._cv:
-            self._stopped = True
+            self._life.stopped = True
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=5)
@@ -176,9 +180,9 @@ class CommPipeline:
     def _worker(self):
         while True:
             with self._cv:
-                while not self._heap and not self._stopped:
+                while not self._heap and not self._life.stopped:
                     self._cv.wait()
-                if self._stopped and not self._heap:
+                if self._life.stopped and not self._heap:
                     return
                 _, _, op = heapq.heappop(self._heap)
                 batch = [op]

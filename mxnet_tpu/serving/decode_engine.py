@@ -83,6 +83,7 @@ import numpy as np
 from .. import metrics as _metrics
 from .. import profiler as _profiler
 from .. import tracing as _tracing
+from ..analysis import racecheck
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, _uid, get_env, hot_path
 from .scheduler import (FutureCompleter, ServeClosed, ServeOverloaded,
@@ -281,45 +282,74 @@ class _BlockPool:
         self.num_blocks = int(num_blocks)
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._ref = {}
-        self.hwm = 0
+        self._hwm = 0
+        # the engine thread mutates the allocator (admission /
+        # retirement); stats() -> describe() reads it from client
+        # threads.  The lock makes those reads coherent; the coarse
+        # shared_state revision marker lets MXNET_RACE_CHECK=1 catch
+        # any future unlocked path through the pool
+        self._lock = make_lock("serving.gen.block_pool")
+        self._rc = racecheck.shared_state("serving.gen.block_pool",
+                                          rev=0)
 
     def capacity(self):
         return self.num_blocks - 1
 
+    @property
+    def hwm(self):
+        with self._lock:
+            _ = self._rc.rev
+            return self._hwm
+
     def used(self):
-        return self.capacity() - len(self._free)
+        with self._lock:
+            _ = self._rc.rev
+            return self.capacity() - len(self._free)
 
     def free_count(self):
-        return len(self._free)
+        with self._lock:
+            _ = self._rc.rev
+            return len(self._free)
 
     def refcount(self, b):
-        return self._ref.get(b, 0)
+        with self._lock:
+            _ = self._rc.rev
+            return self._ref.get(b, 0)
 
     def alloc(self):
         """One fresh block at refcount 1, or None when exhausted."""
-        if not self._free:
-            return None
-        b = self._free.pop()
-        self._ref[b] = 1
-        if self.used() > self.hwm:
-            self.hwm = self.used()
-        return b
+        with self._lock:
+            self._rc.rev += 1
+            if not self._free:
+                return None
+            b = self._free.pop()
+            self._ref[b] = 1
+            used = self.capacity() - len(self._free)
+            if used > self._hwm:
+                self._hwm = used
+            return b
 
     def ref(self, b):
-        self._ref[b] += 1
+        with self._lock:
+            self._rc.rev += 1
+            self._ref[b] += 1
 
     def deref(self, b):
-        r = self._ref[b] - 1
-        if r <= 0:
-            del self._ref[b]
-            self._free.append(b)
-        else:
-            self._ref[b] = r
-        return r
+        with self._lock:
+            self._rc.rev += 1
+            r = self._ref[b] - 1
+            if r <= 0:
+                del self._ref[b]
+                self._free.append(b)
+            else:
+                self._ref[b] = r
+            return r
 
     def shared(self):
         """Blocks currently referenced more than once."""
-        return sum(1 for r in self._ref.values() if r > 1)
+        with self._lock:
+            _ = self._rc.rev
+            return sum(1 for r in self._ref.values() if r > 1)
 
 
 class _PrefixStore:
@@ -557,11 +587,17 @@ class GenerationEngine:
         # per-tenant admission quotas: tenant id -> max inflight TOKENS
         # (prompt + max_tokens over the tenant's unresolved requests)
         self._tenant_quotas = dict(tenant_quotas or {})
-        self._tenant_tokens = {}
+        # tenant ledger + lifecycle flags live in racecheck containers
+        # (plain dict / SimpleNamespace with the detector off): under
+        # MXNET_RACE_CHECK=1 any access that skipped the _submit_lock
+        # edge raises DataRaceError instead of silently going stale
+        self._tenant_tokens = racecheck.shared_map(
+            "serving.gen.tenant_tokens")
         self._queue = queue.Queue()
         self._waiting = {}     # model -> deque[_GenRequest]
         self._states = {}      # model -> _ModelState
-        self._closed = False
+        self._life = racecheck.shared_state(
+            "serving.gen.lifecycle", closed=False, drain_on_stop=True)
         self._seq = 0
         self._submit_lock = make_lock("serving.gen_submit")
         self._stats_lock = make_lock("serving.gen_stats")
@@ -615,6 +651,24 @@ class GenerationEngine:
     def _closed_exc(self, msg):
         return ServeClosed(msg, replica_index=self._owner_index)
 
+    # lifecycle flags route through the shared_state container so the
+    # race detector sees every access; call sites keep the field names
+    @property
+    def _closed(self):
+        return self._life.closed
+
+    @_closed.setter
+    def _closed(self, v):
+        self._life.closed = v
+
+    @property
+    def _drain_on_stop(self):
+        return self._life.drain_on_stop
+
+    @_drain_on_stop.setter
+    def _drain_on_stop(self, v):
+        self._life.drain_on_stop = v
+
     # -- client side ---------------------------------------------------
     def submit(self, model, tokens, max_tokens=16, temperature=0.0,
                top_k=0, seed=0, eos_id=None, stream=None, timeout=None,
@@ -639,10 +693,12 @@ class GenerationEngine:
         (constructor ``tenant_quotas``: prompt+max_tokens over the
         tenant's unresolved requests) — a tenant over budget is shed
         alone with :class:`ServeOverloaded`."""
-        if self._closed:
-            # cheap early gate: every post-close submit raises
-            # ServeClosed, never a validation error about its payload
-            raise self._closed_exc("generation engine is closed")
+        with self._submit_lock:
+            # early gate (under the lock that orders it against
+            # close()): every post-close submit raises ServeClosed,
+            # never a validation error about its payload
+            if self._closed:
+                raise self._closed_exc("generation engine is closed")
         priority = "batch" if priority is None else str(priority)
         if priority not in TIERS:
             raise MXNetError("unknown priority tier %r (want one of %s)"
@@ -758,7 +814,9 @@ class GenerationEngine:
 
     def alive(self):
         """Liveness witness (the front door's /healthz reads it)."""
-        return not self._closed and self._thread.is_alive()
+        with self._submit_lock:
+            closed = self._closed
+        return not closed and self._thread.is_alive()
 
     def stats(self):
         out = self._stats.as_dict()
@@ -816,7 +874,7 @@ class GenerationEngine:
             stopping = False
             while True:
                 stopping = self._pump(stopping) or stopping
-                if stopping and not getattr(self, "_drain_on_stop", True):
+                if stopping and not self._drain_on_stop:
                     self._fail_all()
                     return
                 self._admit_ready()

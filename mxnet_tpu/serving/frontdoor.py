@@ -56,7 +56,7 @@ import json
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -710,8 +710,11 @@ class HttpClient:
             except queue.Empty:
                 return
             if item is not None:
-                item[-1].set_exception(
-                    ServeClosed("HttpClient is closed"))
+                try:
+                    item[-1].set_exception(
+                        ServeClosed("HttpClient is closed"))
+                except InvalidStateError:
+                    pass    # caller cancelled while we drained
 
     def __enter__(self):
         return self

@@ -1545,6 +1545,71 @@ def observability_protocol(smoke=False, seed=29, offered_mult=2.0):
     }
 
 
+def racecheck_overhead_protocol(smoke=False, seed=43):
+    """Race-detector overhead protocol (the ``serving.observability.
+    racecheck_overhead`` bench row): closed-loop capacity of the SAME
+    forward engine with the happens-before detector OFF (the shipping
+    default) vs ARMED at runtime (``racecheck.install()`` before the
+    engine is built, so its seam locks wrap and its shared_state
+    containers track).
+
+    The OFF side is the zero-cost claim: with the detector off,
+    ``shared_state`` returns a plain SimpleNamespace, ``shared_map`` a
+    plain dict, ``make_lock`` an unwrapped ``threading.Lock``, and the
+    stdlib stays unpatched — ``tests/test_racecheck.py``'s spy test
+    pins each of those types, so the hot path cannot silently grow a
+    tracking layer.  The armed ratio is the price CI pays for the
+    ``make racecheck`` stage, banked so it is measured, not guessed."""
+    from ..analysis import racecheck
+    from .registry import ModelRegistry
+    from .scheduler import ServingEngine
+
+    sym, args = _smoke_model(512, 2048, seed)
+    feat = 512
+    rs = np.random.RandomState(seed + 1)
+    pool = [np.asarray(rs.uniform(-1, 1, (1, feat)), np.float32)
+            for _ in range(16)]
+    n_closed = 30 if smoke else 80
+
+    def run_side():
+        registry = ModelRegistry()
+        registry.add_model("m", sym,
+                           {k: v.copy() for k, v in args.items()},
+                           {}, input_shapes={"data": (1, feat)},
+                           warmup=True)
+        engine = ServingEngine(registry, max_delay_ms=2.0)
+        try:
+            for _ in range(3):
+                for f in [engine.submit("m", data=pool[i % len(pool)])
+                          for i in range(8)]:
+                    f.result(60)
+            return max(_engine_capacity(
+                lambda i: engine.submit(
+                    "m", data=pool[i % len(pool)]).result(60),
+                n_closed) for _ in range(2))
+        finally:
+            engine.close()
+
+    was_armed = racecheck.armed()
+    off_qps = run_side() if not was_armed else None
+    racecheck.install()
+    try:
+        armed_qps = run_side()
+    finally:
+        if not was_armed:
+            racecheck.uninstall()
+    if off_qps is None:          # bench launched under MXNET_RACE_CHECK=1
+        off_qps = armed_qps
+    return {
+        "seed": seed,
+        "n_closed": n_closed,
+        "off_closed_qps": round(off_qps, 2),
+        "armed_closed_qps": round(armed_qps, 2),
+        "qps_armed_vs_off": round(armed_qps / off_qps, 4)
+        if off_qps else None,
+    }
+
+
 def swap_protocol(smoke=False, seed=23):
     """Hot-swap-under-traffic bit-consistency: one engine under
     concurrent submit threads while ``swap_params`` republishes a

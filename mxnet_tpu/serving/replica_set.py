@@ -65,6 +65,7 @@ from .. import faultinject
 from .. import metrics as _metrics
 from .. import profiler as _profiler
 from .. import tracing as _tracing
+from ..analysis import racecheck
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, _uid, get_env
 from ..retry import CircuitBreaker, backoff_delay
@@ -130,10 +131,37 @@ class Replica:
                 fail_threshold=int(get_env("MXNET_SERVE_CB_FAILS")),
                 reset_after=float(get_env("MXNET_SERVE_CB_RESET")))
         self.breaker = breaker
-        self.alive = True
-        self.draining = False       # rolling swap: parked, not dead
         self.inflight = 0           # balancer-tracked, set-lock guarded
-        self._life_lock = make_lock("serving.replica")
+        # liveness flags live in a racecheck.shared_state container,
+        # read/written only through the lock-guarded properties below:
+        # kill()/close() (any thread), the prober, the balancer's
+        # comprehensions and the rolling swap all order through
+        # _life_lock, and MXNET_RACE_CHECK=1 flags any future path
+        # that skips it.  RLock: kill/close read-modify under it while
+        # the properties re-acquire
+        self._rc = racecheck.shared_state(
+            "serving.replica%d" % self.index, alive=True, draining=False)
+        self._life_lock = make_lock("serving.replica", rlock=True)
+
+    @property
+    def alive(self):
+        with self._life_lock:
+            return self._rc.alive
+
+    @alive.setter
+    def alive(self, v):
+        with self._life_lock:
+            self._rc.alive = bool(v)
+
+    @property
+    def draining(self):
+        with self._life_lock:
+            return self._rc.draining
+
+    @draining.setter
+    def draining(self, v):
+        with self._life_lock:
+            self._rc.draining = bool(v)
 
     def kill(self):
         """Simulated SIGKILL: the replica stops abruptly.  Queued and

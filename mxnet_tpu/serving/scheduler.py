@@ -43,6 +43,7 @@ import numpy as np
 from .. import metrics as _metrics
 from .. import profiler as _profiler
 from .. import tracing as _tracing
+from ..analysis import racecheck
 from ..analysis.lockcheck import make_lock
 from ..base import MXNetError, _uid, get_env, hot_path
 
@@ -206,7 +207,11 @@ class ServingEngine:
         # budget is shed alone — the noisy tenant backs off, everyone
         # else keeps being served
         self._tenant_quotas = dict(tenant_quotas or {})
-        self._tenant_rows = {}
+        # tenant ledger + lifecycle flags live in racecheck containers
+        # (plain dict / SimpleNamespace with the detector off): under
+        # MXNET_RACE_CHECK=1 any access that skipped the _submit_lock
+        # edge raises DataRaceError instead of silently going stale
+        self._tenant_rows = racecheck.shared_map("serving.tenant_rows")
         if max_delay_ms is None:
             max_delay_ms = float(get_env("MXNET_SERVE_MAX_DELAY_MS"))
         self._max_delay = max(0.0, float(max_delay_ms)) / 1e3
@@ -219,7 +224,8 @@ class ServingEngine:
         self._inflight = 0
         self._queue = queue.Queue()
         self._pending = collections.deque()
-        self._closed = False
+        self._life = racecheck.shared_state(
+            "serving.fwd.lifecycle", closed=False, drain_on_stop=True)
         self._inflight_reqs = ()
         self._submit_lock = make_lock("serving.submit")
         self._stats_lock = make_lock("serving.stats")
@@ -245,6 +251,24 @@ class ServingEngine:
 
     def _closed_exc(self, msg):
         return ServeClosed(msg, replica_index=self._owner_index)
+
+    # lifecycle flags route through the shared_state container so the
+    # race detector sees every access; call sites keep the field names
+    @property
+    def _closed(self):
+        return self._life.closed
+
+    @_closed.setter
+    def _closed(self, v):
+        self._life.closed = v
+
+    @property
+    def _drain_on_stop(self):
+        return self._life.drain_on_stop
+
+    @_drain_on_stop.setter
+    def _drain_on_stop(self, v):
+        self._life.drain_on_stop = v
 
     # -- client side ---------------------------------------------------
     def submit(self, model, timeout=None, priority=None, tenant=None,
@@ -272,10 +296,12 @@ class ServingEngine:
         configured (constructor ``tenant_quotas``), a tenant over its
         inflight-row budget is shed alone with
         :class:`ServeOverloaded`."""
-        if self._closed:
-            # cheap early gate so EVERY post-close submit raises
-            # ServeClosed — not a validation error about its payload
-            raise self._closed_exc("serving engine is closed")
+        with self._submit_lock:
+            # early gate (under the lock that orders it against
+            # close()) so EVERY post-close submit raises ServeClosed —
+            # not a validation error about its payload
+            if self._closed:
+                raise self._closed_exc("serving engine is closed")
         priority = "batch" if priority is None else str(priority)
         if priority not in TIERS:
             raise MXNetError("unknown priority tier %r (want one of %s)"
@@ -371,7 +397,9 @@ class ServingEngine:
     def alive(self):
         """Liveness witness (the front door's /healthz reads it): the
         dispatch loop is running and accepting submits."""
-        return not self._closed and self._thread.is_alive()
+        with self._submit_lock:
+            closed = self._closed
+        return not closed and self._thread.is_alive()
 
     def stats(self):
         """Scheduler counters plus each model's program-store stats,
@@ -494,7 +522,7 @@ class ServingEngine:
         # crashing cycle cannot silently drop ANY accepted request
         # (the exit sweep resolves them with ServeClosed)
         self._inflight_reqs = (head,)
-        if self._closed and not getattr(self, "_drain_on_stop", True):
+        if self._failfast():
             # close(drain=False): queued work ahead of the STOP
             # sentinel fails fast instead of being served out
             self._resolve(head.future, exc=self._closed_exc(
@@ -505,7 +533,7 @@ class ServingEngine:
         reqs, rows, stop = self._collect(head)
         self._inflight_reqs = tuple(reqs)
         _profiler.record_phase("serve_batch", t1)
-        if self._closed and not getattr(self, "_drain_on_stop", True):
+        if self._failfast():
             # close(drain=False) landed while the batch was forming:
             # fail-fast semantics apply to the whole collected batch,
             # not just heads taken after the flag flipped
@@ -695,10 +723,18 @@ class ServingEngine:
             if rows > self._max_rows:
                 self._max_rows = rows
 
+    def _failfast(self):
+        """close(drain=False) landed?  Read under the lock that orders
+        the flags against close() — the engine polls this every cycle,
+        long before any _STOP sentinel provides a queue edge."""
+        with self._submit_lock:
+            return self._closed and not self._drain_on_stop
+
     def _shutdown(self):
         """Drain everything already submitted (or fail it when
         ``close(drain=False)``), then let the loop exit."""
-        drain = getattr(self, "_drain_on_stop", True)
+        with self._submit_lock:
+            drain = self._drain_on_stop
         while True:
             if self._pending:
                 head = self._pending.popleft()

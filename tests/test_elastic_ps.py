@@ -512,7 +512,25 @@ def test_bucket_migration_under_traffic_exactly_once(monkeypatch):
     """Migrate the bucket between two servers while a pusher hammers
     it, with a lost push reply scheduled so a dedup-protected resend
     CROSSES the migration: zero lost, zero duplicated pushes — the
-    final values equal the static run's exactly."""
+    final values equal the static run's exactly.
+
+    MXNET_SCHED_EXPLORE=N re-runs the body under N seeded jitter
+    schedules (analysis/schedules.py, strict=False: the socket planes
+    here can't be cooperatively owned) — each seed perturbs thread
+    timing reproducibly-in-distribution, widening the interleavings
+    this one CI run exercises."""
+    from mxnet_tpu.analysis import schedules
+    from mxnet_tpu.base import get_env
+    n_expl = int(get_env("MXNET_SCHED_EXPLORE"))
+    if n_expl > 0:
+        schedules.explore(
+            lambda: _bucket_migration_body(monkeypatch), n=n_expl,
+            strict=False, watchdog=120.0)
+    else:
+        _bucket_migration_body(monkeypatch)
+
+
+def _bucket_migration_body(monkeypatch):
     n = 30
     cl = _Cluster(monkeypatch, n_workers=1, n_servers=2)
     for srv in cl.servers:

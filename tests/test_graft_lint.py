@@ -482,6 +482,143 @@ def test_span_manifest_rot_flagged():
 
 
 # ---------------------------------------------------------------------------
+# rule: unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+def test_shared_mutation_run_loop_flagged_lock_and_container_ok():
+    vs = run_lint("""
+        class W:
+            def _worker(self):
+                self.state = "hot"              # bare: flagged
+                self.counts["x"] = 1            # bare subscript: flagged
+                with self._lock:
+                    self.guarded = 1            # under the seam lock: ok
+                self._st.field = 2              # through shared_state: ok
+            def helper(self):
+                self.state = "cold"             # not a run-loop: ok
+    """)
+    assert rules(vs) == ["unguarded-shared-mutation"] * 2
+    assert "self.state" in vs[0].msg and "self.counts" in vs[1].msg
+
+
+def test_shared_mutation_nested_def_and_augassign():
+    vs = run_lint("""
+        class W:
+            def drain_loop(self):
+                self.n += 1                     # AugAssign: flagged
+                def cb():
+                    self.inner = 1              # other call stack: ok
+                cb()
+    """)
+    assert rules(vs) == ["unguarded-shared-mutation"]
+    assert "self.n" in vs[0].msg
+
+
+def test_shared_mutation_suppression():
+    vs = run_lint("""
+        class W:
+            def run(self):
+                # single-threaded bring-up, published by start() below
+                self.x = 1  # graft-lint: disable=unguarded-shared-mutation — set before any reader thread exists
+    """)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# rule: atomic-publish
+# ---------------------------------------------------------------------------
+_PUB = (("pkg/fixture.py", "_live", ("Store.swap",)),)
+
+
+def test_atomic_publish_allowed_publishers_ok():
+    vs = run_lint("""
+        class Store:
+            def __init__(self):
+                self._live = (None, 0)
+            def swap(self, params, ver):
+                with self._lock:
+                    self._live = (params, ver)
+            def snapshot(self):
+                return self._live
+    """, atomic_publish=_PUB)
+    assert vs == []
+
+
+def test_atomic_publish_foreign_assign_and_tear_flagged():
+    vs = run_lint("""
+        class Store:
+            def __init__(self):
+                self._live = (None, 0)
+            def refresh(self, p, v):
+                self._live = (p, v)             # not an allowed publisher
+            def bump(self):
+                self._live, x = (1, 2), 3       # tuple-target tear
+                self._live[0] = None            # subscript tear
+                self._live.append(4)            # in-place mutation
+    """, atomic_publish=_PUB)
+    assert rules(vs) == ["atomic-publish"] * 4
+
+
+def test_atomic_publish_manifest_rot_flagged():
+    vs = run_lint("""
+        class Store:
+            pass
+    """, atomic_publish=_PUB)
+    assert rules(vs) == ["atomic-publish"]
+    assert "manifest" in vs[0].msg
+
+
+# ---------------------------------------------------------------------------
+# rule: future-discipline
+# ---------------------------------------------------------------------------
+def test_future_unguarded_flagged_guard_variants_ok():
+    vs = run_lint("""
+        from concurrent.futures import Future, InvalidStateError
+        def bad(fut, exc):
+            fut.set_exception(exc)              # no guard: flagged
+        def guarded(fut, val):
+            try:
+                fut.set_result(val)             # try/except ISE: ok
+            except InvalidStateError:
+                pass
+        def running(fut, val):
+            if not fut.set_running_or_notify_cancel():
+                return
+            fut.set_result(val)                 # RUNNING: cancel lost
+        def fresh(exc):
+            f = Future()
+            f.set_exception(exc)                # local, unescaped: ok
+            return f
+    """)
+    assert rules(vs) == ["future-discipline"]
+    assert vs[0].line == 4
+
+
+def test_future_resolve_under_lock_flagged():
+    vs = run_lint("""
+        def publish(self, fut, val):
+            with self._lock:
+                try:
+                    fut.set_result(val)         # callbacks under lock
+                except InvalidStateError:
+                    pass
+    """)
+    assert rules(vs) == ["future-discipline"]
+    assert "lock" in vs[0].msg
+
+
+def test_future_handler_body_not_inherited_guard():
+    vs = run_lint("""
+        def work(fut, job):
+            try:
+                fut.set_result(job())           # guarded by handler
+            except BaseException as e:
+                fut.set_exception(e)            # handler body: NOT guarded
+    """)
+    assert rules(vs) == ["future-discipline"]
+    assert vs[0].line == 6
+
+
+# ---------------------------------------------------------------------------
 # the acceptance gate: the tree itself is clean
 # ---------------------------------------------------------------------------
 def test_repo_is_lint_clean():

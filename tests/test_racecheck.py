@@ -1,0 +1,504 @@
+"""Happens-before race detector + deterministic schedule explorer.
+
+Three layers:
+
+* detector unit tests — each harvested sync edge (lock, queue, event,
+  future, thread start/join) orders accesses; the same accesses
+  WITHOUT the edge raise ``DataRaceError`` naming both threads, both
+  stacks and the field;
+* explorer tests — bit-identical seeded replay, virtual time, deadlock
+  detection, PCT preemption finding a textbook lost update;
+* the PR-16 rank-race fixture — a sandbox ``kvstore_dist.Server``
+  subclass reintroducing the unbarriered bring-up; the detector
+  catches the missing-edge read and the explorer catches the
+  rank-vs-creation-order inversion on a pinned seed, proving this
+  tooling would have found the 7-PR flake.
+
+Plus the overhead guard: with nothing armed, every seam is spy-pinned
+to the plain stdlib object (no wrapper, no patch).
+"""
+import queue
+import threading
+import time
+import types
+from concurrent.futures import Future
+
+import pytest
+
+from mxnet_tpu import kvstore_dist as ksd
+from mxnet_tpu.analysis import lockcheck, racecheck, schedules
+from mxnet_tpu.analysis.racecheck import DataRaceError
+from mxnet_tpu.analysis.schedules import ScheduleFailure
+
+
+@pytest.fixture
+def hb():
+    """Arm the happens-before detector for one test."""
+    racecheck.install()
+    yield
+    racecheck.uninstall()
+
+
+def _spin_until(flag, timeout=5.0):
+    """Raw busy-wait on a plain list — deliberately NOT a sync edge."""
+    deadline = time.monotonic() + timeout
+    while not flag:
+        assert time.monotonic() < deadline, "helper thread never ran"
+
+
+# ---------------------------------------------------------------------------
+# off-mode: zero cost, spy-pinned
+# ---------------------------------------------------------------------------
+def test_off_mode_is_plain_stdlib(monkeypatch):
+    monkeypatch.delenv("MXNET_RACE_CHECK", raising=False)
+    monkeypatch.delenv("MXNET_LOCK_CHECK", raising=False)
+    # under `make racecheck` the process boots armed; disarm for the
+    # duration so the off-mode contract is checked there too
+    was_armed = racecheck.armed()
+    if was_armed:
+        racecheck.uninstall()
+    try:
+        assert not racecheck.armed()
+        st = racecheck.shared_state("x", a=1)
+        assert type(st) is types.SimpleNamespace
+        m = racecheck.shared_map("x", {"k": 1})
+        assert type(m) is dict
+        lk = lockcheck.make_lock("x")
+        assert type(lk) is type(threading.Lock())
+        # no stdlib patches installed: the seam methods are the originals
+        assert queue.Queue.put.__qualname__ == "Queue.put"
+        assert queue.Queue.put.__module__ == "queue"
+        assert threading.Event.set.__module__ == "threading"
+        assert Future.set_result.__module__ == "concurrent.futures._base"
+        assert "racecheck" not in getattr(time.sleep, "__module__", "time")
+    finally:
+        if was_armed:
+            racecheck.install()
+
+
+def test_armed_mode_wraps_and_uninstall_restores():
+    racecheck.install()
+    try:
+        assert racecheck.armed()
+        st = racecheck.shared_state("x", a=1)
+        assert not isinstance(st, types.SimpleNamespace)
+        lk = lockcheck.make_lock("x")
+        assert isinstance(lk, racecheck.SeamLock)
+        assert queue.Queue.put.__module__ \
+            == "mxnet_tpu.analysis.racecheck"
+    finally:
+        racecheck.uninstall()
+    assert queue.Queue.put.__module__ == "queue"
+    assert time.sleep.__module__ in ("time", None)
+
+
+def test_seamlock_wraps_checkedlock_and_check_owned(monkeypatch):
+    monkeypatch.setenv("MXNET_LOCK_CHECK", "1")
+    racecheck.install()
+    try:
+        lk = lockcheck.make_lock("combo")
+        assert isinstance(lk, racecheck.SeamLock)
+        assert isinstance(lk._inner, lockcheck.CheckedLock)
+        with pytest.raises(lockcheck.LockDisciplineError):
+            lockcheck.check_owned(lk, "the combo state")
+        with lk:
+            lockcheck.check_owned(lk, "the combo state")
+    finally:
+        racecheck.uninstall()
+        lockcheck.reset()
+
+
+# ---------------------------------------------------------------------------
+# the detector: races raise, sync edges order
+# ---------------------------------------------------------------------------
+def test_unordered_read_after_write_races(hb):
+    st = racecheck.shared_state("eng", closed=False)
+    done = []
+
+    def w():
+        st.closed = True
+        done.append(1)
+
+    t = threading.Thread(target=w, daemon=True)
+    t.start()
+    _spin_until(done)                 # real ordering, NO hb edge
+    with pytest.raises(DataRaceError) as ei:
+        _ = st.closed
+    msg = str(ei.value)
+    assert "eng.closed" in msg
+    assert "MainThread" in msg and t.name in msg
+    assert msg.count('File "') >= 2   # both stacks rendered
+    t.join()
+
+
+def test_unordered_write_after_write_races(hb):
+    st = racecheck.shared_state("eng", n=0)
+    done = []
+
+    def w():
+        st.n = 1
+        done.append(1)
+
+    t = threading.Thread(target=w, daemon=True)
+    t.start()
+    _spin_until(done)
+    with pytest.raises(DataRaceError):
+        st.n = 2
+    t.join()
+
+
+def test_lock_edge_orders(hb):
+    lk = lockcheck.make_lock("t.lock")
+    st = racecheck.shared_state("eng", closed=False)
+    done = []
+
+    def w():
+        with lk:
+            st.closed = True
+        done.append(1)
+
+    t = threading.Thread(target=w, daemon=True)
+    t.start()
+    _spin_until(done)
+    with lk:
+        assert st.closed is True      # ordered via the lock edge
+    t.join()
+
+
+def test_queue_edge_orders(hb):
+    q = queue.Queue()
+    st = racecheck.shared_state("eng", payload=None)
+
+    def producer():
+        st.payload = 41
+        q.put("ready")
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    assert q.get(timeout=5) == "ready"
+    assert st.payload == 41           # ordered via put->get
+    t.join()
+
+
+def test_event_edge_orders(hb):
+    ev = threading.Event()
+    st = racecheck.shared_state("eng", payload=None)
+
+    def w():
+        st.payload = 7
+        ev.set()
+
+    t = threading.Thread(target=w, daemon=True)
+    t.start()
+    assert ev.wait(5)
+    assert st.payload == 7
+    t.join()
+
+
+def test_future_edge_orders(hb):
+    fut = Future()
+    st = racecheck.shared_state("eng", payload=None)
+
+    def w():
+        st.payload = 13
+        fut.set_result("done")
+
+    t = threading.Thread(target=w, daemon=True)
+    t.start()
+    assert fut.result(timeout=5) == "done"
+    assert st.payload == 13
+    t.join()
+
+
+def test_thread_join_edge_orders(hb):
+    st = racecheck.shared_state("eng", payload=None)
+
+    def w():
+        st.payload = 3
+
+    t = threading.Thread(target=w, daemon=True)
+    t.start()
+    t.join()
+    assert st.payload == 3
+
+
+def test_thread_start_edge_orders(hb):
+    st = racecheck.shared_state("eng", cfg=None)
+    st.cfg = "from-parent"            # before start: visible to child
+    seen = []
+
+    def w():
+        seen.append(st.cfg)
+
+    t = threading.Thread(target=w, daemon=True)
+    t.start()
+    t.join()
+    assert seen == ["from-parent"]
+
+
+def test_shared_map_is_one_variable(hb):
+    m = racecheck.shared_map("tenants")
+    done = []
+
+    def w():
+        m["a"] = 1
+        done.append(1)
+
+    t = threading.Thread(target=w, daemon=True)
+    t.start()
+    _spin_until(done)
+    with pytest.raises(DataRaceError) as ei:
+        m.get("a")
+    assert "tenants" in str(ei.value)
+    t.join()
+
+
+def test_undeclared_field_rejected(hb):
+    st = racecheck.shared_state("eng", a=1)
+    with pytest.raises(AttributeError):
+        st.b = 2
+    with pytest.raises(AttributeError):
+        _ = st.b
+
+
+# ---------------------------------------------------------------------------
+# the explorer: seeded schedules, virtual time, deadlock, replay
+# ---------------------------------------------------------------------------
+def _two_worker_body():
+    st = racecheck.shared_state("tb", a=0, b=0)
+    q = queue.Queue()
+
+    def w1():
+        for _ in range(3):
+            st.a = st.a + 1
+            q.put(1)
+
+    def w2():
+        for _ in range(3):
+            st.b = st.b + 1
+            q.get()
+
+    ts = [threading.Thread(target=w1, daemon=True),
+          threading.Thread(target=w2, daemon=True)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def test_strict_replay_is_bit_identical():
+    t1 = schedules.run_schedule(_two_worker_body, seed=5, record=True)
+    t2 = schedules.run_schedule(_two_worker_body, seed=5, record=True)
+    assert t1 == t2 and len(t1) > 5
+    # and seeds genuinely produce distinct interleavings
+    traces = {tuple(schedules.run_schedule(_two_worker_body, seed=s,
+                                           record=True))
+              for s in range(6)}
+    assert len(traces) >= 2
+
+
+def _lost_update_body():
+    st = racecheck.shared_state("ctr", v=0)
+
+    def bump():
+        cur = st.v          # yield point between read and write:
+        st.v = cur + 1      # the schedule can interleave another bump
+
+    ts = [threading.Thread(target=bump, daemon=True) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if st.v != 2:
+        raise AssertionError("lost update: v == %d" % st.v)
+
+
+def test_explorer_finds_lost_update_and_seed_replays():
+    with pytest.raises(ScheduleFailure) as ei:
+        schedules.explore(_lost_update_body, n=40, strict=True)
+    seed = ei.value.seed
+    assert "MXNET_SCHED_SEED=%d" % seed in str(ei.value)
+    # the printed seed replays the failure bit-identically
+    with pytest.raises(ScheduleFailure) as ei2:
+        schedules.run_schedule(_lost_update_body, seed)
+    assert "lost update" in str(ei2.value)
+
+
+def test_virtual_time_sleep_costs_no_wall_clock():
+    def body():
+        def sleeper():
+            time.sleep(30.0)        # virtual: free under the schedule
+
+        t = threading.Thread(target=sleeper, daemon=True)
+        t.start()
+        t.join()
+
+    t0 = time.monotonic()
+    schedules.run_schedule(body, seed=0)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_deadlock_is_named():
+    def body():
+        ev1, ev2 = threading.Event(), threading.Event()
+
+        def w():
+            try:
+                ev2.wait()          # never set
+            except Exception:
+                pass
+
+        t = threading.Thread(target=w, daemon=True)
+        t.start()
+        ev1.wait()                  # never set either
+
+    with pytest.raises(ScheduleFailure) as ei:
+        schedules.run_schedule(body, seed=0)
+    assert "deadlocked" in str(ei.value)
+
+
+def test_env_seed_pins_one_schedule(monkeypatch):
+    monkeypatch.setenv("MXNET_SCHED_SEED", "7")
+    traces = schedules.explore(_two_worker_body, record=True)
+    assert len(traces) == 1
+    ref = schedules.run_schedule(_two_worker_body, seed=7, record=True)
+    assert traces[0] == ref
+
+
+def test_jitter_mode_runs_real_threads(monkeypatch):
+    monkeypatch.setenv("MXNET_SCHED_EXPLORE", "2")
+    ran = []
+
+    def body():
+        q = queue.Queue()
+
+        def w():
+            q.put(42)
+
+        t = threading.Thread(target=w, daemon=True)
+        t.start()
+        assert q.get(timeout=5) == 42
+        t.join()
+        ran.append(1)
+
+    schedules.explore(body, strict=False)
+    assert len(ran) == 2
+
+
+# ---------------------------------------------------------------------------
+# the PR-16 rank-assignment race, reintroduced in a sandbox
+# ---------------------------------------------------------------------------
+class _SandboxScheduler:
+    """Registration slice of the kvstore scheduler: ranks assigned in
+    ARRIVAL order under a lock (the real protocol)."""
+
+    def __init__(self):
+        self._lock = lockcheck.make_lock("sandbox.sched")
+        self.next_server = 0
+
+    def register(self, server):
+        with self._lock:
+            rank = self.next_server
+            self.next_server += 1
+        return rank
+
+
+class _SandboxServer(ksd.Server):
+    """``kvstore_dist.Server`` with ``run()`` cut down to the
+    registration slice (no sockets, no heartbeats): the pre-PR-16
+    bring-up, where a server's rank lands whenever its thread happens
+    to register."""
+
+    def __init__(self, sched):
+        # deliberately NOT calling Server.__init__ (sockets/env); only
+        # the registration-slice state survives
+        self._sandbox_sched = sched
+        self.registered = threading.Event()
+        self._reg = racecheck.shared_state("sandbox.server", rank=None)
+        self.done_log = []   # raw side channel (a log line, not an edge)
+
+    def run(self):
+        rank = self._sandbox_sched.register(self)
+        self._reg.rank = rank
+        self.registered.set()      # the PR-16 barrier latch
+        self.done_log.append(rank)
+
+    @property
+    def rank(self):
+        return self._reg.rank
+
+    def wait_registered(self, timeout=30.0):
+        if not self.registered.wait(timeout):
+            raise AssertionError("sandbox server never registered")
+
+
+def test_rank_race_detector_catches_missing_barrier(hb):
+    """Pre-PR-16: nothing orders the server thread's rank write
+    against the bring-up code's rank read — the detector raises on the
+    FIRST run, no lucky interleaving needed."""
+    s = _SandboxServer(_SandboxScheduler())
+    t = threading.Thread(target=s.run, daemon=True)
+    t.start()
+    _spin_until(s.done_log)          # "I saw the log line" is not an edge
+    with pytest.raises(DataRaceError) as ei:
+        _ = s.rank
+    msg = str(ei.value)
+    assert "sandbox.server.rank" in msg
+    assert msg.count('File "') >= 2
+    t.join()
+
+
+def test_rank_barrier_fix_is_race_free(hb):
+    """With the PR-16 registration barrier (Event latch + wait), the
+    same read is ordered: no race."""
+    s = _SandboxServer(_SandboxScheduler())
+    t = threading.Thread(target=s.run, daemon=True)
+    t.start()
+    s.wait_registered()
+    assert s.rank == 0
+    t.join()
+
+
+def _rank_bringup_body(barrier):
+    sched = _SandboxScheduler()
+    servers, threads = [], []
+    for _ in range(2):
+        s = _SandboxServer(sched)
+        t = threading.Thread(target=s.run, daemon=True)
+        t.start()
+        if barrier:
+            s.wait_registered()     # the PR-16 fix: serialize bring-up
+        servers.append(s)
+        threads.append(t)
+    for t in threads:
+        t.join()
+    ranks = [s.rank for s in servers]
+    if ranks != [0, 1]:
+        raise AssertionError(
+            "bring-up order != rank order: %r (the 7-PR flake)" % ranks)
+
+
+# pinned at dev time: the first explorer seed whose schedule runs the
+# second server's registration before the first's (seeds 2 and 10 of
+# 0..15 invert it).  The strict scheduler is deterministic, so this
+# seed fails FOREVER until the barrier exists — exactly the
+# regression pin PR 16 never had.
+RANK_RACE_SEED = 2
+
+
+def test_rank_race_explorer_catches_inversion_on_pinned_seed():
+    seed = RANK_RACE_SEED
+    with pytest.raises(ScheduleFailure) as ei:
+        schedules.run_schedule(
+            lambda: _rank_bringup_body(barrier=False), seed)
+    assert "bring-up order != rank order" in str(ei.value)
+    assert "MXNET_SCHED_SEED=%d" % seed in str(ei.value)
+
+
+def test_rank_race_explore_sweep_catches_and_barrier_survives():
+    with pytest.raises(ScheduleFailure):
+        schedules.explore(lambda: _rank_bringup_body(barrier=False),
+                          n=16, strict=True)
+    # the PR-16 fix survives the same schedule sweep
+    schedules.explore(lambda: _rank_bringup_body(barrier=True),
+                      n=16, strict=True)
